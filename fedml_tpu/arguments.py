@@ -1,0 +1,148 @@
+"""YAML-driven configuration.
+
+Same developer contract as the reference (``python/fedml/arguments.py:36-223``):
+a single YAML file whose sections are flattened onto one ``args`` namespace,
+plus CLI overrides ``--cf/--rank/--role/--run_id``. Downstream code reads
+``args.<attr>`` duck-typed, so algorithms written against the reference's
+config surface translate directly.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+from fedml_tpu import constants
+
+
+class Arguments:
+    """Flat attribute bag loaded from a YAML config.
+
+    Sections (common_args/data_args/model_args/train_args/device_args/
+    comm_args/tracking_args/...) are flattened: every key inside every
+    section becomes a top-level attribute, exactly like the reference's
+    ``Arguments.set_attr_from_config`` (``arguments.py:187``).
+    """
+
+    def __init__(
+        self,
+        cmd_args: Optional[argparse.Namespace] = None,
+        training_type: Optional[str] = None,
+        comm_backend: Optional[str] = None,
+    ):
+        if cmd_args is not None:
+            for k, v in vars(cmd_args).items():
+                setattr(self, k, v)
+        if training_type is not None and not hasattr(self, "training_type"):
+            self.training_type = training_type
+        if comm_backend is not None and not hasattr(self, "backend"):
+            self.backend = comm_backend
+        config_file = getattr(self, "yaml_config_file", None) or getattr(
+            self, "config_file", None
+        )
+        if config_file:
+            self.load_yaml_config(config_file)
+
+    # -- yaml ------------------------------------------------------------
+    def load_yaml_config(self, path: str | os.PathLike) -> None:
+        with open(path, "r") as f:
+            cfg = yaml.safe_load(f) or {}
+        self.set_attr_from_config(cfg)
+        self.yaml_paths = [str(path)]
+
+    def set_attr_from_config(self, configuration: dict) -> None:
+        for section, payload in configuration.items():
+            if isinstance(payload, dict):
+                for k, v in payload.items():
+                    setattr(self, k, v)
+            else:
+                setattr(self, section, payload)
+
+    # -- dict-like conveniences ------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        return getattr(self, key, default)
+
+    def __contains__(self, key: str) -> bool:
+        return hasattr(self, key)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Arguments({self.to_dict()!r})"
+
+
+def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Namespace:
+    """CLI surface parity with the reference (``arguments.py:36-73``)."""
+    parser = parser or argparse.ArgumentParser(description="fedml_tpu")
+    parser.add_argument(
+        "--yaml_config_file", "--cf", help="yaml configuration file", type=str, default=""
+    )
+    parser.add_argument("--run_id", type=str, default="0")
+    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--local_rank", type=int, default=0)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--role", type=str, default=constants.ROLE_CLIENT)
+    args, _ = parser.parse_known_args()
+    return args
+
+
+def load_arguments(
+    training_type: Optional[str] = None, comm_backend: Optional[str] = None
+) -> Arguments:
+    cmd_args = add_args()
+    args = Arguments(cmd_args, training_type, comm_backend)
+    _apply_defaults(args)
+    return args
+
+
+def load_arguments_from_dict(
+    config: dict,
+    training_type: Optional[str] = None,
+) -> Arguments:
+    """Programmatic entry: build args from an in-memory config dict."""
+    args = Arguments(training_type=training_type)
+    args.set_attr_from_config(config)
+    _apply_defaults(args)
+    return args
+
+
+_DEFAULTS = dict(
+    training_type=constants.FEDML_TRAINING_PLATFORM_SIMULATION,
+    backend=constants.FEDML_SIMULATION_TYPE_SP,
+    federated_optimizer=constants.FEDML_FEDERATED_OPTIMIZER_FEDAVG,
+    dataset="synthetic",
+    data_cache_dir="",
+    partition_method="hetero",
+    partition_alpha=0.5,
+    model="lr",
+    client_num_in_total=4,
+    client_num_per_round=2,
+    comm_round=2,
+    epochs=1,
+    batch_size=32,
+    client_optimizer="sgd",
+    learning_rate=0.03,
+    weight_decay=0.0,
+    server_optimizer="sgd",
+    server_lr=1.0,
+    server_momentum=0.9,
+    frequency_of_the_test=1,
+    random_seed=0,
+    rank=0,
+    run_id="0",
+    role=constants.ROLE_CLIENT,
+    using_mlops=False,
+    enable_wandb=False,
+    dtype="float32",
+    scenario=constants.CROSS_SILO_SCENARIO_HORIZONTAL,
+)
+
+
+def _apply_defaults(args: Arguments) -> None:
+    for k, v in _DEFAULTS.items():
+        if not hasattr(args, k):
+            setattr(args, k, v)

@@ -1,0 +1,78 @@
+"""Framework-wide constants.
+
+Mirrors the surface of the reference's ``python/fedml/constants.py:1-83``
+(training types, backends, optimizer names) with TPU-native additions: the
+``xla_ici`` comm backend and the parallel (mesh) simulation backend.
+"""
+
+# ---- training types (engine selector) --------------------------------------
+FEDML_TRAINING_PLATFORM_SIMULATION = "simulation"
+FEDML_TRAINING_PLATFORM_CROSS_SILO = "cross_silo"
+FEDML_TRAINING_PLATFORM_CROSS_DEVICE = "cross_device"
+FEDML_TRAINING_PLATFORM_CROSS_CLOUD = "cross_cloud"
+FEDML_TRAINING_PLATFORM_SERVING = "serving"
+
+# ---- simulation backends ----------------------------------------------------
+FEDML_SIMULATION_TYPE_SP = "sp"  # single process, host round loop
+# TPU-native replacement of the reference's NCCL backend
+# (python/fedml/simulation/nccl/): clients ride a jax.sharding.Mesh axis.
+FEDML_SIMULATION_TYPE_MESH = "mesh"
+FEDML_SIMULATION_TYPE_NCCL = "NCCL"  # alias accepted, runs the mesh backend
+FEDML_SIMULATION_TYPE_MPI = "MPI"  # alias accepted, runs the mesh backend
+
+# ---- cross-silo scenarios ---------------------------------------------------
+CROSS_SILO_SCENARIO_HORIZONTAL = "horizontal"
+CROSS_SILO_SCENARIO_HIERARCHICAL = "hierarchical"
+
+# ---- communication backends -------------------------------------------------
+COMM_BACKEND_LOCAL = "LOCAL"      # deterministic in-process (tests, SP)
+COMM_BACKEND_GRPC = "GRPC"
+COMM_BACKEND_XLA_ICI = "XLA_ICI"  # intra-pod ranks == mesh axes, XLA collectives
+COMM_BACKEND_MQTT_S3 = "MQTT_S3"  # gated: requires paho-mqtt + boto3
+
+# ---- federated optimizers ---------------------------------------------------
+# Parity with the reference list (python/fedml/constants.py:40-63).
+FEDML_FEDERATED_OPTIMIZER_FEDAVG = "FedAvg"
+FEDML_FEDERATED_OPTIMIZER_FEDAVG_SEQ = "FedAvg_seq"
+FEDML_FEDERATED_OPTIMIZER_FEDOPT = "FedOpt"
+FEDML_FEDERATED_OPTIMIZER_FEDOPT_SEQ = "FedOpt_seq"
+FEDML_FEDERATED_OPTIMIZER_FEDPROX = "FedProx"
+FEDML_FEDERATED_OPTIMIZER_FEDNOVA = "FedNova"
+FEDML_FEDERATED_OPTIMIZER_FEDDYN = "FedDyn"
+FEDML_FEDERATED_OPTIMIZER_SCAFFOLD = "SCAFFOLD"
+FEDML_FEDERATED_OPTIMIZER_MIME = "Mime"
+FEDML_FEDERATED_OPTIMIZER_FEDSGD = "FedSGD"
+FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG = "Async_FedAvg"
+FEDML_FEDERATED_OPTIMIZER_FEDGAN = "FedGAN"
+FEDML_FEDERATED_OPTIMIZER_HIERARCHICAL_FL = "HierarchicalFL"
+FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE = "TurboAggregate"
+FEDML_FEDERATED_OPTIMIZER_VERTICAL_FL = "VerticalFL"
+FEDML_FEDERATED_OPTIMIZER_SPLIT_NN = "SplitNN"
+FEDML_FEDERATED_OPTIMIZER_DECENTRALIZED_FL = "DecentralizedFL"
+
+SUPPORTED_FEDERATED_OPTIMIZERS = [
+    FEDML_FEDERATED_OPTIMIZER_FEDAVG,
+    FEDML_FEDERATED_OPTIMIZER_FEDAVG_SEQ,
+    FEDML_FEDERATED_OPTIMIZER_FEDOPT,
+    FEDML_FEDERATED_OPTIMIZER_FEDOPT_SEQ,
+    FEDML_FEDERATED_OPTIMIZER_FEDPROX,
+    FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+    FEDML_FEDERATED_OPTIMIZER_FEDDYN,
+    FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+    FEDML_FEDERATED_OPTIMIZER_MIME,
+    FEDML_FEDERATED_OPTIMIZER_FEDSGD,
+    FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG,
+    FEDML_FEDERATED_OPTIMIZER_HIERARCHICAL_FL,
+    FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
+    FEDML_FEDERATED_OPTIMIZER_VERTICAL_FL,
+    FEDML_FEDERATED_OPTIMIZER_SPLIT_NN,
+    FEDML_FEDERATED_OPTIMIZER_DECENTRALIZED_FL,
+]
+
+# ---- roles ------------------------------------------------------------------
+ROLE_CLIENT = "client"
+ROLE_SERVER = "server"
+
+# ---- misc -------------------------------------------------------------------
+FEDML_CROSS_SILO_CUSTOMIZED_HIERARCHICAL_KEY = "customized_hierarchical"
+DEFAULT_SERVER_RANK = 0

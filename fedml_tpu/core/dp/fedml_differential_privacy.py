@@ -1,0 +1,88 @@
+"""Differential-privacy frame — singleton facade.
+
+Parity target: ``core/dp/fedml_differential_privacy.py:13`` with the
+reference's frames (LDP, CDP, NbAFL, dp-clip) and mechanisms (gaussian,
+laplace). Noise is drawn with ``jax.random`` from a counter-advanced key so
+the whole pipeline stays deterministic given ``args.random_seed``.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Tuple
+
+import jax
+
+Pytree = Any
+
+DP_LDP = "LDP"
+DP_CDP = "CDP"
+DP_NBAFL = "NbAFL"
+
+
+class FedMLDifferentialPrivacy:
+    _instance = None
+
+    def __init__(self):
+        self.is_enabled = False
+        self.dp_solution = None
+        self.frame = None
+        self.clipping_norm = None
+        self._rng_counter = 0
+        self._seed = 0
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDifferentialPrivacy":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_dp", False))
+        if not self.is_enabled:
+            return
+        self.dp_solution = getattr(args, "dp_solution_type", DP_LDP)
+        self._seed = int(getattr(args, "random_seed", 0)) + 7919
+        self.clipping_norm = getattr(args, "clipping_norm", None)
+        from fedml_tpu.core.dp.frames import build_dp_frame
+
+        self.frame = build_dp_frame(self.dp_solution, args)
+        logging.info("DP enabled: %s", self.dp_solution)
+
+    # -- predicates -------------------------------------------------------
+    def is_dp_enabled(self) -> bool:
+        return self.is_enabled
+
+    def is_local_dp_enabled(self) -> bool:
+        return self.is_enabled and self.dp_solution in (DP_LDP, DP_NBAFL)
+
+    def is_global_dp_enabled(self) -> bool:
+        return self.is_enabled and self.dp_solution in (DP_CDP, DP_NBAFL)
+
+    is_central_dp_enabled = is_global_dp_enabled
+
+    def is_clipping(self) -> bool:
+        return self.is_enabled and self.clipping_norm is not None
+
+    # -- ops --------------------------------------------------------------
+    def _next_key(self) -> jax.Array:
+        self._rng_counter += 1
+        return jax.random.fold_in(jax.random.key(self._seed), self._rng_counter)
+
+    def add_local_noise(self, params: Pytree) -> Pytree:
+        return self.frame.add_local_noise(params, self._next_key())
+
+    def add_global_noise(self, params: Pytree) -> Pytree:
+        return self.frame.add_global_noise(params, self._next_key())
+
+    def global_clip(
+        self, client_list: List[Tuple[int, Pytree]]
+    ) -> List[Tuple[int, Pytree]]:
+        from fedml_tpu.core.dp.frames.dp_clip import clip_update
+
+        return [
+            (n, clip_update(p, float(self.clipping_norm))) for n, p in client_list
+        ]
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None

@@ -1,0 +1,70 @@
+"""DP frames: where noise is applied in the FL pipeline.
+
+Parity: ``core/dp/frames/{ldp,cdp,NbAFL,dp_clip}.py``.
+- LDP: each client noises its own update before upload.
+- CDP: server noises the aggregate.
+- NbAFL (Wei et al.): clip + client-side noise + server-side noise scaled by
+  the number of participants.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from fedml_tpu.core.dp.frames.dp_clip import clip_update
+from fedml_tpu.core.dp.mechanisms import build_mechanism
+
+Pytree = Any
+
+
+class BaseDPFrame:
+    def __init__(self, args: Any):
+        self.mechanism = build_mechanism(
+            getattr(args, "mechanism_type", "gaussian"),
+            float(getattr(args, "epsilon", 1.0)),
+            float(getattr(args, "delta", 1e-5)),
+            float(getattr(args, "sensitivity", 1.0)),
+        )
+        self.clipping_norm = getattr(args, "clipping_norm", None)
+
+    def add_local_noise(self, params: Pytree, key: jax.Array) -> Pytree:
+        return params
+
+    def add_global_noise(self, params: Pytree, key: jax.Array) -> Pytree:
+        return params
+
+
+class LocalDP(BaseDPFrame):
+    def add_local_noise(self, params: Pytree, key: jax.Array) -> Pytree:
+        if self.clipping_norm is not None:
+            params = clip_update(params, float(self.clipping_norm))
+        return self.mechanism.add_noise(params, key)
+
+
+class CentralDP(BaseDPFrame):
+    def add_global_noise(self, params: Pytree, key: jax.Array) -> Pytree:
+        return self.mechanism.add_noise(params, key)
+
+
+class NbAFL(BaseDPFrame):
+    """Clip + noise on both sides (NbAFL, IEEE TIFS'20)."""
+
+    def add_local_noise(self, params: Pytree, key: jax.Array) -> Pytree:
+        if self.clipping_norm is not None:
+            params = clip_update(params, float(self.clipping_norm))
+        return self.mechanism.add_noise(params, key)
+
+    def add_global_noise(self, params: Pytree, key: jax.Array) -> Pytree:
+        return self.mechanism.add_noise(params, key)
+
+
+def build_dp_frame(solution: str, args: Any) -> BaseDPFrame:
+    solution = (solution or "LDP").upper()
+    if solution == "LDP":
+        return LocalDP(args)
+    if solution == "CDP":
+        return CentralDP(args)
+    if solution == "NBAFL":
+        return NbAFL(args)
+    raise ValueError(f"unknown dp solution {solution!r}")

@@ -1,0 +1,22 @@
+"""L2 clipping of a model update (pytree), jit-friendly."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.tree import tree_norm
+
+Pytree = Any
+
+
+@jax.jit
+def _clip(params: Pytree, max_norm: jax.Array) -> Pytree:
+    norm = tree_norm(params)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return jax.tree.map(lambda x: (x * factor).astype(x.dtype), params)
+
+
+def clip_update(params: Pytree, max_norm: float) -> Pytree:
+    return _clip(params, jnp.float32(max_norm))

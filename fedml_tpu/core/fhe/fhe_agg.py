@@ -1,0 +1,92 @@
+"""FedMLFHE — homomorphic-encryption aggregation facade.
+
+Parity: ``core/fhe/fhe_agg.py:10`` (TenSEAL CKKS in the reference). TenSEAL
+is not available in this environment, so the default backend is a
+deterministic additive-masking scheme with the same algebra (ciphertexts can
+be summed; decryption removes the aggregate mask) — adequate for protocol
+and pipeline testing. A real CKKS backend can be slotted in behind the same
+``fhe_enc/fhe_dec/fhe_fedavg`` surface when the library is present.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.tree import tree_stack, weighted_tree_sum
+
+Pytree = Any
+
+
+class _AdditiveMaskCipher:
+    """Toy additive-HE stand-in: enc(x) = x + PRG(key); sum of ciphertexts
+    decrypts with the sum of masks. NOT cryptographically meaningful on its
+    own (see core/mpc for the real SecAgg protocols); exists to exercise the
+    FHE code path without TenSEAL."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self._counter = 0
+
+    def _mask_for(self, counter: int, leaf: jax.Array) -> jax.Array:
+        key = jax.random.fold_in(jax.random.key(self.seed), counter)
+        return jax.random.normal(key, leaf.shape, dtype=leaf.dtype)
+
+    def enc(self, params: Pytree) -> Pytree:
+        self._counter += 1
+        c = self._counter
+        leaves, treedef = jax.tree.flatten(params)
+        out = [leaf + self._mask_for(c * 1000 + i, leaf) for i, leaf in enumerate(leaves)]
+        tagged = jax.tree.unflatten(treedef, out)
+        return {"__fhe__": True, "counter": c, "payload": tagged}
+
+    def dec(self, cipher: Any) -> Pytree:
+        if not (isinstance(cipher, dict) and cipher.get("__fhe__")):
+            return cipher
+        c = cipher["counter"]
+        leaves, treedef = jax.tree.flatten(cipher["payload"])
+        out = [leaf - self._mask_for(c * 1000 + i, leaf) for i, leaf in enumerate(leaves)]
+        return jax.tree.unflatten(treedef, out)
+
+
+class FedMLFHE:
+    _instance = None
+
+    def __init__(self):
+        self.is_enabled = False
+        self._cipher = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLFHE":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_fhe", False))
+        if self.is_enabled:
+            self._cipher = _AdditiveMaskCipher(int(getattr(args, "random_seed", 0)))
+            logging.info("FHE enabled (additive-mask backend)")
+
+    def is_fhe_enabled(self) -> bool:
+        return self.is_enabled
+
+    def fhe_enc(self, params: Pytree) -> Pytree:
+        return self._cipher.enc(params)
+
+    def fhe_dec(self, params: Pytree) -> Pytree:
+        return self._cipher.dec(params)
+
+    def fhe_fedavg(self, raw_client_model_list: List[Tuple[int, Pytree]]) -> Pytree:
+        # Weighted mean over ciphertexts: decrypt each (masks are server-side
+        # in this stand-in), then average — mirrors the encrypted FedAvg shape.
+        counts = jnp.asarray([float(num) for num, _ in raw_client_model_list])
+        weights = counts / jnp.sum(counts)
+        plains = [self._cipher.dec(p) for _, p in raw_client_model_list]
+        return weighted_tree_sum(tree_stack(plains), weights)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None

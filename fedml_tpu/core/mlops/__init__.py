@@ -1,0 +1,4 @@
+from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
+from fedml_tpu.core.mlops.metrics import MLOpsMetrics, log, log_metric
+
+__all__ = ["MLOpsProfilerEvent", "MLOpsMetrics", "log", "log_metric"]

@@ -1,0 +1,77 @@
+"""Span-event profiler.
+
+Parity: ``core/mlops/mlops_profiler_event.py:9`` — ``log_event_started/
+log_event_ended`` timestamped spans. Transport here is a local JSONL sink
+(plus optional ``jax.profiler`` traces) instead of MQTT; the hosted control
+plane can attach later via the same interface.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class MLOpsProfilerEvent:
+    def __init__(self, args: Any = None, sink_path: Optional[str] = None):
+        self.enabled = bool(getattr(args, "sys_perf_profiling", True)) if args else True
+        run_id = str(getattr(args, "run_id", "0")) if args else "0"
+        base = sink_path or os.path.join(
+            str(getattr(args, "log_file_dir", "") or ".fedml_logs"), f"run_{run_id}"
+        )
+        self._dir = base
+        self._lock = threading.Lock()
+        self._open_spans: Dict[Tuple[str, Any], float] = {}
+        self._events = []
+        self._jax_trace_dir = getattr(args, "jax_trace_dir", None) if args else None
+
+    def log_event_started(self, event_name: str, event_edge_id: Any = 0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._open_spans[(event_name, event_edge_id)] = time.time()
+
+    def log_event_ended(self, event_name: str, event_edge_id: Any = 0) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        with self._lock:
+            t0 = self._open_spans.pop((event_name, event_edge_id), now)
+            self._events.append(
+                {
+                    "event": event_name,
+                    "edge_id": event_edge_id,
+                    "started": t0,
+                    "ended": now,
+                    "duration_ms": (now - t0) * 1000.0,
+                }
+            )
+
+    def spans(self):
+        return list(self._events)
+
+    def flush(self) -> Optional[str]:
+        if not self._events:
+            return None
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, "events.jsonl")
+        with open(path, "a") as f:
+            for e in self._events:
+                f.write(json.dumps(e) + "\n")
+        self._events.clear()
+        return path
+
+    # jax profiler passthrough for deep TPU traces
+    def start_trace(self):
+        if self._jax_trace_dir:
+            import jax
+
+            jax.profiler.start_trace(self._jax_trace_dir)
+
+    def stop_trace(self):
+        if self._jax_trace_dir:
+            import jax
+
+            jax.profiler.stop_trace()

@@ -1,0 +1,80 @@
+"""Metrics/logging with a local JSONL sink.
+
+Parity: ``core/mlops/mlops_metrics.py`` + the public ``fedml.mlops.log*``
+API (``mlops/__init__.py:23-182``). The hosted MQTT/REST control plane is
+absent by design; the sink writes JSONL under ``.fedml_logs/run_<id>/`` and
+mirrors to wandb when enabled and available.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("fedml_tpu.mlops")
+
+_GLOBAL: "MLOpsMetrics | None" = None
+
+
+class MLOpsMetrics:
+    def __init__(self, args: Any = None, sink_dir: Optional[str] = None):
+        run_id = str(getattr(args, "run_id", "0")) if args else "0"
+        self.run_id = run_id
+        self._dir = sink_dir or os.path.join(
+            str(getattr(args, "log_file_dir", "") or ".fedml_logs"), f"run_{run_id}"
+        )
+        self._lock = threading.Lock()
+        self._wandb = None
+        if args is not None and bool(getattr(args, "enable_wandb", False)):
+            try:
+                import wandb
+
+                self._wandb = wandb
+            except ImportError:
+                logger.warning("wandb requested but not installed; using local sink")
+
+    def _write(self, kind: str, payload: Dict) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        rec = {"ts": time.time(), "kind": kind, **payload}
+        with self._lock:
+            with open(os.path.join(self._dir, "metrics.jsonl"), "a") as f:
+                f.write(json.dumps(rec, default=str) + "\n")
+        if self._wandb is not None and kind == "metric":
+            self._wandb.log(payload)
+
+    def report_server_training_metric(self, metric: Dict) -> None:
+        self._write("server_metric", metric)
+
+    def report_client_training_metric(self, metric: Dict) -> None:
+        self._write("client_metric", metric)
+
+    def report_training_status(self, status: str, run_id: Any = None) -> None:
+        self._write("status", {"status": status, "run_id": run_id or self.run_id})
+
+    def log(self, metrics: Dict) -> None:
+        self._write("metric", metrics)
+
+
+def _global_sink() -> MLOpsMetrics:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = MLOpsMetrics()
+    return _GLOBAL
+
+
+def init(args: Any) -> MLOpsMetrics:
+    global _GLOBAL
+    _GLOBAL = MLOpsMetrics(args)
+    return _GLOBAL
+
+
+def log(metrics: Dict) -> None:
+    """``fedml.mlops.log`` parity."""
+    _global_sink().log(metrics)
+
+
+def log_metric(metrics: Dict) -> None:
+    _global_sink().log(metrics)

@@ -1,0 +1,38 @@
+"""Attack registry (for defense CI and research).
+
+Parity target: ``core/security/attack/*.py`` (11 files): byzantine,
+label-flipping, backdoor (+ model replacement), and DLG gradient-leak
+reconstruction.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+_REGISTRY = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def create_attacker(name: str, args: Any):
+    from fedml_tpu.core.security.attack import (  # noqa: F401
+        backdoor,
+        byzantine,
+        dlg,
+        label_flipping,
+        model_replacement,
+    )
+
+    key = name.strip().lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown attack {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](args)
+
+
+def available_attacks() -> list[str]:
+    return sorted(_REGISTRY)

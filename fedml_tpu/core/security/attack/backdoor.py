@@ -1,0 +1,39 @@
+"""Backdoor (trigger-pattern) data poisoning.
+
+Parity: ``core/security/attack/backdoor_attack.py`` (+ edge-case variant):
+stamp a pixel trigger onto a fraction of samples and relabel them to the
+backdoor target.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from fedml_tpu.core.security.attack import register
+from fedml_tpu.core.security.attack.base import BaseAttack
+
+
+@register("backdoor")
+class BackdoorAttack(BaseAttack):
+    is_data_attack = True
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.target_class = int(getattr(args, "backdoor_target_class", 0))
+        self.ratio = float(getattr(args, "poisoned_ratio", 0.2))
+        self.trigger_value = float(getattr(args, "trigger_value", 1.0))
+        self.trigger_size = int(getattr(args, "trigger_size", 3))
+        self._rng = np.random.default_rng(int(getattr(args, "random_seed", 0)) + 23)
+
+    def poison_data(self, dataset: Any) -> Any:
+        x, y = np.array(dataset[0], copy=True), np.array(dataset[1], copy=True)
+        n = len(y)
+        idx = self._rng.choice(n, size=int(self.ratio * n), replace=False)
+        t = self.trigger_size
+        if x.ndim >= 3:  # image batch [N, H, W, ...] — stamp corner patch
+            x[idx, :t, :t, ...] = self.trigger_value
+        else:  # flat features — stamp leading coords
+            x[idx, :t] = self.trigger_value
+        y[idx] = self.target_class
+        return (x, y)

@@ -1,0 +1,28 @@
+"""Attack base class."""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+Pytree = Any
+
+
+class BaseAttack:
+    is_data_attack = False
+    is_model_attack = False
+    is_reconstruct = False
+
+    def __init__(self, args: Any):
+        self.args = args
+
+    def poison_data(self, dataset: Any) -> Any:
+        return dataset
+
+    def attack_model(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        return raw_client_grad_list
+
+    def reconstruct_data(self, a_gradient: Pytree, extra_auxiliary_info: Any = None):
+        raise NotImplementedError

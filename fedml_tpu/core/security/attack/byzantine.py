@@ -1,0 +1,53 @@
+"""Byzantine attack: replace a subset of client updates with zeros, random
+noise, or sign-flipped values.
+
+Parity: ``core/security/attack/byzantine_attack.py``.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+from fedml_tpu.core.security.attack import register
+from fedml_tpu.core.security.attack.base import BaseAttack
+from fedml_tpu.utils.tree import tree_scale
+
+Pytree = Any
+
+
+@register("byzantine")
+class ByzantineAttack(BaseAttack):
+    is_model_attack = True
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.byzantine_client_num = int(getattr(args, "byzantine_client_num", 1))
+        self.attack_mode = str(getattr(args, "attack_mode", "random")).lower()
+        self._seed = int(getattr(args, "random_seed", 0)) + 31337
+        self._counter = 0
+
+    def attack_model(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        k = min(self.byzantine_client_num, len(raw_client_grad_list))
+        out = list(raw_client_grad_list)
+        for i in range(k):
+            n, params = out[i]
+            if self.attack_mode == "zero":
+                evil = tree_scale(params, 0.0)
+            elif self.attack_mode == "flip":
+                evil = tree_scale(params, -1.0)
+            else:  # random
+                self._counter += 1
+                key = jax.random.fold_in(jax.random.key(self._seed), self._counter)
+                leaves, treedef = jax.tree.flatten(params)
+                keys = jax.random.split(key, len(leaves))
+                evil = jax.tree.unflatten(
+                    treedef,
+                    [jax.random.normal(kk, l.shape, dtype=l.dtype) for l, kk in zip(leaves, keys)],
+                )
+            out[i] = (n, evil)
+        return out

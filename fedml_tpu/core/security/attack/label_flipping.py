@@ -1,0 +1,44 @@
+"""Label-flipping data poisoning.
+
+Parity: ``core/security/attack/label_flipping_attack.py``: flip labels from
+``original_class`` to ``target_class`` (or random permutation when
+unspecified) on the attacker's local dataset.
+
+Datasets here are ``(x, y)`` numpy pairs (see fedml_tpu.data.dataset).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from fedml_tpu.core.security.attack import register
+from fedml_tpu.core.security.attack.base import BaseAttack
+
+
+@register("label_flipping")
+class LabelFlippingAttack(BaseAttack):
+    is_data_attack = True
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.original_class = getattr(args, "original_class_list", None)
+        self.target_class = getattr(args, "target_class_list", None)
+        self.ratio = float(getattr(args, "poisoned_ratio", 1.0))
+        self._rng = np.random.default_rng(int(getattr(args, "random_seed", 0)) + 17)
+
+    def poison_data(self, dataset: Any) -> Any:
+        x, y = dataset[0], np.array(dataset[1])
+        n = len(y)
+        n_poison = int(self.ratio * n)
+        idx = self._rng.choice(n, size=n_poison, replace=False)
+        if self.original_class is not None and self.target_class is not None:
+            orig = np.atleast_1d(self.original_class)
+            targ = np.atleast_1d(self.target_class)
+            for o, t in zip(orig, targ):
+                mask = np.isin(idx, np.where(y == o)[0])
+                y[idx[mask]] = t
+        else:
+            num_classes = int(y.max()) + 1 if n else 0
+            y[idx] = (y[idx] + 1) % max(1, num_classes)
+        return (x, y)

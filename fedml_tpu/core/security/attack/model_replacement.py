@@ -1,0 +1,42 @@
+"""Model-replacement (scaled backdoor) attack — Bagdasaryan et al.
+
+Parity: ``core/security/attack/model_replacement_attack.py``: scale the
+attacker's update by ~N/eta so it survives averaging.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+from fedml_tpu.core.security.attack import register
+from fedml_tpu.core.security.attack.base import BaseAttack
+from fedml_tpu.utils.tree import tree_axpy, tree_sub
+
+Pytree = Any
+
+
+@register("model_replacement")
+class ModelReplacementAttack(BaseAttack):
+    is_model_attack = True
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.scale = float(getattr(args, "replacement_scale", 0.0))  # 0 → auto N
+
+    def attack_model(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        if not raw_client_grad_list:
+            return raw_client_grad_list
+        gamma = self.scale or float(len(raw_client_grad_list))
+        n, params = raw_client_grad_list[0]
+        if extra_auxiliary_info is not None:
+            # global + gamma * (params - global)
+            delta = tree_sub(params, extra_auxiliary_info)
+            boosted = tree_axpy(gamma, delta, extra_auxiliary_info)
+        else:
+            boosted = tree_axpy(gamma - 1.0, params, params)
+        out = list(raw_client_grad_list)
+        out[0] = (n, boosted)
+        return out

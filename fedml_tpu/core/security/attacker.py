@@ -1,0 +1,70 @@
+"""FedMLAttacker — adversarial-injection singleton (CI / research use).
+
+Parity: ``core/security/fedml_attacker.py:14``. Attacks are used to *test*
+defenses; they are enabled only via explicit config (``enable_attack``).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+Pytree = Any
+
+
+class FedMLAttacker:
+    _instance = None
+
+    def __init__(self):
+        self.is_enabled = False
+        self.attack_type: Optional[str] = None
+        self.attacker = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLAttacker":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_attack", False))
+        if not self.is_enabled:
+            return
+        self.attack_type = str(getattr(args, "attack_type", "")).strip().lower()
+        from fedml_tpu.core.security.attack import create_attacker
+
+        self.attacker = create_attacker(self.attack_type, args)
+        logging.info("attack enabled: %s", self.attack_type)
+
+    # -- predicates (reference surface) ----------------------------------
+    def is_attack_enabled(self) -> bool:
+        return self.is_enabled
+
+    def is_data_poisoning_attack(self) -> bool:
+        return self.is_enabled and getattr(self.attacker, "is_data_attack", False)
+
+    def is_model_attack(self) -> bool:
+        return self.is_enabled and getattr(self.attacker, "is_model_attack", False)
+
+    def is_reconstruct_data_attack(self) -> bool:
+        return self.is_enabled and getattr(self.attacker, "is_reconstruct", False)
+
+    def is_to_poison_data(self) -> bool:
+        return self.is_data_poisoning_attack()
+
+    # -- ops --------------------------------------------------------------
+    def poison_data(self, dataset: Any) -> Any:
+        return self.attacker.poison_data(dataset)
+
+    def attack_model(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        return self.attacker.attack_model(raw_client_grad_list, extra_auxiliary_info)
+
+    def reconstruct_data(self, a_gradient, extra_auxiliary_info: Any = None):
+        return self.attacker.reconstruct_data(a_gradient, extra_auxiliary_info)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None

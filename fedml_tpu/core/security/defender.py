@@ -1,0 +1,67 @@
+"""FedMLDefender — robust-aggregation singleton.
+
+Parity: ``core/security/fedml_defender.py:40``. The defense registry lives in
+``core/security/defense``; each defense implements one or more of
+``defend_before_aggregation`` / ``defend_on_aggregation`` /
+``defend_after_aggregation``.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, List, Optional, Tuple
+
+Pytree = Any
+
+
+class FedMLDefender:
+    _instance = None
+
+    def __init__(self):
+        self.is_enabled = False
+        self.defense_type: Optional[str] = None
+        self.defender = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDefender":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_defense", False))
+        if not self.is_enabled:
+            return
+        self.defense_type = str(getattr(args, "defense_type", "")).strip().lower()
+        from fedml_tpu.core.security.defense import create_defender
+
+        self.defender = create_defender(self.defense_type, args)
+        logging.info("defense enabled: %s", self.defense_type)
+
+    def is_defense_enabled(self) -> bool:
+        return self.is_enabled
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        return self.defender.defend_before_aggregation(
+            raw_client_grad_list, extra_auxiliary_info
+        )
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        return self.defender.defend_on_aggregation(
+            raw_client_grad_list, base_aggregation_func, extra_auxiliary_info
+        )
+
+    def defend_after_aggregation(self, global_model: Pytree) -> Pytree:
+        return self.defender.defend_after_aggregation(global_model)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None

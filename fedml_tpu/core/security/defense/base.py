@@ -1,0 +1,79 @@
+"""Defense base class + shared tensorization helpers."""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.tree import (
+    tree_flatten_vector,
+    tree_stack,
+    tree_unflatten_vector,
+    weighted_tree_sum,
+)
+
+Pytree = Any
+
+
+class BaseDefense:
+    """A defense may hook any of the three aggregation phases.
+
+    Mirrors ``core/security/defense/defense_base.py`` in the reference.
+    """
+
+    def __init__(self, args: Any):
+        self.args = args
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        return raw_client_grad_list
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        return base_aggregation_func(self.args, raw_client_grad_list)
+
+    def defend_after_aggregation(self, global_model: Pytree) -> Pytree:
+        return global_model
+
+
+def stack_updates(
+    raw_client_grad_list: List[Tuple[int, Pytree]],
+) -> Tuple[jnp.ndarray, jnp.ndarray, Pytree]:
+    """[(n_k, tree)] → (N×D update matrix fp32, (N,) sample counts, template)."""
+    counts = jnp.asarray([float(n) for n, _ in raw_client_grad_list])
+    vecs = jnp.stack([tree_flatten_vector(p) for _, p in raw_client_grad_list])
+    template = raw_client_grad_list[0][1]
+    return vecs, counts, template
+
+
+def unstack_to_list(
+    vecs: jnp.ndarray, counts: jnp.ndarray, template: Pytree
+) -> List[Tuple[int, Pytree]]:
+    return [
+        (float(counts[i]), tree_unflatten_vector(vecs[i], template))
+        for i in range(vecs.shape[0])
+    ]
+
+
+@jax.jit
+def pairwise_sq_dists(vecs: jnp.ndarray) -> jnp.ndarray:
+    """N×N squared L2 distances via one gram matmul (MXU-friendly)."""
+    sq = jnp.sum(vecs * vecs, axis=1)
+    gram = vecs @ vecs.T
+    d = sq[:, None] + sq[None, :] - 2.0 * gram
+    return jnp.maximum(d, 0.0)
+
+
+def aggregate_trees(
+    trees: List[Pytree], weights: jnp.ndarray
+) -> Pytree:
+    w = weights / jnp.sum(weights)
+    return weighted_tree_sum(tree_stack(trees), w)

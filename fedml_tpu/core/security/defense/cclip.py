@@ -1,0 +1,45 @@
+"""Centered clipping (Karimireddy et al., "Learning from history").
+
+Parity: ``core/security/defense/cclip_defense.py``: clip updates around a
+momentum center maintained across rounds, then average.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+from fedml_tpu.core.security.defense.norm_diff_clipping import _clip_rows_to
+from fedml_tpu.utils.tree import tree_unflatten_vector
+
+Pytree = Any
+
+
+@register("cclip")
+class CClipDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.tau = float(getattr(args, "cclip_tau", 10.0))
+        self.iters = int(getattr(args, "cclip_iters", 1))
+        self._center = None
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        vecs, counts, template = stack_updates(raw_client_grad_list)
+        center = (
+            self._center
+            if self._center is not None and self._center.shape == (vecs.shape[1],)
+            else jnp.zeros((vecs.shape[1],), dtype=vecs.dtype)
+        )
+        w = counts / jnp.sum(counts)
+        for _ in range(self.iters):
+            clipped = _clip_rows_to(vecs, center, jnp.float32(self.tau))
+            center = jnp.einsum("n,nd->d", w, clipped)
+        self._center = center
+        return tree_unflatten_vector(center, template)

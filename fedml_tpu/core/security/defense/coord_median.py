@@ -1,0 +1,33 @@
+"""Coordinate-wise median aggregation (Yin et al., ICML'18).
+
+Parity: ``core/security/defense/coordinate_wise_median_defense.py``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense
+from fedml_tpu.utils.tree import tree_stack
+
+Pytree = Any
+
+
+@jax.jit
+def _median_tree(stacked: Pytree) -> Pytree:
+    return jax.tree.map(lambda x: jnp.median(x, axis=0).astype(x.dtype), stacked)
+
+
+@register("coordinate_wise_median")
+class CoordinateWiseMedianDefense(BaseDefense):
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        stacked = tree_stack([p for _, p in raw_client_grad_list])
+        return _median_tree(stacked)

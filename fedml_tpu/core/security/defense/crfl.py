@@ -1,0 +1,33 @@
+"""CRFL (Xie et al., ICML'21): certifiably robust FL — clip the aggregated
+model then add smoothing noise each round.
+
+Parity: ``core/security/defense/crfl_defense.py``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from fedml_tpu.core.dp.frames.dp_clip import clip_update
+from fedml_tpu.core.dp.mechanisms import add_gaussian_noise
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense
+
+Pytree = Any
+
+
+@register("crfl")
+class CRFLDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.clip_threshold = float(getattr(args, "crfl_clip_threshold", 15.0))
+        self.sigma = float(getattr(args, "crfl_sigma", 0.01))
+        self._counter = 0
+        self._seed = int(getattr(args, "random_seed", 0)) + 15485863
+
+    def defend_after_aggregation(self, global_model: Pytree) -> Pytree:
+        self._counter += 1
+        clipped = clip_update(global_model, self.clip_threshold)
+        key = jax.random.fold_in(jax.random.key(self._seed), self._counter)
+        return add_gaussian_noise(clipped, key, self.sigma)

@@ -1,0 +1,55 @@
+"""FoolsGold (Fung et al.): down-weight sybils by cosine-similarity history.
+
+Parity: ``core/security/defense/foolsgold_defense.py``. History of aggregated
+update directions per client; pairwise cosine similarity → adaptive learning
+rates; all as batched matmuls.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+from fedml_tpu.utils.tree import tree_unflatten_vector
+
+Pytree = Any
+
+
+@register("foolsgold")
+class FoolsGoldDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.use_memory = bool(getattr(args, "foolsgold_use_memory", True))
+        self._history: Dict[int, jnp.ndarray] = {}
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        vecs, _, template = stack_updates(raw_client_grad_list)
+        n = vecs.shape[0]
+        if self.use_memory:
+            for i in range(n):
+                self._history[i] = self._history.get(i, 0.0) + vecs[i]
+            hist = jnp.stack([self._history[i] for i in range(n)])
+        else:
+            hist = vecs
+        normed = hist / (jnp.linalg.norm(hist, axis=1, keepdims=True) + 1e-12)
+        cs = normed @ normed.T
+        cs = cs - jnp.eye(n)
+        maxcs = jnp.max(cs, axis=1)
+        # pardoning: rescale similarity by relative maximums
+        ratio = maxcs[None, :] / (maxcs[:, None] + 1e-12)
+        cs = jnp.where(maxcs[:, None] < maxcs[None, :], cs * ratio, cs)
+        wv = 1.0 - jnp.max(cs, axis=1)
+        wv = jnp.clip(wv, 0.0, 1.0)
+        wv = wv / (jnp.max(wv) + 1e-12)
+        # logit re-scaling as in the paper
+        safe = jnp.clip(wv, 1e-6, 1.0 - 1e-6)
+        wv = jnp.where(wv == 1.0, 1.0, jnp.clip(jnp.log(safe / (1.0 - safe)) / 4.0 + 0.5, 0.0, 1.0))
+        agg = jnp.einsum("n,nd->d", wv / (jnp.sum(wv) + 1e-12), vecs)
+        return tree_unflatten_vector(agg, template)

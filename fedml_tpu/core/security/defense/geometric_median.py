@@ -1,0 +1,52 @@
+"""RFA / geometric median via smoothed Weiszfeld (Pillutla et al., 2022).
+
+Parity: ``core/security/defense/RFA_defense.py`` / ``geometric_median_defense``.
+Fixed-iteration Weiszfeld runs under ``lax.fori_loop`` so the whole defense
+is one compiled program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+from fedml_tpu.utils.tree import tree_unflatten_vector
+
+Pytree = Any
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def geometric_median(vecs: jnp.ndarray, weights: jnp.ndarray, iters: int = 10,
+                     eps: float = 1e-8) -> jnp.ndarray:
+    w = weights / jnp.sum(weights)
+    z0 = jnp.einsum("n,nd->d", w, vecs)
+
+    def body(_, z):
+        dists = jnp.sqrt(jnp.sum((vecs - z[None, :]) ** 2, axis=1) + eps)
+        alpha = w / dists
+        alpha = alpha / jnp.sum(alpha)
+        return jnp.einsum("n,nd->d", alpha, vecs)
+
+    return jax.lax.fori_loop(0, iters, body, z0)
+
+
+@register("rfa")
+@register("geometric_median")
+class GeometricMedianDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.iters = int(getattr(args, "geo_median_iters", 10))
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        vecs, counts, template = stack_updates(raw_client_grad_list)
+        gm = geometric_median(vecs, counts, self.iters)
+        return tree_unflatten_vector(gm, template)

@@ -1,0 +1,45 @@
+"""Norm-difference clipping (Sun et al., "Can you really backdoor FL?").
+
+Parity: ``core/security/defense/norm_diff_clipping_defense.py``: clip each
+client update's *difference from the global model* to a norm bound.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates, unstack_to_list
+from fedml_tpu.utils.tree import tree_flatten_vector
+
+Pytree = Any
+
+
+@jax.jit
+def _clip_rows_to(vecs: jnp.ndarray, center: jnp.ndarray, bound: jnp.ndarray) -> jnp.ndarray:
+    diffs = vecs - center[None, :]
+    norms = jnp.linalg.norm(diffs, axis=1, keepdims=True)
+    factor = jnp.minimum(1.0, bound / (norms + 1e-12))
+    return center[None, :] + diffs * factor
+
+
+@register("norm_diff_clipping")
+class NormDiffClippingDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.norm_bound = float(getattr(args, "norm_bound", 5.0))
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        vecs, counts, template = stack_updates(raw_client_grad_list)
+        if extra_auxiliary_info is not None and not isinstance(extra_auxiliary_info, dict):
+            center = tree_flatten_vector(extra_auxiliary_info)
+        else:
+            center = jnp.zeros((vecs.shape[1],), dtype=vecs.dtype)
+        clipped = _clip_rows_to(vecs, center, jnp.float32(self.norm_bound))
+        return unstack_to_list(clipped, counts, template)

@@ -1,0 +1,42 @@
+"""Cross-round + cross-client outlier detection on update norms and cosine
+similarity to the running aggregate.
+
+Parity: ``core/security/defense/outlier_detection.py`` / ``crossround_defense``.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+
+Pytree = Any
+
+
+@register("outlier_detection")
+@register("cross_round")
+class OutlierDetectionDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.threshold = float(getattr(args, "outlier_cos_threshold", -0.5))
+        self._prev_mean = None
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        vecs, _, _ = stack_updates(raw_client_grad_list)
+        mean = jnp.mean(vecs, axis=0)
+        ref = self._prev_mean if self._prev_mean is not None and self._prev_mean.shape == mean.shape else mean
+        self._prev_mean = mean
+        cos = (vecs @ ref) / (
+            jnp.linalg.norm(vecs, axis=1) * (jnp.linalg.norm(ref) + 1e-12) + 1e-12
+        )
+        norms = jnp.linalg.norm(vecs, axis=1)
+        med = jnp.median(norms)
+        keep = (cos >= self.threshold) & (norms <= 5.0 * (med + 1e-12))
+        kept = [raw_client_grad_list[i] for i in range(len(raw_client_grad_list)) if bool(keep[i])]
+        return kept if kept else raw_client_grad_list

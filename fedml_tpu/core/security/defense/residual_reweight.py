@@ -1,0 +1,40 @@
+"""Residual-based reweighting (Fu et al.): IRLS-style per-client weights from
+repeated-median-regression residuals, approximated per coordinate.
+
+Parity: ``core/security/defense/residual_based_reweighting_defense.py``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+from fedml_tpu.utils.tree import tree_unflatten_vector
+
+Pytree = Any
+
+
+@register("residual_based_reweighting")
+@register("residual_reweight")
+class ResidualReweightDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.lmbda = float(getattr(args, "residual_lambda", 2.0))
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        vecs, _, template = stack_updates(raw_client_grad_list)
+        med = jnp.median(vecs, axis=0)
+        mad = jnp.median(jnp.abs(vecs - med[None, :]), axis=0) * 1.4826 + 1e-12
+        std_res = jnp.abs(vecs - med[None, :]) / mad[None, :]
+        # per-coordinate confidence, averaged per client → IRLS weight
+        conf = jnp.clip(1.0 - std_res / self.lmbda, 0.0, 1.0)
+        wv = jnp.mean(conf, axis=1)
+        wv = wv / (jnp.sum(wv) + 1e-12)
+        return tree_unflatten_vector(jnp.einsum("n,nd->d", wv, vecs), template)

@@ -1,0 +1,36 @@
+"""Robust learning rate (Ozdayi et al., AAAI'21): flip the server learning
+rate on coordinates where update signs disagree below a threshold.
+
+Parity: ``core/security/defense/RobustLearningRate``-style defense.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+from fedml_tpu.utils.tree import tree_unflatten_vector
+
+Pytree = Any
+
+
+@register("robust_learning_rate")
+class RobustLearningRateDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.robust_threshold = float(getattr(args, "robust_threshold", 4.0))
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        vecs, counts, template = stack_updates(raw_client_grad_list)
+        w = counts / jnp.sum(counts)
+        agg = jnp.einsum("n,nd->d", w, vecs)
+        sign_agreement = jnp.abs(jnp.sum(jnp.sign(vecs), axis=0))
+        lr_sign = jnp.where(sign_agreement >= self.robust_threshold, 1.0, -1.0)
+        return tree_unflatten_vector(lr_sign * agg, template)

@@ -1,0 +1,38 @@
+"""SLSGD (Xie et al.): trimmed-mean aggregation + server-side moving average.
+
+Parity: ``core/security/defense/slsgd_defense.py``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense
+from fedml_tpu.core.security.defense.trimmed_mean import _trimmed_mean_tree
+from fedml_tpu.utils.tree import tree_axpy, tree_scale, tree_stack
+
+Pytree = Any
+
+
+@register("slsgd")
+class SLSGDDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.b = int(getattr(args, "trim_param_b", 1))
+        self.alpha = float(getattr(args, "alpha", 0.6))
+        self._last_global = None
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        n = len(raw_client_grad_list)
+        k = min(self.b, (n - 1) // 2)
+        stacked = tree_stack([p for _, p in raw_client_grad_list])
+        agg = _trimmed_mean_tree(stacked, k)
+        if extra_auxiliary_info is not None:
+            # (1 - alpha) * old_global + alpha * aggregated
+            agg = tree_axpy(1.0 - self.alpha, extra_auxiliary_info, tree_scale(agg, self.alpha))
+        return agg

@@ -1,0 +1,44 @@
+"""Soteria (Sun et al., CVPR'21): defend gradient-leakage by perturbing the
+representation layer of the update (largest fc layer), preserving utility.
+
+Parity: ``core/security/defense/soteria_defense.py``. Applied client-side in
+the reference; here exposed as a before-aggregation transform that prunes
+the smallest-magnitude fraction of the chosen layer.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense
+
+Pytree = Any
+
+
+@register("soteria")
+class SoteriaDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.percentile = float(getattr(args, "soteria_percentile", 10.0))
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        def _perturb_largest_leaf(tree: Pytree) -> Pytree:
+            leaves, treedef = jax.tree.flatten(tree)
+            sizes = [leaf.size for leaf in leaves]
+            target = int(jnp.argmax(jnp.asarray(sizes)))
+            out = []
+            for i, leaf in enumerate(leaves):
+                if i == target:
+                    thresh = jnp.percentile(jnp.abs(leaf), self.percentile)
+                    leaf = jnp.where(jnp.abs(leaf) < thresh, 0.0, leaf).astype(leaf.dtype)
+                out.append(leaf)
+            return jax.tree.unflatten(treedef, out)
+
+        return [(n, _perturb_largest_leaf(p)) for n, p in raw_client_grad_list]

@@ -1,0 +1,42 @@
+"""Three-sigma outlier rejection on update-norm scores.
+
+Parity: ``core/security/defense/three_sigma_defense.py`` (+ geomedian/krum
+scored variants): compute a per-client score, drop clients whose score is
+more than 3 sigma from the mean.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+from fedml_tpu.core.security.defense.geometric_median import geometric_median
+
+Pytree = Any
+
+
+@register("3sigma")
+@register("three_sigma")
+class ThreeSigmaDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.score = str(getattr(args, "three_sigma_score", "geomedian")).lower()
+        self.k_sigma = float(getattr(args, "k_sigma", 3.0))
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        vecs, counts, _ = stack_updates(raw_client_grad_list)
+        if self.score == "geomedian":
+            center = geometric_median(vecs, counts)
+        else:
+            center = jnp.mean(vecs, axis=0)
+        scores = jnp.linalg.norm(vecs - center[None, :], axis=1)
+        mu, sigma = jnp.mean(scores), jnp.std(scores) + 1e-12
+        keep = scores <= mu + self.k_sigma * sigma
+        kept = [raw_client_grad_list[i] for i in range(len(raw_client_grad_list)) if bool(keep[i])]
+        return kept if kept else raw_client_grad_list

@@ -1,0 +1,47 @@
+"""Coordinate-wise trimmed mean (Yin et al., ICML'18).
+
+Parity: ``core/security/defense/coordinate_wise_trimmed_mean_defense.py``.
+Trims the beta largest and smallest values per coordinate, then averages.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense
+from fedml_tpu.utils.tree import tree_stack
+
+Pytree = Any
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _trimmed_mean_tree(stacked: Pytree, k: int) -> Pytree:
+    def _tm(x):
+        xs = jnp.sort(x, axis=0)
+        n = x.shape[0]
+        kept = jax.lax.slice_in_dim(xs, k, n - k, axis=0)
+        return jnp.mean(kept, axis=0).astype(x.dtype)
+
+    return jax.tree.map(_tm, stacked)
+
+
+@register("trimmed_mean")
+class TrimmedMeanDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.beta = float(getattr(args, "beta", 0.1))  # trim fraction per side
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Pytree:
+        n = len(raw_client_grad_list)
+        k = min(int(self.beta * n), (n - 1) // 2)
+        stacked = tree_stack([p for _, p in raw_client_grad_list])
+        return _trimmed_mean_tree(stacked, k)

@@ -1,0 +1,41 @@
+"""WBC — weight-based clustering defense: cluster client updates (2-means on
+distance to coordinate median) and keep the larger cluster.
+
+Parity: ``core/security/defense/wbc_defense.py``.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense, stack_updates
+
+Pytree = Any
+
+
+@register("wbc")
+class WbcDefense(BaseDefense):
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        vecs, _, _ = stack_updates(raw_client_grad_list)
+        med = jnp.median(vecs, axis=0)
+        dists = jnp.linalg.norm(vecs - med[None, :], axis=1)
+        # simple 1-D 2-means on distances: threshold at midpoint of extremes
+        lo, hi = jnp.min(dists), jnp.max(dists)
+        thresh = (lo + hi) / 2.0
+        for _ in range(10):
+            lo_mean = jnp.mean(jnp.where(dists <= thresh, dists, 0.0))
+            lo_cnt = jnp.sum(dists <= thresh)
+            hi_cnt = jnp.maximum(1, dists.shape[0] - lo_cnt)
+            hi_mean = jnp.sum(jnp.where(dists > thresh, dists, 0.0)) / hi_cnt
+            lo_mean = jnp.sum(jnp.where(dists <= thresh, dists, 0.0)) / jnp.maximum(1, lo_cnt)
+            new_thresh = (lo_mean + hi_mean) / 2.0
+            thresh = jnp.where(jnp.isfinite(new_thresh), new_thresh, thresh)
+        keep = dists <= thresh
+        kept = [raw_client_grad_list[i] for i in range(len(raw_client_grad_list)) if bool(keep[i])]
+        return kept if kept else raw_client_grad_list

@@ -1,0 +1,29 @@
+"""Weak DP defense: add small gaussian noise to the aggregate.
+
+Parity: ``core/security/defense/weak_dp_defense.py``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from fedml_tpu.core.dp.mechanisms import add_gaussian_noise
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import BaseDefense
+
+Pytree = Any
+
+
+@register("weak_dp")
+class WeakDPDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.stddev = float(getattr(args, "stddev", 0.002))
+        self._counter = 0
+        self._seed = int(getattr(args, "random_seed", 0)) + 104729
+
+    def defend_after_aggregation(self, global_model: Pytree) -> Pytree:
+        self._counter += 1
+        key = jax.random.fold_in(jax.random.key(self._seed), self._counter)
+        return add_gaussian_noise(global_model, key, self.stddev)

@@ -1,0 +1,10 @@
+from fedml_tpu.data.data_loader import available_datasets, load, load_federated
+from fedml_tpu.data.dataset import FederatedDataset, batch_epochs
+
+__all__ = [
+    "available_datasets",
+    "batch_epochs",
+    "FederatedDataset",
+    "load",
+    "load_federated",
+]

@@ -1,0 +1,32 @@
+"""Device discovery — parity with ``python/fedml/device/device.py:42``.
+
+On TPU the interesting object is not a single device but the mesh; this
+module exposes both: ``get_device`` (reference surface) and ``get_mesh``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def get_device(args: Any = None):
+    devices = jax.devices()
+    return devices[0]
+
+
+def get_mesh(
+    args: Any = None,
+    axis_names: Sequence[str] = ("clients",),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    devices = np.asarray(jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    return Mesh(devices.reshape(shape), axis_names=tuple(axis_names))
+
+
+def device_count() -> int:
+    return jax.device_count()

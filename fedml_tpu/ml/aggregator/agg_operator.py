@@ -1,0 +1,55 @@
+"""FedMLAggOperator — per-optimizer aggregation as one XLA program.
+
+Parity target: ``ml/aggregator/agg_operator.py:8-60`` in the reference, which
+loops over state-dict keys in Python per optimizer. Here every branch bottoms
+out in :func:`fedml_tpu.utils.tree.weighted_tree_sum`: client trees are
+stacked on a leading axis and reduced in a single jitted program, so cost is
+a few fused HBM passes regardless of how many layers the model has.
+
+Supported federated optimizers (reference list at ``constants.py:40-63``):
+FedAvg/FedAvg_seq/FedSGD/FedProx/FedDyn/FedNova → sample-weighted average;
+FedOpt → weighted average of client models, server optimizer applied by the
+FedOpt server (see ``ml/trainer/fedopt_server.py``); SCAFFOLD/Mime →
+uniform average of (model, control-variate) pairs.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.utils.tree import tree_stack, weighted_tree_sum
+
+Pytree = Any
+
+_UNIFORM_OPTS = {"SCAFFOLD", "Mime"}
+
+
+class FedMLAggOperator:
+    @staticmethod
+    def agg(args: Any, raw_grad_list: List[Tuple[int, Pytree]]) -> Pytree:
+        """Aggregate ``[(n_samples, params), ...]`` → params.
+
+        Weighting: n_k / sum(n) for the FedAvg family; uniform for
+        SCAFFOLD/Mime (matching the reference's ``torch_aggregator``
+        branches at ``agg_operator.py:33-58``).
+        """
+        opt = getattr(args, "federated_optimizer", "FedAvg")
+        n = len(raw_grad_list)
+        if n == 0:
+            raise ValueError("empty client model list")
+        counts = jnp.asarray([float(num) for num, _ in raw_grad_list])
+        if opt in _UNIFORM_OPTS:
+            weights = jnp.full((n,), 1.0 / n)
+        else:
+            weights = counts / jnp.sum(counts)
+        stacked = tree_stack([params for _, params in raw_grad_list])
+        return weighted_tree_sum(stacked, weights)
+
+    @staticmethod
+    def agg_with_weights(
+        raw_list: List[Pytree], weights: List[float]
+    ) -> Pytree:
+        w = jnp.asarray(weights, dtype=jnp.float32)
+        w = w / jnp.sum(w)
+        return weighted_tree_sum(tree_stack(raw_list), w)

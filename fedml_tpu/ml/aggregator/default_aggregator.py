@@ -1,0 +1,36 @@
+"""Default ServerAggregator — parity with ``ml/aggregator/default_aggregator.py``."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.alg_frame.server_aggregator import ServerAggregator
+from fedml_tpu.ml.trainer.local_sgd import build_evaluator
+
+Pytree = Any
+
+
+class DefaultServerAggregator(ServerAggregator):
+    def __init__(self, model, args):
+        super().__init__(model, args)
+        self.apply_fn = lambda params, x: model.apply(params, x)
+        self._evaluate = build_evaluator(self.apply_fn)
+
+    def test(self, params: Pytree, test_data, device, args) -> dict:
+        x, y = test_data
+        loss_sum, correct, n = self._evaluate(
+            params, jnp.asarray(np.asarray(x)), jnp.asarray(np.asarray(y))
+        )
+        n = float(n)
+        return {
+            "test_loss": float(loss_sum) / max(n, 1.0),
+            "test_acc": float(correct) / max(n, 1.0),
+            "test_total": n,
+            "test_correct": float(correct),
+        }
+
+
+def create_server_aggregator(model: Any, args: Any) -> ServerAggregator:
+    return DefaultServerAggregator(model, args)

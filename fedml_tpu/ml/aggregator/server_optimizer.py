@@ -1,0 +1,45 @@
+"""Server-side optimizers on the aggregated pseudo-gradient.
+
+Parity: the reference's FedOpt server update (``simulation/sp/fedopt``) and
+FedNova normalization (``simulation/sp/fednova``), expressed as optax on the
+pseudo-gradient ``g = w_global - w_aggregated`` (Reddi et al., FedOpt).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import optax
+
+from fedml_tpu.utils.tree import tree_sub
+
+Pytree = Any
+
+
+class ServerOptimizer:
+    """w_{t+1} = server_opt(w_t, pseudo_grad). FedAvg = plain replacement."""
+
+    def __init__(self, args: Any):
+        self.fed_opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+        name = str(getattr(args, "server_optimizer", "sgd")).lower()
+        lr = float(getattr(args, "server_lr", 1.0))
+        momentum = float(getattr(args, "server_momentum", 0.9))
+        if self.fed_opt in ("FedOpt", "FedOpt_seq"):
+            if name == "adam":
+                self.tx = optax.adam(lr, b1=momentum)
+            else:
+                self.tx = optax.sgd(lr, momentum=momentum or None)
+        elif self.fed_opt == "SCAFFOLD":
+            self.tx = optax.sgd(lr)
+        else:
+            self.tx = None
+        self._opt_state = None
+
+    def step(self, w_global: Pytree, w_aggregated: Pytree) -> Pytree:
+        if self.tx is None:
+            return w_aggregated
+        pseudo_grad = tree_sub(w_global, w_aggregated)
+        if self._opt_state is None:
+            self._opt_state = self.tx.init(w_global)
+        updates, self._opt_state = self.tx.update(pseudo_grad, self._opt_state, w_global)
+        return optax.apply_updates(w_global, updates)

@@ -1,0 +1,81 @@
+"""Default ClientTrainer for classification/seq tasks.
+
+Parity: ``ml/trainer/my_model_trainer_classification.py`` (+ NWP variant) —
+but the torch epoch loop is a single compiled XLA program built by
+:mod:`fedml_tpu.ml.trainer.local_sgd`.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.alg_frame.client_trainer import ClientTrainer
+from fedml_tpu.data.dataset import batch_epochs
+from fedml_tpu.ml.trainer.local_sgd import (
+    LocalState,
+    build_evaluator,
+    build_local_trainer,
+    init_local_state,
+)
+
+Pytree = Any
+
+
+class ClassificationTrainer(ClientTrainer):
+    def __init__(self, model, args):
+        super().__init__(model, args)
+        self.apply_fn = lambda params, x: model.apply(params, x)
+        self._run_local = build_local_trainer(self.apply_fn, args)
+        self._evaluate = build_evaluator(self.apply_fn)
+        self._pad_to_batches: Optional[int] = None
+        self._round_seed = 0
+
+    def set_pad_to_batches(self, n: Optional[int]) -> None:
+        """Share one compiled shape across heterogeneous clients."""
+        self._pad_to_batches = n
+
+    def set_round(self, round_idx: int) -> None:
+        self._round_seed = round_idx
+
+    def train(
+        self, params: Pytree, train_data: Tuple[np.ndarray, np.ndarray], device, args
+    ) -> Tuple[Pytree, dict]:
+        x, y = train_data
+        state = init_local_state(params, args)
+        xs, ys, mask = batch_epochs(
+            np.asarray(x),
+            np.asarray(y),
+            int(getattr(args, "batch_size", 32)),
+            int(getattr(args, "epochs", 1)),
+            seed=int(getattr(args, "random_seed", 0)) * 100003
+            + self.id * 1009
+            + self._round_seed,
+            pad_to_batches=self._pad_to_batches,
+        )
+        new_params, new_state, metrics = self._run_local(
+            params, state, jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask)
+        )
+        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics["scaffold_c_delta"] = None
+        if new_state.c_local is not None:
+            import jax
+
+            metrics["scaffold_c_delta"] = jax.tree.map(
+                lambda a, b: a - b, new_state.c_local, state.c_local
+            )
+        return new_params, metrics
+
+    def test(self, params: Pytree, test_data, device, args) -> dict:
+        x, y = test_data
+        loss_sum, correct, n = self._evaluate(
+            params, jnp.asarray(np.asarray(x)), jnp.asarray(np.asarray(y))
+        )
+        n = float(n)
+        return {
+            "test_loss": float(loss_sum) / max(n, 1.0),
+            "test_acc": float(correct) / max(n, 1.0),
+            "test_total": n,
+            "test_correct": float(correct),
+        }

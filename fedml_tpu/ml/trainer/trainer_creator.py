@@ -1,0 +1,12 @@
+"""create_model_trainer — parity with ``ml/trainer/trainer_creator.py``."""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu.ml.trainer.classification_trainer import ClassificationTrainer
+
+
+def create_model_trainer(model: Any, args: Any):
+    # classification covers seq tasks too (3-D logits handled by the loss);
+    # dataset-specific trainers can be registered here as they are added.
+    return ClassificationTrainer(model, args)

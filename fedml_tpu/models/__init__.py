@@ -1,0 +1,3 @@
+from fedml_tpu.models.model_hub import create, example_input, init_params
+
+__all__ = ["create", "example_input", "init_params"]

@@ -1,0 +1,48 @@
+"""CNNs from the reference zoo (``model/cv/cnn.py``): the FedAvg-paper
+femnist CNN (two 5×5 convs) and a CIFAR variant. NHWC layout — XLA's native
+conv layout on TPU.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNFemnist(nn.Module):
+    """Conv(32,5x5)-pool-Conv(64,5x5)-pool-Dense(2048)-Dense(out).
+
+    Parity: ``model/cv/cnn.py`` CNN_DropOut for femnist/mnist.
+    """
+
+    output_dim: int = 62
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat 784 → 28×28×1
+            side = int(jnp.sqrt(x.shape[-1]))
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(2048)(x))
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.output_dim)(x)
+
+
+class CNNCifar(nn.Module):
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="SAME")(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(self.output_dim)(x)

@@ -1,0 +1,28 @@
+"""Logistic regression — the canonical SP-simulation model.
+
+Parity: ``model/linear/lr.py`` (reference north-star config #1: LR on MNIST).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.output_dim)(x)
+
+
+class MLP(nn.Module):
+    hidden_dim: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden_dim)(x))
+        return nn.Dense(self.output_dim)(x)

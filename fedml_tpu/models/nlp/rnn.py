@@ -1,0 +1,46 @@
+"""RNN/LSTM models for federated text tasks.
+
+Parity: ``model/nlp/rnn.py`` — RNN_OriginalFedAvg (shakespeare next-char,
+2-layer LSTM 256) and RNN_StackOverFlow (next-word prediction). The
+recurrence runs as ``nn.RNN``/``lax.scan`` so the whole sequence unrolls
+inside one XLA program.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    """Embedding(8) → LSTM(256) ×2 → Dense(vocab); shakespeare charset 90."""
+
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [batch, seq] int tokens
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)  # [batch, seq, vocab]
+
+
+class RNNStackOverflow(nn.Module):
+    """Next-word prediction: Embed(96) → LSTM(670) → Dense(96) → Dense(vocab).
+
+    Matches the layer plan of the reference's RNN_StackOverFlow
+    (``model/nlp/rnn.py``, 10k vocab + special tokens).
+    """
+
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

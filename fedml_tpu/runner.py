@@ -1,0 +1,50 @@
+"""FedMLRunner — facade choosing the engine runner.
+
+Parity: ``python/fedml/runner.py:19-185``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu import constants
+
+
+class FedMLRunner:
+    def __init__(
+        self,
+        args: Any,
+        device: Any,
+        dataset: Any,
+        model: Any,
+        client_trainer=None,
+        server_aggregator=None,
+    ):
+        self.args = args
+        self.runner = self._build(args, device, dataset, model, client_trainer, server_aggregator)
+
+    def _build(self, args, device, dataset, model, client_trainer, server_aggregator):
+        tt = str(getattr(args, "training_type", constants.FEDML_TRAINING_PLATFORM_SIMULATION))
+        if tt == constants.FEDML_TRAINING_PLATFORM_SIMULATION:
+            from fedml_tpu.simulation.simulator import create_simulator
+
+            return create_simulator(args, device, dataset, model, client_trainer, server_aggregator)
+        if tt in (
+            constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
+            constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD,
+        ):
+            role = str(getattr(args, "role", constants.ROLE_CLIENT))
+            if role == constants.ROLE_SERVER or int(getattr(args, "rank", 0)) == 0:
+                from fedml_tpu.cross_silo.server.server import Server
+
+                return Server(args, device, dataset, model, server_aggregator)
+            from fedml_tpu.cross_silo.client.client import Client
+
+            return Client(args, device, dataset, model, client_trainer)
+        if tt == constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+            from fedml_tpu.cross_device.server import ServerCrossDevice
+
+            return ServerCrossDevice(args, device, dataset, model, server_aggregator)
+        raise ValueError(f"unknown training_type {tt!r}")
+
+    def run(self):
+        return self.runner.run()

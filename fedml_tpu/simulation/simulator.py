@@ -1,0 +1,63 @@
+"""Simulator facade — picks SP or mesh-parallel runner per ``args.backend``.
+
+Parity: ``simulation/simulator.py:27-160`` (SimulatorSingleProcess /
+SimulatorMPI / SimulatorNCCL). The MPI and NCCL backends both map to the
+mesh simulator here: on TPU, "one process per client" and "GPU-cluster
+collectives" collapse into one ``shard_map``'d XLA program over the device
+mesh (SURVEY §2.10).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu import constants
+from fedml_tpu.data.dataset import FederatedDataset
+
+
+class SimulatorSingleProcess:
+    def __init__(self, args, device, dataset: FederatedDataset, model,
+                 client_trainer=None, server_aggregator=None):
+        from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+        self.fl_trainer = FedAvgAPI(
+            args, device, dataset, model, client_trainer, server_aggregator
+        )
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorMesh:
+    """Clients ride a mesh axis; FedAvg is an ICI all-reduce."""
+
+    def __init__(self, args, device, dataset: FederatedDataset, model,
+                 client_trainer=None, server_aggregator=None):
+        from fedml_tpu.simulation.parallel.mesh_simulator import MeshFedAvgAPI
+
+        self.fl_trainer = MeshFedAvgAPI(args, device, dataset, model)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+# reference-name aliases
+SimulatorMPI = SimulatorMesh
+SimulatorNCCL = SimulatorMesh
+
+
+def create_simulator(args: Any, device, dataset, model,
+                     client_trainer=None, server_aggregator=None):
+    backend = str(getattr(args, "backend", constants.FEDML_SIMULATION_TYPE_SP))
+    if backend == constants.FEDML_SIMULATION_TYPE_SP:
+        return SimulatorSingleProcess(
+            args, device, dataset, model, client_trainer, server_aggregator
+        )
+    if backend in (
+        constants.FEDML_SIMULATION_TYPE_MESH,
+        constants.FEDML_SIMULATION_TYPE_NCCL,
+        constants.FEDML_SIMULATION_TYPE_MPI,
+    ):
+        return SimulatorMesh(
+            args, device, dataset, model, client_trainer, server_aggregator
+        )
+    raise ValueError(f"unknown simulation backend {backend!r}")

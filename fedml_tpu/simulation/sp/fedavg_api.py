@@ -1,0 +1,146 @@
+"""Single-process federated simulation — "Parrot" SP backend.
+
+Parity: ``simulation/sp/fedavg/fedavg_api.py:14-190`` (train loop, client
+sampling, ``_aggregate``, ``_local_test_on_all_clients``) generalized over
+every federated optimizer the reference ships as a separate sp/ directory
+(FedAvg/FedProx/FedOpt/FedNova/FedDyn/SCAFFOLD/Mime): the local-optimizer
+differences live in the compiled local trainer
+(``ml/trainer/local_sgd.py``), the server-side differences in
+``ServerOptimizer`` — so one round loop serves all algorithms.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from fedml_tpu.core.alg_frame.params import Context
+from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
+from fedml_tpu.data.dataset import FederatedDataset
+from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
+from fedml_tpu.ml.aggregator.server_optimizer import ServerOptimizer
+from fedml_tpu.ml.trainer.trainer_creator import create_model_trainer
+from fedml_tpu.models import model_hub
+from fedml_tpu.utils.tree import tree_add, tree_scale, tree_stack, weighted_tree_sum
+
+Pytree = Any
+
+logger = logging.getLogger(__name__)
+
+
+class FedAvgAPI:
+    def __init__(
+        self,
+        args: Any,
+        device: Any,
+        dataset: FederatedDataset,
+        model: Any,
+        client_trainer=None,
+        server_aggregator=None,
+    ):
+        self.args = args
+        self.device = device
+        self.dataset = dataset
+        self.model = model
+        self.trainer = client_trainer or create_model_trainer(model, args)
+        self.aggregator = server_aggregator or create_server_aggregator(model, args)
+        self.server_opt = ServerOptimizer(args)
+        sample_x = dataset.train_data_global[0][: int(getattr(args, "batch_size", 32))]
+        self.global_params = model_hub.init_params(model, args, sample_x)
+        # shared compiled shape across clients (hard part (b): pad-and-mask)
+        max_n = max(dataset.train_data_local_num_dict.values())
+        self.trainer.set_pad_to_batches(
+            max(1, math.ceil(max_n / int(getattr(args, "batch_size", 32))))
+        )
+        self.test_history: List[dict] = []
+        self._c_global = None  # SCAFFOLD server control variate
+        self.event = MLOpsProfilerEvent(args)
+
+    # -- client sampling (parity: fedavg_api.py:128-141) ------------------
+    def _client_sampling(self, round_idx: int) -> List[int]:
+        total = int(self.args.client_num_in_total)
+        per_round = min(int(self.args.client_num_per_round), total)
+        if total == per_round:
+            return list(range(total))
+        rng = np.random.default_rng(round_idx + int(getattr(self.args, "random_seed", 0)))
+        return sorted(rng.choice(total, per_round, replace=False).tolist())
+
+    # -- round ------------------------------------------------------------
+    def train_one_round(self, round_idx: int) -> dict:
+        client_ids = self._client_sampling(round_idx)
+        ctx = Context()
+        ctx.add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
+        ctx.add(Context.KEY_CLIENT_NUM_IN_THIS_ROUND, len(client_ids))
+
+        w_locals: List[Tuple[int, Pytree]] = []
+        c_deltas = []
+        self.event.log_event_started("train", round_idx)
+        for cid in client_ids:
+            self.trainer.set_id(cid)
+            self.trainer.set_round(round_idx)
+            train_data = self.dataset.train_data_local_dict[cid]
+            n_k = self.dataset.train_data_local_num_dict[cid]
+            w, metrics = self.trainer.run_local_training(
+                self.global_params, train_data, self.device, self.args
+            )
+            if metrics.get("scaffold_c_delta") is not None:
+                c_deltas.append(metrics["scaffold_c_delta"])
+            w_locals.append((n_k, w))
+        self.event.log_event_ended("train", round_idx)
+
+        self.event.log_event_started("aggregate", round_idx)
+        ctx.add("global_model_for_defense", self.global_params)
+        w_list, _ = self.aggregator.on_before_aggregation(w_locals)
+        w_agg = self.aggregator.aggregate(w_list)
+        w_agg = self.aggregator.on_after_aggregation(w_agg)
+        self.global_params = self.server_opt.step(self.global_params, w_agg)
+        if c_deltas:  # SCAFFOLD: c += (1/N) * sum(c_deltas) * (S/N)
+            total = int(self.args.client_num_in_total)
+            scale = 1.0 / total
+            avg_delta = tree_scale(
+                weighted_tree_sum(
+                    tree_stack(c_deltas),
+                    np.full(len(c_deltas), 1.0 / len(c_deltas)),
+                ),
+                len(c_deltas) * scale,
+            )
+            from fedml_tpu.ml.trainer.local_sgd import init_local_state
+
+            if self._c_global is None:
+                self._c_global = jax.tree.map(lambda x: 0 * x, avg_delta)
+            self._c_global = tree_add(self._c_global, avg_delta)
+        self.event.log_event_ended("aggregate", round_idx)
+
+        report = {"round": round_idx, "clients": client_ids}
+        freq = int(getattr(self.args, "frequency_of_the_test", 1))
+        if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
+            metrics = self.aggregator.test(
+                self.global_params, self.dataset.test_data_global, self.device, self.args
+            )
+            report.update(metrics)
+            self.test_history.append(report)
+            logger.info(
+                "round %d acc=%.4f loss=%.4f",
+                round_idx,
+                metrics.get("test_acc", -1),
+                metrics.get("test_loss", -1),
+            )
+        return report
+
+    def train(self) -> dict:
+        t0 = time.time()
+        for round_idx in range(int(self.args.comm_round)):
+            self.train_one_round(round_idx)
+        wall = time.time() - t0
+        final = self.test_history[-1] if self.test_history else {}
+        return {
+            "wall_clock_sec": wall,
+            "rounds": int(self.args.comm_round),
+            "rounds_per_sec": int(self.args.comm_round) / max(wall, 1e-9),
+            **final,
+        }

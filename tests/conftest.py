@@ -1,0 +1,30 @@
+"""Test config: force CPU with 8 virtual devices so multi-chip sharding
+paths (mesh simulator, xla_ici backend, FSDP/TP shardings) are exercised
+without TPU hardware — per the driver's dryrun contract."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Trust-stack singletons are process-global; isolate tests."""
+    yield
+    from fedml_tpu.core.alg_frame.params import Context
+    from fedml_tpu.core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+    from fedml_tpu.core.security.attacker import FedMLAttacker
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    FedMLAttacker.reset()
+    FedMLDefender.reset()
+    FedMLDifferentialPrivacy.reset()
+    FedMLFHE.reset()
+    Context.reset()

@@ -1,0 +1,113 @@
+"""Core frame: tree ops, AggOperator, message, config, partition."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.core.data.noniid_partition import (
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+)
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+from fedml_tpu.utils.tree import (
+    tree_flatten_vector,
+    tree_norm,
+    tree_stack,
+    tree_sub,
+    tree_unflatten_vector,
+    weighted_tree_sum,
+)
+
+
+def test_weighted_tree_sum_matches_manual():
+    trees = [
+        {"w": jnp.ones((3, 2)) * i, "b": jnp.ones((2,)) * i} for i in range(1, 4)
+    ]
+    stacked = tree_stack(trees)
+    weights = jnp.asarray([0.5, 0.3, 0.2])
+    out = weighted_tree_sum(stacked, weights)
+    expected = 1 * 0.5 + 2 * 0.3 + 3 * 0.2
+    np.testing.assert_allclose(out["w"], expected, rtol=1e-6)
+    np.testing.assert_allclose(out["b"], expected, rtol=1e-6)
+
+
+def test_flatten_roundtrip():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": jnp.ones((4,))}
+    vec = tree_flatten_vector(tree)
+    assert vec.shape == (10,)
+    back = tree_unflatten_vector(vec, tree)
+    np.testing.assert_allclose(back["a"], tree["a"])
+    np.testing.assert_allclose(back["b"], tree["b"])
+
+
+def test_agg_operator_fedavg_weighting():
+    args = load_arguments_from_dict({"train_args": {"federated_optimizer": "FedAvg"}})
+    lst = [
+        (10, {"w": jnp.zeros((2, 2))}),
+        (30, {"w": jnp.ones((2, 2))}),
+    ]
+    out = FedMLAggOperator.agg(args, lst)
+    np.testing.assert_allclose(out["w"], 0.75, rtol=1e-6)
+
+
+def test_agg_operator_uniform_for_scaffold():
+    args = load_arguments_from_dict({"train_args": {"federated_optimizer": "SCAFFOLD"}})
+    lst = [(10, {"w": jnp.zeros((2,))}), (90, {"w": jnp.ones((2,))})]
+    out = FedMLAggOperator.agg(args, lst)
+    np.testing.assert_allclose(out["w"], 0.5, rtol=1e-6)
+
+
+def test_message_roundtrip():
+    msg = Message("MSG_TYPE_S2C_INIT", sender_id=0, receiver_id=3)
+    msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, {"w": jnp.ones(2)})
+    msg.add_params("round", 7)
+    assert msg.get_sender_id() == 0
+    assert msg.get_receiver_id() == 3
+    assert msg.get("round") == 7
+    m2 = Message.construct_from_params(msg.get_params())
+    assert m2.get_type() == "MSG_TYPE_S2C_INIT"
+    assert m2.get("round") == 7
+
+
+def test_arguments_flatten_sections(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        """
+common_args:
+  training_type: "simulation"
+  random_seed: 42
+train_args:
+  client_num_in_total: 7
+  learning_rate: 0.5
+"""
+    )
+    from fedml_tpu.arguments import Arguments
+
+    args = Arguments()
+    args.load_yaml_config(str(cfg))
+    assert args.training_type == "simulation"
+    assert args.client_num_in_total == 7
+    assert args.learning_rate == 0.5
+
+
+def test_dirichlet_partition_covers_all_samples():
+    labels = np.random.default_rng(0).integers(0, 10, size=2000)
+    mp = non_iid_partition_with_dirichlet_distribution(labels, 10, 10, 0.5, seed=0)
+    all_idx = np.concatenate([mp[i] for i in range(10)])
+    assert sorted(all_idx.tolist()) == list(range(2000))
+    sizes = np.array([len(mp[i]) for i in range(10)])
+    assert sizes.std() > 0  # non-IID should be uneven
+
+
+def test_dirichlet_partition_deterministic():
+    labels = np.random.default_rng(1).integers(0, 5, size=500)
+    a = non_iid_partition_with_dirichlet_distribution(labels, 4, 5, 0.3, seed=7)
+    b = non_iid_partition_with_dirichlet_distribution(labels, 4, 5, 0.3, seed=7)
+    for i in range(4):
+        np.testing.assert_array_equal(a[i], b[i])
+
+
+def test_homo_partition_even():
+    mp = homo_partition(100, 4, seed=0)
+    assert all(len(mp[i]) == 25 for i in range(4))
