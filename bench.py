@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Benchmark driver entry — prints ONE JSON line.
+
+Metric (BASELINE.json): FedAvg rounds/sec/chip. The reference publishes no
+numbers (BASELINE.md), so vs_baseline is measured against the reference's
+canonical SP config shape executed by our own SP engine on the same
+hardware (sequential host loop == what FedML's sp backend does), i.e.
+vs_baseline = mesh-parallel rounds/sec ÷ sequential rounds/sec.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+
+    # canonical config #1 shape (reference simulation_sp/fedml_config.yaml):
+    # LR on MNIST-shaped data, 1000 clients total, 10 per round
+    def cfg(backend):
+        return {
+            "common_args": {"training_type": "simulation", "random_seed": 0},
+            "data_args": {
+                "dataset": "mnist",
+                "partition_method": "hetero",
+                "partition_alpha": 0.5,
+                "train_size": 60000,
+                "test_size": 10000,
+            },
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "backend": backend,
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 1000,
+                "client_num_per_round": 10,
+                "comm_round": 20,
+                "epochs": 1,
+                "batch_size": 10,
+                "learning_rate": 0.03,
+                "frequency_of_the_test": 100,
+            },
+        }
+
+    import jax
+
+    n_chips = jax.device_count()
+
+    def run(backend):
+        args = fedml_tpu.init(load_arguments_from_dict(cfg(backend)))
+        ds = load_federated(args)
+        model = models_mod.create(args, ds.class_num)
+        if backend == "mesh":
+            from fedml_tpu.simulation.parallel.mesh_simulator import MeshFedAvgAPI
+
+            api = MeshFedAvgAPI(args, None, ds, model)
+        else:
+            from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+            api = FedAvgAPI(args, None, ds, model)
+        api.train_one_round(0)  # warm-up: compile outside the timed region
+        t0 = time.time()
+        rounds = int(args.comm_round)
+        for r in range(1, rounds + 1):
+            api.train_one_round(r)
+        return rounds / (time.time() - t0)
+
+    sp_rps = run("sp")
+    mesh_rps = run("mesh")
+    value = mesh_rps / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_rounds_per_sec_per_chip",
+                "value": round(value, 4),
+                "unit": "rounds/s/chip",
+                "vs_baseline": round(mesh_rps / sp_rps, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Deterministic in-process transport — the test/SP federation backbone.
+
+The reference's CI rendezvouses real processes over a hosted MQTT broker
+(SURVEY §4 calls this "flaky by construction"); this backend replaces that
+with an in-process broker: every rank has an inbox queue, sends are
+enqueue-only, and each manager drains its own inbox on its own thread (or
+cooperatively via ``pump()``), so protocol FSM tests are fully
+deterministic and run in milliseconds.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from fedml_tpu.core.distributed.communication.base_com_manager import (
+    BaseCommunicationManager,
+    Observer,
+)
+from fedml_tpu.core.distributed.message import Message
+
+
+class LocalBroker:
+    """Per-run registry of rank inboxes. Process-global, keyed by run_id."""
+
+    _instances: Dict[str, "LocalBroker"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.inboxes: Dict[int, "queue.Queue[Optional[Message]]"] = defaultdict(queue.Queue)
+
+    @classmethod
+    def get(cls, run_id: str) -> "LocalBroker":
+        with cls._lock:
+            if run_id not in cls._instances:
+                cls._instances[run_id] = cls()
+            return cls._instances[run_id]
+
+    @classmethod
+    def destroy(cls, run_id: str) -> None:
+        with cls._lock:
+            cls._instances.pop(run_id, None)
+
+    def post(self, receiver_id: int, msg: Optional[Message]) -> None:
+        self.inboxes[receiver_id].put(msg)
+
+
+class LocalCommManager(BaseCommunicationManager):
+    def __init__(self, run_id: str, rank: int):
+        self.run_id = str(run_id)
+        self.rank = int(rank)
+        self.broker = LocalBroker.get(self.run_id)
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.broker.post(msg.get_receiver_id(), msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        inbox = self.broker.inboxes[self.rank]
+        while self._running:
+            try:
+                msg = inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if msg is None:  # poison pill
+                break
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def pump(self, max_messages: int = 0) -> int:
+        """Cooperative drain (no thread): deliver pending messages now."""
+        inbox = self.broker.inboxes[self.rank]
+        n = 0
+        while not inbox.empty() and (max_messages == 0 or n < max_messages):
+            msg = inbox.get_nowait()
+            if msg is None:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+            n += 1
+        return n
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.broker.post(self.rank, None)

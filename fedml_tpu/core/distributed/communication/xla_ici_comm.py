@@ -1,0 +1,59 @@
+"""XLA-ICI federation transport — ranks are devices on the pod.
+
+The reference's compute-plane collectives are torch.distributed NCCL/gloo
+(``simulation/nccl/base_framework/common.py:122-228``); SURVEY §2.10 maps
+them to XLA collectives over ICI. Two layers here:
+
+1. *Aggregation* collectives never appear as messages at all — FedAvg-as-
+   psum lives inside the compiled round program (mesh simulator /
+   ``parallel``). That is the hot path.
+2. This class covers the *federation control plane* for intra-pod ranks:
+   same ``BaseCommunicationManager`` contract as gRPC/MQTT so engines are
+   transport-agnostic, but model payloads stay ON DEVICE — delivery moves
+   arrays chip→chip with ``jax.device_put`` (riding ICI; no host copy, no
+   serialization), which is the reason to prefer it over gRPC-over-
+   loopback inside a pod.
+
+Control metadata still flows through an in-process broker (single-process
+runtime) — in a true multi-host deployment the control hop rides DCN while
+payload device_put rides ICI, preserving the same interface.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List
+
+import jax
+
+from fedml_tpu.core.distributed.communication.local_comm import (
+    LocalBroker,
+    LocalCommManager,
+)
+from fedml_tpu.core.distributed.message import Message
+
+logger = logging.getLogger(__name__)
+
+
+class XlaIciCommManager(LocalCommManager):
+    def __init__(self, run_id: str, rank: int, size: int = 0):
+        super().__init__(run_id, rank)
+        devices = jax.devices()
+        self.device_of_rank = {
+            r: devices[r % len(devices)] for r in range(max(size, len(devices)) + 1)
+        }
+
+    def send_message(self, msg: Message) -> None:
+        receiver = msg.get_receiver_id()
+        target = self.device_of_rank.get(receiver)
+        payload = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        if payload is not None and target is not None:
+            # device→device transfer over ICI; leaves land on the
+            # receiver's chip before the control message is delivered
+            moved = jax.tree.map(
+                lambda x: jax.device_put(x, target)
+                if isinstance(x, jax.Array)
+                else x,
+                payload,
+            )
+            msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, moved)
+        super().send_message(msg)

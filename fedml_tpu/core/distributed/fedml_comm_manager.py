@@ -1,0 +1,130 @@
+"""FedMLCommManager — backend-agnostic messaging hub.
+
+Parity: ``core/distributed/fedml_comm_manager.py:11-209``: a registry of
+``msg_type → handler`` callbacks observing a pluggable transport, with
+``_init_manager`` instantiating the backend by name.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.communication.base_com_manager import (
+    BaseCommunicationManager,
+    Observer,
+)
+from fedml_tpu.core.distributed.message import Message
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLCommManager(Observer):
+    def __init__(
+        self,
+        args: Any,
+        comm: Any = None,
+        rank: int = 0,
+        size: int = 0,
+        backend: str = constants.COMM_BACKEND_LOCAL,
+    ):
+        self.args = args
+        self.size = int(size)
+        self.rank = int(rank)
+        self.backend = backend
+        self.com_manager: Optional[BaseCommunicationManager] = comm
+        self.message_handler_dict: Dict[str, Callable] = {}
+        self._receive_thread: Optional[threading.Thread] = None
+        self.handler_error: Optional[BaseException] = None
+        if self.com_manager is None:
+            self._init_manager()
+        self.com_manager.add_observer(self)
+
+    # -- public surface (reference names) ---------------------------------
+    def register_comm_manager(self, comm_manager: BaseCommunicationManager) -> None:
+        self.com_manager = comm_manager
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        logger.debug("rank %d running (%s backend)", self.rank, self.backend)
+        self.com_manager.handle_receive_message()
+
+    def run_async(self) -> threading.Thread:
+        """Run the receive loop on a daemon thread (in-proc federation)."""
+        self.register_message_receive_handlers()
+        t = threading.Thread(target=self.com_manager.handle_receive_message, daemon=True)
+        t.start()
+        self._receive_thread = t
+        return t
+
+    def get_sender_id(self) -> int:
+        return self.rank
+
+    def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        handler = self.message_handler_dict.get(str(msg_type))
+        if handler is None:
+            logger.warning("rank %d: no handler for %s", self.rank, msg_type)
+            return
+        try:
+            handler(msg_params)
+        except BaseException as e:
+            # a raising handler must not silently kill the receive thread
+            # and hang the federation — record, log, and stop this rank's
+            # loop so joins return promptly and callers can surface it
+            self.handler_error = e
+            logger.exception(
+                "rank %d: handler for %s raised; stopping receive loop",
+                self.rank,
+                msg_type,
+            )
+            self.com_manager.stop_receive_message()
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def register_message_receive_handler(self, msg_type: str, handler: Callable) -> None:
+        self.message_handler_dict[str(msg_type)] = handler
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their FSM handlers here."""
+
+    def finish(self) -> None:
+        logger.debug("rank %d finishing", self.rank)
+        self.com_manager.stop_receive_message()
+
+    # -- backend factory (parity: fedml_comm_manager.py:131) --------------
+    def _init_manager(self) -> None:
+        backend = str(self.backend).upper()
+        run_id = str(getattr(self.args, "run_id", "0"))
+        if backend == constants.COMM_BACKEND_LOCAL:
+            from fedml_tpu.core.distributed.communication.local_comm import (
+                LocalCommManager,
+            )
+
+            self.com_manager = LocalCommManager(run_id, self.rank)
+        elif backend == constants.COMM_BACKEND_GRPC:
+            from fedml_tpu.core.distributed.communication.grpc_comm import (
+                GRPCCommManager,
+            )
+
+            ip_config = getattr(self.args, "grpc_ipconfig", None)
+            self.com_manager = GRPCCommManager(
+                ip_config=ip_config,
+                client_id=self.rank,
+                client_num=self.size,
+                base_port=int(getattr(self.args, "grpc_base_port", 8890)),
+            )
+        elif backend == constants.COMM_BACKEND_XLA_ICI:
+            from fedml_tpu.core.distributed.communication.xla_ici_comm import (
+                XlaIciCommManager,
+            )
+
+            self.com_manager = XlaIciCommManager(run_id, self.rank, self.size)
+        elif backend == constants.COMM_BACKEND_MQTT_S3:
+            raise RuntimeError(
+                "MQTT_S3 backend requires paho-mqtt/boto3 (not available in "
+                "this environment); use GRPC or LOCAL"
+            )
+        else:
+            raise ValueError(f"unknown comm backend {self.backend!r}")

@@ -1,0 +1,89 @@
+"""Client→device workload scheduling for parallel simulation.
+
+Parity: ``core/schedule/seq_train_scheduler.py:9`` + ``runtime_estimate.py``
+in the reference (DP-based assignment of simulated clients to GPUs using
+fitted runtime estimates). TPU-native framing: the output is a *static*
+[n_devices, clients_per_device] id matrix (padded with -1) consumed by one
+``shard_map``'d round program — scheduling happens on host between rounds,
+never inside the compiled program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class RuntimeEstimator:
+    """Fit t ≈ a * n_samples + b per client from observed round times.
+
+    Parity: ``core/schedule/runtime_estimate.py`` (``t_sample_fit``).
+    """
+
+    def __init__(self):
+        self._obs: List[Tuple[float, float]] = []  # (n_samples, seconds)
+        self.a = 1.0
+        self.b = 0.0
+
+    def observe(self, n_samples: float, seconds: float) -> None:
+        self._obs.append((float(n_samples), float(seconds)))
+        if len(self._obs) >= 2:
+            x = np.asarray([o[0] for o in self._obs])
+            y = np.asarray([o[1] for o in self._obs])
+            A = np.stack([x, np.ones_like(x)], axis=1)
+            sol, *_ = np.linalg.lstsq(A, y, rcond=None)
+            self.a, self.b = float(sol[0]), float(sol[1])
+
+    def estimate(self, n_samples: float) -> float:
+        return self.a * float(n_samples) + self.b
+
+
+class SeqTrainScheduler:
+    """Greedy LPT (longest-processing-time-first) assignment of clients to
+    devices, balancing estimated runtime — the practical equivalent of the
+    reference's DP workload solver, with O(S log S) cost.
+    """
+
+    def __init__(
+        self,
+        workloads: Sequence[float],
+        constraints_num: int,
+        estimator: RuntimeEstimator | None = None,
+    ):
+        self.workloads = [float(w) for w in workloads]
+        self.n_devices = int(constraints_num)
+        self.estimator = estimator or RuntimeEstimator()
+
+    def schedule(self) -> List[List[int]]:
+        """Return per-device client-index lists, balanced by workload."""
+        est = [self.estimator.estimate(w) for w in self.workloads]
+        order = np.argsort(est)[::-1]
+        loads = np.zeros(self.n_devices)
+        assignment: List[List[int]] = [[] for _ in range(self.n_devices)]
+        for idx in order:
+            d = int(np.argmin(loads))
+            assignment[d].append(int(idx))
+            loads[d] += est[idx]
+        return assignment
+
+
+def schedule_clients_to_devices(
+    client_ids: Sequence[int],
+    client_sample_counts: Dict[int, int],
+    n_devices: int,
+    estimator: RuntimeEstimator | None = None,
+) -> np.ndarray:
+    """Static [n_devices, slots] id matrix, padded with -1.
+
+    ``slots`` = max clients on any device; every device sees the same
+    number of slots so the compiled round program has one shape.
+    """
+    workloads = [client_sample_counts[c] for c in client_ids]
+    sched = SeqTrainScheduler(workloads, n_devices, estimator)
+    assignment = sched.schedule()
+    slots = max(1, max(len(a) for a in assignment))
+    out = np.full((n_devices, slots), -1, dtype=np.int32)
+    for d, idxs in enumerate(assignment):
+        for s, local_idx in enumerate(idxs):
+            out[d, s] = client_ids[local_idx]
+    return out

@@ -1,0 +1,32 @@
+"""Cross-silo Client facade.
+
+Parity: ``cross_silo/client/fedml_client.py`` + ``client_initializer.py``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu import constants
+from fedml_tpu.cross_silo.client.fedml_client_master_manager import ClientMasterManager
+from fedml_tpu.cross_silo.client.trainer_dist_adapter import TrainerDistAdapter
+
+
+class Client:
+    def __init__(self, args: Any, device: Any, dataset: Any, model: Any, client_trainer=None):
+        self.args = args
+        backend = str(getattr(args, "comm_backend", None) or getattr(args, "backend", "LOCAL"))
+        if backend.lower() in ("sp", "mesh"):
+            backend = constants.COMM_BACKEND_LOCAL
+        rank = int(getattr(args, "rank", 1))
+        client_num = int(getattr(args, "client_num_per_round", 1))
+        adapter = TrainerDistAdapter(args, device, rank, model, dataset, client_trainer)
+        self.manager = ClientMasterManager(
+            args, adapter, rank=rank, size=client_num + 1, backend=backend
+        )
+
+    def run(self):
+        self.manager.run()
+        return None
+
+    def run_async(self):
+        return self.manager.run_async()

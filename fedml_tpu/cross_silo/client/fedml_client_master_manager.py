@@ -1,0 +1,104 @@
+"""Cross-silo client FSM.
+
+Parity: ``cross_silo/client/fedml_client_master_manager.py:22`` — report
+status on connection-ready, train on init/sync, upload the model, stop on
+finish. ``trainer`` is a TrainerDistAdapter so the hierarchical (in-silo
+sharded) path plugs in transparently.
+"""
+from __future__ import annotations
+
+import logging
+import platform
+from typing import Any, Optional
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.cross_silo.message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(
+        self,
+        args: Any,
+        trainer_dist_adapter,
+        comm=None,
+        rank: int = 0,
+        size: int = 0,
+        backend: str = constants.COMM_BACKEND_LOCAL,
+    ):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer_dist_adapter = trainer_dist_adapter
+        self.num_rounds = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.has_sent_online_msg = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS,
+            self.handle_message_check_status,
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            self.handle_message_receive_model_from_server,
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish
+        )
+
+    # -- handlers ----------------------------------------------------------
+    def handle_message_connection_ready(self, msg: Message) -> None:
+        if not self.has_sent_online_msg:
+            self.has_sent_online_msg = True
+            self.send_client_status(0)
+
+    def handle_message_check_status(self, msg: Message) -> None:
+        self.send_client_status(msg.get_sender_id())
+
+    def handle_message_init(self, msg: Message) -> None:
+        global_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        data_silo_idx = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
+        self.trainer_dist_adapter.update_dataset(int(data_silo_idx))
+        self.__train(global_params)
+
+    def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        global_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        data_silo_idx = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx + 1))
+        self.trainer_dist_adapter.update_dataset(int(data_silo_idx))
+        self.__train(global_params)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        logger.debug("client %d finished", self.rank)
+        self.finish()
+
+    # -- actions -----------------------------------------------------------
+    def send_client_status(self, receive_id: int, status: str = None) -> None:
+        status = status or MyMessage.MSG_CLIENT_STATUS_IDLE
+        msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.get_sender_id(), receive_id)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())
+        self.send_message(msg)
+
+    def send_model_to_server(self, receive_id: int, weights, local_sample_num: int) -> None:
+        msg = Message(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.get_sender_id(), receive_id
+        )
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        self.send_message(msg)
+
+    def __train(self, global_params) -> None:
+        weights, local_sample_num = self.trainer_dist_adapter.train(
+            self.round_idx, global_params
+        )
+        self.send_model_to_server(0, weights, local_sample_num)

@@ -1,0 +1,80 @@
+"""In-process cross-silo federation harness.
+
+The reference's CI spawns server + N clients as OS processes rendezvousing
+over a hosted MQTT broker (``python/tests/cross-silo/run_cross_silo.sh``).
+This harness runs the SAME manager FSMs over the deterministic LOCAL
+transport in one process — threads instead of processes, no broker — which
+is both the test harness and a legitimate single-host deployment mode.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from fedml_tpu.core.distributed.communication.local_comm import LocalBroker
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.cross_silo.client.client import Client
+from fedml_tpu.cross_silo.message_define import MyMessage
+from fedml_tpu.cross_silo.server.server import Server
+from fedml_tpu.data.dataset import FederatedDataset
+
+
+def run_cross_silo_inproc(
+    args: Any,
+    dataset: FederatedDataset,
+    model: Any,
+    client_trainer=None,
+    server_aggregator=None,
+    timeout: float = 600.0,
+) -> Optional[dict]:
+    """Run server + client_num_per_round clients to completion; return the
+    server's final metrics."""
+    run_id = str(getattr(args, "run_id", "0"))
+    LocalBroker.destroy(run_id)
+    client_num = int(getattr(args, "client_num_per_round", 1))
+
+    server = Server(args, None, dataset, model, server_aggregator)
+    clients: List[Client] = []
+    for rank in range(1, client_num + 1):
+        import copy
+
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        clients.append(Client(cargs, None, dataset, model, client_trainer))
+
+    threads = [server.run_async()] + [c.run_async() for c in clients]
+
+    broker = LocalBroker.get(run_id)
+    for rank in range(0, client_num + 1):
+        broker.post(rank, Message(MyMessage.MSG_TYPE_CONNECTION_IS_READY, rank, rank))
+
+    import time
+
+    managers = [server.manager] + [c.manager for c in clients]
+
+    def first_error():
+        for mgr in managers:
+            err = getattr(mgr, "handler_error", None)
+            if err is not None:
+                return mgr, err
+        return None, None
+
+    # poll: a raising handler stops only its own receive loop, so on error
+    # shut the whole federation down instead of waiting out the deadline
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and any(t.is_alive() for t in threads):
+        mgr, err = first_error()
+        if err is not None:
+            for m in managers:
+                m.finish()
+            for t in threads:
+                t.join(timeout=5.0)
+            raise RuntimeError(
+                f"rank {mgr.rank} message handler failed: {err!r}"
+            ) from err
+        time.sleep(0.01)
+
+    mgr, err = first_error()
+    if err is not None:
+        raise RuntimeError(f"rank {mgr.rank} message handler failed: {err!r}") from err
+    return server.manager.result

@@ -1,0 +1,115 @@
+"""Server-side aggregation bookkeeping for cross-silo training.
+
+Parity: ``cross_silo/server/fedml_aggregator.py:13`` — collect per-client
+models, check-all-received, aggregate through the ServerAggregator hook
+chain, client/data-silo selection.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.alg_frame.params import Context
+from fedml_tpu.core.alg_frame.server_aggregator import ServerAggregator
+from fedml_tpu.ml.aggregator.server_optimizer import ServerOptimizer
+
+Pytree = Any
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLAggregator:
+    def __init__(
+        self,
+        test_global,
+        train_global,
+        all_train_data_num: int,
+        train_data_local_dict: Dict,
+        test_data_local_dict: Dict,
+        train_data_local_num_dict: Dict[int, int],
+        client_num: int,
+        device: Any,
+        args: Any,
+        server_aggregator: ServerAggregator,
+    ):
+        self.aggregator = server_aggregator
+        self.args = args
+        self.test_global = test_global
+        self.all_train_data_num = all_train_data_num
+        self.train_data_local_dict = train_data_local_dict
+        self.test_data_local_dict = test_data_local_dict
+        self.train_data_local_num_dict = train_data_local_num_dict
+        self.client_num = int(client_num)
+        self.device = device
+        self.server_opt = ServerOptimizer(args)
+        self.global_params: Optional[Pytree] = None
+        self.model_dict: Dict[int, Pytree] = {}
+        self.sample_num_dict: Dict[int, int] = {}
+        self.flag_client_model_uploaded_dict = {i: False for i in range(self.client_num)}
+
+    def set_global_model_params(self, params: Pytree) -> None:
+        self.global_params = params
+
+    def get_global_model_params(self) -> Pytree:
+        return self.global_params
+
+    def add_local_trained_result(self, index: int, model_params: Pytree, sample_num: int) -> None:
+        logger.debug("add model from client idx %d (n=%d)", index, sample_num)
+        self.model_dict[index] = model_params
+        self.sample_num_dict[index] = int(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        return self.check_whether_all_receive_subset(self.client_num)
+
+    def check_whether_all_receive_subset(self, expected: int) -> bool:
+        """All of this round's ``expected`` participants reported?"""
+        if len(self.model_dict) < expected:
+            return False
+        for i in range(expected):
+            if not self.flag_client_model_uploaded_dict.get(i, False):
+                return False
+        for i in range(expected):
+            self.flag_client_model_uploaded_dict[i] = False
+        return True
+
+    def aggregate(self) -> Pytree:
+        raw_list: List[Tuple[int, Pytree]] = [
+            (self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)
+        ]
+        Context().add("global_model_for_defense", self.global_params)
+        w_list, _ = self.aggregator.on_before_aggregation(raw_list)
+        w_agg = self.aggregator.aggregate(w_list)
+        w_agg = self.aggregator.on_after_aggregation(w_agg)
+        self.global_params = self.server_opt.step(self.global_params, w_agg)
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        return self.global_params
+
+    # -- selection (parity: fedml_aggregator.py:96-140) --------------------
+    def data_silo_selection(
+        self, round_idx: int, client_num_in_total: int, client_num_per_round: int
+    ) -> List[int]:
+        if client_num_in_total == client_num_per_round:
+            return list(range(client_num_in_total))
+        rng = np.random.default_rng(round_idx + int(getattr(self.args, "random_seed", 0)))
+        return sorted(
+            rng.choice(client_num_in_total, client_num_per_round, replace=False).tolist()
+        )
+
+    def client_selection(
+        self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int
+    ) -> List[int]:
+        if client_num_per_round >= len(client_id_list_in_total):
+            return list(client_id_list_in_total)
+        rng = np.random.default_rng(round_idx + int(getattr(self.args, "random_seed", 0)))
+        return sorted(
+            rng.choice(client_id_list_in_total, client_num_per_round, replace=False).tolist()
+        )
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> dict:
+        metrics = self.aggregator.test(self.global_params, self.test_global, self.device, self.args)
+        logger.info("server test round %d: %s", round_idx, metrics)
+        return metrics

@@ -1,0 +1,148 @@
+"""Cross-silo server FSM.
+
+Parity: ``cross_silo/server/fedml_server_manager.py:15`` — wait for all
+clients ONLINE → send init config → on each client model: add → check-all →
+aggregate → test → select next round's clients → sync or finish.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mlops import metrics as mlops
+from fedml_tpu.cross_silo.message_define import MyMessage
+from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(
+        self,
+        args: Any,
+        aggregator: FedMLAggregator,
+        comm=None,
+        client_rank: int = 0,
+        client_num: int = 0,
+        backend: str = constants.COMM_BACKEND_LOCAL,
+    ):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.args.round_idx = 0
+        self.client_num = client_num
+        self.client_online_status: Dict[int, bool] = {}
+        self.client_id_list_in_this_round = None
+        self.data_silo_index_of_client: Dict[int, int] = {}
+        self.is_initialized = False
+        self.result: Optional[dict] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> None:
+        super().run()
+
+    def send_init_msg(self) -> None:
+        global_params = self.aggregator.get_global_model_params()
+        for client_id in self.client_id_list_in_this_round:
+            silo_idx = self.data_silo_index_of_client[client_id]
+            msg = Message(
+                MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), client_id
+            )
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(msg)
+        mlops.log({"event": "server.init_sent", "round": 0})
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status_update
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client,
+        )
+
+    # -- handlers ----------------------------------------------------------
+    def handle_message_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        # ask every client for status (liveness handshake,
+        # parity: fedml_server_manager.py:101-145)
+        for client_id in range(1, self.client_num + 1):
+            m = Message(
+                MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.get_sender_id(), client_id
+            )
+            self.send_message(m)
+
+    def handle_message_client_status_update(self, msg: Message) -> None:
+        status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        if status == MyMessage.MSG_CLIENT_STATUS_IDLE:
+            self.client_online_status[msg.get_sender_id()] = True
+        all_online = all(
+            self.client_online_status.get(cid, False)
+            for cid in range(1, self.client_num + 1)
+        )
+        if all_online and not self.is_initialized:
+            self.is_initialized = True
+            self._select_round_clients()
+            self.send_init_msg()
+
+    def _select_round_clients(self) -> None:
+        client_ids = list(range(1, self.client_num + 1))
+        self.client_id_list_in_this_round = self.aggregator.client_selection(
+            self.args.round_idx, client_ids, int(self.args.client_num_per_round)
+        )
+        silo_indexes = self.aggregator.data_silo_selection(
+            self.args.round_idx,
+            int(self.args.client_num_in_total),
+            len(self.client_id_list_in_this_round),
+        )
+        self.data_silo_index_of_client = dict(
+            zip(self.client_id_list_in_this_round, silo_indexes)
+        )
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(
+            self.client_id_list_in_this_round.index(sender), model_params, local_sample_num
+        )
+        if not self.aggregator.check_whether_all_receive_subset(
+            len(self.client_id_list_in_this_round)
+        ):
+            return
+
+        global_params = self.aggregator.aggregate()
+        metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        mlops.log({"round": self.args.round_idx, **{k: v for k, v in metrics.items()}})
+
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            self.result = {"rounds": self.round_num, **metrics}
+            self._send_finish()
+            self.finish()
+            return
+
+        self._select_round_clients()
+        for client_id in self.client_id_list_in_this_round:
+            silo_idx = self.data_silo_index_of_client[client_id]
+            m = Message(
+                MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.get_sender_id(), client_id
+            )
+            m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+            m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(m)
+
+    def _send_finish(self) -> None:
+        for client_id in range(1, self.client_num + 1):
+            m = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), client_id)
+            self.send_message(m)

@@ -1,0 +1,61 @@
+"""Cross-silo Server facade.
+
+Parity: ``cross_silo/server/fedml_server.py`` + ``server_initializer.py``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu import constants
+from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+from fedml_tpu.cross_silo.server.fedml_server_manager import FedMLServerManager
+from fedml_tpu.data.dataset import FederatedDataset
+from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
+from fedml_tpu.models import model_hub
+
+
+class Server:
+    def __init__(self, args: Any, device: Any, dataset: FederatedDataset, model: Any,
+                 server_aggregator=None):
+        self.args = args
+        backend = str(getattr(args, "comm_backend", None) or getattr(args, "backend", "LOCAL"))
+        if backend.lower() in ("sp", "mesh"):
+            backend = constants.COMM_BACKEND_LOCAL
+        aggregator = server_aggregator or create_server_aggregator(model, args)
+        aggregator.set_id(0)
+        client_num = int(getattr(args, "client_num_per_round", 1))
+        self.fedml_aggregator = FedMLAggregator(
+            dataset.test_data_global,
+            dataset.train_data_global,
+            dataset.train_data_num,
+            dataset.train_data_local_dict,
+            dataset.test_data_local_dict,
+            dataset.train_data_local_num_dict,
+            client_num,
+            device,
+            args,
+            aggregator,
+        )
+        sample_x = dataset.train_data_global[0][: int(getattr(args, "batch_size", 32))]
+        self.fedml_aggregator.set_global_model_params(
+            model_hub.init_params(model, args, sample_x)
+        )
+        self.manager = FedMLServerManager(
+            args, self.fedml_aggregator, client_rank=0, client_num=client_num,
+            backend=backend,
+        )
+
+    def run(self):
+        self.manager.run()
+        return self.manager.result
+
+    def run_async(self):
+        return self.manager.run_async()
+
+    def kickoff(self):
+        """Trigger the liveness handshake (LOCAL backend has no broker event)."""
+        from fedml_tpu.core.distributed.message import Message
+        from fedml_tpu.cross_silo.message_define import MyMessage
+
+        msg = Message(MyMessage.MSG_TYPE_CONNECTION_IS_READY, 0, 0)
+        self.manager.send_message(msg)

@@ -226,10 +226,19 @@ def load_shakespeare(args: Any) -> FederatedDataset:
     # partition by contiguous ranges (clients = "speakers")
     client_num = int(getattr(args, "client_num_in_total", 4))
     train_local = {}
-    per = max(1, len(xtr) // client_num)
-    for i in range(client_num):
-        sl = slice(i * per, (i + 1) * per if i < client_num - 1 else len(xtr))
-        train_local[i] = (xtr[sl], ytr[sl])
+    if len(xtr) >= client_num:
+        # near-contiguous split; linspace bounds differ by >=1 everywhere
+        # when len(xtr) >= client_num, so no client is empty
+        bounds = np.linspace(0, len(xtr), client_num + 1).astype(int)
+        for i in range(client_num):
+            sl = slice(bounds[i], bounds[i + 1])
+            train_local[i] = (xtr[sl], ytr[sl])
+    else:
+        # tiny corpus: stride with wraparound so every client still holds
+        # >=1 sequence (duplication is fine for the synthetic path)
+        for i in range(client_num):
+            idx = np.arange(i, i + 1) % len(xtr)
+            train_local[i] = (xtr[idx], ytr[idx])
     test_local = {i: (xte, yte) for i in range(client_num)}
     return FederatedDataset(
         train_data_num=len(xtr),

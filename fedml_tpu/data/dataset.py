@@ -66,6 +66,15 @@ def batch_epochs(
     rng = np.random.default_rng(seed)
     per_epoch = max(1, int(np.ceil(n / batch_size)))
     steps_per_epoch = pad_to_batches or per_epoch
+    if n == 0:
+        # empty client (tiny datasets / unlucky partition): fully padded,
+        # mask 0 everywhere → training step is a masked no-op
+        shape = (steps_per_epoch * epochs, batch_size)
+        return (
+            np.zeros((*shape, *x.shape[1:]), dtype=x.dtype),
+            np.zeros((*shape, *y.shape[1:]), dtype=y.dtype),
+            np.zeros(shape, dtype=np.float32),
+        )
     xs, ys, ms = [], [], []
     for _ in range(epochs):
         order = rng.permutation(n)

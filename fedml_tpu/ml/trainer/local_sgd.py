@@ -86,10 +86,23 @@ def build_local_trainer(
     args: Any,
     loss_builder: Callable = softmax_ce_loss,
 ) -> Callable:
-    """Compile the full local-training program for one client shape.
+    """Compile the full local-training program for one client shape."""
+    return jax.jit(build_local_fn(apply_fn, args, loss_builder))
 
-    Returns run_local(params, state: LocalState, xs, ys, mask)
+
+def build_local_fn(
+    apply_fn: Callable,
+    args: Any,
+    loss_builder: Callable = softmax_ce_loss,
+) -> Callable:
+    """The *un-jitted* local-training program.
+
+    run_local(params, state: LocalState, xs, ys, mask)
       -> (new_params, new_state, metrics dict)
+
+    Exposed un-jitted so the mesh simulator can ``vmap`` it over a
+    client axis and ``shard_map`` the result over devices — the whole
+    round (N clients' local SGD + FedAvg psum) becomes ONE XLA program.
     """
     fed_opt = str(getattr(args, "federated_optimizer", "FedAvg"))
     mu = float(getattr(args, "fedprox_mu", 0.1))
@@ -118,7 +131,6 @@ def build_local_trainer(
             loss = loss - lin + quad
         return loss, aux
 
-    @jax.jit
     def run_local(params, state: LocalState, xs, ys, mask):
         opt_state = tx.init(params)
 

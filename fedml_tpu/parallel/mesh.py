@@ -1,0 +1,56 @@
+"""Mesh construction helpers — the framework's sharding vocabulary.
+
+Axes used across the framework (SURVEY §2.10 mapping):
+  clients — FL parallelism (one device trains a batch of clients)
+  data    — data parallel inside a silo (replaces torch DDP)
+  fsdp    — parameter sharding (replaces DeepSpeed ZeRO-3)
+  tensor  — tensor parallelism (LLM path)
+  seq     — sequence/context parallelism (ring attention)
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def make_mesh(
+    shape: Sequence[int], axis_names: Sequence[str], devices=None
+) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n > devices.size:
+        raise ValueError(f"mesh shape {tuple(shape)} needs {n} devices, have {devices.size}")
+    return Mesh(devices[:n].reshape(shape), axis_names=tuple(axis_names))
+
+
+def clients_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices() if n is None else jax.devices()[:n]
+    return make_mesh((len(devs),), ("clients",), devs)
+
+
+def silo_data_mesh(n_proc: int) -> Mesh:
+    return make_mesh((n_proc,), ("data",), jax.devices()[:n_proc])
+
+
+def llm_mesh(
+    n_devices: Optional[int] = None,
+    fsdp: Optional[int] = None,
+    tensor: int = 1,
+    seq: int = 1,
+) -> Mesh:
+    """FSDP×TP(×SP) mesh for the LLM path (replaces DeepSpeed ZeRO-3)."""
+    total = n_devices or jax.device_count()
+    fsdp = fsdp or max(1, total // (tensor * seq))
+    return make_mesh((fsdp, tensor, seq), ("fsdp", "tensor", "seq"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_leading(mesh: Mesh, axis: str) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,21 @@
+"""Round client sampling shared by every simulation backend.
+
+Parity: ``simulation/sp/fedavg/fedavg_api.py:128-141`` (_client_sampling).
+One implementation so sp/mesh (and any future backend) stay bit-identical —
+the mesh==sp parity test relies on both backends drawing the same client
+sets for a given (round, seed).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+
+def sample_clients(args: Any, round_idx: int) -> List[int]:
+    total = int(args.client_num_in_total)
+    per_round = min(int(args.client_num_per_round), total)
+    if total == per_round:
+        return list(range(total))
+    rng = np.random.default_rng(round_idx + int(getattr(args, "random_seed", 0)))
+    return sorted(rng.choice(total, per_round, replace=False).tolist())

@@ -26,6 +26,7 @@ from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
 from fedml_tpu.ml.aggregator.server_optimizer import ServerOptimizer
 from fedml_tpu.ml.trainer.trainer_creator import create_model_trainer
 from fedml_tpu.models import model_hub
+from fedml_tpu.simulation.sampling import sample_clients
 from fedml_tpu.utils.tree import tree_add, tree_scale, tree_stack, weighted_tree_sum
 
 Pytree = Any
@@ -63,12 +64,7 @@ class FedAvgAPI:
 
     # -- client sampling (parity: fedavg_api.py:128-141) ------------------
     def _client_sampling(self, round_idx: int) -> List[int]:
-        total = int(self.args.client_num_in_total)
-        per_round = min(int(self.args.client_num_per_round), total)
-        if total == per_round:
-            return list(range(total))
-        rng = np.random.default_rng(round_idx + int(getattr(self.args, "random_seed", 0)))
-        return sorted(rng.choice(total, per_round, replace=False).tolist())
+        return sample_clients(self.args, round_idx)
 
     # -- round ------------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:

@@ -1,0 +1,33 @@
+"""Pytree (de)serialization at the transport boundary.
+
+Model payloads stay on device as JAX arrays until a transport needs bytes;
+then leaves are pulled to host numpy and packed. Format: a small header
+(treedef repr via pickle of the numpy-leaved pytree). The reference ships
+state dicts with torch.save/pickle over S3 (``communication/s3/remote_storage.py``);
+we keep the same contract with numpy.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+def tree_to_bytes(tree: Pytree) -> bytes:
+    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    buf = io.BytesIO()
+    pickle.dump(host_tree, buf, protocol=4)
+    return buf.getvalue()
+
+
+def tree_from_bytes(data: bytes) -> Pytree:
+    return pickle.loads(data)
+
+
+def tree_nbytes(tree: Pytree) -> int:
+    return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree))

@@ -3,12 +3,23 @@ paths (mesh simulator, xla_ici backend, FSDP/TP shardings) are exercised
 without TPU hardware — per the driver's dryrun contract."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read when the CPU client is first created, so setting it
+# here (before any backend init) is effective even though jax may already
+# be imported by a sitecustomize hook.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The env may pin JAX_PLATFORMS to a hardware plugin AND import jax at
+# interpreter start (sitecustomize), in which case the env var above is
+# already baked into jax's config — force it through the config API too,
+# which works post-import as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
