@@ -30,6 +30,16 @@ class ClientTrainer(abc.ABC):
     def set_id(self, trainer_id: int) -> None:
         self.id = trainer_id
 
+    # engine-contract hooks (overridden where meaningful; no-ops otherwise)
+    def set_pad_to_batches(self, n) -> None:
+        """Share one compiled shape across heterogeneous clients."""
+
+    def set_round(self, round_idx: int) -> None:
+        """Give the trainer the round index (per-round data shuffling)."""
+
+    def set_data_sharding(self, sharding) -> None:
+        """In-silo parallelism: shard local batches over a silo mesh."""
+
     # ---- parameter plumbing (pytree, not state_dict) --------------------
     def get_model_params(self) -> Pytree:
         raise NotImplementedError(

@@ -1,8 +1,10 @@
 """gRPC federation transport.
 
 Parity: ``core/distributed/communication/grpc/grpc_comm_manager.py:30`` —
-one gRPC server per rank at base_port+rank, ip table from config, messages
-as pickled (control json + binary pytree payload). The proto contract
+one gRPC server per rank at base_port+rank, ip table from config. Unlike the
+reference (pickled payloads — arbitrary code execution on load), messages
+ride the pickle-free safe wire format (``utils/serialization.py``),
+so a hostile peer can at worst inject wrong numbers. The proto contract
 matches the reference's ``grpc_comm_manager.proto`` (a unary ``sendMessage``
 carrying opaque bytes); we register the service generically so no codegen
 step is needed.
@@ -10,7 +12,6 @@ step is needed.
 from __future__ import annotations
 
 import logging
-import pickle
 import queue
 import threading
 from concurrent import futures
@@ -62,8 +63,10 @@ class GRPCCommManager(BaseCommunicationManager):
 
         inbox = self._inbox
 
+        from fedml_tpu.utils.serialization import safe_loads
+
         def handler(request: bytes, context) -> bytes:
-            inbox.put(pickle.loads(request))
+            inbox.put(Message.construct_from_params(safe_loads(request)))
             return b"ok"
 
         rpc = grpc.unary_unary_rpc_method_handler(
@@ -103,7 +106,9 @@ class GRPCCommManager(BaseCommunicationManager):
         )
 
     def send_message(self, msg: Message) -> None:
-        payload = pickle.dumps(msg, protocol=4)
+        from fedml_tpu.utils.serialization import safe_dumps
+
+        payload = safe_dumps(msg.get_params())
         self._stub(msg.get_receiver_id())(payload, wait_for_ready=True, timeout=120)
 
     def add_observer(self, observer: Observer) -> None:

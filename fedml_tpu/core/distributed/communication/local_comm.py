@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import queue
 import threading
-from collections import defaultdict
 from typing import Dict, List, Optional
 
 from fedml_tpu.core.distributed.communication.base_com_manager import (
@@ -28,7 +27,10 @@ class LocalBroker:
     _lock = threading.Lock()
 
     def __init__(self):
-        self.inboxes: Dict[int, "queue.Queue[Optional[Message]]"] = defaultdict(queue.Queue)
+        # NOT a defaultdict: first access races between sender and receiver
+        # threads, and two concurrent __missing__ calls would orphan a Queue
+        self.inboxes: Dict[int, "queue.Queue[Optional[Message]]"] = {}
+        self._inbox_lock = threading.Lock()
 
     @classmethod
     def get(cls, run_id: str) -> "LocalBroker":
@@ -42,8 +44,16 @@ class LocalBroker:
         with cls._lock:
             cls._instances.pop(run_id, None)
 
+    def inbox(self, rank: int) -> "queue.Queue[Optional[Message]]":
+        with self._inbox_lock:
+            q = self.inboxes.get(rank)
+            if q is None:
+                q = queue.Queue()
+                self.inboxes[rank] = q
+            return q
+
     def post(self, receiver_id: int, msg: Optional[Message]) -> None:
-        self.inboxes[receiver_id].put(msg)
+        self.inbox(receiver_id).put(msg)
 
 
 class LocalCommManager(BaseCommunicationManager):
@@ -66,7 +76,7 @@ class LocalCommManager(BaseCommunicationManager):
 
     def handle_receive_message(self) -> None:
         self._running = True
-        inbox = self.broker.inboxes[self.rank]
+        inbox = self.broker.inbox(self.rank)
         while self._running:
             try:
                 msg = inbox.get(timeout=0.2)
@@ -79,7 +89,7 @@ class LocalCommManager(BaseCommunicationManager):
 
     def pump(self, max_messages: int = 0) -> int:
         """Cooperative drain (no thread): deliver pending messages now."""
-        inbox = self.broker.inboxes[self.rank]
+        inbox = self.broker.inbox(self.rank)
         n = 0
         while not inbox.empty() and (max_messages == 0 or n < max_messages):
             msg = inbox.get_nowait()

@@ -48,12 +48,25 @@ class TrainerDistAdapter:
         )
         n_proc = int(getattr(args, "n_proc_in_silo", 1))
         if n_proc > 1:
-            logger.info(
-                "hierarchical silo: sharding local batch over %d devices", n_proc
-            )
+            batch = int(getattr(args, "batch_size", 32))
+            if batch % n_proc != 0:
+                raise ValueError(
+                    f"batch_size={batch} must be divisible by n_proc_in_silo={n_proc}"
+                )
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
             from fedml_tpu.parallel.mesh import silo_data_mesh
 
             self.silo_mesh = silo_data_mesh(n_proc)
+            # [steps, batch, ...]: shard the batch dim over the silo's
+            # data axis; XLA adds the gradient all-reduce over ICI
+            self.trainer.set_data_sharding(
+                NamedSharding(self.silo_mesh, P(None, "data"))
+            )
+            logger.info(
+                "hierarchical silo: sharding local batch over %d devices", n_proc
+            )
         else:
             self.silo_mesh = None
 

@@ -77,4 +77,15 @@ def run_cross_silo_inproc(
     mgr, err = first_error()
     if err is not None:
         raise RuntimeError(f"rank {mgr.rank} message handler failed: {err!r}") from err
+    if any(t.is_alive() for t in threads):
+        # deadline hit with the federation still running: shut it down and
+        # fail loudly — a silent None would masquerade as a finished run
+        for m in managers:
+            m.finish()
+        for t in threads:
+            t.join(timeout=5.0)
+        raise TimeoutError(
+            f"cross-silo run did not finish within {timeout}s "
+            f"(alive: {[t.name for t in threads if t.is_alive()]})"
+        )
     return server.manager.result

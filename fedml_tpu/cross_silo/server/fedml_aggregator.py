@@ -88,25 +88,26 @@ class FedMLAggregator:
         self.sample_num_dict.clear()
         return self.global_params
 
-    # -- selection (parity: fedml_aggregator.py:96-140) --------------------
+    # -- selection (parity: fedml_aggregator.py:96-140); routed through the
+    # shared sampler so every backend draws bit-identical selections
     def data_silo_selection(
         self, round_idx: int, client_num_in_total: int, client_num_per_round: int
     ) -> List[int]:
-        if client_num_in_total == client_num_per_round:
-            return list(range(client_num_in_total))
-        rng = np.random.default_rng(round_idx + int(getattr(self.args, "random_seed", 0)))
-        return sorted(
-            rng.choice(client_num_in_total, client_num_per_round, replace=False).tolist()
+        from fedml_tpu.simulation.sampling import sample_from_list
+
+        return sample_from_list(
+            list(range(client_num_in_total)), client_num_per_round, round_idx,
+            int(getattr(self.args, "random_seed", 0)),
         )
 
     def client_selection(
         self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int
     ) -> List[int]:
-        if client_num_per_round >= len(client_id_list_in_total):
-            return list(client_id_list_in_total)
-        rng = np.random.default_rng(round_idx + int(getattr(self.args, "random_seed", 0)))
-        return sorted(
-            rng.choice(client_id_list_in_total, client_num_per_round, replace=False).tolist()
+        from fedml_tpu.simulation.sampling import sample_from_list
+
+        return sample_from_list(
+            list(client_id_list_in_total), client_num_per_round, round_idx,
+            int(getattr(self.args, "random_seed", 0)),
         )
 
     def test_on_server_for_all_clients(self, round_idx: int) -> dict:

@@ -31,6 +31,7 @@ class ClassificationTrainer(ClientTrainer):
         self._evaluate = build_evaluator(self.apply_fn)
         self._pad_to_batches: Optional[int] = None
         self._round_seed = 0
+        self._data_sharding = None
 
     def set_pad_to_batches(self, n: Optional[int]) -> None:
         """Share one compiled shape across heterogeneous clients."""
@@ -38,6 +39,12 @@ class ClassificationTrainer(ClientTrainer):
 
     def set_round(self, round_idx: int) -> None:
         self._round_seed = round_idx
+
+    def set_data_sharding(self, sharding) -> None:
+        """Shard [steps, batch, ...] arrays over the silo's data axis; the
+        jitted local step follows the input sharding, so XLA inserts the
+        in-silo gradient all-reduce (the torch-DDP replacement)."""
+        self._data_sharding = sharding
 
     def train(
         self, params: Pytree, train_data: Tuple[np.ndarray, np.ndarray], device, args
@@ -54,8 +61,15 @@ class ClassificationTrainer(ClientTrainer):
             + self._round_seed,
             pad_to_batches=self._pad_to_batches,
         )
+        xs, ys, mask = jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask)
+        if self._data_sharding is not None:
+            import jax as _jax
+
+            xs, ys, mask = (
+                _jax.device_put(a, self._data_sharding) for a in (xs, ys, mask)
+            )
         new_params, new_state, metrics = self._run_local(
-            params, state, jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask)
+            params, state, xs, ys, mask
         )
         metrics = {k: float(v) for k, v in metrics.items()}
         metrics["scaffold_c_delta"] = None

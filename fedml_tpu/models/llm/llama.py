@@ -1,0 +1,392 @@
+"""Llama-family causal LM — the flagship model of the LLM path.
+
+Parity target: the reference fine-tunes HF Llama/GPT-NeoX checkpoints via
+``train/llm`` (``configurations.py:140`` ModelArguments, flash-attn patch
+``models/attention.py:30``). Here the architecture is implemented natively
+in flax so the whole forward/backward is one XLA program:
+
+- RMSNorm, rotary position embeddings, grouped-query attention, SwiGLU MLP
+  (Llama-2/3 architecture);
+- attention runs through the framework's Pallas flash kernel on TPU
+  (``fedml_tpu/ops/flash_attention.py``) and plain XLA elsewhere;
+- optional LoRA adapters on the attention projections (the federated LLM
+  path exchanges *only* these — reference ``configurations.py:291``
+  ``get_peft_config`` / ``peft_utils.py``);
+- weights are stored with named axes that match the FSDP×TP partition
+  rules in ``fedml_tpu/train/llm/sharding.py``.
+
+Compute dtype is bf16 by default (MXU-native); params stay fp32 masters.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    # LoRA (0 = disabled)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    # training knobs
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    # -- presets ---------------------------------------------------------
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=32, **kw,
+        )
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+            num_hidden_layers=40, num_attention_heads=40,
+            num_key_value_heads=40, **kw,
+        )
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, rope_theta=500000.0, **kw,
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Unit-test / dry-run scale (runs on CPU in milliseconds)."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("remat", False)
+        return LlamaConfig(**kw)
+
+    PRESETS = ("tiny", "llama2_7b", "llama2_13b", "llama3_8b")
+
+    @staticmethod
+    def from_args(args: Any, vocab_size: Optional[int] = None) -> "LlamaConfig":
+        preset = str(
+            getattr(args, "model_size", None)
+            or getattr(args, "model_name", "tiny")
+        ).lower().replace("-", "_")
+        kw = {}
+        for field in ("lora_rank", "lora_alpha", "max_position_embeddings",
+                      "num_hidden_layers", "hidden_size"):
+            if getattr(args, field, None) is not None:
+                kw[field] = type(LlamaConfig.__dataclass_fields__[field].default)(
+                    getattr(args, field)
+                )
+        if getattr(args, "use_flash_attention", None) is not None:
+            kw["use_flash"] = bool(args.use_flash_attention)
+        builder = {
+            "tiny": LlamaConfig.tiny,
+            "llama2_7b": LlamaConfig.llama2_7b,
+            "7b": LlamaConfig.llama2_7b,
+            "llama2_13b": LlamaConfig.llama2_13b,
+            "13b": LlamaConfig.llama2_13b,
+            "llama3_8b": LlamaConfig.llama3_8b,
+            "8b": LlamaConfig.llama3_8b,
+        }.get(preset, LlamaConfig.tiny)
+        # build the preset bare, then overlay user overrides — presets pass
+        # their architecture fields explicitly, so builder(**kw) would raise
+        # 'multiple values' for overlapping keys
+        cfg = builder()
+        if kw:
+            cfg = dataclasses.replace(cfg, **kw)
+        if vocab_size is not None and preset == "tiny":
+            cfg = dataclasses.replace(cfg, vocab_size=max(vocab_size, 32))
+        return cfg
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables for rotary embeddings; positions [B, T] or [T]."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., T, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array):
+    """x: [B, H, T, D]; cos/sin: [B, T, D/2] or [T, D/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    if cos.ndim == 2:
+        cos, sin = cos[None, None], sin[None, None]
+    else:
+        cos, sin = cos[:, None], sin[:, None]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+class LoRADense(nn.Module):
+    """Dense with optional additive low-rank adapter: y = xW + (x A) B * s.
+
+    The base kernel is a normal flax param (frozen by the LLM optimizer
+    mask); ``lora_a/lora_b`` live under the same params tree with a
+    ``lora_`` name prefix, which is what the trainable/exchange filters key
+    on (``fedml_tpu/train/llm/federated.py``).
+    """
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    dtype: Any = jnp.bfloat16
+    kernel_axes: Tuple[str, ...] = ()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), self.kernel_axes
+            ),
+            (x.shape[-1], self.features),
+            jnp.float32,
+        )
+        y = x @ kernel.astype(self.dtype)
+        if self.rank > 0:
+            a = self.param(
+                "lora_a",
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(),
+                    (self.kernel_axes[0] if self.kernel_axes else None, None),
+                ),
+                (x.shape[-1], self.rank),
+                jnp.float32,
+            )
+            b = self.param(
+                "lora_b",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros,
+                    (None, self.kernel_axes[1] if len(self.kernel_axes) > 1 else None),
+                ),
+                (self.rank, self.features),
+                jnp.float32,
+            )
+            scaling = self.alpha / self.rank
+            y = y + (x @ a.astype(self.dtype)) @ b.astype(self.dtype) * scaling
+        return y
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, kv_cache=None, attention_fn=None):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        h, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        dense = lambda feats, name, axes: LoRADense(
+            feats, rank=cfg.lora_rank, alpha=cfg.lora_alpha, dtype=cfg.dtype,
+            kernel_axes=axes, name=name,
+        )
+        q = dense(h * d, "q_proj", ("embed", "heads"))(x)
+        k = dense(hkv * d, "k_proj", ("embed", "heads"))(x)
+        v = dense(hkv * d, "v_proj", ("embed", "heads"))(x)
+        q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, hkv, d).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, hkv, d).transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        new_cache = None
+        if kv_cache is not None:
+            # decode: append to cache, attend over full prefix
+            ck, cv, cache_len = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, cache_len, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv, cache_len + t)
+            s_len = ck.shape[2]
+            group = h // hkv
+            kk = jnp.repeat(k, group, axis=1)
+            vv = jnp.repeat(v, group, axis=1)
+            scale = d ** -0.5
+            logits = jnp.einsum(
+                "bhtd,bhsd->bhts", q.astype(jnp.float32), kk.astype(jnp.float32)
+            ) * scale
+            pos = cache_len + jnp.arange(t)[:, None]
+            mask = jnp.arange(s_len)[None, :] <= pos  # causal over the prefix
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhts,bhsd->bhtd", probs, vv.astype(jnp.float32))
+            out = out.astype(cfg.dtype)
+        else:
+            if attention_fn is not None:
+                out = attention_fn(q, k, v)
+            elif cfg.use_flash:
+                from fedml_tpu.ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                from fedml_tpu.ops.flash_attention import reference_attention
+
+                out = reference_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+        out = dense(cfg.hidden_size, "o_proj", ("heads", "embed"))(out)
+        return out, new_cache
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name, axes: LoRADense(
+            feats, rank=0, dtype=cfg.dtype, kernel_axes=axes, name=name
+        )
+        gate = dense(cfg.intermediate_size, "gate_proj", ("embed", "mlp"))(x)
+        up = dense(cfg.intermediate_size, "up_proj", ("embed", "mlp"))(x)
+        return dense(cfg.hidden_size, "down_proj", ("mlp", "embed"))(
+            nn.silu(gate) * up
+        )
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, kv_cache=None, attention_fn=None):
+        cfg = self.cfg
+        # pin the residual stream to (batch, seq, embed) so SPMD never
+        # round-trips activations through a tp-sharded layout in the bwd pass
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        attn_out, new_cache = LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_norm")(x),
+            cos, sin, kv_cache, attention_fn,
+        )
+        x = x + attn_out
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        x = x + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attn_norm")(x)
+        )
+        return x, new_cache
+
+
+class LlamaForCausalLM(nn.Module):
+    """Token ids [B, T] → logits [B, T, V].
+
+    ``__call__(tokens)`` is the training forward; ``decode_step`` threads an
+    explicit KV cache for serving (``fedml_tpu/serving``).
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, kv_caches=None, attention_fn=None):
+        cfg = self.cfg
+        emb = self.param(
+            "embed_tokens",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = emb.astype(cfg.dtype)[tokens]
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+        block = LlamaBlock
+        if cfg.remat and kv_caches is None:
+            block = nn.remat(LlamaBlock, static_argnums=(5,))
+        new_caches = []
+        for i in range(cfg.num_hidden_layers):
+            cache_i = kv_caches[i] if kv_caches is not None else None
+            x, new_cache = block(cfg, name=f"layer_{i}")(
+                x, cos, sin, cache_i, attention_fn
+            )
+            new_caches.append(new_cache)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = x @ emb.astype(cfg.dtype).T
+        else:
+            head = self.param(
+                "lm_head",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("embed", "vocab")
+                ),
+                (cfg.hidden_size, cfg.vocab_size),
+                jnp.float32,
+            )
+            logits = x @ head.astype(cfg.dtype)
+        logits = logits.astype(jnp.float32)
+        if kv_caches is not None:
+            return logits, new_caches
+        return logits
+
+    # -- serving helpers --------------------------------------------------
+    def init_kv_caches(self, batch: int, max_len: int):
+        cfg = self.cfg
+        shape = (batch, cfg.num_key_value_heads, max_len, cfg.head_dim)
+        return [
+            (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype), 0)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+
+def causal_lm_loss(apply_fn):
+    """Next-token CE over a [B, T] token batch; mask is [B] sample validity.
+
+    Matches the trainer contract in ``ml/trainer/local_sgd.py`` so the LLM
+    drops into every federated engine unchanged.
+    """
+    import optax
+
+    def loss_fn(params, x, y, mask):
+        logits = apply_fn(params, x)  # y: next tokens [B, T]
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        valid = (y >= 0).astype(jnp.float32) * mask[:, None]
+        total = jnp.sum(ce * valid)
+        denom = jnp.maximum(jnp.sum(valid), 1.0)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = jnp.sum((pred == y).astype(jnp.float32) * valid)
+        return total / denom, (correct, denom)
+
+    return loss_fn

@@ -4,8 +4,8 @@ Axes used across the framework (SURVEY §2.10 mapping):
   clients — FL parallelism (one device trains a batch of clients)
   data    — data parallel inside a silo (replaces torch DDP)
   fsdp    — parameter sharding (replaces DeepSpeed ZeRO-3)
-  tensor  — tensor parallelism (LLM path)
-  seq     — sequence/context parallelism (ring attention)
+  tp      — tensor parallelism (LLM path)
+  sp      — sequence/context parallelism (ring attention)
 """
 from __future__ import annotations
 
@@ -38,14 +38,18 @@ def silo_data_mesh(n_proc: int) -> Mesh:
 
 def llm_mesh(
     n_devices: Optional[int] = None,
+    dp: int = 1,
     fsdp: Optional[int] = None,
-    tensor: int = 1,
-    seq: int = 1,
+    tp: int = 1,
+    sp: int = 1,
 ) -> Mesh:
-    """FSDP×TP(×SP) mesh for the LLM path (replaces DeepSpeed ZeRO-3)."""
-    total = n_devices or jax.device_count()
-    fsdp = fsdp or max(1, total // (tensor * seq))
-    return make_mesh((fsdp, tensor, seq), ("fsdp", "tensor", "seq"))
+    """The LLM-path mesh — delegates to ``train.llm.sharding.make_mesh`` so
+    the axis names always match LOGICAL_RULES ((dp, fsdp, tp, sp))."""
+    from fedml_tpu.train.llm.sharding import make_mesh as llm_make_mesh
+
+    devices = jax.devices()[: n_devices] if n_devices else None
+    return llm_make_mesh(dp=dp, fsdp=-1 if fsdp is None else fsdp, tp=tp,
+                         sp=sp, devices=devices)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

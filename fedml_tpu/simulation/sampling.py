@@ -12,10 +12,21 @@ from typing import Any, List
 import numpy as np
 
 
+def sample_from_list(
+    ids: List[int], per_round: int, round_idx: int, seed: int
+) -> List[int]:
+    """THE seeded client draw — every backend (sp/mesh/cross-silo) routes
+    through here so selections stay bit-identical across engines."""
+    if per_round >= len(ids):
+        return list(ids)
+    rng = np.random.default_rng(round_idx + seed)
+    return sorted(rng.choice(ids, per_round, replace=False).tolist())
+
+
 def sample_clients(args: Any, round_idx: int) -> List[int]:
     total = int(args.client_num_in_total)
     per_round = min(int(args.client_num_per_round), total)
-    if total == per_round:
-        return list(range(total))
-    rng = np.random.default_rng(round_idx + int(getattr(args, "random_seed", 0)))
-    return sorted(rng.choice(total, per_round, replace=False).tolist())
+    return sample_from_list(
+        list(range(total)), per_round, round_idx,
+        int(getattr(args, "random_seed", 0)),
+    )

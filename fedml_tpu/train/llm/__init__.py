@@ -1,0 +1,17 @@
+"""LLM fine-tuning kit (UnitedLLM-equivalent): sharded trainer, LoRA,
+federated binding. Parity: reference ``python/fedml/train/llm/``."""
+from fedml_tpu.train.llm.configurations import (  # noqa: F401
+    DatasetArguments,
+    ExperimentArguments,
+    ModelArguments,
+)
+from fedml_tpu.train.llm.sharding import (  # noqa: F401
+    LOGICAL_RULES,
+    make_mesh,
+    mesh_from_args,
+)
+from fedml_tpu.train.llm.trainer import (  # noqa: F401
+    LLMTrainer,
+    extract_lora,
+    merge_lora,
+)

@@ -1,0 +1,229 @@
+"""Sharded LLM trainer — HF-Trainer/DeepSpeed replaced by one jitted step.
+
+Parity target: ``train/llm/hf_trainer.py:28`` (HFTrainer w/ checkpointing)
++ ``train/llm/distributed.py`` (ZeRO-3 helpers). TPU-native design:
+
+- ONE compiled train step: grad-accumulation microbatches under
+  ``lax.scan``, loss/grad in bf16 compute with fp32 masters, optimizer
+  update — all inside the same XLA program, sharded over the
+  (dp, fsdp, tp, sp) mesh from ``sharding.py``;
+- LoRA fine-tuning freezes the base weights with an ``optax.multi_transform``
+  (set_to_zero branch) (reference: peft adapters, ``configurations.py:291``);
+- round-level checkpointing via orbax (SURVEY §5 flags this as an
+  improvement over the reference, which has no FL-engine checkpointing).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+from fedml_tpu.train.llm.sharding import (
+    batch_sharding,
+    init_sharded_params,
+    mesh_from_args,
+    replicated,
+)
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+def is_lora_path(path: Tuple) -> bool:
+    return any("lora" in str(getattr(p, "key", p)) for p in path)
+
+
+def lora_mask(params: Pytree) -> Pytree:
+    """True where trainable (LoRA leaves), False for frozen base weights."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: is_lora_path(path), params
+    )
+
+
+def _path_str(path: Tuple) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def extract_lora(params: Pytree) -> dict:
+    """The exchangeable state: a flat {key-path: leaf} dict of LoRA leaves.
+
+    A flat dict (not a pruned pytree) so it serializes directly onto the
+    federation transport — parity with the reference shipping peft adapter
+    state dicts (``spotlight_prj/fedllm/run_fedllm.py:171-244``).
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {_path_str(p): v for p, v in flat if is_lora_path(p)}
+
+
+def merge_lora(params: Pytree, lora: dict) -> Pytree:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, base: lora.get(_path_str(path), base), params
+    )
+
+
+class LLMTrainer:
+    """Compiled causal-LM fine-tuning over a named mesh."""
+
+    def __init__(self, cfg: LlamaConfig, args: Any, mesh=None):
+        self.cfg = cfg
+        self.args = args
+        self.model = LlamaForCausalLM(cfg)
+        self.mesh = mesh if mesh is not None else mesh_from_args(args)
+        self.seq_len = int(getattr(args, "max_seq_length", 512))
+        self.batch_size = int(getattr(args, "per_device_batch_size",
+                                      getattr(args, "batch_size", 8)))
+        self.accum = int(getattr(args, "gradient_accumulation_steps", 1))
+        self.lora_only = cfg.lora_rank > 0
+
+        lr = float(getattr(args, "learning_rate", 1e-4))
+        wd = float(getattr(args, "weight_decay", 0.0))
+        warmup = int(getattr(args, "warmup_steps", 0))
+        max_steps = int(getattr(args, "max_steps", 1000))
+        if warmup > 0:
+            sched = optax.warmup_cosine_decay_schedule(
+                0.0, lr, warmup, max(max_steps, warmup + 1)
+            )
+        else:
+            sched = lr
+        base_tx = optax.chain(
+            optax.clip_by_global_norm(float(getattr(args, "max_grad_norm", 1.0))),
+            optax.adamw(sched, weight_decay=wd),
+        )
+        if self.lora_only:
+            # frozen base weights get set_to_zero (optax.masked would pass
+            # their raw gradients through as updates)
+            labels = lambda params: jax.tree_util.tree_map_with_path(
+                lambda path, _: "train" if is_lora_path(path) else "freeze", params
+            )
+            self.tx = optax.multi_transform(
+                {"train": base_tx, "freeze": optax.set_to_zero()}, labels
+            )
+        else:
+            self.tx = base_tx
+
+        import flax.linen as nn
+
+        from fedml_tpu.train.llm.sharding import LOGICAL_RULES
+
+        def apply_fn(p, x):
+            # activation constraints inside the model resolve against these
+            # logical→mesh rules (otherwise they are silent no-ops)
+            with nn.logical_axis_rules(LOGICAL_RULES):
+                return self.model.apply(p, x)
+
+        self._loss_fn = causal_lm_loss(apply_fn)
+        self._train_step = None  # compiled lazily once shardings exist
+        self.params = None
+        self.opt_state = None
+        self._step = 0
+
+    # -- init -------------------------------------------------------------
+    def init(self, seed: int = 0):
+        sample = jnp.zeros((self.batch_size, self.seq_len), jnp.int32)
+        self.params, self.shardings = init_sharded_params(
+            self.model, sample, self.mesh, seed=seed
+        )
+        self.opt_state = jax.jit(self.tx.init)(self.params)
+        self._compile()
+        return self.params
+
+    def _compile(self):
+        loss_fn = self._loss_fn
+        tx = self.tx
+
+        def train_step(params, opt_state, xs, ys, mask):
+            """xs/ys: [n_micro, B, T]; mask: [n_micro, B]."""
+            n_micro = xs.shape[0]  # static at trace time
+
+            def micro(carry, batch):
+                grads_acc, loss_acc = carry
+                x, y, m = batch
+                (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, x, y, m
+                )
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                return (grads_acc, loss_acc + loss), None
+
+            zero = jax.tree.map(jnp.zeros_like, params)
+            (grads, loss_sum), _ = jax.lax.scan(micro, (zero, 0.0), (xs, ys, mask))
+            grads = jax.tree.map(lambda g: g / n_micro, grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss_sum / n_micro
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        # inputs are [accum, B, ...]: the *batch* dim rides (dp, fsdp)
+        micro_spec = NamedSharding(self.mesh, P(None, ("dp", "fsdp")))
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(self.shardings, None, micro_spec, micro_spec, micro_spec),
+            out_shardings=(self.shardings, None, replicated(self.mesh)),
+            donate_argnums=(0, 1),
+        )
+
+        def eval_step(params, x, y, m):
+            loss, (correct, denom) = loss_fn(params, x, y, m)
+            return loss, correct, denom
+
+        eval_spec = batch_sharding(self.mesh)
+        self._eval_step = jax.jit(
+            eval_step,
+            in_shardings=(self.shardings, eval_spec, eval_spec, eval_spec),
+        )
+
+    # -- stepping ---------------------------------------------------------
+    def step(self, xs, ys, mask) -> float:
+        """One optimizer step over [accum, B, T] token microbatches."""
+        if xs.ndim == 2:  # single microbatch convenience
+            xs, ys = xs[None], ys[None]
+            mask = mask[None]
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+            jnp.asarray(mask, jnp.float32),
+        )
+        self._step += 1
+        return float(loss)
+
+    def evaluate(self, x, y) -> dict:
+        m = jnp.ones((x.shape[0],), jnp.float32)
+        loss, correct, denom = self._eval_step(
+            self.params, jnp.asarray(x), jnp.asarray(y), m
+        )
+        return {
+            "eval_loss": float(loss),
+            "eval_acc": float(correct) / max(float(denom), 1.0),
+        }
+
+    # -- checkpointing (orbax) -------------------------------------------
+    def save_checkpoint(self, ckpt_dir: str, round_idx: int):
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(os.path.join(ckpt_dir, f"round_{round_idx}"))
+        ckptr = ocp.StandardCheckpointer()
+        payload = extract_lora(self.params) if self.lora_only else self.params
+        ckptr.save(path, payload, force=True)
+        ckptr.wait_until_finished()
+        logger.info("saved %s checkpoint → %s", "LoRA" if self.lora_only else "full", path)
+        return path
+
+    def load_checkpoint(self, path: str):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        if self.lora_only:
+            template = jax.tree.map(np.asarray, extract_lora(self.params))
+            restored = ckptr.restore(os.path.abspath(path), template)
+            self.params = merge_lora(self.params, restored)
+        else:
+            template = jax.tree.map(np.asarray, self.params)
+            self.params = ckptr.restore(os.path.abspath(path), template)
+        return self.params

@@ -1,32 +1,108 @@
-"""Pytree (de)serialization at the transport boundary.
+"""Pytree (de)serialization at the transport boundary — pickle-free.
 
 Model payloads stay on device as JAX arrays until a transport needs bytes;
-then leaves are pulled to host numpy and packed. Format: a small header
-(treedef repr via pickle of the numpy-leaved pytree). The reference ships
-state dicts with torch.save/pickle over S3 (``communication/s3/remote_storage.py``);
-we keep the same contract with numpy.
+then leaves are pulled to host numpy and packed. The reference ships state
+dicts with torch.save/pickle over S3 (``communication/s3/remote_storage.py``)
+— a design that executes attacker-controlled bytecode on load. Here the
+wire format is deliberately dumb: a JSON skeleton (dicts/lists/tuples/
+scalars with array placeholders) plus concatenated raw ``.npy`` blobs read
+back with ``allow_pickle=False``, so deserializing a hostile payload can at
+worst produce wrong numbers, never code execution.
+
+Format:  [4-byte header length][header JSON][npy blob]*
+         header = {"skeleton": ..., "arrays": [nbytes, ...]}
 """
 from __future__ import annotations
 
 import io
-import pickle
-from typing import Any
+import json
+import struct
+from typing import Any, List, Tuple
 
 import jax
 import numpy as np
 
 Pytree = Any
 
+_ARRAY = "__ndarray__"
+_TUPLE = "__tuple__"
+
+
+def _encode(obj: Any, blobs: List[bytes]) -> Any:
+    """Recursively JSON-ify; arrays become placeholders into ``blobs``."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (np.ndarray, jax.Array, np.generic)):
+        arr = np.asarray(jax.device_get(obj))
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        blobs.append(buf.getvalue())
+        return {_ARRAY: len(blobs) - 1}
+    if isinstance(obj, dict):
+        if any(not isinstance(k, str) for k in obj):
+            # JSON keys must be strings; tag-encode non-str keys losslessly
+            return {
+                _TUPLE: "dict_items",
+                "items": [
+                    [_encode(k, blobs), _encode(v, blobs)] for k, v in obj.items()
+                ],
+            }
+        return {k: _encode(v, blobs) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return {_TUPLE: "tuple", "items": [_encode(v, blobs) for v in obj]}
+    if isinstance(obj, list):
+        return [_encode(v, blobs) for v in obj]
+    raise TypeError(
+        f"safe serialization does not support {type(obj).__name__}; "
+        "transport payloads must be pytrees of arrays/scalars/str"
+    )
+
+
+def _decode(node: Any, blobs: List[np.ndarray]) -> Any:
+    if isinstance(node, dict):
+        if _ARRAY in node and len(node) == 1:
+            return blobs[int(node[_ARRAY])]
+        if node.get(_TUPLE) == "tuple":
+            return tuple(_decode(v, blobs) for v in node["items"])
+        if node.get(_TUPLE) == "dict_items":
+            return {
+                _decode(k, blobs): _decode(v, blobs) for k, v in node["items"]
+            }
+        return {k: _decode(v, blobs) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_decode(v, blobs) for v in node]
+    return node
+
+
+def safe_dumps(obj: Any) -> bytes:
+    blobs: List[bytes] = []
+    skeleton = _encode(obj, blobs)
+    header = json.dumps(
+        {"skeleton": skeleton, "arrays": [len(b) for b in blobs]}
+    ).encode()
+    return b"".join([struct.pack("<I", len(header)), header, *blobs])
+
+
+def safe_loads(data: bytes) -> Any:
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    header = json.loads(data[4 : 4 + hlen].decode())
+    offset = 4 + hlen
+    blobs: List[np.ndarray] = []
+    for nbytes in header["arrays"]:
+        buf = io.BytesIO(data[offset : offset + nbytes])
+        blobs.append(np.load(buf, allow_pickle=False))
+        offset += nbytes
+    return _decode(header["skeleton"], blobs)
+
+
+# -- pytree-payload convenience (kept API-compatible) -----------------------
 
 def tree_to_bytes(tree: Pytree) -> bytes:
-    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
-    buf = io.BytesIO()
-    pickle.dump(host_tree, buf, protocol=4)
-    return buf.getvalue()
+    return safe_dumps(tree)
 
 
 def tree_from_bytes(data: bytes) -> Pytree:
-    return pickle.loads(data)
+    return safe_loads(data)
 
 
 def tree_nbytes(tree: Pytree) -> int:
