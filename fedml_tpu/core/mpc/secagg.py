@@ -1,0 +1,191 @@
+"""Secure aggregation primitives (Bonawitz-style SecAgg).
+
+Parity target: ``core/mpc/secagg.py`` (395 LoC: BGW/Shamir share generation
+:164-212, additive shares :316, DH key agreement :329-343, PRG masks +
+model masking :83-163). TPU-era re-design:
+
+- shares/masks are vectorised int64 field vectors (one flat vector per
+  model, from ``finite.tree_to_finite``) instead of per-layer dict loops;
+- Shamir reconstruct reuses the LCC Lagrange kernel (C++ or numpy) —
+  reconstruction at 0 is interpolation to target point 0;
+- PRG masks come from ``numpy.random.Philox`` keyed by the DH-agreed
+  secret, so pairwise masks are reproducible on both endpoints without
+  shipping them.
+
+The protocol dance (round-trip messages) lives in
+``cross_silo/secagg``; this module is the math, unit-testable without any
+transport.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME
+from fedml_tpu.core.mpc.lcc import field_matmul, gen_lagrange_coeffs
+
+# small safe defaults for DH over GF(p) (toy-sized on purpose: transport
+# security is TLS's job; this keying only has to make masks unpredictable)
+DH_PRIME = DEFAULT_PRIME
+DH_GENERATOR = 7
+
+
+# -- Shamir secret sharing ---------------------------------------------------
+
+def shamir_share(secret: np.ndarray, n_shares: int, threshold: int,
+                 p: int = DEFAULT_PRIME, rng: np.random.Generator = None
+                 ) -> np.ndarray:
+    """Split ``secret`` [dim] into n shares, any ``threshold+1`` reconstruct.
+
+    Polynomial of degree ``threshold`` with the secret at x=0, evaluated at
+    x = 1..n (reference: ``BGW_encoding`` :164).
+    Returns [n_shares, dim].
+    """
+    rng = rng or np.random.default_rng()
+    secret = np.mod(np.asarray(secret, np.int64), p)
+    dim = secret.shape[0]
+    coeffs = np.concatenate(
+        [secret[None], rng.integers(0, p, size=(threshold, dim)).astype(np.int64)]
+    )  # [deg+1, dim]
+    xs = np.arange(1, n_shares + 1, dtype=np.int64)
+    # Vandermonde [n, deg+1] times coeffs mod p
+    V = np.ones((n_shares, threshold + 1), np.int64)
+    for k in range(1, threshold + 1):
+        V[:, k] = (V[:, k - 1] * xs) % p
+    return field_matmul(V, coeffs, p)
+
+
+def shamir_reconstruct(shares: np.ndarray, idxs: Sequence[int],
+                       p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Reconstruct the secret from shares at 1-based points ``idxs``.
+
+    Reference: ``BGW_decoding`` :192 — here it is one Lagrange
+    interpolation to x=0 through the shared LCC kernel.
+    """
+    pts = np.asarray(idxs, np.int64)
+    U = gen_lagrange_coeffs(pts, np.zeros(1, np.int64), p)  # [1, k]
+    return field_matmul(U, np.asarray(shares, np.int64), p)[0]
+
+
+# -- additive shares (reference: Gen_Additive_SS :316) -----------------------
+
+def additive_share(secret: np.ndarray, n_out: int, p: int = DEFAULT_PRIME,
+                   rng: np.random.Generator = None) -> np.ndarray:
+    rng = rng or np.random.default_rng()
+    secret = np.mod(np.asarray(secret, np.int64), p)
+    parts = rng.integers(0, p, size=(n_out - 1, secret.shape[0])).astype(np.int64)
+    last = np.mod(secret - parts.sum(axis=0), p)
+    return np.concatenate([parts, last[None]])
+
+
+# -- Diffie-Hellman keying (reference: my_pk_gen :329, my_key_agreement :337)
+
+def dh_keygen(rng: np.random.Generator, p: int = DH_PRIME,
+              g: int = DH_GENERATOR) -> Tuple[int, int]:
+    sk = int(rng.integers(2, p - 2))
+    return sk, pow(g, sk, p)
+
+
+def dh_agree(my_sk: int, their_pk: int, p: int = DH_PRIME) -> int:
+    return pow(int(their_pk), int(my_sk), p)
+
+
+# -- PRG masks ---------------------------------------------------------------
+
+def prg_mask(seed: int, dim: int, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Deterministic field vector from a shared seed (Philox counter PRG)."""
+    bits = np.random.Generator(np.random.Philox(key=seed & ((1 << 64) - 1)))
+    return bits.integers(0, p, size=dim).astype(np.int64)
+
+
+# -- the SecAgg math, endpoint by endpoint ----------------------------------
+
+class SecAggClient:
+    """Client-side state: pairwise + self masks over one round.
+
+    Masking (reference ``model_masking`` :83):
+        y_i = x_i + b_i + Σ_{j: i<j} s_ij − Σ_{j: j<i} s_ij   (mod p)
+    where s_ij = PRG(DH(i,j)) cancels pairwise, and b_i = PRG(self seed) is
+    removed by the server after clients reveal Shamir shares of b-seeds for
+    *survivors* (dropout tolerance: pairwise seeds are revealed for the
+    dropped instead).
+    """
+
+    def __init__(self, client_id: int, n_clients: int, threshold: int,
+                 dim: int, p: int = DEFAULT_PRIME, seed: int = 0):
+        self.id = int(client_id)
+        self.n = int(n_clients)
+        self.t = int(threshold)
+        self.dim = int(dim)
+        self.p = int(p)
+        self.rng = np.random.default_rng(seed * 7919 + self.id)
+        self.sk, self.pk = dh_keygen(self.rng)
+        # drawn in [0, p): the seed is Shamir-shared over GF(p), so it must
+        # survive the mod-p round trip bit-exactly
+        self.self_seed = int(self.rng.integers(0, self.p))
+        self.pairwise: Dict[int, int] = {}
+
+    # round 0: advertise pk; round 1: agree with every peer
+    def set_peer_keys(self, pks: Dict[int, int]) -> None:
+        for j, pk in pks.items():
+            if j != self.id:
+                self.pairwise[j] = dh_agree(self.sk, pk)
+
+    def self_seed_shares(self) -> np.ndarray:
+        """Shamir shares of the self-mask seed, one per client."""
+        return shamir_share(
+            np.array([self.self_seed % self.p], np.int64),
+            self.n, self.t, self.p, self.rng,
+        )
+
+    def mask(self, x_finite: np.ndarray) -> np.ndarray:
+        y = np.mod(x_finite + prg_mask(self.self_seed, self.dim, self.p), self.p)
+        for j, key in self.pairwise.items():
+            s = prg_mask(key, self.dim, self.p)
+            y = np.mod(y + s if self.id < j else y - s, self.p)
+        return y
+
+    def pairwise_seed(self, j: int) -> int:
+        return self.pairwise[j]
+
+
+class SecAggServer:
+    """Server-side unmasking given survivors' seed shares / dropout keys."""
+
+    def __init__(self, n_clients: int, threshold: int, dim: int,
+                 p: int = DEFAULT_PRIME):
+        self.n, self.t, self.dim, self.p = n_clients, threshold, dim, p
+
+    def aggregate(
+        self,
+        masked: Dict[int, np.ndarray],
+        self_seed_shares: Dict[int, Dict[int, np.ndarray]],
+        dropped_pairwise: Dict[int, Dict[int, int]] = None,
+    ) -> np.ndarray:
+        """Sum survivors' masked vectors and strip masks.
+
+        masked: {client_id: y_i} — the survivors.
+        self_seed_shares: {owner_id: {holder_id: share_row}} for survivors
+          (holders reveal their share of each survivor's b-seed).
+        dropped_pairwise: {dropped_id: {survivor_id: pairwise_seed}} —
+          revealed so half-cancelled pairwise masks can be removed.
+        """
+        survivors = sorted(masked)
+        agg = np.zeros(self.dim, np.int64)
+        for i in survivors:
+            agg = np.mod(agg + masked[i], self.p)
+        # strip self masks: reconstruct each survivor's seed from shares
+        for i in survivors:
+            holders = sorted(self_seed_shares[i])[: self.t + 1]
+            shares = np.stack([self_seed_shares[i][h] for h in holders])
+            seed = int(shamir_reconstruct(shares, [h + 1 for h in holders],
+                                          self.p)[0])
+            agg = np.mod(agg - prg_mask(seed, self.dim, self.p), self.p)
+        # strip half-cancelled pairwise masks of dropped clients
+        for d, seeds in (dropped_pairwise or {}).items():
+            for i in survivors:
+                s = prg_mask(seeds[i], self.dim, self.p)
+                # survivor i applied +s if i<d else -s; remove it
+                agg = np.mod(agg - s if i < d else agg + s, self.p)
+        return agg

@@ -1,0 +1,4 @@
+"""LightSecAgg cross-silo engine. Parity: ``cross_silo/lightsecagg/``."""
+from fedml_tpu.cross_silo.lightsecagg.run_inproc import (  # noqa: F401
+    run_lightsecagg_inproc,
+)

@@ -1,0 +1,137 @@
+"""LightSecAgg client FSM.
+
+Parity: ``cross_silo/lightsecagg/lsa_fedml_client_manager.py`` (265 LoC).
+Round phases on the client:
+
+  sync(model) → local train → quantize update → draw mask z, LCC-encode,
+  send row j to client j (server relays) → once all peers' rows arrive,
+  upload x+z → on server's agg-mask request (with the active set), send
+  Σ_{i active} held-row_i — ONE vector, the one-shot unmasking.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, tree_to_finite
+from fedml_tpu.core.mpc.lightsecagg import (
+    compute_aggregate_encoded_mask,
+    mask_encoding,
+    model_masking,
+)
+from fedml_tpu.cross_silo.lightsecagg.lsa_message_define import LSAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class LSAClientManager(FedMLCommManager):
+    def __init__(self, args: Any, trainer_dist_adapter, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, rank, size, backend)
+        self.adapter = trainer_dist_adapter
+        self.num_rounds = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.n_clients = size - 1
+        self.targeted_active = int(getattr(
+            args, "lsa_targeted_active", max(2, self.n_clients - 1)))
+        self.privacy_t = int(getattr(args, "lsa_privacy_guarantee",
+                                     max(1, self.targeted_active // 2 - 1)))
+        self.p = int(getattr(args, "lsa_prime", DEFAULT_PRIME))
+        self.q_bits = int(getattr(args, "lsa_q_bits", 16))
+        self.has_sent_online_msg = False
+        self._reset_round_state()
+
+    def _reset_round_state(self):
+        self.local_mask: Optional[np.ndarray] = None
+        self.received_rows: Dict[int, np.ndarray] = {}
+        self.masked_sent = False
+        self._pending_upload = None
+
+    # -- registration ------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        M = LSAMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_check_status)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_INIT_CONFIG, self.handle_sync_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_sync_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FORWARD_ENCODED_MASK, self.handle_encoded_mask)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_REQUEST_AGG_MASK, self.handle_agg_mask_request)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+    # -- handshake ---------------------------------------------------------
+    def handle_connection_ready(self, msg: Message) -> None:
+        if not self.has_sent_online_msg:
+            self.has_sent_online_msg = True
+            self._send_status(0)
+
+    def handle_check_status(self, msg: Message) -> None:
+        self._send_status(msg.get_sender_id())
+
+    def _send_status(self, receiver: int) -> None:
+        M = LSAMessage
+        m = Message(M.MSG_TYPE_C2S_CLIENT_STATUS, self.get_sender_id(), receiver)
+        m.add_params(M.MSG_ARG_KEY_CLIENT_STATUS, M.MSG_CLIENT_STATUS_IDLE)
+        self.send_message(m)
+
+    # -- round body --------------------------------------------------------
+    def handle_sync_model(self, msg: Message) -> None:
+        M = LSAMessage
+        self._reset_round_state()
+        global_params = msg.get(M.MSG_ARG_KEY_MODEL_PARAMS)
+        silo_idx = msg.get(M.MSG_ARG_KEY_CLIENT_INDEX)
+        self.round_idx = int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx))
+        self.adapter.update_dataset(int(silo_idx))
+        weights, n_samples = self.adapter.train(self.round_idx, global_params)
+        x_finite, _ = tree_to_finite(weights, self.q_bits, self.p)
+        self.dim = x_finite.shape[0]
+        rng = np.random.default_rng(
+            int(getattr(self.args, "random_seed", 0)) * 65537
+            + self.rank * 257 + self.round_idx)
+        self.local_mask = rng.integers(0, self.p, size=self.dim).astype(np.int64)
+        # encode + distribute: receiver j is rank j+1 (ranks are 1-based)
+        coded = mask_encoding(self.dim, self.n_clients, self.targeted_active,
+                              self.privacy_t, self.p, self.local_mask, rng)
+        for j, row in coded.items():
+            m = Message(M.MSG_TYPE_C2S_SEND_ENCODED_MASK, self.get_sender_id(), 0)
+            m.add_params(M.MSG_ARG_KEY_MASK_TARGET, int(j + 1))
+            m.add_params(M.MSG_ARG_KEY_ENCODED_MASK, row)
+            self.send_message(m)
+        # upload the masked model right away; the one-shot round happens
+        # after the server has everyone's upload
+        masked = model_masking(x_finite, self.local_mask, self.p)
+        up = Message(M.MSG_TYPE_C2S_SEND_MASKED_MODEL, self.get_sender_id(), 0)
+        up.add_params(M.MSG_ARG_KEY_MASKED_MODEL, masked)
+        up.add_params(M.MSG_ARG_KEY_NUM_SAMPLES, int(n_samples))
+        self.send_message(up)
+
+    def handle_encoded_mask(self, msg: Message) -> None:
+        M = LSAMessage
+        sender_rank = int(msg.get(M.MSG_ARG_KEY_SENDER))
+        # the relay preserves the ORIGINATING client in a dedicated key
+        origin = int(msg.get("origin_client", sender_rank))
+        self.received_rows[origin - 1] = np.asarray(
+            msg.get(M.MSG_ARG_KEY_ENCODED_MASK), np.int64)
+
+    def handle_agg_mask_request(self, msg: Message) -> None:
+        M = LSAMessage
+        active = [int(a) for a in msg.get(M.MSG_ARG_KEY_ACTIVE_CLIENTS)]
+        agg = compute_aggregate_encoded_mask(
+            self.received_rows, self.p, [a - 1 for a in active])
+        m = Message(M.MSG_TYPE_C2S_SEND_AGG_MASK, self.get_sender_id(), 0)
+        m.add_params(M.MSG_ARG_KEY_AGG_ENCODED_MASK, agg)
+        self.send_message(m)
+
+    def handle_finish(self, msg: Message) -> None:
+        self.finish()

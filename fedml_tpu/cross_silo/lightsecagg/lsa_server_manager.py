@@ -1,0 +1,168 @@
+"""LightSecAgg server FSM.
+
+Parity: ``cross_silo/lightsecagg/lsa_fedml_server_manager.py`` (281 LoC) +
+``lsa_fedml_aggregator.py`` (303 LoC). The server:
+
+  handshake → init → relay encoded-mask rows between clients → collect all
+  masked models → broadcast the active set, requesting aggregate-encoded
+  masks → decode Σ z_i from the first U responses (LCC, C++ kernel) →
+  unmask, dequantize, average → test → next round.
+
+The server never sees an individual model: only x_i + z_i and the coded
+aggregate of masks.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mlops import metrics as mlops
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, finite_to_tree
+from fedml_tpu.core.mpc.lightsecagg import decode_aggregate_mask
+from fedml_tpu.cross_silo.lightsecagg.lsa_message_define import LSAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class LSAServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator, comm=None, client_rank: int = 0,
+                 client_num: int = 0, backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator  # cross_silo FedMLAggregator (test/select)
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.args.round_idx = 0
+        self.client_num = client_num
+        self.targeted_active = int(getattr(
+            args, "lsa_targeted_active", max(2, client_num - 1)))
+        self.privacy_t = int(getattr(args, "lsa_privacy_guarantee",
+                                     max(1, self.targeted_active // 2 - 1)))
+        self.p = int(getattr(args, "lsa_prime", DEFAULT_PRIME))
+        self.q_bits = int(getattr(args, "lsa_q_bits", 16))
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        self.result: Optional[dict] = None
+        self._reset_round_state()
+
+    def _reset_round_state(self):
+        self.masked_models: Dict[int, np.ndarray] = {}
+        self.sample_nums: Dict[int, int] = {}
+        self.agg_points: Dict[int, np.ndarray] = {}
+        self.active_set = None
+        self.round_done = False
+
+    # -- registration ------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        M = LSAMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_client_status)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_ENCODED_MASK, self.handle_relay_encoded_mask)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_MASKED_MODEL, self.handle_masked_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_AGG_MASK, self.handle_agg_mask)
+
+    # -- handshake ---------------------------------------------------------
+    def handle_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        M = LSAMessage
+        for cid in range(1, self.client_num + 1):
+            self.send_message(Message(
+                M.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.get_sender_id(), cid))
+
+    def handle_client_status(self, msg: Message) -> None:
+        M = LSAMessage
+        if msg.get(M.MSG_ARG_KEY_CLIENT_STATUS) == M.MSG_CLIENT_STATUS_IDLE:
+            self.client_online_status[msg.get_sender_id()] = True
+        if not self.is_initialized and all(
+            self.client_online_status.get(c, False)
+            for c in range(1, self.client_num + 1)
+        ):
+            self.is_initialized = True
+            self._sync_model(LSAMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _sync_model(self, msg_type: str) -> None:
+        M = LSAMessage
+        global_params = self.aggregator.get_global_model_params()
+        for cid in range(1, self.client_num + 1):
+            m = Message(msg_type, self.get_sender_id(), cid)
+            m.add_params(M.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            m.add_params(M.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(m)
+
+    # -- round body --------------------------------------------------------
+    def handle_relay_encoded_mask(self, msg: Message) -> None:
+        M = LSAMessage
+        target = int(msg.get(M.MSG_ARG_KEY_MASK_TARGET))
+        fwd = Message(M.MSG_TYPE_S2C_FORWARD_ENCODED_MASK,
+                      self.get_sender_id(), target)
+        fwd.add_params("origin_client", msg.get_sender_id())
+        fwd.add_params(M.MSG_ARG_KEY_ENCODED_MASK,
+                       msg.get(M.MSG_ARG_KEY_ENCODED_MASK))
+        self.send_message(fwd)
+
+    def handle_masked_model(self, msg: Message) -> None:
+        M = LSAMessage
+        sender = msg.get_sender_id()
+        self.masked_models[sender] = np.asarray(
+            msg.get(M.MSG_ARG_KEY_MASKED_MODEL), np.int64)
+        self.sample_nums[sender] = int(msg.get(M.MSG_ARG_KEY_NUM_SAMPLES))
+        if len(self.masked_models) == self.client_num:
+            # everyone uploaded; open the one-shot unmasking round
+            self.active_set = sorted(self.masked_models)
+            for cid in self.active_set:
+                m = Message(M.MSG_TYPE_S2C_REQUEST_AGG_MASK,
+                            self.get_sender_id(), cid)
+                m.add_params(M.MSG_ARG_KEY_ACTIVE_CLIENTS, list(self.active_set))
+                self.send_message(m)
+
+    def handle_agg_mask(self, msg: Message) -> None:
+        M = LSAMessage
+        if self.round_done:
+            return
+        self.agg_points[msg.get_sender_id()] = np.asarray(
+            msg.get(M.MSG_ARG_KEY_AGG_ENCODED_MASK), np.int64)
+        if len(self.agg_points) < self.targeted_active:
+            return
+        self.round_done = True
+        dim = self.masked_models[self.active_set[0]].shape[0]
+        # client ranks are 1-based; LCC alpha indices are 0-based
+        agg_mask = decode_aggregate_mask(
+            {cid - 1: v for cid, v in self.agg_points.items()},
+            dim, self.client_num, self.targeted_active, self.privacy_t, self.p)
+        agg_finite = np.zeros(dim, np.int64)
+        for cid in self.active_set:
+            agg_finite = np.mod(agg_finite + self.masked_models[cid], self.p)
+        agg_finite = np.mod(agg_finite - agg_mask, self.p)
+        # dequantize the SUM, then uniform-average (dequantize is linear)
+        template = self.aggregator.get_global_model_params()
+        summed = finite_to_tree(agg_finite, template, self.q_bits, self.p,
+                                n_summands=len(self.active_set))
+        import jax
+
+        n_active = float(len(self.active_set))
+        averaged = jax.tree.map(lambda x: x / n_active, summed)
+        self.aggregator.set_global_model_params(averaged)
+
+        metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        mlops.log({"round": self.args.round_idx, "secure": "lightsecagg", **metrics})
+        self.args.round_idx += 1
+        self._reset_round_state()
+        if self.args.round_idx >= self.round_num:
+            self.result = {"rounds": self.round_num, **metrics}
+            M = LSAMessage
+            for cid in range(1, self.client_num + 1):
+                self.send_message(Message(
+                    M.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))
+            self.finish()
+            return
+        self._sync_model(LSAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)

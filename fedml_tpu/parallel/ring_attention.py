@@ -1,0 +1,114 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference has NO sequence/context parallelism anywhere (SURVEY §2.10:
+its long-context story is a FlashAttention kernel swap plus dataset
+truncation, ``train/llm/models/attention.py:30``, ``configurations.py:530``)
+— this module is the capability *extension* the TPU build adds so sequences
+can scale past one chip's HBM.
+
+Design (Liu et al. ring attention, TPU-idiomatic):
+- tokens are sharded over the ``sp`` axis: each device holds a [B, H, T/sp,
+  D] slice of Q, K, V;
+- the ring runs sp steps under ``lax.scan``; each step combines the local Q
+  block with the currently-held K/V block via online softmax (running max
+  ``m``, normalizer ``l``, accumulator ``acc``), then rotates K/V one hop
+  around the ring with ``lax.ppermute`` — compute overlaps the ICI transfer
+  and no device ever materialises more than one remote K/V block;
+- causal masking compares *global* token positions (device index × block
+  length + local offset), so the result is bit-identical to full causal
+  attention over the gathered sequence;
+- backward is plain autodiff: the transpose of ``ppermute`` is the reverse
+  ``ppermute``, so gradients ride the same ring.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard ring attention — call INSIDE ``shard_map`` over ``axis_name``.
+
+    q: [B, H, T_local, D]; k/v: [B, Hkv, T_local, D] (this device's block).
+    Returns the attention output for the local Q block: [B, H, T_local, D].
+    """
+    b, h, t_local, d = q.shape
+    _, hkv, _, _ = k.shape
+    if hkv != h:  # GQA: expand kv heads (T_local is small per shard)
+        k = jnp.repeat(k, h // hkv, axis=1)
+        v = jnp.repeat(v, h // hkv, axis=1)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+
+    rows = my_idx * t_local + jnp.arange(t_local)  # global q positions
+
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # after i hops, this device holds the block that started on idx - i
+        src = (my_idx - i) % sp
+        s = jnp.einsum("bhtd,bhsd->bhts", qf, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            cols = src * t_local + jnp.arange(t_local)
+            mask = rows[:, None] >= cols[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhts,bhsd->bhtd", p, v_cur.astype(jnp.float32)
+        )
+        # rotate K/V one hop; overlap with next step's compute
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = True):
+    """Build an ``attention_fn(q, k, v)`` for the Llama blocks.
+
+    Wraps :func:`ring_attention_shard` in a ``shard_map`` over ``axis_name``
+    (other mesh axes stay under automatic GSPMD partitioning), so it drops
+    into a jitted, fully-sharded train step: Q/K/V arrive sequence-sharded,
+    attention runs as an explicit ring over the ICI, and the output stays
+    sequence-sharded. This replaces the all-gather XLA would otherwise
+    insert for the [T, T] attention, bounding per-device memory at
+    O(T/sp · d + (T/sp)²) instead of O(T²).
+    """
+    other = frozenset(n for n in mesh.axis_names if n != axis_name)
+    fn = functools.partial(
+        ring_attention_shard, axis_name=axis_name, causal=causal
+    )
+    spec = P(None, None, axis_name, None)  # shard the T dim of [B,H,T,D]
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False, axis_names=frozenset({axis_name}),
+    )

@@ -112,11 +112,20 @@ class LLMTrainer:
 
         from fedml_tpu.train.llm.sharding import LOGICAL_RULES
 
+        # sequence parallelism: when the mesh has an sp axis, attention runs
+        # as an explicit ring over the ICI instead of GSPMD's all-gather
+        attention_fn = None
+        sp_size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("sp", 1)
+        if sp_size > 1 and bool(getattr(args, "use_ring_attention", True)):
+            from fedml_tpu.parallel.ring_attention import make_ring_attention_fn
+
+            attention_fn = make_ring_attention_fn(self.mesh, "sp", causal=True)
+
         def apply_fn(p, x):
             # activation constraints inside the model resolve against these
             # logical→mesh rules (otherwise they are silent no-ops)
             with nn.logical_axis_rules(LOGICAL_RULES):
-                return self.model.apply(p, x)
+                return self.model.apply(p, x, attention_fn=attention_fn)
 
         self._loss_fn = causal_lm_loss(apply_fn)
         self._train_step = None  # compiled lazily once shardings exist
