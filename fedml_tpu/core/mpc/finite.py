@@ -50,15 +50,22 @@ def quantize(x: np.ndarray, q_bits: int = 16, p: int = DEFAULT_PRIME) -> np.ndar
 
 def dequantize(xq: np.ndarray, q_bits: int = 16, p: int = DEFAULT_PRIME,
                n_summands: int = 1) -> np.ndarray:
-    """Field element → float. ``n_summands`` widens the negative window so a
-    sum of n quantized values (each possibly negative) decodes correctly —
-    the reference hardcodes the half-field split (``my_q_inv`` :359); the
-    explicit window is what lets aggregated sums of many clients decode.
+    """Field element → float via the symmetric half-field split (matching
+    the reference's ``my_q_inv`` :359).
+
+    Overflow bound: decoding is correct iff the true (summed) value v
+    satisfies ``|v| * 2^q_bits < p/2`` — the symmetric window is already
+    the maximal unambiguous range, and no runtime check can detect a wrap
+    (a wrapped sum is indistinguishable from a legitimate value of the
+    other sign). Summing n clients therefore requires the CALLER to size
+    ``q_bits``/``p`` such that n · max|x| · 2^q_bits < p/2; at the
+    defaults that is |sum| < 2^14 = 16384. ``n_summands`` is accepted so
+    call sites document how many values were summed.
     """
     xq = np.mod(np.asarray(xq, np.int64), p)
+    del n_summands
     neg = xq > (p - 1) // 2
     signed = np.where(neg, xq.astype(np.float64) - p, xq.astype(np.float64))
-    del n_summands  # window is symmetric at p/2; kept for API clarity
     return (signed / (1 << q_bits)).astype(np.float32)
 
 

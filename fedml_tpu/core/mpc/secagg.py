@@ -8,9 +8,16 @@ model masking :83-163). TPU-era re-design:
   model, from ``finite.tree_to_finite``) instead of per-layer dict loops;
 - Shamir reconstruct reuses the LCC Lagrange kernel (C++ or numpy) —
   reconstruction at 0 is interpolation to target point 0;
-- PRG masks come from ``numpy.random.Philox`` keyed by the DH-agreed
+- PRG masks come from ``numpy.random.Philox`` keyed by the X25519-agreed
   secret, so pairwise masks are reproducible on both endpoints without
   shipping them.
+
+Key exchange is real X25519 (via ``cryptography``), NOT finite-field DH
+over the aggregation prime: the adversary SecAgg defends against is the
+aggregation *server* itself, which relays all public keys, so the key
+agreement must resist the server, not just the network (TLS covers only
+the latter). Secrets default to OS entropy; deterministic seeding exists
+solely for reproducible tests.
 
 The protocol dance (round-trip messages) lives in
 ``cross_silo/secagg``; this module is the math, unit-testable without any
@@ -18,17 +25,17 @@ transport.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
 
 from fedml_tpu.core.mpc.finite import DEFAULT_PRIME
 from fedml_tpu.core.mpc.lcc import field_matmul, gen_lagrange_coeffs
-
-# small safe defaults for DH over GF(p) (toy-sized on purpose: transport
-# security is TLS's job; this keying only has to make masks unpredictable)
-DH_PRIME = DEFAULT_PRIME
-DH_GENERATOR = 7
 
 
 # -- Shamir secret sharing ---------------------------------------------------
@@ -79,23 +86,33 @@ def additive_share(secret: np.ndarray, n_out: int, p: int = DEFAULT_PRIME,
     return np.concatenate([parts, last[None]])
 
 
-# -- Diffie-Hellman keying (reference: my_pk_gen :329, my_key_agreement :337)
+# -- key exchange (reference: my_pk_gen :329, my_key_agreement :337 — which
+# use toy finite-field DH; here it is X25519, see module docstring)
 
-def dh_keygen(rng: np.random.Generator, p: int = DH_PRIME,
-              g: int = DH_GENERATOR) -> Tuple[int, int]:
-    sk = int(rng.integers(2, p - 2))
-    return sk, pow(g, sk, p)
+def kx_keygen(rng: np.random.Generator = None) -> Tuple[X25519PrivateKey, bytes]:
+    """Generate an X25519 keypair → (private key, 32-byte public key).
+
+    ``rng`` seeds the private scalar deterministically (tests only);
+    default is OS entropy via ``X25519PrivateKey.generate``.
+    """
+    if rng is None:
+        sk = X25519PrivateKey.generate()
+    else:
+        sk = X25519PrivateKey.from_private_bytes(rng.bytes(32))
+    return sk, sk.public_key().public_bytes_raw()
 
 
-def dh_agree(my_sk: int, their_pk: int, p: int = DH_PRIME) -> int:
-    return pow(int(their_pk), int(my_sk), p)
+def kx_agree(my_sk: X25519PrivateKey, their_pk: bytes) -> int:
+    """Shared secret → 128-bit PRG seed (SHA-256 of the raw exchange)."""
+    secret = my_sk.exchange(X25519PublicKey.from_public_bytes(bytes(their_pk)))
+    return int.from_bytes(hashlib.sha256(secret).digest()[:16], "little")
 
 
 # -- PRG masks ---------------------------------------------------------------
 
 def prg_mask(seed: int, dim: int, p: int = DEFAULT_PRIME) -> np.ndarray:
     """Deterministic field vector from a shared seed (Philox counter PRG)."""
-    bits = np.random.Generator(np.random.Philox(key=seed & ((1 << 64) - 1)))
+    bits = np.random.Generator(np.random.Philox(key=seed & ((1 << 128) - 1)))
     return bits.integers(0, p, size=dim).astype(np.int64)
 
 
@@ -113,24 +130,26 @@ class SecAggClient:
     """
 
     def __init__(self, client_id: int, n_clients: int, threshold: int,
-                 dim: int, p: int = DEFAULT_PRIME, seed: int = 0):
+                 dim: int, p: int = DEFAULT_PRIME, seed: int = None):
         self.id = int(client_id)
         self.n = int(n_clients)
         self.t = int(threshold)
         self.dim = int(dim)
         self.p = int(p)
-        self.rng = np.random.default_rng(seed * 7919 + self.id)
-        self.sk, self.pk = dh_keygen(self.rng)
+        # OS entropy by default; a seed is accepted only so tests reproduce
+        self.rng = (np.random.default_rng() if seed is None
+                    else np.random.default_rng(seed * 7919 + self.id))
+        self.sk, self.pk = kx_keygen(None if seed is None else self.rng)
         # drawn in [0, p): the seed is Shamir-shared over GF(p), so it must
         # survive the mod-p round trip bit-exactly
         self.self_seed = int(self.rng.integers(0, self.p))
         self.pairwise: Dict[int, int] = {}
 
     # round 0: advertise pk; round 1: agree with every peer
-    def set_peer_keys(self, pks: Dict[int, int]) -> None:
+    def set_peer_keys(self, pks: Dict[int, bytes]) -> None:
         for j, pk in pks.items():
             if j != self.id:
-                self.pairwise[j] = dh_agree(self.sk, pk)
+                self.pairwise[j] = kx_agree(self.sk, pk)
 
     def self_seed_shares(self) -> np.ndarray:
         """Shamir shares of the self-mask seed, one per client."""

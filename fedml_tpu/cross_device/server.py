@@ -1,0 +1,29 @@
+"""Cross-device ("BeeHive") server.
+
+Parity: ``cross_device/mnn_server.py:6`` + ``server_mnn/fedml_aggregator.py``
+in the reference, where a Python server aggregates models trained by
+C++/MNN mobile clients over MQTT+S3.
+
+TPU-era re-design: the server IS the cross-silo server FSM — the message
+protocol (handshake → init → per-round sync/upload → finish) is identical;
+what differs on-device is the client runtime, not the server. Mobile/edge
+clients speak the same typed-message wire format (pickle-free, see
+``utils/serialization.py``) over a broker transport, and upload plain
+pytree deltas instead of ``.mnn`` files. A reference-style lightweight
+client runtime lives in ``cross_device/client.py``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu.cross_silo.server.server import Server
+
+
+class ServerCrossDevice(Server):
+    """Cross-device aggregation server (cross-silo FSM, device clients)."""
+
+    def __init__(self, args: Any, device: Any, dataset: Any, model: Any,
+                 server_aggregator=None):
+        # device clients are never co-scheduled as mesh slices: force the
+        # federation transport (broker/grpc/local), never 'sp'/'mesh'
+        super().__init__(args, device, dataset, model, server_aggregator)

@@ -96,9 +96,11 @@ class LSAClientManager(FedMLCommManager):
         weights, n_samples = self.adapter.train(self.round_idx, global_params)
         x_finite, _ = tree_to_finite(weights, self.q_bits, self.p)
         self.dim = x_finite.shape[0]
-        rng = np.random.default_rng(
-            int(getattr(self.args, "random_seed", 0)) * 65537
-            + self.rank * 257 + self.round_idx)
+        # the mask z_i and its LCC noise rows carry the T-collusion privacy
+        # guarantee — they MUST come from OS entropy, never from run config
+        # the server also knows (an honest-but-curious server could replay a
+        # config-derived RNG and unmask each client individually)
+        rng = np.random.default_rng()
         self.local_mask = rng.integers(0, self.p, size=self.dim).astype(np.int64)
         # encode + distribute: receiver j is rank j+1 (ranks are 1-based)
         coded = mask_encoding(self.dim, self.n_clients, self.targeted_active,
@@ -107,6 +109,7 @@ class LSAClientManager(FedMLCommManager):
             m = Message(M.MSG_TYPE_C2S_SEND_ENCODED_MASK, self.get_sender_id(), 0)
             m.add_params(M.MSG_ARG_KEY_MASK_TARGET, int(j + 1))
             m.add_params(M.MSG_ARG_KEY_ENCODED_MASK, row)
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
             self.send_message(m)
         # upload the masked model right away; the one-shot round happens
         # after the server has everyone's upload
@@ -114,23 +117,42 @@ class LSAClientManager(FedMLCommManager):
         up = Message(M.MSG_TYPE_C2S_SEND_MASKED_MODEL, self.get_sender_id(), 0)
         up.add_params(M.MSG_ARG_KEY_MASKED_MODEL, masked)
         up.add_params(M.MSG_ARG_KEY_NUM_SAMPLES, int(n_samples))
+        up.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
         self.send_message(up)
 
     def handle_encoded_mask(self, msg: Message) -> None:
         M = LSAMessage
+        # drop cross-round strays: a row encoded for round r is meaningless
+        # in any other round's unmasking
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
+            return
         sender_rank = int(msg.get(M.MSG_ARG_KEY_SENDER))
         # the relay preserves the ORIGINATING client in a dedicated key
         origin = int(msg.get("origin_client", sender_rank))
         self.received_rows[origin - 1] = np.asarray(
             msg.get(M.MSG_ARG_KEY_ENCODED_MASK), np.int64)
+        self._maybe_answer_agg_mask()
 
     def handle_agg_mask_request(self, msg: Message) -> None:
         M = LSAMessage
-        active = [int(a) for a in msg.get(M.MSG_ARG_KEY_ACTIVE_CLIENTS)]
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
+            return
+        self._pending_upload = [int(a) for a in msg.get(M.MSG_ARG_KEY_ACTIVE_CLIENTS)]
+        self._maybe_answer_agg_mask()
+
+    def _maybe_answer_agg_mask(self) -> None:
+        """Answer the one-shot request once rows from every active client are
+        held — the request can arrive before the relayed rows do."""
+        M = LSAMessage
+        active = self._pending_upload
+        if active is None or any((a - 1) not in self.received_rows for a in active):
+            return
         agg = compute_aggregate_encoded_mask(
             self.received_rows, self.p, [a - 1 for a in active])
+        self._pending_upload = None
         m = Message(M.MSG_TYPE_C2S_SEND_AGG_MASK, self.get_sender_id(), 0)
         m.add_params(M.MSG_ARG_KEY_AGG_ENCODED_MASK, agg)
+        m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
         self.send_message(m)
 
     def handle_finish(self, msg: Message) -> None:

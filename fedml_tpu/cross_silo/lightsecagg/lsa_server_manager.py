@@ -108,10 +108,14 @@ class LSAServerManager(FedMLCommManager):
         fwd.add_params("origin_client", msg.get_sender_id())
         fwd.add_params(M.MSG_ARG_KEY_ENCODED_MASK,
                        msg.get(M.MSG_ARG_KEY_ENCODED_MASK))
+        fwd.add_params(M.MSG_ARG_KEY_ROUND,
+                       msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx))
         self.send_message(fwd)
 
     def handle_masked_model(self, msg: Message) -> None:
         M = LSAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
+            return
         sender = msg.get_sender_id()
         self.masked_models[sender] = np.asarray(
             msg.get(M.MSG_ARG_KEY_MASKED_MODEL), np.int64)
@@ -123,10 +127,15 @@ class LSAServerManager(FedMLCommManager):
                 m = Message(M.MSG_TYPE_S2C_REQUEST_AGG_MASK,
                             self.get_sender_id(), cid)
                 m.add_params(M.MSG_ARG_KEY_ACTIVE_CLIENTS, list(self.active_set))
+                m.add_params(M.MSG_ARG_KEY_ROUND, self.args.round_idx)
                 self.send_message(m)
 
     def handle_agg_mask(self, msg: Message) -> None:
         M = LSAMessage
+        # a straggler's response from round r-1 (only the first
+        # targeted_active are consumed) must not pollute round r's decode
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
+            return
         if self.round_done:
             return
         self.agg_points[msg.get_sender_id()] = np.asarray(

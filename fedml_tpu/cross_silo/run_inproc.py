@@ -19,6 +19,59 @@ from fedml_tpu.cross_silo.server.server import Server
 from fedml_tpu.data.dataset import FederatedDataset
 
 
+def run_managers_to_completion(managers: List[Any], run_id: str,
+                               ready_msg_type: str,
+                               timeout: float = 600.0) -> Optional[dict]:
+    """Shared run-to-completion harness for in-proc federations.
+
+    Starts every manager's receive loop, posts the connection-ready event,
+    polls for handler errors (a raising handler stops only its own loop,
+    so on error the whole federation is shut down instead of waiting out
+    the deadline), and fails loudly on timeout — a silent None would
+    masquerade as a finished run. Returns managers[0].result (the server).
+    """
+    import time
+
+    threads = [m.run_async() for m in managers]
+    broker = LocalBroker.get(run_id)
+    for rank in range(len(managers)):
+        broker.post(rank, Message(ready_msg_type, rank, rank))
+
+    def first_error():
+        for mgr in managers:
+            err = getattr(mgr, "handler_error", None)
+            if err is not None:
+                return mgr, err
+        return None, None
+
+    def shutdown():
+        for m in managers:
+            m.finish()
+        for t in threads:
+            t.join(timeout=5.0)
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and any(t.is_alive() for t in threads):
+        mgr, err = first_error()
+        if err is not None:
+            shutdown()
+            raise RuntimeError(
+                f"rank {mgr.rank} message handler failed: {err!r}"
+            ) from err
+        time.sleep(0.01)
+
+    mgr, err = first_error()
+    if err is not None:
+        raise RuntimeError(f"rank {mgr.rank} message handler failed: {err!r}") from err
+    if any(t.is_alive() for t in threads):
+        shutdown()
+        raise TimeoutError(
+            f"federation run did not finish within {timeout}s "
+            f"(alive: {[t.name for t in threads if t.is_alive()]})"
+        )
+    return managers[0].result
+
+
 def run_cross_silo_inproc(
     args: Any,
     dataset: FederatedDataset,
@@ -42,50 +95,7 @@ def run_cross_silo_inproc(
         cargs.rank = rank
         clients.append(Client(cargs, None, dataset, model, client_trainer))
 
-    threads = [server.run_async()] + [c.run_async() for c in clients]
-
-    broker = LocalBroker.get(run_id)
-    for rank in range(0, client_num + 1):
-        broker.post(rank, Message(MyMessage.MSG_TYPE_CONNECTION_IS_READY, rank, rank))
-
-    import time
-
     managers = [server.manager] + [c.manager for c in clients]
-
-    def first_error():
-        for mgr in managers:
-            err = getattr(mgr, "handler_error", None)
-            if err is not None:
-                return mgr, err
-        return None, None
-
-    # poll: a raising handler stops only its own receive loop, so on error
-    # shut the whole federation down instead of waiting out the deadline
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline and any(t.is_alive() for t in threads):
-        mgr, err = first_error()
-        if err is not None:
-            for m in managers:
-                m.finish()
-            for t in threads:
-                t.join(timeout=5.0)
-            raise RuntimeError(
-                f"rank {mgr.rank} message handler failed: {err!r}"
-            ) from err
-        time.sleep(0.01)
-
-    mgr, err = first_error()
-    if err is not None:
-        raise RuntimeError(f"rank {mgr.rank} message handler failed: {err!r}") from err
-    if any(t.is_alive() for t in threads):
-        # deadline hit with the federation still running: shut it down and
-        # fail loudly — a silent None would masquerade as a finished run
-        for m in managers:
-            m.finish()
-        for t in threads:
-            t.join(timeout=5.0)
-        raise TimeoutError(
-            f"cross-silo run did not finish within {timeout}s "
-            f"(alive: {[t.name for t in threads if t.is_alive()]})"
-        )
-    return server.manager.result
+    return run_managers_to_completion(
+        managers, run_id, MyMessage.MSG_TYPE_CONNECTION_IS_READY, timeout
+    )

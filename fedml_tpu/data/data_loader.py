@@ -46,8 +46,34 @@ def load(args: Any) -> Tuple:
 def load_federated(args: Any) -> FederatedDataset:
     name = str(getattr(args, "dataset", "synthetic")).lower()
     if name not in _LOADERS:
+        _synthetic_fallback(
+            name,
+            f"unknown dataset name {name!r} (registered: {sorted(_LOADERS)})",
+            advice="fix the `dataset:` config value",
+        )
         name = "synthetic"
     return _LOADERS[name](args)
+
+
+def _synthetic_fallback(name: str, reason: str,
+                        advice: str = "place the real files under "
+                        "args.data_cache_dir") -> None:
+    """Loudly record that a run is about to train on synthetic stand-in data.
+
+    Silent substitution would make accuracy-parity claims meaningless and
+    let a typo'd ``dataset:`` train on fake data unnoticed — so this both
+    warns at WARNING level and writes the substitution into the metrics
+    sink, where it sits next to the run's accuracy numbers.
+    """
+    import logging
+
+    msg = (f"dataset {name!r}: SYNTHETIC STAND-IN in use — {reason}. "
+           f"Accuracy is NOT comparable to the real dataset; {advice} "
+           "to silence this.")
+    logging.getLogger(__name__).warning(msg)
+    from fedml_tpu.core.mlops import metrics as mlops
+
+    mlops.log({"synthetic_data_fallback": name, "reason": reason})
 
 
 # --------------------------------------------------------------------------
@@ -143,6 +169,7 @@ def load_mnist(args: Any) -> FederatedDataset:
             xte = (d["x_test"].astype(np.float32) / 255.0).reshape(-1, 784)
             yte = d["y_test"].astype(np.int32)
     else:
+        _synthetic_fallback("mnist", f"no mnist.npz under {cache!r}")
         xtr, ytr, xte, yte = _make_classification_arrays(
             int(getattr(args, "train_size", 6000)),
             int(getattr(args, "test_size", 1000)),
@@ -182,6 +209,7 @@ def _load_image_or_synthetic(args, shape, classes, name):
                 d["x_test"].astype(np.float32) / 255.0,
                 d["y_test"].astype(np.int32).ravel(),
             )
+    _synthetic_fallback(name, f"no {name}.npz under {cache!r}")
     return _make_classification_arrays(
         int(getattr(args, "train_size", 4000)),
         int(getattr(args, "test_size", 800)),
@@ -207,6 +235,9 @@ def load_shakespeare(args: Any) -> FederatedDataset:
                     corpus = np.frombuffer(f.read(), dtype=np.uint8) % vocab
                 break
     if corpus is None:
+        _synthetic_fallback(
+            str(getattr(args, "dataset", "shakespeare")),
+            f"no shakespeare.txt/all_data.txt under {cache!r}")
         rng = np.random.default_rng(int(getattr(args, "random_seed", 0)) + 5)
         # order-1 markov chain over the charset → learnable structure
         trans = rng.dirichlet(np.full(vocab, 0.05), size=vocab)

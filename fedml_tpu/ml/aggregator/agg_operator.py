@@ -8,9 +8,9 @@ a few fused HBM passes regardless of how many layers the model has.
 
 Supported federated optimizers (reference list at ``constants.py:40-63``):
 FedAvg/FedAvg_seq/FedSGD/FedProx/FedDyn/FedNova → sample-weighted average;
-FedOpt → weighted average of client models, server optimizer applied by the
-FedOpt server (see ``ml/trainer/fedopt_server.py``); SCAFFOLD/Mime →
-uniform average of (model, control-variate) pairs.
+FedOpt → weighted average of client models, server optimizer applied by
+``ml/aggregator/server_optimizer.py``; SCAFFOLD/Mime → uniform average of
+(model, control-variate) pairs.
 """
 from __future__ import annotations
 

@@ -26,12 +26,17 @@ Pytree = Any
 
 _ARRAY = "__ndarray__"
 _TUPLE = "__tuple__"
+_BYTES = "__bytes__"
+_RESERVED = (_ARRAY, _TUPLE, _BYTES)
 
 
 def _encode(obj: Any, blobs: List[bytes]) -> Any:
     """Recursively JSON-ify; arrays become placeholders into ``blobs``."""
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
+    if isinstance(obj, (bytes, bytearray)):
+        blobs.append(b"RAW0" + bytes(obj))
+        return {_BYTES: len(blobs) - 1}
     if isinstance(obj, (np.ndarray, jax.Array, np.generic)):
         arr = np.asarray(jax.device_get(obj))
         buf = io.BytesIO()
@@ -39,8 +44,10 @@ def _encode(obj: Any, blobs: List[bytes]) -> Any:
         blobs.append(buf.getvalue())
         return {_ARRAY: len(blobs) - 1}
     if isinstance(obj, dict):
-        if any(not isinstance(k, str) for k in obj):
-            # JSON keys must be strings; tag-encode non-str keys losslessly
+        if any(not isinstance(k, str) or k in _RESERVED for k in obj):
+            # JSON keys must be strings, and user keys that collide with
+            # the decode tags must not be interpretable as tags: both go
+            # through the lossless items encoding
             return {
                 _TUPLE: "dict_items",
                 "items": [
@@ -58,10 +65,25 @@ def _encode(obj: Any, blobs: List[bytes]) -> Any:
     )
 
 
+def _blob_at(blobs: List[Any], idx: Any) -> Any:
+    i = int(idx)
+    if not 0 <= i < len(blobs):
+        raise ValueError(f"payload references blob {i} of {len(blobs)}")
+    return blobs[i]
+
+
 def _decode(node: Any, blobs: List[np.ndarray]) -> Any:
     if isinstance(node, dict):
         if _ARRAY in node and len(node) == 1:
-            return blobs[int(node[_ARRAY])]
+            raw = _blob_at(blobs, node[_ARRAY])
+            if raw[:4] == b"RAW0":
+                raise ValueError("array tag references a bytes blob")
+            return np.load(io.BytesIO(raw), allow_pickle=False)
+        if _BYTES in node and len(node) == 1:
+            raw = _blob_at(blobs, node[_BYTES])
+            if raw[:4] != b"RAW0":
+                raise ValueError("bytes tag references a non-bytes blob")
+            return raw[4:]
         if node.get(_TUPLE) == "tuple":
             return tuple(_decode(v, blobs) for v in node["items"])
         if node.get(_TUPLE) == "dict_items":
@@ -87,10 +109,9 @@ def safe_loads(data: bytes) -> Any:
     (hlen,) = struct.unpack_from("<I", data, 0)
     header = json.loads(data[4 : 4 + hlen].decode())
     offset = 4 + hlen
-    blobs: List[np.ndarray] = []
+    blobs: List[bytes] = []
     for nbytes in header["arrays"]:
-        buf = io.BytesIO(data[offset : offset + nbytes])
-        blobs.append(np.load(buf, allow_pickle=False))
+        blobs.append(bytes(data[offset : offset + nbytes]))
         offset += nbytes
     return _decode(header["skeleton"], blobs)
 

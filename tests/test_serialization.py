@@ -1,0 +1,63 @@
+"""Pickle-free wire format: roundtrips, reserved-tag escaping, hostile
+payload bounds-checking."""
+import numpy as np
+import pytest
+
+from fedml_tpu.utils.serialization import safe_dumps, safe_loads
+
+
+def test_roundtrip_pytree():
+    obj = {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "meta": {"lr": 0.1, "steps": 5, "name": "m"},
+        "shapes": (1, 2, (3, "x")),
+        "flags": [True, None, 2.5],
+    }
+    out = safe_loads(safe_dumps(obj))
+    assert np.array_equal(out["w"], obj["w"])
+    assert out["meta"] == obj["meta"]
+    assert out["shapes"] == obj["shapes"]
+    assert out["flags"] == obj["flags"]
+
+
+def test_reserved_keys_roundtrip():
+    # user dicts whose keys collide with the decode tags must roundtrip
+    # verbatim, not be mis-decoded into arrays/tuples
+    obj = {
+        "__ndarray__": 0,
+        "inner": {"__tuple__": "tuple", "items": [1, 2]},
+        "b": {"__bytes__": 7},
+    }
+    out = safe_loads(safe_dumps(obj))
+    assert out == obj
+
+
+def test_bytes_roundtrip():
+    obj = {"pk": b"\x00\x01\xffraw-key-bytes", "n": 3}
+    out = safe_loads(safe_dumps(obj))
+    assert out["pk"] == obj["pk"]
+    assert isinstance(out["pk"], bytes)
+
+
+def test_nonstring_keys_roundtrip():
+    obj = {1: "a", (2, 3): np.ones(2, np.int64)}
+    out = safe_loads(safe_dumps(obj))
+    assert out[1] == "a"
+    assert np.array_equal(out[(2, 3)], np.ones(2, np.int64))
+
+
+def test_hostile_blob_index_rejected():
+    import json
+    import struct
+
+    header = json.dumps(
+        {"skeleton": {"__ndarray__": 99}, "arrays": []}
+    ).encode()
+    payload = struct.pack("<I", len(header)) + header
+    with pytest.raises(ValueError):
+        safe_loads(payload)
+
+
+def test_unsupported_type_raises():
+    with pytest.raises(TypeError):
+        safe_dumps({"f": object()})
