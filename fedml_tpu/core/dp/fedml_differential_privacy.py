@@ -68,6 +68,19 @@ class FedMLDifferentialPrivacy:
         self._rng_counter += 1
         return jax.random.fold_in(jax.random.key(self._seed), self._rng_counter)
 
+    def take_key_data(self, n: int):
+        """Raw key data for the next ``n`` counter keys (advances the counter).
+
+        The mesh simulator stages these onto devices so LDP noise drawn
+        *inside* the compiled round is bit-identical to the sequential sp
+        path calling :meth:`add_local_noise` once per client in order.
+        """
+        import numpy as np
+
+        return np.stack(
+            [np.asarray(jax.random.key_data(self._next_key())) for _ in range(n)]
+        )
+
     def add_local_noise(self, params: Pytree) -> Pytree:
         return self.frame.add_local_noise(params, self._next_key())
 

@@ -31,3 +31,19 @@ class CoordinateWiseMedianDefense(BaseDefense):
     ) -> Pytree:
         stacked = tree_stack([p for _, p in raw_client_grad_list])
         return _median_tree(stacked)
+
+    def defend_stacked(self, vecs, counts, valid, global_vec):
+        """Traced masked median for the in-mesh compiled round.
+
+        Matches ``jnp.median`` semantics (mean of the two middles for even
+        counts) over the *valid* rows only.
+        """
+        import jax.numpy as jnp
+
+        big = jnp.float32(1e30)
+        col = jnp.where(valid[:, None], vecs, big)  # pads sort to the end
+        s = jnp.sort(col, axis=0)
+        nv = jnp.sum(valid.astype(jnp.int32))
+        lo = (nv - 1) // 2
+        hi = nv // 2
+        return 0.5 * (s[lo] + s[hi])

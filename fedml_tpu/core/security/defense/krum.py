@@ -47,3 +47,29 @@ class KrumDefense(BaseDefense):
         keep = jnp.argsort(scores)[: self.krum_param_k]
         keep_idx = sorted(int(i) for i in keep)
         return [raw_client_grad_list[i] for i in keep_idx]
+
+    def defend_stacked(self, vecs, counts, valid, global_vec):
+        """Traced krum for the in-mesh compiled round.
+
+        Same math as ``defend_before_aggregation`` + count-weighted FedAvg
+        over the survivors, but fully traceable (no data-dependent Python),
+        so it runs *inside* the one-XLA-program mesh round. ``valid`` masks
+        padded scheduler slots (their rows never enter distances/selection).
+        """
+        n = vecs.shape[0]
+        big = jnp.float32(1e30)
+        inv = ~valid
+        d = pairwise_sq_dists(vecs)
+        d = d + big * (inv[:, None] | inv[None, :]).astype(jnp.float32)
+        d = d.at[jnp.arange(n), jnp.arange(n)].set(big)
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        f = jnp.minimum(self.byzantine_client_num,
+                        jnp.maximum(0, (n_valid - 3) // 2))
+        m = jnp.maximum(1, n_valid - f - 2)
+        sorted_d = jnp.sort(d, axis=1)
+        take = jnp.arange(n)[None, :] < m
+        scores = jnp.sum(jnp.where(take, sorted_d, 0.0), axis=1)
+        scores = scores + big * inv.astype(jnp.float32)
+        keep = jnp.argsort(scores)[: self.krum_param_k]
+        w = jnp.zeros((n,), jnp.float32).at[keep].set(counts[keep])
+        return jnp.einsum("n,nd->d", w / jnp.sum(w), vecs)

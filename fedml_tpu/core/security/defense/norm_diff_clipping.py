@@ -43,3 +43,14 @@ class NormDiffClippingDefense(BaseDefense):
             center = jnp.zeros((vecs.shape[1],), dtype=vecs.dtype)
         clipped = _clip_rows_to(vecs, center, jnp.float32(self.norm_bound))
         return unstack_to_list(clipped, counts, template)
+
+    def defend_stacked(self, vecs, counts, valid, global_vec):
+        """Traced clip + count-weighted FedAvg for the in-mesh round.
+
+        Center matches the host path's default (zeros — the aux passed by
+        the hook chain is a metrics dict, not a model).
+        """
+        center = jnp.zeros((vecs.shape[1],), dtype=vecs.dtype)
+        clipped = _clip_rows_to(vecs, center, jnp.float32(self.norm_bound))
+        w = counts / jnp.sum(counts)
+        return jnp.einsum("n,nd->d", w, clipped)

@@ -45,3 +45,23 @@ class TrimmedMeanDefense(BaseDefense):
         k = min(int(self.beta * n), (n - 1) // 2)
         stacked = tree_stack([p for _, p in raw_client_grad_list])
         return _trimmed_mean_tree(stacked, k)
+
+    def defend_stacked(self, vecs, counts, valid, global_vec):
+        """Traced masked trimmed mean for the in-mesh compiled round."""
+        import jax.numpy as jnp
+
+        n = vecs.shape[0]
+        big = jnp.float32(1e30)
+        nv = jnp.sum(valid.astype(jnp.int32))
+        # +1e-4 before truncation: float32 beta*nv can land just below an
+        # exact integer (0.35*20 → 6.99999988) where the host path's float64
+        # int(beta*n) truncates to the integer — keep the two paths agreeing
+        k = jnp.minimum(
+            (self.beta * nv + 1e-4).astype(jnp.int32), (nv - 1) // 2
+        )
+        col = jnp.where(valid[:, None], vecs, big)  # pads sort to the end
+        s = jnp.sort(col, axis=0)
+        rank = jnp.arange(n)[:, None]
+        keep = (rank >= k) & (rank < nv - k)
+        denom = jnp.maximum(nv - 2 * k, 1).astype(jnp.float32)
+        return jnp.sum(jnp.where(keep, s, 0.0), axis=0) / denom

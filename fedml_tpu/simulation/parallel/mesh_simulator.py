@@ -43,6 +43,7 @@ from fedml_tpu.ml.aggregator.server_optimizer import ServerOptimizer
 from fedml_tpu.ml.trainer.local_sgd import build_local_fn, init_local_state
 from fedml_tpu.models import model_hub
 from fedml_tpu.simulation.sampling import sample_clients
+from fedml_tpu.utils.tree import tree_flatten_vector, tree_unflatten_vector
 
 Pytree = Any
 
@@ -55,15 +56,6 @@ class MeshFedAvgAPI:
         self.args = args
         self.dataset = dataset
         self.model = model
-        # the mesh round aggregates inside one XLA program (psum), which does
-        # NOT run the ServerAggregator defense/DP hook chain yet — refuse
-        # loudly rather than report undefended results as defended
-        for flag in ("enable_defense", "enable_dp", "enable_attack"):
-            if bool(getattr(args, flag, False)):
-                raise ValueError(
-                    f"backend='mesh' does not support {flag} yet; "
-                    "use the sp backend for the trust stack"
-                )
         self.mesh = mesh or Mesh(np.asarray(jax.devices()), axis_names=("clients",))
         self.n_devices = self.mesh.devices.size
         self.aggregator = create_server_aggregator(model, args)
@@ -85,7 +77,63 @@ class MeshFedAvgAPI:
         run_local = build_local_fn(apply_fn, args)
         fed_opt = str(getattr(args, "federated_optimizer", "FedAvg"))
 
-        def per_device_round(global_params, local_state, xs, ys, mask, nk):
+        # -- trust stack wiring (VERDICT r1 #3: DP + defenses INSIDE the
+        # compiled round; model attacks / exotic defenses fall back to a
+        # host aggregation path so the full hook chain still applies) ------
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+        from fedml_tpu.core.security.attacker import FedMLAttacker
+        from fedml_tpu.core.security.defender import FedMLDefender
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        defender = FedMLDefender.get_instance()
+        attacker = FedMLAttacker.get_instance()
+        self._dp = dp
+        self._ldp = dp.is_dp_enabled() and dp.is_local_dp_enabled()
+        cdp = dp.is_dp_enabled() and dp.is_central_dp_enabled()
+        global_clip = cdp and dp.is_clipping()
+        dp_frame = dp.frame if dp.is_dp_enabled() else None
+        defense_stacked = None
+        if defender.is_defense_enabled():
+            defense_stacked = getattr(defender.defender, "defend_stacked", None)
+        # host-aggregation fallback: the per-client training (and LDP) still
+        # run in one XLA program; the stacked models come back to the host,
+        # where the standard ServerAggregator chain (attack injection,
+        # list-based defenses, CDP) applies — full trust-stack coverage at
+        # the cost of one device→host model transfer per round.
+        self._host_agg = attacker.is_model_attack() or (
+            defender.is_defense_enabled() and defense_stacked is None
+        )
+        self._cdp_in_program = cdp and not self._host_agg
+        self._key_width = 0
+        if self._ldp or self._cdp_in_program:
+            import jax.random as jrandom
+
+            self._key_width = np.asarray(
+                jrandom.key_data(jrandom.key(0))
+            ).shape[0]
+        host_agg = self._host_agg
+
+        def per_client_postprocess(new_params, ldp_kd):
+            """LDP noise + CDP clipping, vmapped over the client slots."""
+            if self._ldp:
+                new_params = jax.vmap(
+                    lambda p, kd: dp_frame.add_local_noise(
+                        p, jax.random.wrap_key_data(kd)
+                    )
+                )(new_params, ldp_kd)
+            if global_clip and not host_agg:
+                from fedml_tpu.core.dp.frames.dp_clip import clip_update
+
+                clip = float(dp.clipping_norm)
+                new_params = jax.vmap(lambda p: clip_update(p, clip))(new_params)
+            return new_params
+
+        template = self.global_params
+
+        def per_device_round(global_params, local_state, xs, ys, mask, nk,
+                             ldp_kd, cdp_kd):
             """One device's share: xs [slots, steps, B, ...], nk [slots].
 
             Runs every client slot via vmap, locally weight-sums the
@@ -97,6 +145,7 @@ class MeshFedAvgAPI:
             # axis with the axis kept: [n_dev/n_dev=1, slots, ...] — squeeze
             # it so vmap runs over the client *slots*.
             xs, ys, mask, nk = xs[0], ys[0], mask[0], nk[0]
+            ldp_kd = ldp_kd[0]
             # the replicated (unvarying) model enters a scan whose carry
             # becomes device-varying after the first SGD step — cast it to
             # varying over the mesh axis up front so scan's type check passes
@@ -110,22 +159,54 @@ class MeshFedAvgAPI:
                 return new_p, metrics
 
             new_params, metrics = jax.vmap(one_client)(xs, ys, mask)
+            new_params = per_client_postprocess(new_params, ldp_kd)
             w = nk.astype(jnp.float32)  # padded slots have nk=0 → no weight
-            local_wsum = jax.tree.map(
-                lambda p: jnp.einsum("c,c...->...", w, p.astype(jnp.float32)),
-                new_params,
-            )
-            wsum = jax.lax.psum(local_wsum, "clients")
             total = jax.lax.psum(jnp.sum(w), "clients")
-            agg = jax.tree.map(lambda x: x / total, wsum)
             loss = jax.lax.psum(jnp.sum(w * metrics["train_loss"]), "clients") / total
+
+            if host_agg:
+                # stacked per-slot models go back to the host, where the
+                # full ServerAggregator hook chain (attack/defense/CDP) runs
+                return new_params, loss
+
+            if defense_stacked is not None:
+                # robust aggregation INSIDE the program: gather the client
+                # axis (every device sees all N candidate models), flatten
+                # to an N×D matrix, run the traced defense (e.g. krum — one
+                # gram matmul on the MXU), and normalize the result's
+                # device-variance with a pmean of identical values.
+                gathered = jax.lax.all_gather(new_params, "clients")
+                stacked = jax.tree.map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), gathered
+                )
+                vecs = jax.vmap(tree_flatten_vector)(stacked)
+                counts = jax.lax.all_gather(w, "clients").reshape(-1)
+                valid = counts > 0
+                global_vec = tree_flatten_vector(global_params)
+                agg_vec = defense_stacked(vecs, counts, valid, global_vec)
+                agg = tree_unflatten_vector(agg_vec, global_params)
+                agg = jax.lax.pmean(agg, "clients")
+            else:
+                local_wsum = jax.tree.map(
+                    lambda p: jnp.einsum("c,c...->...", w, p.astype(jnp.float32)),
+                    new_params,
+                )
+                wsum = jax.lax.psum(local_wsum, "clients")
+                agg = jax.tree.map(lambda x: x / total, wsum)
+
+            if self._cdp_in_program:
+                agg = dp_frame.add_global_noise(
+                    agg, jax.random.wrap_key_data(cdp_kd)
+                )
             return agg, loss
 
+        out_model_spec = P("clients") if self._host_agg else P()
         shard = jax.shard_map(
             per_device_round,
             mesh=self.mesh,
-            in_specs=(P(), P(), P("clients"), P("clients"), P("clients"), P("clients")),
-            out_specs=(P(), P()),
+            in_specs=(P(), P(), P("clients"), P("clients"), P("clients"),
+                      P("clients"), P("clients"), P()),
+            out_specs=(out_model_spec, P()),
         )
         self._round_fn = jax.jit(shard)
         self._local_state = init_local_state(self.global_params, args)
@@ -138,6 +219,12 @@ class MeshFedAvgAPI:
         key = (cid, round_idx)
         if key not in self._data_cache:
             x, y = self.dataset.train_data_local_dict[cid]
+            from fedml_tpu.core.security.attacker import FedMLAttacker
+
+            attacker = FedMLAttacker.get_instance()
+            if attacker.is_data_poisoning_attack() and attacker.is_to_poison_data():
+                # same hook the sp path runs in on_before_local_training
+                x, y = attacker.poison_data((x, y))
             seed = int(getattr(self.args, "random_seed", 0)) * 100003 + cid * 1009 + round_idx
             self._data_cache[key] = batch_epochs(
                 np.asarray(x), np.asarray(y), self.batch_size, self.epochs,
@@ -147,6 +234,12 @@ class MeshFedAvgAPI:
 
     def _stage_round(self, round_idx: int, client_ids: List[int]):
         self._data_cache.clear()  # only the current round stays hot
+        # stage data in client_ids order FIRST: data-poisoning attacks draw
+        # from a stateful RNG per call, and the sp path poisons clients in
+        # exactly this order — staging in scheduler order would give each
+        # client a different poison draw and break sp==mesh parity
+        for cid in client_ids:
+            self._client_arrays(int(cid), round_idx)
         id_matrix = schedule_clients_to_devices(
             client_ids,
             self.dataset.train_data_local_num_dict,
@@ -167,12 +260,32 @@ class MeshFedAvgAPI:
                 x, y, m = self._client_arrays(int(cid), round_idx)
                 xs[d, s], ys[d, s], ms[d, s] = x, y, m
                 nk[d, s] = self.dataset.train_data_local_num_dict[int(cid)]
+        # per-client LDP keys: the SAME counter keys, in the SAME client
+        # order, the sequential sp path would draw — so in-program noise is
+        # bit-identical to host-side add_local_noise (see take_key_data)
+        kd_width = max(self._key_width, 1)
+        ldp_kd = np.zeros((n_dev, slots, kd_width), dtype=np.uint32)
+        if self._ldp:
+            key_rows = self._dp.take_key_data(len(client_ids))
+            pos = {cid: i for i, cid in enumerate(client_ids)}
+            for d in range(n_dev):
+                for s in range(slots):
+                    cid = id_matrix[d, s]
+                    if cid >= 0:
+                        ldp_kd[d, s] = key_rows[pos[int(cid)]]
+        cdp_kd = np.zeros((kd_width,), dtype=np.uint32)
+        if self._cdp_in_program:
+            cdp_kd = self._dp.take_key_data(1)[0]
+        self._last_id_matrix = id_matrix
         spec = NamedSharding(self.mesh, P("clients"))
+        rep = NamedSharding(self.mesh, P())
         return (
             jax.device_put(xs, spec),
             jax.device_put(ys, spec),
             jax.device_put(ms, spec),
             jax.device_put(nk, spec),
+            jax.device_put(ldp_kd, spec),
+            jax.device_put(cdp_kd, rep),
         )
 
     def _client_sampling(self, round_idx: int) -> List[int]:
@@ -180,18 +293,49 @@ class MeshFedAvgAPI:
 
     # -- round loop -------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:
+        from fedml_tpu.core.alg_frame.params import Context
+
         client_ids = self._client_sampling(round_idx)
+        ctx = Context()
+        ctx.add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
+        ctx.add(Context.KEY_CLIENT_NUM_IN_THIS_ROUND, len(client_ids))
         self.event.log_event_started("stage", round_idx)
-        xs, ys, ms, nk = self._stage_round(round_idx, client_ids)
+        xs, ys, ms, nk, ldp_kd, cdp_kd = self._stage_round(round_idx, client_ids)
         self.event.log_event_ended("stage", round_idx)
 
         self.event.log_event_started("train+agg", round_idx)
         t0 = time.time()
-        w_agg, loss = self._round_fn(self.global_params, self._local_state, xs, ys, ms, nk)
-        w_agg = jax.block_until_ready(w_agg)
+        out, loss = self._round_fn(
+            self.global_params, self._local_state, xs, ys, ms, nk, ldp_kd, cdp_kd
+        )
+        out = jax.block_until_ready(out)
         dt = time.time() - t0
         self.event.log_event_ended("train+agg", round_idx)
         self.estimator.observe(float(np.sum(jax.device_get(nk))), dt)
+
+        if self._host_agg:
+            # reassemble (n_k, model) in client order and run the standard
+            # ServerAggregator hook chain — attacks and list-based defenses
+            # see exactly what they would under the sp backend
+            ctx.add("global_model_for_defense", self.global_params)
+            flat_ids = np.asarray(self._last_id_matrix).reshape(-1)
+            slot_models = jax.device_get(out)
+            w_locals = []
+            by_cid = {}
+            for slot, cid in enumerate(flat_ids):
+                if cid >= 0:
+                    by_cid[int(cid)] = jax.tree.map(
+                        lambda x: x[slot], slot_models
+                    )
+            for cid in client_ids:
+                w_locals.append(
+                    (self.dataset.train_data_local_num_dict[int(cid)], by_cid[int(cid)])
+                )
+            w_list, _ = self.aggregator.on_before_aggregation(w_locals)
+            w_agg = self.aggregator.aggregate(w_list)
+            w_agg = self.aggregator.on_after_aggregation(w_agg)
+        else:
+            w_agg = out
 
         self.global_params = self.server_opt.step(self.global_params, w_agg)
         report = {"round": round_idx, "train_loss": float(loss), "round_sec": dt}

@@ -108,3 +108,129 @@ def test_runtime_estimator_fits_linear():
     for n in [10, 20, 40, 80]:
         est.observe(n, 0.5 * n + 3.0)
     assert abs(est.estimate(100) - 53.0) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# trust stack inside the compiled mesh round (VERDICT r1 #3)
+# ---------------------------------------------------------------------------
+def _fresh_init(args):
+    """Reset every trust singleton, then re-init from args — so sp and mesh
+    runs inside one test start from identical RNG counters."""
+    from fedml_tpu.core.alg_frame.params import Context
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy,
+    )
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+    from fedml_tpu.core.security.attacker import FedMLAttacker
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    FedMLAttacker.reset()
+    FedMLDefender.reset()
+    FedMLDifferentialPrivacy.reset()
+    FedMLFHE.reset()
+    Context.reset()
+    return fedml_tpu.init(args)
+
+
+def _sp_vs_mesh(over, rtol=2e-4, atol=2e-5):
+    args = _fresh_init(make_args(comm_round=1, **over))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    sp = FedAvgAPI(args, device_mod.get_device(args), ds, model)
+    sp.train_one_round(0)
+
+    args = _fresh_init(make_args(comm_round=1, **over))
+    mesh = MeshFedAvgAPI(args, None, ds, model)
+    mesh.train_one_round(0)
+
+    a = np.asarray(tree_flatten_vector(sp.global_params))
+    b = np.asarray(tree_flatten_vector(mesh.global_params))
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+    return sp, mesh
+
+
+def test_mesh_matches_sp_with_ldp():
+    """Local-DP noise drawn INSIDE the compiled round == sp's per-client calls."""
+    sp, mesh = _sp_vs_mesh({
+        "enable_dp": True, "dp_solution_type": "LDP",
+        "epsilon": 5.0, "delta": 1e-5, "clipping_norm": 1.0,
+    })
+    assert not mesh._host_agg
+
+
+def test_mesh_matches_sp_with_cdp():
+    """Global clip + central noise inside the program == sp hook chain."""
+    sp, mesh = _sp_vs_mesh({
+        "enable_dp": True, "dp_solution_type": "CDP",
+        "epsilon": 5.0, "delta": 1e-5, "clipping_norm": 1.0,
+    })
+    assert not mesh._host_agg and mesh._cdp_in_program
+
+
+@pytest.mark.parametrize("defense,extra", [
+    ("krum", {"byzantine_client_num": 2}),
+    ("krum", {"byzantine_client_num": 1, "krum_param_k": 3, "multi": True}),
+    ("coordinate_wise_median", {}),
+    ("trimmed_mean", {"beta": 0.2}),
+    ("norm_diff_clipping", {"norm_bound": 0.5}),
+])
+def test_mesh_matches_sp_with_defense(defense, extra):
+    """Robust aggregation runs inside the one-XLA-program round."""
+    sp, mesh = _sp_vs_mesh({
+        "enable_defense": True, "defense_type": defense, **extra,
+    })
+    assert not mesh._host_agg  # these defenses are in-program
+
+
+def test_mesh_defense_with_padded_slots():
+    """6 clients on 8 devices: padded scheduler slots must not enter krum."""
+    _sp_vs_mesh({
+        "enable_defense": True, "defense_type": "krum",
+        "byzantine_client_num": 1,
+        "client_num_in_total": 6, "client_num_per_round": 6,
+    })
+
+
+def test_mesh_host_fallback_for_model_attack():
+    """Model attacks gather models to the host hook chain — sp parity."""
+    sp, mesh = _sp_vs_mesh({
+        "enable_attack": True, "attack_type": "byzantine",
+        "attack_mode": "flip", "byzantine_client_num": 2,
+    })
+    assert mesh._host_agg
+
+
+def test_mesh_host_fallback_for_exotic_defense():
+    """Defenses without a traced form still work via the host path."""
+    sp, mesh = _sp_vs_mesh({
+        "enable_defense": True, "defense_type": "foolsgold",
+    })
+    assert mesh._host_agg
+
+
+def test_mesh_dp_plus_defense_composes():
+    """LDP in-program + krum in-program in the same compiled round."""
+    _sp_vs_mesh({
+        "enable_dp": True, "dp_solution_type": "LDP",
+        "epsilon": 5.0, "delta": 1e-5, "clipping_norm": 1.0,
+        "enable_defense": True, "defense_type": "krum",
+        "byzantine_client_num": 1,
+    })
+
+
+def test_mesh_matches_sp_with_data_poisoning():
+    """Stateful poison RNG must be consumed in client order on both paths."""
+    sp, mesh = _sp_vs_mesh({
+        "enable_attack": True, "attack_type": "label_flipping",
+        "poisoned_ratio": 0.5,
+        "client_num_in_total": 6, "client_num_per_round": 6,
+    })
+    assert not mesh._host_agg  # data poisoning alone stays in-program
+
+
+def test_mesh_matches_sp_trimmed_mean_f32_edge():
+    """beta*n landing just below an integer in f32 (0.35*20) must agree."""
+    _sp_vs_mesh({
+        "enable_defense": True, "defense_type": "trimmed_mean", "beta": 0.35,
+        "client_num_in_total": 20, "client_num_per_round": 20,
+    })
