@@ -1,0 +1,176 @@
+"""`fedml_tpu` CLI — launch/run/stop/status/logs/jobs/env/version/serve.
+
+Parity target: ``python/fedml/cli/cli.py:18-75`` (the click app behind the
+`fedml` command: login/launch/run/device/model/build/train/federate/...).
+Cloud-backend commands (login, device bind) have no hosted control plane
+here; the local equivalents are:
+
+  fedml_tpu launch job.yaml      # run a job yaml on the local agent
+  fedml_tpu run   'shell cmd'    # ad-hoc command as a job
+  fedml_tpu stop  RUN_ID
+  fedml_tpu status RUN_ID
+  fedml_tpu logs  RUN_ID [--tail N] [--follow]
+  fedml_tpu jobs                 # list runs
+  fedml_tpu env                  # environment / accelerator report
+  fedml_tpu version
+  fedml_tpu serve --model tiny   # boot an LLM inference endpoint
+
+Invoke as `python -m fedml_tpu.cli ...` (console-script packaging comes
+with the wheel build).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import click
+
+
+@click.group()
+def cli() -> None:
+    """FedML-TPU: TPU-native federated learning + serving."""
+
+
+@cli.command()
+def version() -> None:
+    import fedml_tpu
+
+    click.echo(getattr(fedml_tpu, "__version__", "dev"))
+
+
+@cli.command()
+def env() -> None:
+    from fedml_tpu.scheduler.env_collect import print_env
+
+    print_env()
+
+
+@cli.command()
+@click.argument("yaml_path")
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+@click.option("--wait/--no-wait", default=True, show_default=True,
+              help="block until the job reaches a terminal status")
+@click.option("--timeout", default=86400.0, show_default=True)
+def launch(yaml_path: str, workdir: str, wait: bool, timeout: float) -> None:
+    """Run a job yaml on the local agent."""
+    from fedml_tpu.scheduler.launch import get_agent, launch_job
+
+    rid = launch_job(yaml_path, workdir=workdir)
+    click.echo(f"run_id: {rid}")
+    if wait:
+        status = get_agent(workdir).wait(rid, timeout=timeout)
+        click.echo(f"status: {status}")
+        sys.stdout.write(get_agent(workdir).logs(rid, tail=20))
+        if status != "FINISHED":
+            raise SystemExit(1)
+
+
+@cli.command()
+@click.argument("command")
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+@click.option("--name", default="adhoc", show_default=True)
+def run(command: str, workdir: str, name: str) -> None:
+    """Run an ad-hoc shell command as a tracked job."""
+    from fedml_tpu.scheduler.agent import LocalAgent
+    from fedml_tpu.scheduler.job_yaml import JobSpec
+    from fedml_tpu.scheduler.launch import get_agent
+
+    spec = JobSpec(job_name=name, job=command, workspace=".")
+    rid = get_agent(workdir).start_run(spec)
+    click.echo(f"run_id: {rid}")
+
+
+@cli.command()
+@click.argument("run_id")
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+def stop(run_id: str, workdir: str) -> None:
+    from fedml_tpu.scheduler.launch import run_stop
+
+    ok = run_stop(run_id, workdir=workdir)
+    click.echo("killed" if ok else "no such running job")
+    if not ok:
+        raise SystemExit(1)
+
+
+@cli.command()
+@click.argument("run_id")
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+def status(run_id: str, workdir: str) -> None:
+    from fedml_tpu.scheduler.launch import run_status
+
+    st = run_status(run_id, workdir=workdir)
+    click.echo(st or "unknown run")
+    if st is None:
+        raise SystemExit(1)
+
+
+@cli.command()
+@click.argument("run_id")
+@click.option("--tail", default=None, type=int)
+@click.option("--follow", is_flag=True)
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+def logs(run_id: str, tail, follow: bool, workdir: str) -> None:
+    from fedml_tpu.scheduler.launch import get_agent, run_logs
+
+    click.echo(run_logs(run_id, tail=tail, workdir=workdir))
+    while follow:
+        agent = get_agent(workdir)
+        rec = agent._runs.get(run_id)
+        if rec is None or rec.fsm.is_terminal:
+            break
+        time.sleep(1.0)
+        click.echo(run_logs(run_id, tail=5, workdir=workdir))
+
+
+@cli.command()
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+def jobs(workdir: str) -> None:
+    from fedml_tpu.scheduler.launch import list_jobs
+
+    for row in list_jobs(workdir=workdir):
+        click.echo(json.dumps(row))
+
+
+@cli.command()
+@click.option("--model", "model_size", default="tiny", show_default=True,
+              help="llama preset: tiny/llama2_7b/llama2_13b/llama3_8b")
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=8080, show_default=True)
+@click.option("--batch-slots", default=4, show_default=True)
+@click.option("--max-len", default=512, show_default=True)
+@click.option("--lora-rank", default=0, show_default=True)
+def serve(model_size: str, host: str, port: int, batch_slots: int,
+          max_len: int, lora_rank: int) -> None:
+    """Boot a continuous-batching LLM inference endpoint (blocking)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+    from fedml_tpu.serving import (
+        ContinuousBatchingEngine,
+        FedMLInferenceRunner,
+        LlamaPredictor,
+    )
+
+    class _A:
+        pass
+
+    a = _A()
+    a.model_size = model_size
+    a.lora_rank = lora_rank or None
+    cfg = LlamaConfig.from_args(a)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    engine = ContinuousBatchingEngine(
+        model, params, batch_slots=batch_slots, max_len=max_len
+    )
+    runner = FedMLInferenceRunner(
+        LlamaPredictor(engine), host=host, port=port
+    )
+    click.echo(f"serving {model_size} on http://{host}:{runner.port}")
+    runner.run()
+
+
+if __name__ == "__main__":
+    cli()

@@ -29,6 +29,8 @@ COMM_BACKEND_LOCAL = "LOCAL"      # deterministic in-process (tests, SP)
 COMM_BACKEND_GRPC = "GRPC"
 COMM_BACKEND_XLA_ICI = "XLA_ICI"  # intra-pod ranks == mesh axes, XLA collectives
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"  # gated: requires paho-mqtt + boto3
+COMM_BACKEND_BROKER = "BROKER"    # in-tree pub/sub broker + object store
+                                  # (the MQTT+S3 deployment shape, no deps)
 
 # ---- federated optimizers ---------------------------------------------------
 # Parity with the reference list (python/fedml/constants.py:40-63).

@@ -40,6 +40,10 @@ class ClientTrainer(abc.ABC):
     def set_data_sharding(self, sharding) -> None:
         """In-silo parallelism: shard local batches over a silo mesh."""
 
+    def set_server_state(self, server_state: dict) -> None:
+        """Round-scoped algorithm state pushed by the server/engine
+        (SCAFFOLD's c_global, Mime's server momentum s)."""
+
     # ---- parameter plumbing (pytree, not state_dict) --------------------
     def get_model_params(self) -> Pytree:
         raise NotImplementedError(

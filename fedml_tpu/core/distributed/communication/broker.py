@@ -1,0 +1,189 @@
+"""Lightweight TCP pub/sub broker — the MQTT stand-in.
+
+Parity target: the reference's default cross-silo control plane is a
+hosted MQTT broker (``mqtt_s3/mqtt_s3_multi_clients_comm_manager.py:20``,
+topics keyed by run_id/client). This environment ships no paho-mqtt and no
+external broker, so the framework carries its own: a single-process
+``PubSubBroker`` speaking a length-prefixed binary frame protocol
+
+    frame   := u32 len ‖ payload
+    payload := op (1 byte: S=subscribe, P=publish) ‖ u16 topic_len ‖ topic
+               ‖ body
+
+over TCP, with MQTT semantics (topic strings, fan-out to all subscribers,
+QoS0). Any rank can host it; everyone else dials host:port — the same
+deployment shape as a small MQTT broker, without the dependency.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_OP_SUB = b"S"
+_OP_PUB = b"P"
+MAX_FRAME = 1 << 30
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds limit")
+    return _recv_exact(sock, n)
+
+
+def _pack(op: bytes, topic: str, body: bytes = b"") -> bytes:
+    t = topic.encode()
+    return op + struct.pack(">H", len(t)) + t + body
+
+
+def _unpack(payload: bytes) -> Tuple[bytes, str, bytes]:
+    op = payload[:1]
+    (tlen,) = struct.unpack(">H", payload[1:3])
+    topic = payload[3 : 3 + tlen].decode()
+    return op, topic, payload[3 + tlen :]
+
+
+class PubSubBroker:
+    """The broker process: accepts connections, routes publishes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.create_server((host, port))
+        self._subs: Dict[str, List[socket.socket]] = {}
+        self._lock = threading.Lock()
+        # one write lock per subscriber socket: concurrent publishers fan
+        # out from their own _serve threads, and interleaved sendall calls
+        # would corrupt the length-prefixed frame stream
+        self._wlocks: Dict[socket.socket, threading.Lock] = {}
+        self._stopping = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._srv.getsockname()[:2]
+
+    def start(self) -> "PubSubBroker":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stopping.is_set():
+                payload = _recv_frame(conn)
+                if payload is None:
+                    break
+                op, topic, body = _unpack(payload)
+                if op == _OP_SUB:
+                    with self._lock:
+                        self._subs.setdefault(topic, []).append(conn)
+                        self._wlocks.setdefault(conn, threading.Lock())
+                elif op == _OP_PUB:
+                    self._route(topic, body)
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            with self._lock:
+                for subs in self._subs.values():
+                    if conn in subs:
+                        subs.remove(conn)
+                self._wlocks.pop(conn, None)
+            conn.close()
+
+    def _route(self, topic: str, body: bytes) -> None:
+        with self._lock:
+            targets = [
+                (sock, self._wlocks.setdefault(sock, threading.Lock()))
+                for sock in self._subs.get(topic, [])
+            ]
+        frame = _pack(_OP_PUB, topic, body)
+        for sock, wlock in targets:
+            try:
+                with wlock:  # serialize frames per subscriber socket
+                    _send_frame(sock, frame)
+            except OSError:
+                pass  # subscriber died; pruned on its reader exit
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class BrokerClient:
+    """Client connection: subscribe(topic, cb) + publish(topic, bytes)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        self._handlers: Dict[str, Callable[[bytes], None]] = {}
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def subscribe(self, topic: str, handler: Callable[[bytes], None]) -> None:
+        with self._lock:
+            self._handlers[topic] = handler
+            _send_frame(self._sock, _pack(_OP_SUB, topic))
+
+    def publish(self, topic: str, body: bytes) -> None:
+        with self._lock:
+            _send_frame(self._sock, _pack(_OP_PUB, topic, body))
+
+    def _read_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                payload = _recv_frame(self._sock)
+            except OSError:
+                return
+            if payload is None:
+                return
+            _, topic, body = _unpack(payload)
+            handler = self._handlers.get(topic)
+            if handler is not None:
+                try:
+                    handler(body)
+                except Exception:
+                    logger.exception("broker handler failed on %s", topic)
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

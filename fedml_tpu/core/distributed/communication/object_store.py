@@ -1,0 +1,76 @@
+"""Object store — the S3 payload-offload seam.
+
+Parity target: ``core/distributed/communication/s3/remote_storage.py``
+(669 LoC of boto3 put/get for model payloads). The API keeps the S3 shape
+(bucket-less keys, bytes in/out) behind an ABC so a real S3/GCS backend
+drops in later; the in-tree backend is a shared directory — which on
+multi-host TPU pods (NFS/gcsfuse mounts) is also the realistic deployment.
+"""
+from __future__ import annotations
+
+import abc
+import os
+import tempfile
+import uuid
+from typing import Optional
+
+
+class ObjectStore(abc.ABC):
+    @abc.abstractmethod
+    def put_object(self, key: str, data: bytes) -> str:
+        """Store bytes; returns the key (S3 parity: upload → url)."""
+
+    @abc.abstractmethod
+    def get_object(self, key: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def delete_object(self, key: str) -> None:
+        ...
+
+    def new_key(self, prefix: str = "payload") -> str:
+        return f"{prefix}/{uuid.uuid4().hex}"
+
+
+class LocalDirObjectStore(ObjectStore):
+    """Directory-backed store with atomic writes (tmp + rename)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = os.path.abspath(
+            root or os.path.join(tempfile.gettempdir(), "fedml_tpu_store")
+        )
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("..", "_")
+        path = os.path.join(self.root, safe)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        return path
+
+    def put_object(self, key: str, data: bytes) -> str:
+        path = self._path(key)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic: readers never see partials
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return key
+
+    def get_object(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def delete_object(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+def create_object_store(args=None) -> ObjectStore:
+    root = getattr(args, "object_store_dir", None) if args is not None else None
+    return LocalDirObjectStore(root)

@@ -121,10 +121,29 @@ class FedMLCommManager(Observer):
             )
 
             self.com_manager = XlaIciCommManager(run_id, self.rank, self.size)
+        elif backend == constants.COMM_BACKEND_BROKER:
+            from fedml_tpu.core.distributed.communication.broker_comm import (
+                BrokerCommManager,
+            )
+            from fedml_tpu.core.distributed.communication.object_store import (
+                create_object_store,
+            )
+
+            self.com_manager = BrokerCommManager(
+                run_id,
+                self.rank,
+                host=str(getattr(self.args, "broker_host", "127.0.0.1")),
+                port=int(getattr(self.args, "broker_port", 1883)),
+                object_store=create_object_store(self.args),
+                offload_bytes=int(
+                    getattr(self.args, "payload_offload_bytes", 64 * 1024)
+                ),
+            )
         elif backend == constants.COMM_BACKEND_MQTT_S3:
             raise RuntimeError(
                 "MQTT_S3 backend requires paho-mqtt/boto3 (not available in "
-                "this environment); use GRPC or LOCAL"
+                "this environment); use BROKER (in-tree pub/sub + object "
+                "store, same deployment shape), GRPC, or LOCAL"
             )
         else:
             raise ValueError(f"unknown comm backend {self.backend!r}")

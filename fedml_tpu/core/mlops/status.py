@@ -1,0 +1,80 @@
+"""Run-status state machine.
+
+Parity target: ``core/mlops/mlops_status.py`` + the status constants used
+by the agents (``slave/client_constants.py`` / ``master/server_constants``:
+IDLE/UPGRADING/QUEUED/INITIALIZING/TRAINING/STOPPING/KILLED/FAILED/
+FINISHED/EXCEPTION transitions). The reference scatters transition checks
+across runners; here one machine validates transitions and mirrors every
+change into the local metrics sink, so agents and engines share a single
+source of truth.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class RunStatus:
+    IDLE = "IDLE"
+    QUEUED = "QUEUED"
+    PROVISIONING = "PROVISIONING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+    EXCEPTION = "EXCEPTION"
+
+    TERMINAL = {FINISHED, FAILED, KILLED, EXCEPTION}
+
+    _ALLOWED = {
+        IDLE: {QUEUED, PROVISIONING, RUNNING, KILLED},
+        QUEUED: {PROVISIONING, RUNNING, KILLED, FAILED},
+        PROVISIONING: {RUNNING, FAILED, KILLED, EXCEPTION},
+        RUNNING: {STOPPING, FINISHED, FAILED, KILLED, EXCEPTION},
+        STOPPING: {KILLED, FINISHED, FAILED, EXCEPTION},
+        FINISHED: set(),
+        FAILED: set(),
+        KILLED: set(),
+        EXCEPTION: set(),
+    }
+
+    @classmethod
+    def can_transition(cls, src: str, dst: str) -> bool:
+        return dst in cls._ALLOWED.get(src, set())
+
+
+class RunStatusMachine:
+    """Validated status holder for one run; mirrors changes to observers."""
+
+    def __init__(self, run_id: Any, sink: Optional[Callable[[Dict], None]] = None):
+        self.run_id = run_id
+        self.status = RunStatus.IDLE
+        self.history: List[Dict] = []
+        self._sink = sink
+
+    def transition(self, dst: str, reason: str = "") -> bool:
+        """Returns True if applied; False (no-op) for an illegal move."""
+        if dst == self.status:
+            return True
+        if not RunStatus.can_transition(self.status, dst):
+            return False
+        entry = {
+            "run_id": self.run_id,
+            "from": self.status,
+            "to": dst,
+            "reason": reason,
+            "ts": time.time(),
+        }
+        self.status = dst
+        self.history.append(entry)
+        if self._sink is not None:
+            try:
+                self._sink(entry)
+            except Exception:
+                pass
+        return True
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.status in RunStatus.TERMINAL

@@ -95,6 +95,13 @@ class ClientMasterManager(FedMLCommManager):
         )
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        # model version this update was computed from — the async server
+        # uses it for staleness discounting; the sync server ignores it
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        metrics = getattr(self.trainer_dist_adapter, "last_train_metrics", None)
+        if metrics and metrics.get("local_steps") is not None:
+            # FedNova's τ_i: the server rescales the normalized aggregate
+            msg.add_params("local_steps", float(metrics["local_steps"]))
         self.send_message(msg)
 
     def __train(self, global_params) -> None:

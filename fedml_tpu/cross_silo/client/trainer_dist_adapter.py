@@ -77,9 +77,12 @@ class TrainerDistAdapter:
         self.trainer.set_round(round_idx)
         train_data = self.dataset.train_data_local_dict[self.client_index]
         n_samples = self.dataset.train_data_local_num_dict[self.client_index]
-        new_params, _metrics = self.trainer.run_local_training(
+        new_params, metrics = self.trainer.run_local_training(
             global_params, train_data, self.device, self.args
         )
+        # surfaced for the upload message (FedNova τ_i etc.) without
+        # breaking the (params, n) train contract
+        self.last_train_metrics = metrics
         return new_params, int(n_samples)
 
     def test(self, round_idx: int, params: Pytree) -> dict:

@@ -1,0 +1,18 @@
+"""Bonawitz secure-aggregation cross-silo engine.
+
+Parity: reference ``cross_silo/secagg/`` (sa_fedml_aggregator.py,
+sa_fedml_client_manager.py, sa_fedml_server_manager.py,
+sa_message_define.py) over the vectorized finite-field math in
+``core/mpc/secagg.py``.
+"""
+from fedml_tpu.cross_silo.secagg.run_inproc import run_secagg_inproc
+from fedml_tpu.cross_silo.secagg.sa_client_manager import SAClientManager
+from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage
+from fedml_tpu.cross_silo.secagg.sa_server_manager import SAServerManager
+
+__all__ = [
+    "SAClientManager",
+    "SAMessage",
+    "SAServerManager",
+    "run_secagg_inproc",
+]

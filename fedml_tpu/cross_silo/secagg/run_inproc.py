@@ -1,0 +1,72 @@
+"""In-process SecAgg federation harness.
+
+Same shape as ``cross_silo/lightsecagg/run_inproc.py`` — the reference CI's
+process-spawning script collapsed onto the deterministic LOCAL transport —
+but driving the Bonawitz SecAgg manager FSMs (parity:
+``cross_silo/secagg/`` in the reference).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, List, Optional
+
+from fedml_tpu.core.distributed.communication.local_comm import LocalBroker
+from fedml_tpu.cross_silo.client.trainer_dist_adapter import TrainerDistAdapter
+from fedml_tpu.cross_silo.run_inproc import run_managers_to_completion
+from fedml_tpu.cross_silo.secagg.sa_client_manager import SAClientManager
+from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage
+from fedml_tpu.cross_silo.secagg.sa_server_manager import SAServerManager
+from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+from fedml_tpu.data.dataset import FederatedDataset
+from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
+from fedml_tpu.models import model_hub
+
+
+def run_secagg_inproc(
+    args: Any,
+    dataset: FederatedDataset,
+    model: Any,
+    client_trainer=None,
+    server_aggregator=None,
+    timeout: float = 600.0,
+) -> Optional[dict]:
+    """Run SecAgg server + clients to completion; return server metrics."""
+    run_id = str(getattr(args, "run_id", "0"))
+    LocalBroker.destroy(run_id)
+    client_num = int(getattr(args, "client_num_per_round", 1))
+
+    aggregator = server_aggregator or create_server_aggregator(model, args)
+    aggregator.set_id(0)
+    fedml_aggregator = FedMLAggregator(
+        dataset.test_data_global,
+        dataset.train_data_global,
+        dataset.train_data_num,
+        dataset.train_data_local_dict,
+        dataset.test_data_local_dict,
+        dataset.train_data_local_num_dict,
+        client_num,
+        None,
+        args,
+        aggregator,
+    )
+    sample_x = dataset.train_data_global[0][: int(getattr(args, "batch_size", 32))]
+    fedml_aggregator.set_global_model_params(
+        model_hub.init_params(model, args, sample_x)
+    )
+    server_mgr = SAServerManager(args, fedml_aggregator, client_rank=0,
+                                 client_num=client_num)
+
+    client_mgrs: List[SAClientManager] = []
+    for rank in range(1, client_num + 1):
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        adapter = TrainerDistAdapter(cargs, None, rank, model, dataset,
+                                     client_trainer)
+        client_mgrs.append(
+            SAClientManager(cargs, adapter, rank=rank, size=client_num + 1)
+        )
+
+    managers = [server_mgr] + client_mgrs
+    return run_managers_to_completion(
+        managers, run_id, SAMessage.MSG_TYPE_CONNECTION_IS_READY, timeout
+    )

@@ -1,0 +1,185 @@
+"""Bonawitz SecAgg client FSM.
+
+Parity: ``cross_silo/secagg/sa_fedml_client_manager.py`` in the reference.
+Round phases on the client:
+
+  sync(model) → X25519 keygen, advertise pk → on the server's pk broadcast:
+  agree pairwise seeds, Shamir-share the self-mask seed (row j → client j,
+  server relays) → local train, quantize, mask (self + pairwise) → upload
+  x̃_i → on the reconstruction request: reveal held self-seed shares of
+  SURVIVORS + pairwise seeds shared with DROPPED clients (never both for
+  one client — that is the protocol's core privacy invariant).
+
+The trust math lives in ``core/mpc/secagg.py`` (vectorized finite-field
+ops, X25519 key exchange, OS-entropy seeds); this manager only moves its
+artifacts over the federation transport.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, tree_to_finite
+from fedml_tpu.core.mpc.secagg import SecAggClient
+from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class SAClientManager(FedMLCommManager):
+    def __init__(self, args: Any, trainer_dist_adapter, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, rank, size, backend)
+        self.adapter = trainer_dist_adapter
+        self.num_rounds = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.n_clients = size - 1
+        self.threshold = int(getattr(args, "sa_threshold",
+                                     max(1, self.n_clients // 2)))
+        self.p = int(getattr(args, "sa_prime", DEFAULT_PRIME))
+        self.q_bits = int(getattr(args, "sa_q_bits", 16))
+        # CI-only dropout simulation: this rank goes silent after key/share
+        # distribution in round 0 (production uses the server's timeout)
+        self.simulate_dropout = (
+            int(getattr(args, "sa_simulate_dropout_rank", -1)) == rank
+        )
+        self.has_sent_online_msg = False
+        self._reset_round_state()
+
+    def _reset_round_state(self):
+        self.sa: Optional[SecAggClient] = None
+        self.held_shares: Dict[int, np.ndarray] = {}  # owner rank → my share
+        self.global_params = None
+        self.silo_idx = None
+
+    # -- registration ------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        M = SAMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_check_status)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_INIT_CONFIG, self.handle_sync_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_sync_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_BROADCAST_PUBLIC_KEYS, self.handle_public_keys)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FORWARD_SEED_SHARE, self.handle_seed_share)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_REQUEST_RECONSTRUCTION, self.handle_reconstruction)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+    # -- handshake ---------------------------------------------------------
+    def handle_connection_ready(self, msg: Message) -> None:
+        if not self.has_sent_online_msg:
+            self.has_sent_online_msg = True
+            self._send_status(0)
+
+    def handle_check_status(self, msg: Message) -> None:
+        self._send_status(msg.get_sender_id())
+
+    def _send_status(self, receiver: int) -> None:
+        M = SAMessage
+        m = Message(M.MSG_TYPE_C2S_CLIENT_STATUS, self.get_sender_id(), receiver)
+        m.add_params(M.MSG_ARG_KEY_CLIENT_STATUS, M.MSG_CLIENT_STATUS_IDLE)
+        self.send_message(m)
+
+    # -- round body --------------------------------------------------------
+    def handle_sync_model(self, msg: Message) -> None:
+        M = SAMessage
+        self._reset_round_state()
+        self.global_params = msg.get(M.MSG_ARG_KEY_MODEL_PARAMS)
+        self.silo_idx = int(msg.get(M.MSG_ARG_KEY_CLIENT_INDEX))
+        self.round_idx = int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx))
+        # fresh per-round keys from OS entropy (core/mpc/secagg keygen)
+        # dim is fixed later, after training; keys can go out immediately
+        self.sa = SecAggClient(
+            client_id=self.rank, n_clients=self.n_clients,
+            threshold=self.threshold, dim=1, p=self.p,
+        )
+        m = Message(M.MSG_TYPE_C2S_SEND_PUBLIC_KEY, self.get_sender_id(), 0)
+        m.add_params(M.MSG_ARG_KEY_PUBLIC_KEY, self.sa.pk)
+        m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+        self.send_message(m)
+
+    def handle_public_keys(self, msg: Message) -> None:
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
+            return
+        pks = {int(k): v for k, v in msg.get(M.MSG_ARG_KEY_PUBLIC_KEYS).items()}
+        # SecAggClient ids are ranks (1-based) — keep rank keying throughout
+        self.sa.set_peer_keys({j: pk for j, pk in pks.items() if j != self.rank})
+        # Shamir rows: row h (0-based) goes to rank h+1; keep own row
+        shares = self.sa.self_seed_shares()
+        for h in range(self.n_clients):
+            rank_h = h + 1
+            if rank_h == self.rank:
+                self.held_shares[self.rank] = shares[h]
+                continue
+            m = Message(M.MSG_TYPE_C2S_SEND_SEED_SHARE, self.get_sender_id(), 0)
+            m.add_params(M.MSG_ARG_KEY_SHARE_TARGET, rank_h)
+            m.add_params(M.MSG_ARG_KEY_SEED_SHARE, shares[h])
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(m)
+        if self.simulate_dropout and self.round_idx == 0:
+            # keys + shares are out; the "crash" happens before upload.
+            # Production: the server's liveness timeout flags the silence;
+            # in-proc the broker is synchronous, so announce it explicitly.
+            m = Message(M.MSG_TYPE_C2S_DROPOUT, self.get_sender_id(), 0)
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(m)
+            return
+        self._train_and_upload()
+
+    def _train_and_upload(self) -> None:
+        M = SAMessage
+        self.adapter.update_dataset(self.silo_idx)
+        weights, n_samples = self.adapter.train(self.round_idx, self.global_params)
+        x_finite, _ = tree_to_finite(weights, self.q_bits, self.p)
+        self.sa.dim = int(x_finite.shape[0])
+        masked = self.sa.mask(x_finite)
+        up = Message(M.MSG_TYPE_C2S_SEND_MASKED_MODEL, self.get_sender_id(), 0)
+        up.add_params(M.MSG_ARG_KEY_MASKED_MODEL, masked)
+        up.add_params(M.MSG_ARG_KEY_NUM_SAMPLES, int(n_samples))
+        up.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+        self.send_message(up)
+
+    def handle_seed_share(self, msg: Message) -> None:
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
+            return
+        owner = int(msg.get("origin_client"))
+        self.held_shares[owner] = np.asarray(
+            msg.get(M.MSG_ARG_KEY_SEED_SHARE), np.int64)
+
+    def handle_reconstruction(self, msg: Message) -> None:
+        """Reveal survivors' self-seed shares + dropped clients' pairwise
+        seeds. A client reveals the self-share OR the pairwise seed for any
+        given peer — never both (that would unmask an individual model)."""
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
+            return
+        survivors = [int(s) for s in msg.get(M.MSG_ARG_KEY_SURVIVORS)]
+        dropped = [int(d) for d in msg.get(M.MSG_ARG_KEY_DROPPED)]
+        self_shares = {
+            owner: self.held_shares[owner]
+            for owner in survivors if owner in self.held_shares
+        }
+        pairwise = {d: self.sa.pairwise_seed(d) for d in dropped
+                    if d in self.sa.pairwise}
+        m = Message(M.MSG_TYPE_C2S_SEND_RECONSTRUCTION, self.get_sender_id(), 0)
+        m.add_params(M.MSG_ARG_KEY_SELF_SHARES, self_shares)
+        m.add_params(M.MSG_ARG_KEY_PAIRWISE_SEEDS, pairwise)
+        m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+        self.send_message(m)
+
+    def handle_finish(self, msg: Message) -> None:
+        self.finish()

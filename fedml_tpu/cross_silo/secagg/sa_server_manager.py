@@ -1,0 +1,245 @@
+"""Bonawitz SecAgg server FSM.
+
+Parity: ``cross_silo/secagg/sa_fedml_aggregator.py`` (317 LoC) +
+``sa_fedml_server_manager.py``. The server:
+
+  handshake → init → collect pks, broadcast the key directory → relay
+  Shamir seed-share rows between clients → collect masked models (a
+  dropout notice — production: liveness timeout — removes a client from
+  the expected set) → request reconstruction from survivors → once the
+  reveal quorum is in, strip self masks (Shamir-reconstructed seeds) and
+  the dropped clients' half-cancelled pairwise masks → dequantize the SUM,
+  average, test → next round.
+
+The server never sees an individual model: uploads arrive masked, and the
+reveals only ever cover survivors' self-seeds and dropped clients'
+pairwise seeds.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mlops import metrics as mlops
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, finite_to_tree
+from fedml_tpu.core.mpc.secagg import SecAggServer
+from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class SAServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator, comm=None, client_rank: int = 0,
+                 client_num: int = 0, backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.args.round_idx = 0
+        self.client_num = client_num
+        self.threshold = int(getattr(args, "sa_threshold", max(1, client_num // 2)))
+        self.p = int(getattr(args, "sa_prime", DEFAULT_PRIME))
+        self.q_bits = int(getattr(args, "sa_q_bits", 16))
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        self.result: Optional[dict] = None
+        self._reset_round_state()
+
+    def _reset_round_state(self):
+        self.public_keys: Dict[int, bytes] = {}
+        self.masked_models: Dict[int, np.ndarray] = {}
+        self.sample_nums: Dict[int, int] = {}
+        self.dropped: set = set()
+        self.reveals: Dict[int, Dict] = {}
+        self.reconstruction_requested = False
+        self.round_done = False
+
+    # -- registration ------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        M = SAMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_client_status)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_PUBLIC_KEY, self.handle_public_key)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_SEED_SHARE, self.handle_relay_seed_share)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_MASKED_MODEL, self.handle_masked_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_DROPOUT, self.handle_dropout)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_RECONSTRUCTION, self.handle_reconstruction)
+
+    # -- handshake ---------------------------------------------------------
+    def handle_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        M = SAMessage
+        for cid in range(1, self.client_num + 1):
+            self.send_message(Message(
+                M.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.get_sender_id(), cid))
+
+    def handle_client_status(self, msg: Message) -> None:
+        M = SAMessage
+        if msg.get(M.MSG_ARG_KEY_CLIENT_STATUS) == M.MSG_CLIENT_STATUS_IDLE:
+            self.client_online_status[msg.get_sender_id()] = True
+        if not self.is_initialized and all(
+            self.client_online_status.get(c, False)
+            for c in range(1, self.client_num + 1)
+        ):
+            self.is_initialized = True
+            self._sync_model(SAMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _sync_model(self, msg_type: str) -> None:
+        M = SAMessage
+        global_params = self.aggregator.get_global_model_params()
+        for cid in range(1, self.client_num + 1):
+            m = Message(msg_type, self.get_sender_id(), cid)
+            m.add_params(M.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            m.add_params(M.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(m)
+
+    # -- round body --------------------------------------------------------
+    def handle_public_key(self, msg: Message) -> None:
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
+            return
+        self.public_keys[msg.get_sender_id()] = msg.get(M.MSG_ARG_KEY_PUBLIC_KEY)
+        if len(self.public_keys) == self.client_num:
+            for cid in range(1, self.client_num + 1):
+                m = Message(M.MSG_TYPE_S2C_BROADCAST_PUBLIC_KEYS,
+                            self.get_sender_id(), cid)
+                m.add_params(M.MSG_ARG_KEY_PUBLIC_KEYS, dict(self.public_keys))
+                m.add_params(M.MSG_ARG_KEY_ROUND, self.args.round_idx)
+                self.send_message(m)
+
+    def handle_relay_seed_share(self, msg: Message) -> None:
+        M = SAMessage
+        target = int(msg.get(M.MSG_ARG_KEY_SHARE_TARGET))
+        fwd = Message(M.MSG_TYPE_S2C_FORWARD_SEED_SHARE,
+                      self.get_sender_id(), target)
+        fwd.add_params("origin_client", msg.get_sender_id())
+        fwd.add_params(M.MSG_ARG_KEY_SEED_SHARE, msg.get(M.MSG_ARG_KEY_SEED_SHARE))
+        fwd.add_params(M.MSG_ARG_KEY_ROUND,
+                       msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx))
+        self.send_message(fwd)
+
+    def handle_dropout(self, msg: Message) -> None:
+        """Production: raised by the liveness timeout; CI: an explicit
+        notice from the simulated-crash client (deterministic in-proc)."""
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
+            return
+        self.dropped.add(msg.get_sender_id())
+        self._maybe_request_reconstruction()
+
+    def handle_masked_model(self, msg: Message) -> None:
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
+            return
+        sender = msg.get_sender_id()
+        self.masked_models[sender] = np.asarray(
+            msg.get(M.MSG_ARG_KEY_MASKED_MODEL), np.int64)
+        self.sample_nums[sender] = int(msg.get(M.MSG_ARG_KEY_NUM_SAMPLES))
+        self._maybe_request_reconstruction()
+
+    def _maybe_request_reconstruction(self) -> None:
+        M = SAMessage
+        if self.reconstruction_requested:
+            return
+        if len(self.masked_models) + len(self.dropped) < self.client_num:
+            return
+        survivors = sorted(self.masked_models)
+        if len(survivors) <= self.threshold:
+            raise RuntimeError(
+                f"SecAgg: only {len(survivors)} survivors ≤ threshold "
+                f"{self.threshold}; aggregate unrecoverable"
+            )
+        self.reconstruction_requested = True
+        for cid in survivors:
+            m = Message(M.MSG_TYPE_S2C_REQUEST_RECONSTRUCTION,
+                        self.get_sender_id(), cid)
+            m.add_params(M.MSG_ARG_KEY_SURVIVORS, survivors)
+            m.add_params(M.MSG_ARG_KEY_DROPPED, sorted(self.dropped))
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(m)
+
+    def handle_reconstruction(self, msg: Message) -> None:
+        M = SAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
+            return
+        if self.round_done:
+            return
+        sender = msg.get_sender_id()
+        self.reveals[sender] = {
+            "self_shares": {
+                int(k): np.asarray(v, np.int64)
+                for k, v in msg.get(M.MSG_ARG_KEY_SELF_SHARES).items()
+            },
+            "pairwise": {
+                int(k): int(v)
+                for k, v in msg.get(M.MSG_ARG_KEY_PAIRWISE_SEEDS).items()
+            },
+        }
+        survivors = sorted(self.masked_models)
+        if any(s not in self.reveals for s in survivors):
+            return
+        self.round_done = True
+        self._unmask_and_advance(survivors)
+
+    def _unmask_and_advance(self, survivors) -> None:
+        dim = self.masked_models[survivors[0]].shape[0]
+        server = SecAggServer(self.client_num, self.threshold, dim, self.p)
+        self_seed_shares = {
+            owner: {
+                holder: self.reveals[holder]["self_shares"][owner]
+                for holder in survivors
+                if owner in self.reveals[holder]["self_shares"]
+            }
+            for owner in survivors
+        }
+        dropped_pairwise = {
+            d: {s: self.reveals[s]["pairwise"][d] for s in survivors}
+            for d in sorted(self.dropped)
+        }
+        # SecAggServer indexes shares by 0-based holder (share row h ↔ rank
+        # h+1): shift the rank keys down
+        agg_finite = server.aggregate(
+            masked=dict(self.masked_models),
+            self_seed_shares={
+                o: {h - 1: row for h, row in holders.items()}
+                for o, holders in self_seed_shares.items()
+            },
+            dropped_pairwise=dropped_pairwise,
+        )
+        template = self.aggregator.get_global_model_params()
+        summed = finite_to_tree(agg_finite, template, self.q_bits, self.p,
+                                n_summands=len(survivors))
+        import jax
+
+        n_active = float(len(survivors))
+        averaged = jax.tree.map(lambda x: x / n_active, summed)
+        self.aggregator.set_global_model_params(averaged)
+
+        metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        mlops.log({"round": self.args.round_idx, "secure": "secagg",
+                   "dropped": sorted(self.dropped), **metrics})
+        self.args.round_idx += 1
+        self._reset_round_state()
+        if self.args.round_idx >= self.round_num:
+            self.result = {"rounds": self.round_num,
+                           "global_model": averaged, **metrics}
+            M = SAMessage
+            for cid in range(1, self.client_num + 1):
+                self.send_message(Message(
+                    M.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))
+            self.finish()
+            return
+        self._sync_model(SAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)

@@ -1,0 +1,132 @@
+"""Asynchronous FedAvg server FSM (FedAsync-style).
+
+Parity: ``simulation/mpi/async_fedavg/`` in the reference — the only
+asynchronous variant it ships. Here async aggregation is a first-class
+cross-silo server: there is NO round barrier. Each client update is
+applied the moment it arrives,
+
+    x ← (1 − α_s)·x + α_s·x_i,   α_s = α·(1 + staleness)^(−a)
+
+(polynomial staleness discount, Xie et al. '19), and the *same* client is
+immediately handed the new model for its next local round. A lost client
+therefore slows nothing down — the exact failure mode that stalls the
+synchronous FSM's ``check_whether_all_receive``.
+
+Budget: ``async_total_updates`` applied updates (default
+comm_round × client_num), then test + finish.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mlops import metrics as mlops
+from fedml_tpu.cross_silo.message_define import MyMessage
+from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncFedMLServerManager(FedMLCommManager):
+    def __init__(
+        self,
+        args: Any,
+        aggregator: FedMLAggregator,
+        comm=None,
+        client_rank: int = 0,
+        client_num: int = 0,
+        backend: str = constants.COMM_BACKEND_LOCAL,
+    ):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.client_num = client_num
+        self.alpha = float(getattr(args, "async_alpha", 0.6))
+        self.staleness_exp = float(getattr(args, "async_staleness_exponent", 0.5))
+        self.total_updates = int(getattr(
+            args, "async_total_updates",
+            int(getattr(args, "comm_round", 1)) * client_num))
+        self.version = 0  # server model version == #applied updates
+        self.staleness_seen: list = []
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        self.finishing = False
+        self.result: Optional[dict] = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_client_status)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_client_update)
+
+    # -- handshake ---------------------------------------------------------
+    def handle_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        for cid in range(1, self.client_num + 1):
+            self.send_message(Message(
+                MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS,
+                self.get_sender_id(), cid))
+
+    def handle_client_status(self, msg: Message) -> None:
+        if msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS) == MyMessage.MSG_CLIENT_STATUS_IDLE:
+            self.client_online_status[msg.get_sender_id()] = True
+        if not self.is_initialized and all(
+            self.client_online_status.get(c, False)
+            for c in range(1, self.client_num + 1)
+        ):
+            self.is_initialized = True
+            global_params = self.aggregator.get_global_model_params()
+            for cid in range(1, self.client_num + 1):
+                m = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
+                            self.get_sender_id(), cid)
+                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+                m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+                m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.version)
+                self.send_message(m)
+
+    # -- async hot path ----------------------------------------------------
+    def handle_client_update(self, msg: Message) -> None:
+        if self.finishing:
+            return
+        sender = msg.get_sender_id()
+        w_client = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
+        staleness = max(0, self.version - base_version)
+        a = self.alpha * (1.0 + staleness) ** (-self.staleness_exp)
+        x = self.aggregator.get_global_model_params()
+        mixed = jax.tree.map(lambda g, c: (1.0 - a) * g + a * c, x, w_client)
+        self.aggregator.set_global_model_params(mixed)
+        self.version += 1
+        self.staleness_seen.append(staleness)
+
+        if self.version >= self.total_updates:
+            self.finishing = True
+            metrics = self.aggregator.test_on_server_for_all_clients(self.version)
+            mlops.log({"async_updates": self.version,
+                       "mean_staleness": float(
+                           sum(self.staleness_seen) / len(self.staleness_seen)),
+                       **metrics})
+            self.result = {"updates": self.version,
+                           "staleness": list(self.staleness_seen), **metrics}
+            for cid in range(1, self.client_num + 1):
+                self.send_message(Message(
+                    MyMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))
+            self.finish()
+            return
+
+        # hand the refreshed model straight back to the reporting client —
+        # no barrier, other clients keep training on their (stale) versions
+        m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                    self.get_sender_id(), sender)
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                     self.aggregator.get_global_model_params())
+        m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, sender - 1)
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.version)
+        self.send_message(m)

@@ -47,6 +47,7 @@ class FedMLAggregator:
         self.global_params: Optional[Pytree] = None
         self.model_dict: Dict[int, Pytree] = {}
         self.sample_num_dict: Dict[int, int] = {}
+        self.local_steps_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {i: False for i in range(self.client_num)}
 
     def set_global_model_params(self, params: Pytree) -> None:
@@ -55,10 +56,14 @@ class FedMLAggregator:
     def get_global_model_params(self) -> Pytree:
         return self.global_params
 
-    def add_local_trained_result(self, index: int, model_params: Pytree, sample_num: int) -> None:
+    def add_local_trained_result(self, index: int, model_params: Pytree,
+                                 sample_num: int,
+                                 local_steps: Optional[float] = None) -> None:
         logger.debug("add model from client idx %d (n=%d)", index, sample_num)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = int(sample_num)
+        if local_steps is not None:
+            self.local_steps_dict[index] = float(local_steps)
         self.flag_client_model_uploaded_dict[index] = True
 
     def check_whether_all_receive(self) -> bool:
@@ -83,9 +88,22 @@ class FedMLAggregator:
         w_list, _ = self.aggregator.on_before_aggregation(raw_list)
         w_agg = self.aggregator.aggregate(w_list)
         w_agg = self.aggregator.on_after_aggregation(w_agg)
-        self.global_params = self.server_opt.step(self.global_params, w_agg)
+        tau_eff = None
+        if (str(getattr(self.args, "federated_optimizer", "")) == "FedNova"
+                and self.local_steps_dict):
+            counts = np.asarray(
+                [float(self.sample_num_dict[i]) for i in sorted(self.model_dict)]
+            )
+            taus = np.asarray(
+                [self.local_steps_dict.get(i, 1.0) for i in sorted(self.model_dict)]
+            )
+            tau_eff = float(np.sum(counts / counts.sum() * taus))
+        self.global_params = self.server_opt.step(
+            self.global_params, w_agg, tau_eff=tau_eff
+        )
         self.model_dict.clear()
         self.sample_num_dict.clear()
+        self.local_steps_dict.clear()
         return self.global_params
 
     # -- selection (parity: fedml_aggregator.py:96-140); routed through the

@@ -34,6 +34,28 @@ class FedMLServerManager(FedMLCommManager):
         self.round_num = int(getattr(args, "comm_round", 1))
         self.args.round_idx = 0
         self.client_num = client_num
+
+        # round checkpoint/resume: a restarted server re-enters at the
+        # last aggregated round with the aggregated model + optimizer state
+        from fedml_tpu.core.checkpoint import (
+            apply_round_state,
+            engine_checkpointer,
+            pack_round_state,
+        )
+
+        self._ckpt = engine_checkpointer(args)
+        if self._ckpt is not None and bool(getattr(args, "resume", False)):
+            template = pack_round_state(
+                self.aggregator.get_global_model_params(),
+                self.aggregator.server_opt, 0,
+            )
+            restored = self._ckpt.restore_latest(template)
+            if restored is not None:
+                _, state = restored
+                self.aggregator.set_global_model_params(state["global_params"])
+                self.args.round_idx = apply_round_state(
+                    state, self.aggregator.server_opt
+                )
         self.client_online_status: Dict[int, bool] = {}
         self.client_id_list_in_this_round = None
         self.data_silo_index_of_client: Dict[int, int] = {}
@@ -91,6 +113,16 @@ class FedMLServerManager(FedMLCommManager):
         )
         if all_online and not self.is_initialized:
             self.is_initialized = True
+            if self.args.round_idx >= self.round_num:
+                # resumed past the final round: report and finish, don't
+                # train an extra round beyond comm_round
+                metrics = self.aggregator.test_on_server_for_all_clients(
+                    self.args.round_idx - 1
+                )
+                self.result = {"rounds": self.round_num, **metrics}
+                self._send_finish()
+                self.finish()
+                return
             self._select_round_clients()
             self.send_init_msg()
 
@@ -113,7 +145,8 @@ class FedMLServerManager(FedMLCommManager):
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         self.aggregator.add_local_trained_result(
-            self.client_id_list_in_this_round.index(sender), model_params, local_sample_num
+            self.client_id_list_in_this_round.index(sender), model_params,
+            local_sample_num, local_steps=msg.get("local_steps"),
         )
         if not self.aggregator.check_whether_all_receive_subset(
             len(self.client_id_list_in_this_round)
@@ -123,6 +156,15 @@ class FedMLServerManager(FedMLCommManager):
         global_params = self.aggregator.aggregate()
         metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
         mlops.log({"round": self.args.round_idx, **{k: v for k, v in metrics.items()}})
+
+        if self._ckpt is not None:
+            from fedml_tpu.core.checkpoint import pack_round_state, should_save
+
+            if should_save(self.args, self.args.round_idx):
+                self._ckpt.save(self.args.round_idx, pack_round_state(
+                    global_params, self.aggregator.server_opt,
+                    self.args.round_idx + 1,
+                ))
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:

@@ -40,10 +40,23 @@ class Server:
         self.fedml_aggregator.set_global_model_params(
             model_hub.init_params(model, args, sample_x)
         )
-        self.manager = FedMLServerManager(
-            args, self.fedml_aggregator, client_rank=0, client_num=client_num,
-            backend=backend,
+        use_async = bool(getattr(args, "async_aggregation", False)) or (
+            str(getattr(args, "federated_optimizer", "")) == "AsyncFedAvg"
         )
+        if use_async:
+            from fedml_tpu.cross_silo.server.async_server_manager import (
+                AsyncFedMLServerManager,
+            )
+
+            self.manager = AsyncFedMLServerManager(
+                args, self.fedml_aggregator, client_rank=0,
+                client_num=client_num, backend=backend,
+            )
+        else:
+            self.manager = FedMLServerManager(
+                args, self.fedml_aggregator, client_rank=0, client_num=client_num,
+                backend=backend,
+            )
 
     def run(self):
         self.manager.run()

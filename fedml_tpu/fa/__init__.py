@@ -1,0 +1,21 @@
+"""Federated analytics engine ("FA").
+
+Parity: reference ``fa/`` (56 files, base_frame + analyzer/aggregator per
+task + cross-silo manager clones) — AVG, TrieHH heavy hitters, union,
+intersection, cardinality, frequency estimation, k-percentile, histogram
+(``fa/constants.py:5-13``), over the same FSM the cross-silo engine uses.
+"""
+from fedml_tpu.fa.aggregator import create_aggregator
+from fedml_tpu.fa.analyzer import create_analyzer
+from fedml_tpu.fa.base_frame import FAClientAnalyzer, FAServerAggregator
+from fedml_tpu.fa.constants import ALL_TASKS
+from fedml_tpu.fa.run_inproc import run_fa_inproc
+
+__all__ = [
+    "ALL_TASKS",
+    "FAClientAnalyzer",
+    "FAServerAggregator",
+    "create_aggregator",
+    "create_analyzer",
+    "run_fa_inproc",
+]

@@ -1,0 +1,52 @@
+"""FA frame — client analyzer / server aggregator ABCs.
+
+Parity: ``fa/base_frame/client_analyzer.py`` and
+``fa/base_frame/server_aggregator.py``. The FA engine reuses the
+cross-silo FSM with scalar payloads (SURVEY §2.8): a task is a pair of
+operators, possibly iterated over rounds (TrieHH, k-percentile).
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional, Tuple
+
+Payload = Any
+
+
+class FAClientAnalyzer(abc.ABC):
+    """Local analysis operator: (local data, server state) → submission."""
+
+    def __init__(self, args: Any = None):
+        self.args = args
+        self.id = 0
+
+    def set_id(self, analyzer_id: int) -> None:
+        self.id = analyzer_id
+
+    @abc.abstractmethod
+    def local_analyze(self, data: Any, server_state: Payload,
+                      round_idx: int) -> Payload:
+        ...
+
+
+class FAServerAggregator(abc.ABC):
+    """Server reduction operator, iterated until it reports done.
+
+    ``aggregate`` returns (next server_state, done, result) — result is
+    meaningful only when done is True.
+    """
+
+    def __init__(self, args: Any = None):
+        self.args = args
+
+    def init_state(self) -> Payload:
+        """State broadcast with the first analyze request."""
+        return None
+
+    @abc.abstractmethod
+    def aggregate(
+        self,
+        submissions: List[Tuple[int, Payload]],
+        round_idx: int,
+    ) -> Tuple[Payload, bool, Optional[Payload]]:
+        ...

@@ -1,0 +1,15 @@
+"""FA protocol messages — the cross-silo FSM with analytics payloads.
+
+Parity: ``fa/cross_silo/`` manager clones in the reference.
+"""
+from fedml_tpu.cross_silo.message_define import MyMessage
+
+
+class FAMessage(MyMessage):
+    MSG_TYPE_S2C_ANALYZE_REQUEST = "MSG_TYPE_S2C_ANALYZE_REQUEST"
+    MSG_TYPE_C2S_SUBMIT = "MSG_TYPE_C2S_SUBMIT"
+
+    MSG_ARG_KEY_FA_TASK = "fa_task"
+    MSG_ARG_KEY_SERVER_STATE = "fa_server_state"
+    MSG_ARG_KEY_SUBMISSION = "fa_submission"
+    MSG_ARG_KEY_RESULT = "fa_result"

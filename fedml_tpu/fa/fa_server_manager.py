@@ -1,0 +1,95 @@
+"""FA server FSM: handshake → broadcast analyze request (+state) → collect
+submissions → aggregate → iterate or finish with the result.
+
+Parity: ``fa/cross_silo/fa_server_manager`` shape in the reference — the
+cross-silo server FSM with the model-sync phase replaced by analytics
+state broadcast.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.core.mlops import metrics as mlops
+from fedml_tpu.fa.fa_message_define import FAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class FAServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator, comm=None, client_rank: int = 0,
+                 client_num: int = 0, backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.client_num = client_num
+        self.task = str(getattr(args, "fa_task"))
+        self.round_idx = 0
+        self.server_state = aggregator.init_state()
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        self.submissions: Dict[int, Any] = {}
+        self.result: Optional[dict] = None
+
+    def register_message_receive_handlers(self) -> None:
+        M = FAMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_client_status)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SUBMIT, self.handle_submission)
+
+    def handle_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        M = FAMessage
+        for cid in range(1, self.client_num + 1):
+            self.send_message(Message(
+                M.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.get_sender_id(), cid))
+
+    def handle_client_status(self, msg: Message) -> None:
+        M = FAMessage
+        if msg.get(M.MSG_ARG_KEY_CLIENT_STATUS) == M.MSG_CLIENT_STATUS_IDLE:
+            self.client_online_status[msg.get_sender_id()] = True
+        if not self.is_initialized and all(
+            self.client_online_status.get(c, False)
+            for c in range(1, self.client_num + 1)
+        ):
+            self.is_initialized = True
+            self._broadcast_request()
+
+    def _broadcast_request(self) -> None:
+        M = FAMessage
+        for cid in range(1, self.client_num + 1):
+            m = Message(M.MSG_TYPE_S2C_ANALYZE_REQUEST, self.get_sender_id(), cid)
+            m.add_params(M.MSG_ARG_KEY_FA_TASK, self.task)
+            m.add_params(M.MSG_ARG_KEY_SERVER_STATE, self.server_state)
+            m.add_params(M.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+            m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(m)
+
+    def handle_submission(self, msg: Message) -> None:
+        M = FAMessage
+        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
+            return
+        self.submissions[msg.get_sender_id()] = msg.get(M.MSG_ARG_KEY_SUBMISSION)
+        if len(self.submissions) < self.client_num:
+            return
+        subs = sorted(self.submissions.items())
+        self.submissions = {}
+        state, done, result = self.aggregator.aggregate(subs, self.round_idx)
+        self.round_idx += 1
+        if done:
+            self.result = {"task": self.task, "rounds": self.round_idx, **result}
+            mlops.log({"fa_task": self.task, **{k: str(v) for k, v in result.items()}})
+            M = FAMessage
+            for cid in range(1, self.client_num + 1):
+                self.send_message(Message(
+                    M.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))
+            self.finish()
+            return
+        self.server_state = state
+        self._broadcast_request()

@@ -1,0 +1,47 @@
+"""In-process FA federation harness + the public run_fa entry.
+
+Parity: the reference runs FA through FedMLRunner with
+``training_type: federated_analytics`` (``fa/`` engine); here
+``run_fa_inproc(args, client_data)`` drives the manager FSMs over the
+deterministic LOCAL transport and returns the server's result.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.core.distributed.communication.local_comm import LocalBroker
+from fedml_tpu.cross_silo.run_inproc import run_managers_to_completion
+from fedml_tpu.fa.aggregator import create_aggregator
+from fedml_tpu.fa.analyzer import create_analyzer
+from fedml_tpu.fa.fa_client_manager import FAClientManager
+from fedml_tpu.fa.fa_message_define import FAMessage
+from fedml_tpu.fa.fa_server_manager import FAServerManager
+
+
+def run_fa_inproc(
+    args: Any,
+    client_data: Dict[int, Any],
+    timeout: float = 120.0,
+) -> Optional[dict]:
+    """client_data: {rank (1-based): list/array of local values}."""
+    run_id = str(getattr(args, "run_id", "fa"))
+    LocalBroker.destroy(run_id)
+    client_num = len(client_data)
+    task = str(getattr(args, "fa_task"))
+
+    server_mgr = FAServerManager(
+        args, create_aggregator(task, args), client_rank=0, client_num=client_num
+    )
+    client_mgrs: List[FAClientManager] = []
+    for rank in sorted(client_data):
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        client_mgrs.append(FAClientManager(
+            cargs, create_analyzer(task, cargs), client_data[rank],
+            rank=rank, size=client_num + 1,
+        ))
+    managers = [server_mgr] + client_mgrs
+    return run_managers_to_completion(
+        managers, run_id, FAMessage.MSG_TYPE_CONNECTION_IS_READY, timeout
+    )

@@ -35,7 +35,28 @@ class ServerOptimizer:
             self.tx = None
         self._opt_state = None
 
-    def step(self, w_global: Pytree, w_aggregated: Pytree) -> Pytree:
+    # -- round-checkpoint plumbing ---------------------------------------
+    def get_state(self, params: Pytree) -> Pytree:
+        """Materialized optimizer state (forces lazy init) for checkpoints."""
+        if self.tx is None:
+            return {}
+        if self._opt_state is None:
+            self._opt_state = self.tx.init(params)
+        return self._opt_state
+
+    def set_state(self, state: Pytree) -> None:
+        if self.tx is not None:
+            self._opt_state = state
+
+    def step(self, w_global: Pytree, w_aggregated: Pytree,
+             tau_eff: Optional[float] = None) -> Pytree:
+        if self.fed_opt == "FedNova" and tau_eff is not None:
+            # clients uploaded x̂_i = anchor − d_i (normalized updates);
+            # x⁺ = anchor − τ_eff·Σ p_i d_i = anchor + τ_eff·(x̄ − anchor)
+            t = float(tau_eff)
+            return jax.tree.map(
+                lambda g, a: g + t * (a - g), w_global, w_aggregated
+            )
         if self.tx is None:
             return w_aggregated
         pseudo_grad = tree_sub(w_global, w_aggregated)

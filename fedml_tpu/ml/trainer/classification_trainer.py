@@ -32,6 +32,7 @@ class ClassificationTrainer(ClientTrainer):
         self._pad_to_batches: Optional[int] = None
         self._round_seed = 0
         self._data_sharding = None
+        self._server_state: dict = {}
 
     def set_pad_to_batches(self, n: Optional[int]) -> None:
         """Share one compiled shape across heterogeneous clients."""
@@ -46,11 +47,18 @@ class ClassificationTrainer(ClientTrainer):
         in-silo gradient all-reduce (the torch-DDP replacement)."""
         self._data_sharding = sharding
 
+    def set_server_state(self, server_state: dict) -> None:
+        self._server_state = dict(server_state or {})
+
     def train(
         self, params: Pytree, train_data: Tuple[np.ndarray, np.ndarray], device, args
     ) -> Tuple[Pytree, dict]:
         x, y = train_data
         state = init_local_state(params, args)
+        # engine-pushed round state: SCAFFOLD's server control variate,
+        # Mime's server momentum (both ride the c_global slot)
+        if self._server_state.get("c_global") is not None:
+            state = state._replace(c_global=self._server_state["c_global"])
         xs, ys, mask = batch_epochs(
             np.asarray(x),
             np.asarray(y),
@@ -71,7 +79,10 @@ class ClassificationTrainer(ClientTrainer):
         new_params, new_state, metrics = self._run_local(
             params, state, xs, ys, mask
         )
-        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics = {
+            k: (float(v) if getattr(v, "ndim", 1) == 0 else v)
+            for k, v in metrics.items()
+        }
         metrics["scaffold_c_delta"] = None
         if new_state.c_local is not None:
             import jax

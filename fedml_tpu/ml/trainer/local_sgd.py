@@ -107,6 +107,7 @@ def build_local_fn(
     fed_opt = str(getattr(args, "federated_optimizer", "FedAvg"))
     mu = float(getattr(args, "fedprox_mu", 0.1))
     feddyn_alpha = float(getattr(args, "feddyn_alpha", 0.01))
+    mime_beta = float(getattr(args, "mime_beta", 0.9))
     lr = float(getattr(args, "learning_rate", 0.03))
     base_loss = loss_builder(apply_fn)
     tx = build_optimizer(args)
@@ -131,16 +132,36 @@ def build_local_fn(
             loss = loss - lin + quad
         return loss, aux
 
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
     def run_local(params, state: LocalState, xs, ys, mask):
         opt_state = tx.init(params)
+
+        # Mime (Karimireddy et al. '21): the full-batch local gradient at the
+        # round anchor drives both the SVRG correction and the server
+        # momentum update — one masked pass over the staged batches
+        mime_full_grad = None
+        if fed_opt == "Mime":
+            def accum(carry, batch):
+                gsum, wsum = carry
+                x, y, m = batch
+                (_, _), g = grad_fn(state.anchor, state, x, y, m)
+                w = jnp.sum(m)
+                gsum = jax.tree.map(lambda a, b: a + b * w, gsum, g)
+                return (gsum, wsum + w), None
+
+            (gsum, wsum), _ = jax.lax.scan(
+                accum, (tree_zeros_like(params), 0.0), (xs, ys, mask)
+            )
+            mime_full_grad = jax.tree.map(
+                lambda g: g / jnp.maximum(wsum, 1.0), gsum
+            )
 
         def step(carry, batch):
             params, opt_state = carry
             x, y, m = batch
-            (loss, (correct, denom)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, state, x, y, m)
-            if fed_opt in ("SCAFFOLD", "Mime") and state.c_global is not None:
+            (loss, (correct, denom)), grads = grad_fn(params, state, x, y, m)
+            if fed_opt == "SCAFFOLD" and state.c_global is not None:
                 # SCAFFOLD drift correction: g - c_i + c
                 grads = jax.tree.map(
                     lambda g, cg, cl: g + cg - cl,
@@ -148,18 +169,42 @@ def build_local_fn(
                     state.c_global,
                     state.c_local,
                 )
-            updates, opt_state = tx.update(grads, opt_state, params)
+            if fed_opt == "Mime":
+                # SVRG correction g(y) − g_batch(anchor) + ḡ_i, then the
+                # FIXED server momentum s (state.c_global) — the momentum is
+                # never updated locally, that is Mime's defining property
+                (_, _), g_anchor = grad_fn(state.anchor, state, x, y, m)
+                grads = jax.tree.map(
+                    lambda g, ga, gf: g - ga + gf,
+                    grads, g_anchor, mime_full_grad,
+                )
+                updates = jax.tree.map(
+                    lambda g, s: -lr * ((1.0 - mime_beta) * g + mime_beta * s),
+                    grads, state.c_global,
+                )
+            else:
+                updates, opt_state = tx.update(grads, opt_state, params)
             # fully-padded steps (mask all zero) must be no-ops so clients with
             # fewer batches than the shared compiled shape stay exact
             valid = (jnp.sum(m) > 0).astype(jnp.float32)
             updates = jax.tree.map(lambda u: u * valid, updates)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), (loss, correct, denom)
+            return (params, opt_state), (loss, correct, denom, valid)
 
-        (new_params, _), (losses, corrects, denoms) = jax.lax.scan(
+        (new_params, _), (losses, corrects, denoms, valids) = jax.lax.scan(
             step, (params, opt_state), (xs, ys, mask)
         )
         n_steps = xs.shape[0]
+        tau = jnp.sum(valids)  # actual (non-padded) local optimizer steps
+
+        if fed_opt == "FedNova":
+            # normalized update (Wang et al. '20): upload the pseudo-model
+            # x̂ = anchor − d_i where d_i = (anchor − x_τ)/τ; the server
+            # rescales Σ p_i d_i by τ_eff = Σ p_i τ_i (ServerOptimizer)
+            safe_tau = jnp.maximum(tau, 1.0)
+            new_params = jax.tree.map(
+                lambda a, p: a - (a - p) / safe_tau, state.anchor, new_params
+            )
 
         new_state = state
         if fed_opt == "SCAFFOLD":
@@ -187,7 +232,10 @@ def build_local_fn(
             "train_loss": jnp.mean(losses),
             "train_correct": jnp.sum(corrects),
             "train_samples": jnp.sum(denoms),
+            "local_steps": tau,
         }
+        if mime_full_grad is not None:
+            metrics["mime_full_grad"] = mime_full_grad
         return new_params, new_state, metrics
 
     return run_local
@@ -196,10 +244,12 @@ def build_local_fn(
 def init_local_state(params: Pytree, args: Any) -> LocalState:
     fed_opt = str(getattr(args, "federated_optimizer", "FedAvg"))
     zeros = tree_zeros_like(params)
+    # SCAFFOLD: c_global/c_local are control variates; Mime: c_global holds
+    # the SERVER momentum s (fixed during local steps — Mime's invariant)
     return LocalState(
         anchor=params,
         c_global=zeros if fed_opt in ("SCAFFOLD", "Mime") else None,
-        c_local=zeros if fed_opt in ("SCAFFOLD", "Mime") else None,
+        c_local=zeros if fed_opt == "SCAFFOLD" else None,
         h=zeros if fed_opt == "FedDyn" else None,
     )
 

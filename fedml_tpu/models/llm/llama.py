@@ -235,10 +235,18 @@ class LlamaAttention(nn.Module):
 
         new_cache = None
         if kv_cache is not None:
-            # decode: append to cache, attend over full prefix
+            # decode: append to cache, attend over full prefix. cache_len may
+            # be a scalar (all rows aligned) or a [B] vector of per-row
+            # lengths — the latter is what continuous batching needs: each
+            # slot of the serving batch sits at its own position.
             ck, cv, cache_len = kv_cache
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, cache_len, 0))
+            lens = jnp.broadcast_to(jnp.asarray(cache_len), (b,))
+            ck = jax.vmap(
+                lambda c, kk, l: jax.lax.dynamic_update_slice(c, kk, (0, l, 0))
+            )(ck, k, lens)
+            cv = jax.vmap(
+                lambda c, vv, l: jax.lax.dynamic_update_slice(c, vv, (0, l, 0))
+            )(cv, v, lens)
             k, v = ck, cv
             new_cache = (ck, cv, cache_len + t)
             s_len = ck.shape[2]
@@ -249,9 +257,11 @@ class LlamaAttention(nn.Module):
             logits = jnp.einsum(
                 "bhtd,bhsd->bhts", q.astype(jnp.float32), kk.astype(jnp.float32)
             ) * scale
-            pos = cache_len + jnp.arange(t)[:, None]
-            mask = jnp.arange(s_len)[None, :] <= pos  # causal over the prefix
-            logits = jnp.where(mask[None, None], logits, -1e30)
+            pos = lens[:, None] + jnp.arange(t)[None, :]  # [B, T]
+            mask = (
+                jnp.arange(s_len)[None, None, :] <= pos[:, :, None]
+            )  # causal over each row's prefix [B, T, S]
+            logits = jnp.where(mask[:, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhts,bhsd->bhtd", probs, vv.astype(jnp.float32))
             out = out.astype(cfg.dtype)

@@ -1,0 +1,27 @@
+"""Compute plane — local agent daemon, launch manager, job yaml, env.
+
+Parity: reference ``computing/scheduler/`` (slave/master agents,
+scheduler_entry launch path) in the thin single-host shape SURVEY §7.8
+plans: job-yaml runner + agent daemon + local metrics sink.
+"""
+from fedml_tpu.scheduler.agent import LocalAgent
+from fedml_tpu.scheduler.env_collect import collect_env
+from fedml_tpu.scheduler.job_yaml import JobSpec
+from fedml_tpu.scheduler.launch import (
+    launch_job,
+    list_jobs,
+    run_logs,
+    run_status,
+    run_stop,
+)
+
+__all__ = [
+    "LocalAgent",
+    "JobSpec",
+    "collect_env",
+    "launch_job",
+    "list_jobs",
+    "run_logs",
+    "run_status",
+    "run_stop",
+]

@@ -1,0 +1,270 @@
+"""Local agent — spawns, monitors, and kills job subprocesses.
+
+Parity target: the reference slave agent (``slave/client_runner.py:60`` —
+``run`` :378 spawns the job process, ``callback_start_train`` :893,
+``callback_stop_train`` :982; the daemon loop ``slave/client_daemon.py:34``
+cleans zombies and relaunches). TPU-build re-design: one `LocalAgent`
+owns a run table; each run is a subprocess started from a JobSpec
+(bootstrap → job), its stdout/stderr tailed into a per-run log file, its
+status tracked by the validated FSM and mirrored into the JSONL metrics
+sink. A monitor thread reaps exits; `kill` terminates the whole process
+group (the reference's cleanup_all_fedml_client_* equivalent).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.core.mlops.metrics import MLOpsMetrics
+from fedml_tpu.core.mlops.status import RunStatus, RunStatusMachine
+from fedml_tpu.scheduler.job_yaml import JobSpec
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except (ProcessLookupError, PermissionError, ValueError):
+        return False
+
+
+class RunRecord:
+    def __init__(self, run_id: str, spec: JobSpec, log_path: str, sink):
+        self.run_id = run_id
+        self.spec = spec
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.pid: Optional[int] = None  # survives across agent processes
+        self.fsm = RunStatusMachine(run_id, sink=sink)
+        self.returncode: Optional[int] = None
+        self.started = time.time()
+
+
+class LocalAgent:
+    """Single-host agent daemon; the scheduler plane's execution leaf."""
+
+    def __init__(self, workdir: str = ".fedml_runs", args: Any = None,
+                 poll_interval: float = 0.2):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self._runs: Dict[str, RunRecord] = {}
+        self._lock = threading.Lock()
+        self._metrics = MLOpsMetrics(args, sink_dir=os.path.join(self.workdir, "mlops"))
+        self._poll_interval = poll_interval
+        self._monitor: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._table_path = os.path.join(self.workdir, "runs.json")
+        self._load_table()
+
+    # -- cross-process run table -----------------------------------------
+    # the reference's agents persist run state in sqlite
+    # (slave/client_data_interface.py) so `fedml stop` works from any
+    # process; here a json table in the workdir serves the same purpose
+    def _persist_table(self) -> None:
+        rows = {}
+        with self._lock:
+            for rid, rec in self._runs.items():
+                rows[rid] = {
+                    "job_name": rec.spec.job_name,
+                    "log_path": rec.log_path,
+                    "pid": rec.pid,
+                    "status": rec.fsm.status,
+                    "returncode": rec.returncode,
+                }
+        tmp = self._table_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rows, f)
+        os.replace(tmp, self._table_path)
+
+    def _load_table(self) -> None:
+        if not os.path.exists(self._table_path):
+            return
+        try:
+            with open(self._table_path) as f:
+                rows = json.load(f)
+        except (OSError, ValueError):
+            return
+        for rid, row in rows.items():
+            rec = RunRecord(
+                rid, JobSpec(job_name=row.get("job_name", rid), job="",
+                             workspace="."),
+                row.get("log_path", ""), self._status_sink,
+            )
+            rec.pid = row.get("pid")
+            rec.returncode = row.get("returncode")
+            rec.fsm.status = row.get("status", RunStatus.IDLE)
+            if (rec.fsm.status == RunStatus.RUNNING and rec.pid
+                    and not _pid_alive(rec.pid)):
+                # process died while no agent was watching; exact rc unknown
+                rec.fsm.status = RunStatus.FINISHED
+            self._runs[rid] = rec
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "LocalAgent":
+        if self._monitor is None:
+            self._stopping.clear()
+            self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+            self._monitor.start()
+        return self
+
+    def shutdown(self, kill_running: bool = True) -> None:
+        self._stopping.set()
+        if kill_running:
+            for rid in list(self._runs):
+                self.kill(rid)
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+
+    # -- run control ------------------------------------------------------
+    def start_run(self, spec: JobSpec, run_id: Optional[str] = None,
+                  extra_env: Optional[Dict[str, str]] = None) -> str:
+        run_id = run_id or f"run-{int(time.time()*1000)}-{len(self._runs)}"
+        log_path = os.path.join(self.workdir, f"{run_id}.log")
+        rec = RunRecord(run_id, spec, log_path, self._status_sink)
+        rec.fsm.transition(RunStatus.PROVISIONING, "agent accepted job")
+
+        script = ""
+        if spec.bootstrap:
+            script += spec.bootstrap.rstrip() + "\n"
+        script += spec.job
+        env = dict(os.environ)
+        env.update(spec.env)
+        env.update(extra_env or {})
+        env["FEDML_RUN_ID"] = run_id
+        log_f = open(log_path, "ab")
+        try:
+            rec.proc = subprocess.Popen(
+                ["/bin/sh", "-c", script],
+                cwd=spec.workspace,
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # own pgid → group kill works
+            )
+        except Exception as e:
+            log_f.close()
+            rec.fsm.transition(RunStatus.FAILED, f"spawn error: {e}")
+            with self._lock:
+                self._runs[run_id] = rec
+            raise
+        finally:
+            if rec.proc is not None:
+                log_f.close()  # child holds its own fd
+        rec.pid = rec.proc.pid
+        rec.fsm.transition(RunStatus.RUNNING, f"pid {rec.proc.pid}")
+        with self._lock:
+            self._runs[run_id] = rec
+        self._persist_table()
+        self.start()
+        return run_id
+
+    def kill(self, run_id: str, grace_s: float = 3.0) -> bool:
+        rec = self._runs.get(run_id)
+        if rec is None:
+            return False
+        if rec.proc is None:
+            # adopted from the persisted table (other-process launch):
+            # the child got its own session, so its pgid == its pid
+            if rec.pid is None or not _pid_alive(rec.pid):
+                return False
+            rec.fsm.transition(RunStatus.STOPPING, "kill requested (adopted)")
+            try:
+                os.killpg(rec.pid, signal.SIGTERM)
+                deadline = time.time() + grace_s
+                while time.time() < deadline and _pid_alive(rec.pid):
+                    time.sleep(0.05)
+                if _pid_alive(rec.pid):
+                    os.killpg(rec.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            rec.fsm.transition(RunStatus.KILLED, "adopted pgid killed")
+            self._persist_table()
+            return True
+        if rec.proc.poll() is not None:
+            return False
+        rec.fsm.transition(RunStatus.STOPPING, "kill requested")
+        pgid = os.getpgid(rec.proc.pid)
+        os.killpg(pgid, signal.SIGTERM)
+        deadline = time.time() + grace_s
+        while time.time() < deadline and rec.proc.poll() is None:
+            time.sleep(0.05)
+        if rec.proc.poll() is None:
+            os.killpg(pgid, signal.SIGKILL)
+            rec.proc.wait(timeout=5)
+        rec.returncode = rec.proc.returncode
+        rec.fsm.transition(RunStatus.KILLED, f"rc={rec.returncode}")
+        self._persist_table()
+        return True
+
+    def status(self, run_id: str) -> Optional[str]:
+        rec = self._runs.get(run_id)
+        return rec.fsm.status if rec else None
+
+    def wait(self, run_id: str, timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            rec = self._runs.get(run_id)
+            if rec is not None and rec.fsm.is_terminal:
+                return rec.fsm.status
+            time.sleep(self._poll_interval / 2)
+        raise TimeoutError(f"run {run_id} not terminal after {timeout}s")
+
+    def logs(self, run_id: str, tail: Optional[int] = None) -> str:
+        rec = self._runs.get(run_id)
+        if rec is None or not os.path.exists(rec.log_path):
+            return ""
+        with open(rec.log_path, "rb") as f:
+            data = f.read().decode(errors="replace")
+        if tail is not None:
+            data = "\n".join(data.splitlines()[-tail:])
+        return data
+
+    def list_runs(self) -> List[Dict]:
+        with self._lock:
+            return [
+                {
+                    "run_id": rid,
+                    "job_name": rec.spec.job_name,
+                    "status": rec.fsm.status,
+                    "returncode": rec.returncode,
+                    "log_path": rec.log_path,
+                }
+                for rid, rec in self._runs.items()
+            ]
+
+    def cleanup(self) -> int:
+        """Drop terminal runs from the table (daemon zombie-cleanup twin)."""
+        with self._lock:
+            dead = [rid for rid, rec in self._runs.items() if rec.fsm.is_terminal]
+            for rid in dead:
+                del self._runs[rid]
+        self._persist_table()
+        return len(dead)
+
+    # -- internals --------------------------------------------------------
+    def _status_sink(self, entry: Dict) -> None:
+        self._metrics.report_training_status(entry["to"], run_id=entry["run_id"])
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping.is_set():
+            for rec in list(self._runs.values()):
+                if rec.proc is None or rec.fsm.is_terminal:
+                    continue
+                rc = rec.proc.poll()
+                if rc is None:
+                    continue
+                rec.returncode = rc
+                if rec.fsm.status == RunStatus.STOPPING:
+                    rec.fsm.transition(RunStatus.KILLED, f"rc={rc}")
+                elif rc == 0:
+                    rec.fsm.transition(RunStatus.FINISHED, "rc=0")
+                else:
+                    rec.fsm.transition(RunStatus.FAILED, f"rc={rc}")
+                self._persist_table()
+            time.sleep(self._poll_interval)

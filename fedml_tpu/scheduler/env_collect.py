@@ -1,0 +1,44 @@
+"""Environment collector — `fedml_tpu env`.
+
+Parity target: ``computing/scheduler/env/collect_env.py`` (prints
+fedml/torch/GPU environment at init). TPU edition reports the JAX stack
+and visible accelerators instead of torch/CUDA.
+"""
+from __future__ import annotations
+
+import platform
+import sys
+from typing import Dict
+
+
+def collect_env() -> Dict:
+    info: Dict = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+    }
+    try:
+        import fedml_tpu
+
+        info["fedml_tpu"] = getattr(fedml_tpu, "__version__", "dev")
+    except Exception as e:
+        info["fedml_tpu"] = f"import error: {e}"
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = __import__(mod)
+            info[mod] = getattr(m, "__version__", "?")
+        except Exception:
+            info[mod] = "absent"
+    try:
+        import jax
+
+        devs = jax.devices()
+        info["devices"] = [f"{d.device_kind}:{d.id}" for d in devs]
+        info["default_backend"] = jax.default_backend()
+    except Exception as e:
+        info["devices"] = f"unavailable: {e}"
+    return info
+
+
+def print_env() -> None:
+    for k, v in collect_env().items():
+        print(f"{k:>18}: {v}")

@@ -1,0 +1,54 @@
+"""Job YAML spec — what `fedml_tpu launch <job.yaml>` consumes.
+
+Parity target: the reference's launch job yaml handled by
+``scheduler_entry/launch_manager.py`` (job/bootstrap shell blocks,
+workspace, computing resources). The TPU build keeps the same shape:
+
+    job_name: my-experiment
+    workspace: .                 # cwd for the job process
+    bootstrap: |                 # optional one-time setup shell
+      echo preparing
+    job: |                       # the job shell (required)
+      python my_train.py --cf fedml_config.yaml
+    computing:
+      minimum_num_chips: 0       # informational on a single host
+    env:                         # extra environment for the job
+      MY_FLAG: "1"
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+import yaml
+
+
+@dataclasses.dataclass
+class JobSpec:
+    job_name: str
+    job: str
+    workspace: str = "."
+    bootstrap: Optional[str] = None
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    computing: Dict = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def load(path: str) -> "JobSpec":
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        if not raw.get("job"):
+            raise ValueError(f"{path}: job yaml must define a 'job' shell block")
+        workspace = raw.get("workspace", ".")
+        if not os.path.isabs(workspace):
+            workspace = os.path.normpath(
+                os.path.join(os.path.dirname(os.path.abspath(path)), workspace)
+            )
+        return JobSpec(
+            job_name=str(raw.get("job_name", os.path.basename(path))),
+            job=str(raw["job"]),
+            workspace=workspace,
+            bootstrap=raw.get("bootstrap"),
+            env={k: str(v) for k, v in (raw.get("env") or {}).items()},
+            computing=raw.get("computing") or {},
+        )

@@ -1,0 +1,74 @@
+"""Launch manager — job yaml → running job on the local agent.
+
+Parity target: ``scheduler_entry/launch_manager.py`` (package app → match
+resources → dispatch). With no hosted backend, "matching" is a local
+capacity check against visible accelerators, and dispatch goes straight to
+the in-process LocalAgent; the module-level agent keeps `fedml_tpu launch`
+/ `fedml_tpu stop` CLI invocations coherent within one process.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.scheduler.agent import LocalAgent
+from fedml_tpu.scheduler.job_yaml import JobSpec
+
+logger = logging.getLogger(__name__)
+
+_agents: Dict[str, LocalAgent] = {}
+
+
+def get_agent(workdir: str = ".fedml_runs") -> LocalAgent:
+    import os
+
+    key = os.path.abspath(workdir)
+    if key not in _agents:
+        _agents[key] = LocalAgent(workdir=workdir).start()
+    return _agents[key]
+
+
+def check_resources(spec: JobSpec) -> None:
+    """Local capacity check (the reference's resource matcher, degenerated
+    to one host): fail fast when the job demands more chips than visible."""
+    want = int(spec.computing.get("minimum_num_chips", 0) or 0)
+    if want <= 0:
+        return
+    try:
+        import jax
+
+        have = jax.device_count()
+    except Exception:
+        have = 0
+    if have < want:
+        raise RuntimeError(
+            f"job '{spec.job_name}' wants {want} chips; host has {have}"
+        )
+
+
+def launch_job(yaml_path: str, workdir: str = ".fedml_runs",
+               run_id: Optional[str] = None,
+               extra_env: Optional[Dict[str, str]] = None) -> str:
+    spec = JobSpec.load(yaml_path)
+    check_resources(spec)
+    agent = get_agent(workdir)
+    rid = agent.start_run(spec, run_id=run_id, extra_env=extra_env)
+    logger.info("launched job '%s' as %s", spec.job_name, rid)
+    return rid
+
+
+def run_stop(run_id: str, workdir: str = ".fedml_runs") -> bool:
+    return get_agent(workdir).kill(run_id)
+
+
+def run_status(run_id: str, workdir: str = ".fedml_runs") -> Optional[str]:
+    return get_agent(workdir).status(run_id)
+
+
+def run_logs(run_id: str, tail: Optional[int] = None,
+             workdir: str = ".fedml_runs") -> str:
+    return get_agent(workdir).logs(run_id, tail=tail)
+
+
+def list_jobs(workdir: str = ".fedml_runs") -> List[Dict]:
+    return get_agent(workdir).list_runs()

@@ -1,0 +1,20 @@
+"""Serving engine — Predictor ABC, HTTP inference runner, TPU
+continuous-batching LLM engine, endpoint monitor.
+
+Parity: reference ``serving/`` (``fedml_predictor.py``,
+``fedml_inference_runner.py``) + the deploy plane's inference path
+(``model_scheduler/device_model_inference.py``).
+"""
+from fedml_tpu.serving.inference_runner import FedMLInferenceRunner
+from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+from fedml_tpu.serving.llm_predictor import LlamaPredictor
+from fedml_tpu.serving.monitor import EndpointMonitor
+from fedml_tpu.serving.predictor import FedMLPredictor
+
+__all__ = [
+    "FedMLPredictor",
+    "FedMLInferenceRunner",
+    "ContinuousBatchingEngine",
+    "LlamaPredictor",
+    "EndpointMonitor",
+]

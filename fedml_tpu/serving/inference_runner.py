@@ -1,0 +1,130 @@
+"""FedMLInferenceRunner — HTTP wrapper around a FedMLPredictor.
+
+Parity target: ``serving/fedml_inference_runner.py:8-39`` (FastAPI app with
+``/predict`` and ``/ready``). This environment ships no ASGI stack, so the
+runner is a stdlib ``ThreadingHTTPServer`` with the same endpoint contract:
+
+  POST /predict   body: JSON request → JSON response; if the predictor
+                  returns an iterator, the response streams newline-
+                  delimited JSON chunks (chunked transfer encoding)
+  GET  /ready     {"ready": bool} — liveness for the deploy plane
+
+Every request is recorded in the EndpointMonitor (latency, errors), which
+mirrors the reference's endpoint monitoring into the local metrics sink.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from fedml_tpu.serving.monitor import EndpointMonitor
+from fedml_tpu.serving.predictor import FedMLPredictor
+
+
+class FedMLInferenceRunner:
+    def __init__(
+        self,
+        predictor: FedMLPredictor,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        monitor: Optional[EndpointMonitor] = None,
+    ):
+        self.predictor = predictor
+        self.monitor = monitor or EndpointMonitor()
+        runner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # chunked transfer encoding (streaming responses) only exists
+            # in HTTP/1.1 — the 1.0 default would make clients treat the
+            # raw chunk framing as body bytes
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/ready", "/health"):
+                    body = json.dumps(
+                        {"ready": bool(runner.predictor.ready()),
+                         **runner.monitor.snapshot()}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/predict":
+                    self.send_error(404)
+                    return
+                t0 = time.time()
+                ok = True
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    request = json.loads(self.rfile.read(n) or b"{}")
+                    result = runner.predictor.predict(request)
+                    if hasattr(result, "__next__"):  # streaming
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/x-ndjson"
+                        )
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        for chunk in result:
+                            data = (json.dumps(chunk) + "\n").encode()
+                            self.wfile.write(
+                                f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                            )
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        body = json.dumps(result).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                except BrokenPipeError:
+                    ok = False
+                except Exception as e:  # predictor errors → 500 + message
+                    ok = False
+                    try:
+                        body = json.dumps({"error": str(e)}).encode()
+                        self.send_response(500)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except BrokenPipeError:
+                        pass
+                finally:
+                    runner.monitor.record_request(time.time() - t0, ok)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "FedMLInferenceRunner":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def run(self) -> None:  # blocking variant (reference runner.run())
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,272 @@
+"""TPU continuous-batching decode engine over the Llama KV cache.
+
+Parity target: the reference serves LLMs by delegating to vLLM/Triton
+containers (``model_scheduler/device_model_deployment.py:528``,
+``serving/templates/hf_template`` vLLM backend). TPU-native re-design: the
+engine owns a fixed pool of *batch slots*, each with its own row in a
+shared [B, H_kv, S, D] KV cache, and runs
+
+- a compiled **prefill** program per prompt-length bucket (one slot's rows
+  are sliced out, the prompt runs in one forward pass, the filled rows are
+  written back), and
+- ONE compiled **decode** program for the whole pool — every active slot
+  advances one token per step regardless of when its request arrived
+  (continuous batching: slots are re-admitted the step after a sequence
+  finishes, so the MXU always sees the full batch).
+
+Per-slot cache positions ride the [B]-vector ``cache_len`` support in
+``models/llm/llama.py``; sampling happens on host (logits are [B, V]).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+@dataclass
+class _Slot:
+    request_id: int = -1
+    out: Optional[queue.Queue] = None
+    last_token: int = 0
+    generated: int = 0
+    max_new: int = 0
+    temperature: float = 0.0
+    rng: Optional[np.random.Generator] = None
+    eos_id: Optional[int] = None
+    active: bool = False
+    tokens: List[int] = field(default_factory=list)
+
+
+class ContinuousBatchingEngine:
+    """Schedules generation requests onto a fixed slot pool."""
+
+    def __init__(
+        self,
+        model: Any,
+        params: Pytree,
+        batch_slots: int = 4,
+        max_len: int = 256,
+        min_prompt_bucket: int = 16,
+        eos_id: Optional[int] = None,
+    ):
+        self.model = model
+        self.params = params
+        self.n_slots = int(batch_slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        cfg = model.cfg
+        shape = (self.n_slots, cfg.num_key_value_heads, self.max_len, cfg.head_dim)
+        self.caches = [
+            (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        self.lengths = np.zeros((self.n_slots,), np.int32)
+        self.slots = [_Slot() for _ in range(self.n_slots)]
+        self._buckets = []
+        b = max(int(min_prompt_bucket), 8)
+        while b < self.max_len:
+            self._buckets.append(b)
+            b *= 2
+        self._buckets.append(self.max_len)
+        self._requests: "queue.Queue" = queue.Queue()
+        self._req_counter = 0
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._prefill_cache: Dict[int, Any] = {}
+
+        model_apply = model.apply
+
+        def prefill_fn(params, caches, tokens, slot, true_len):
+            """tokens [1, P] (padded): fill slot's cache rows, return the
+            next-token logits at the prompt's true end."""
+            sub = [
+                (
+                    jax.lax.dynamic_slice_in_dim(k, slot, 1, axis=0),
+                    jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=0),
+                    0,
+                )
+                for k, v in caches
+            ]
+            p_len = tokens.shape[1]
+            logits, new_sub = model_apply(
+                params, tokens, positions=jnp.arange(p_len)[None], kv_caches=sub
+            )
+            caches = [
+                (
+                    jax.lax.dynamic_update_slice_in_dim(k, nk, slot, axis=0),
+                    jax.lax.dynamic_update_slice_in_dim(v, nv, slot, axis=0),
+                )
+                for (k, v), (nk, nv, _) in zip(caches, new_sub)
+            ]
+            return caches, logits[0, true_len - 1]
+
+        def decode_fn(params, caches, last_tokens, lengths):
+            """One token for every slot: [B] → [B, V] next-token logits."""
+            sub = [(k, v, lengths) for k, v in caches]
+            logits, new_sub = model_apply(
+                params,
+                last_tokens[:, None],
+                positions=lengths[:, None],
+                kv_caches=sub,
+            )
+            caches = [(k, v) for k, v, _ in new_sub]
+            return caches, logits[:, 0, :]
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+
+    # -- public API -------------------------------------------------------
+    def submit(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+    ) -> "queue.Queue":
+        """Enqueue a generation request; returns the token stream queue.
+
+        The queue yields ints (generated token ids) and a final ``None``.
+        """
+        if len(prompt_tokens) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt({len(prompt_tokens)}) + max_new({max_new_tokens}) "
+                f"exceeds max_len={self.max_len}"
+            )
+        out: "queue.Queue" = queue.Queue()
+        with self._lock:
+            self._req_counter += 1
+            rid = self._req_counter
+        self._requests.put(
+            (rid, list(map(int, prompt_tokens)), int(max_new_tokens),
+             float(temperature), int(seed),
+             self.eos_id if eos_id is None else eos_id, out)
+        )
+        return out
+
+    def generate(self, prompt_tokens, max_new_tokens=32, temperature=0.0,
+                 seed=0, eos_id=None) -> List[int]:
+        """Blocking convenience wrapper: returns the full generation."""
+        q = self.submit(prompt_tokens, max_new_tokens, temperature, seed, eos_id)
+        toks = []
+        while True:
+            t = q.get()
+            if t is None:
+                return toks
+            toks.append(t)
+
+    def start(self) -> "ContinuousBatchingEngine":
+        if self._thread is None:
+            self._stopping.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    @property
+    def active_slots(self) -> int:
+        return sum(s.active for s in self.slots)
+
+    # -- engine loop ------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self.max_len
+
+    def _sample(self, slot: _Slot, logits: np.ndarray) -> int:
+        if slot.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / slot.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(slot.rng.choice(len(p), p=p))
+
+    def _admit(self, req) -> None:
+        rid, prompt, max_new, temp, seed, eos, out = req
+        slot_idx = next(i for i, s in enumerate(self.slots) if not s.active)
+        p = self._bucket(len(prompt))
+        padded = np.zeros((1, p), np.int32)
+        padded[0, : len(prompt)] = prompt
+        self.caches, last_logits = self._prefill(
+            self.params, self.caches, jnp.asarray(padded),
+            jnp.int32(slot_idx), jnp.int32(len(prompt)),
+        )
+        slot = self.slots[slot_idx]
+        slot.request_id = rid
+        slot.out = out
+        slot.generated = 0
+        slot.max_new = max_new
+        slot.temperature = temp
+        slot.rng = np.random.default_rng(seed)
+        slot.eos_id = eos
+        slot.active = True
+        slot.tokens = []
+        self.lengths[slot_idx] = len(prompt)
+        self._emit(slot_idx, np.asarray(last_logits))
+
+    def _emit(self, slot_idx: int, logits: np.ndarray) -> None:
+        """Sample one token for a slot; stream it; retire on EOS/max."""
+        slot = self.slots[slot_idx]
+        tok = self._sample(slot, logits)
+        slot.last_token = tok
+        slot.generated += 1
+        slot.tokens.append(tok)
+        slot.out.put(tok)
+        if (slot.eos_id is not None and tok == slot.eos_id) or (
+            slot.generated >= slot.max_new
+        ):
+            slot.out.put(None)
+            slot.active = False
+
+    def _loop(self) -> None:
+        while not self._stopping.is_set():
+            # admit as many waiting requests as there are free slots
+            while self.active_slots < self.n_slots:
+                try:
+                    # never stall active decodes waiting for new arrivals
+                    if self.active_slots:
+                        req = self._requests.get_nowait()
+                    else:
+                        req = self._requests.get(timeout=0.2)
+                except queue.Empty:
+                    break
+                self._admit(req)
+            if self.active_slots == 0:
+                continue
+            self.step()
+
+    def step(self) -> None:
+        """One batched decode step for every active slot."""
+        last = np.asarray([s.last_token for s in self.slots], np.int32)
+        lengths = jnp.asarray(self.lengths)
+        self.caches, logits = self._decode(
+            self.params, self.caches, jnp.asarray(last), lengths
+        )
+        logits = np.asarray(logits)
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            # this step wrote the slot's last token at position lengths[i]
+            self.lengths[i] += 1
+            if self.lengths[i] >= self.max_len:
+                slot.out.put(None)
+                slot.active = False
+                continue
+            self._emit(i, logits[i])

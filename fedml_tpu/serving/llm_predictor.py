@@ -1,0 +1,57 @@
+"""LlamaPredictor — binds the continuous-batching engine to the serving
+contract (the reference's hf_template chatbot predictor,
+``serving/templates/hf_template/src/main_entry.py``, with vLLM swapped for
+the TPU engine).
+
+Request body:
+  {"prompt_tokens": [int, ...],      # pre-tokenized prompt
+   "max_new_tokens": 32,
+   "temperature": 0.0,
+   "seed": 0,
+   "stream": false}
+
+Response: {"tokens": [...]} — or, when ``stream`` is true, an iterator of
+{"token": t} chunks followed by {"done": true} (the runner turns this into
+an ndjson streaming response). Tokenization is deliberately external: the
+engine is tokenizer-agnostic, callers bring their own vocab (the reference
+similarly delegates to the HF tokenizer of the deployed model).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+from fedml_tpu.serving.predictor import FedMLPredictor
+
+
+class LlamaPredictor(FedMLPredictor):
+    def __init__(self, engine: ContinuousBatchingEngine):
+        self.engine = engine
+        engine.start()
+
+    def ready(self) -> bool:
+        return self.engine._thread is not None and self.engine._thread.is_alive()
+
+    def predict(self, request: Any) -> Any:
+        prompt = list(map(int, request.get("prompt_tokens", [])))
+        if not prompt:
+            raise ValueError("prompt_tokens is required and must be non-empty")
+        max_new = int(request.get("max_new_tokens", 32))
+        temperature = float(request.get("temperature", 0.0))
+        seed = int(request.get("seed", 0))
+        eos = request.get("eos_id")
+        eos = None if eos is None else int(eos)
+        if request.get("stream"):
+            q = self.engine.submit(prompt, max_new, temperature, seed, eos)
+
+            def stream():
+                while True:
+                    tok = q.get()
+                    if tok is None:
+                        yield {"done": True}
+                        return
+                    yield {"token": tok}
+
+            return stream()
+        toks = self.engine.generate(prompt, max_new, temperature, seed, eos)
+        return {"tokens": toks}

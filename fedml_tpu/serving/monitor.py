@@ -1,0 +1,61 @@
+"""Endpoint monitor — liveness + rolling latency/throughput stats.
+
+Parity target: ``model_scheduler/device_model_monitor.py`` (the reference
+samples endpoint health and replica metrics into its MLOps plane). Here the
+monitor is an in-process stats aggregator the inference runner feeds; its
+snapshot lands in the JSONL metrics sink (``core/mlops``) so the scheduler
+plane can poll endpoint health without a hosted backend.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class EndpointMonitor:
+    def __init__(self, endpoint_id: str = "default", args: Any = None):
+        self.endpoint_id = endpoint_id
+        self._lock = threading.Lock()
+        self._count = 0
+        self._errors = 0
+        self._lat_sum = 0.0
+        self._lat_max = 0.0
+        self._started = time.time()
+        self._last_request = None
+        self._metrics = None
+        if args is not None:
+            try:
+                from fedml_tpu.core.mlops.metrics import MLOpsMetrics
+
+                self._metrics = MLOpsMetrics(args)
+            except Exception:
+                self._metrics = None
+
+    def record_request(self, latency_s: float, ok: bool = True) -> None:
+        with self._lock:
+            self._count += 1
+            if not ok:
+                self._errors += 1
+            self._lat_sum += latency_s
+            self._lat_max = max(self._lat_max, latency_s)
+            self._last_request = time.time()
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            n = max(self._count, 1)
+            snap = {
+                "endpoint_id": self.endpoint_id,
+                "requests": self._count,
+                "errors": self._errors,
+                "latency_avg_ms": round(1e3 * self._lat_sum / n, 3),
+                "latency_max_ms": round(1e3 * self._lat_max, 3),
+                "uptime_s": round(time.time() - self._started, 1),
+                "last_request_ts": self._last_request,
+            }
+        if self._metrics is not None:
+            try:
+                self._metrics.log({"endpoint": snap})
+            except Exception:
+                pass
+        return snap

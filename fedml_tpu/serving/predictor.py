@@ -1,0 +1,25 @@
+"""FedMLPredictor — the serving-side model operator.
+
+Parity target: ``serving/fedml_predictor.py:4`` in the reference (an ABC
+with a single ``predict`` the FastAPI runner wraps). Same contract here:
+``predict(request)`` takes the decoded JSON request body and returns either
+a JSON-serializable response or an *iterator* of JSON-serializable chunks
+(streaming generation).
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class FedMLPredictor(abc.ABC):
+    """Subclass and implement :meth:`predict`; hand to FedMLInferenceRunner."""
+
+    def ready(self) -> bool:
+        """Liveness: the runner's /ready endpoint reports this."""
+        return True
+
+    @abc.abstractmethod
+    def predict(self, request: Any) -> Any:
+        """request (decoded JSON) → response (JSON-serializable) or an
+        iterator of chunks for a streaming response."""

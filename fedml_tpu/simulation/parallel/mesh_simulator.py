@@ -163,11 +163,15 @@ class MeshFedAvgAPI:
             w = nk.astype(jnp.float32)  # padded slots have nk=0 → no weight
             total = jax.lax.psum(jnp.sum(w), "clients")
             loss = jax.lax.psum(jnp.sum(w * metrics["train_loss"]), "clients") / total
+            # FedNova: τ_eff = Σ p_i τ_i (identically 0-weighted for pads)
+            tau_eff = jax.lax.psum(
+                jnp.sum(w * metrics["local_steps"]), "clients"
+            ) / total
 
             if host_agg:
                 # stacked per-slot models go back to the host, where the
                 # full ServerAggregator hook chain (attack/defense/CDP) runs
-                return new_params, loss
+                return new_params, loss, tau_eff
 
             if defense_stacked is not None:
                 # robust aggregation INSIDE the program: gather the client
@@ -198,7 +202,7 @@ class MeshFedAvgAPI:
                 agg = dp_frame.add_global_noise(
                     agg, jax.random.wrap_key_data(cdp_kd)
                 )
-            return agg, loss
+            return agg, loss, tau_eff
 
         out_model_spec = P("clients") if self._host_agg else P()
         shard = jax.shard_map(
@@ -206,12 +210,36 @@ class MeshFedAvgAPI:
             mesh=self.mesh,
             in_specs=(P(), P(), P("clients"), P("clients"), P("clients"),
                       P("clients"), P("clients"), P()),
-            out_specs=(out_model_spec, P()),
+            out_specs=(out_model_spec, P(), P()),
         )
         self._round_fn = jax.jit(shard)
         self._local_state = init_local_state(self.global_params, args)
         self.test_history: List[dict] = []
         self._data_cache: dict = {}
+
+        from fedml_tpu.core.checkpoint import engine_checkpointer
+
+        self._ckpt = engine_checkpointer(args)
+        self._start_round = 0
+        if self._ckpt is not None and bool(getattr(args, "resume", False)):
+            restored = self._ckpt.restore_latest(self._ckpt_state())
+            if restored is not None:
+                _, state = restored
+                self._apply_ckpt_state(state)
+
+    # -- round checkpoint state ------------------------------------------
+    def _ckpt_state(self) -> dict:
+        from fedml_tpu.core.checkpoint import pack_round_state
+
+        return pack_round_state(
+            self.global_params, self.server_opt, self._start_round
+        )
+
+    def _apply_ckpt_state(self, state: dict) -> None:
+        from fedml_tpu.core.checkpoint import apply_round_state
+
+        self.global_params = state["global_params"]
+        self._start_round = apply_round_state(state, self.server_opt)
 
     # -- host-side data staging ------------------------------------------
     def _client_arrays(self, cid: int, round_idx: int):
@@ -305,7 +333,7 @@ class MeshFedAvgAPI:
 
         self.event.log_event_started("train+agg", round_idx)
         t0 = time.time()
-        out, loss = self._round_fn(
+        out, loss, tau_eff = self._round_fn(
             self.global_params, self._local_state, xs, ys, ms, nk, ldp_kd, cdp_kd
         )
         out = jax.block_until_ready(out)
@@ -337,7 +365,18 @@ class MeshFedAvgAPI:
         else:
             w_agg = out
 
-        self.global_params = self.server_opt.step(self.global_params, w_agg)
+        fednova = str(getattr(self.args, "federated_optimizer", "")) == "FedNova"
+        self.global_params = self.server_opt.step(
+            self.global_params, w_agg,
+            tau_eff=float(tau_eff) if fednova else None,
+        )
+        if self._ckpt is not None:
+            from fedml_tpu.core.checkpoint import should_save
+
+            if should_save(self.args, round_idx):
+                self._start_round = round_idx + 1
+                self._ckpt.save(round_idx, self._ckpt_state())
+
         report = {"round": round_idx, "train_loss": float(loss), "round_sec": dt}
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
         if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
@@ -351,7 +390,7 @@ class MeshFedAvgAPI:
 
     def train(self) -> dict:
         t0 = time.time()
-        for round_idx in range(int(self.args.comm_round)):
+        for round_idx in range(self._start_round, int(self.args.comm_round)):
             self.train_one_round(round_idx)
         wall = time.time() - t0
         final = self.test_history[-1] if self.test_history else {}

@@ -60,7 +60,46 @@ class FedAvgAPI:
         )
         self.test_history: List[dict] = []
         self._c_global = None  # SCAFFOLD server control variate
+        self._mime_s = None  # Mime server momentum
+        self._mime_beta = float(getattr(args, "mime_beta", 0.9))
         self.event = MLOpsProfilerEvent(args)
+
+        # round checkpoint/resume (SURVEY §5 improvement over the reference)
+        from fedml_tpu.core.checkpoint import engine_checkpointer
+
+        self._ckpt = engine_checkpointer(args)
+        self._start_round = 0
+        if self._ckpt is not None and bool(getattr(args, "resume", False)):
+            restored = self._ckpt.restore_latest(self._ckpt_state())
+            if restored is not None:
+                _, state = restored
+                self._apply_ckpt_state(state)
+
+    # -- round checkpoint state ------------------------------------------
+    def _ckpt_state(self) -> dict:
+        from fedml_tpu.core.checkpoint import pack_round_state
+        from fedml_tpu.utils.tree import tree_zeros_like
+
+        zeros = tree_zeros_like(self.global_params)
+        return pack_round_state(
+            self.global_params, self.server_opt, self._start_round,
+            extra={
+                "c_global": self._c_global if self._c_global is not None else zeros,
+                "has_c": np.int32(self._c_global is not None),
+                "mime_s": self._mime_s if self._mime_s is not None else zeros,
+                "has_mime": np.int32(self._mime_s is not None),
+            },
+        )
+
+    def _apply_ckpt_state(self, state: dict) -> None:
+        from fedml_tpu.core.checkpoint import apply_round_state
+
+        self.global_params = state["global_params"]
+        if int(state["has_c"]):
+            self._c_global = state["c_global"]
+        if int(state["has_mime"]):
+            self._mime_s = state["mime_s"]
+        self._start_round = apply_round_state(state, self.server_opt)
 
     # -- client sampling (parity: fedavg_api.py:128-141) ------------------
     def _client_sampling(self, round_idx: int) -> List[int]:
@@ -75,10 +114,18 @@ class FedAvgAPI:
 
         w_locals: List[Tuple[int, Pytree]] = []
         c_deltas = []
+        taus: List[float] = []
+        mime_grads = []
+        server_state = {}
+        if self._c_global is not None:
+            server_state["c_global"] = self._c_global
+        if self._mime_s is not None:
+            server_state["c_global"] = self._mime_s  # Mime rides the same slot
         self.event.log_event_started("train", round_idx)
         for cid in client_ids:
             self.trainer.set_id(cid)
             self.trainer.set_round(round_idx)
+            self.trainer.set_server_state(server_state)
             train_data = self.dataset.train_data_local_dict[cid]
             n_k = self.dataset.train_data_local_num_dict[cid]
             w, metrics = self.trainer.run_local_training(
@@ -86,6 +133,9 @@ class FedAvgAPI:
             )
             if metrics.get("scaffold_c_delta") is not None:
                 c_deltas.append(metrics["scaffold_c_delta"])
+            if metrics.get("mime_full_grad") is not None:
+                mime_grads.append(metrics["mime_full_grad"])
+            taus.append(float(metrics.get("local_steps", 0.0)))
             w_locals.append((n_k, w))
         self.event.log_event_ended("train", round_idx)
 
@@ -94,7 +144,24 @@ class FedAvgAPI:
         w_list, _ = self.aggregator.on_before_aggregation(w_locals)
         w_agg = self.aggregator.aggregate(w_list)
         w_agg = self.aggregator.on_after_aggregation(w_agg)
-        self.global_params = self.server_opt.step(self.global_params, w_agg)
+        tau_eff = None
+        if str(getattr(self.args, "federated_optimizer", "")) == "FedNova" and taus:
+            counts = np.asarray([float(n) for n, _ in w_locals])
+            tau_eff = float(np.sum(counts / counts.sum() * np.asarray(taus)))
+        self.global_params = self.server_opt.step(
+            self.global_params, w_agg, tau_eff=tau_eff
+        )
+        if mime_grads:  # s ← (1−β)·avg(ḡ_i) + β·s  (Mime server momentum)
+            avg_g = jax.tree.map(
+                lambda *xs: sum(xs) / len(xs), *mime_grads
+            )
+            if self._mime_s is None:
+                self._mime_s = avg_g
+            else:
+                b = self._mime_beta
+                self._mime_s = jax.tree.map(
+                    lambda s, g: b * s + (1.0 - b) * g, self._mime_s, avg_g
+                )
         if c_deltas:  # SCAFFOLD: c += (1/N) * sum(c_deltas) * (S/N)
             total = int(self.args.client_num_in_total)
             scale = 1.0 / total
@@ -111,6 +178,13 @@ class FedAvgAPI:
                 self._c_global = jax.tree.map(lambda x: 0 * x, avg_delta)
             self._c_global = tree_add(self._c_global, avg_delta)
         self.event.log_event_ended("aggregate", round_idx)
+
+        if self._ckpt is not None:
+            from fedml_tpu.core.checkpoint import should_save
+
+            if should_save(self.args, round_idx):
+                self._start_round = round_idx + 1
+                self._ckpt.save(round_idx, self._ckpt_state())
 
         report = {"round": round_idx, "clients": client_ids}
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
@@ -130,7 +204,7 @@ class FedAvgAPI:
 
     def train(self) -> dict:
         t0 = time.time()
-        for round_idx in range(int(self.args.comm_round)):
+        for round_idx in range(self._start_round, int(self.args.comm_round)):
             self.train_one_round(round_idx)
         wall = time.time() - t0
         final = self.test_history[-1] if self.test_history else {}

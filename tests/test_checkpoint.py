@@ -1,0 +1,164 @@
+"""Round checkpoint/resume: a killed-and-resumed run must reproduce the
+uninterrupted run bit-exactly (params + DP counters + server-opt state)."""
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models as models_mod
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.data import load_federated
+from fedml_tpu.utils.tree import tree_flatten_vector
+
+
+def _fresh_init(args):
+    from fedml_tpu.core.alg_frame.params import Context
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy,
+    )
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+    from fedml_tpu.core.security.attacker import FedMLAttacker
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    FedMLAttacker.reset()
+    FedMLDefender.reset()
+    FedMLDifferentialPrivacy.reset()
+    FedMLFHE.reset()
+    Context.reset()
+    return fedml_tpu.init(args)
+
+
+def make_args(backend="sp", rounds=6, ckpt_dir=None, resume=False, **over):
+    train = {
+        "backend": backend,
+        "federated_optimizer": "FedOpt",  # server momentum state must survive
+        "server_optimizer": "sgd", "server_lr": 1.0, "server_momentum": 0.9,
+        "client_num_in_total": 4, "client_num_per_round": 4,
+        "comm_round": rounds, "epochs": 1, "batch_size": 16,
+        "learning_rate": 0.1, "frequency_of_the_test": 100,
+    }
+    if ckpt_dir:
+        train.update({"checkpoint_dir": str(ckpt_dir), "resume": resume})
+    train.update(over)
+    return _fresh_init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic", "train_size": 400,
+                      "test_size": 100, "class_num": 4, "feature_dim": 12},
+        "model_args": {"model": "lr"},
+        "train_args": train,
+    }))
+
+
+def _sp_params(args, ds, model):
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    api = FedAvgAPI(args, None, ds, model)
+    api.train()
+    return np.asarray(tree_flatten_vector(api.global_params))
+
+
+def test_sp_kill_and_resume_bit_exact(tmp_path):
+    args = make_args(rounds=6)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    straight = _sp_params(args, ds, model)
+
+    # "crash" after round 2 (comm_round=3), then resume to round 6
+    args_a = make_args(rounds=3, ckpt_dir=tmp_path / "ck")
+    _sp_params(args_a, ds, model)
+    args_b = make_args(rounds=6, ckpt_dir=tmp_path / "ck", resume=True)
+    resumed = _sp_params(args_b, ds, model)
+    np.testing.assert_array_equal(straight, resumed)
+
+
+def test_sp_resume_with_dp_counter(tmp_path):
+    dp = {"enable_dp": True, "dp_solution_type": "LDP", "epsilon": 5.0,
+          "delta": 1e-5, "clipping_norm": 1.0}
+    args = make_args(rounds=4, **dp)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    straight = _sp_params(args, ds, model)
+
+    args_a = make_args(rounds=2, ckpt_dir=tmp_path / "ck", **dp)
+    _sp_params(args_a, ds, model)
+    args_b = make_args(rounds=4, ckpt_dir=tmp_path / "ck", resume=True, **dp)
+    resumed = _sp_params(args_b, ds, model)
+    # the resumed run must draw the SAME noise keys rounds 2-3 as the
+    # uninterrupted run — the checkpointed DP counter carries that
+    np.testing.assert_array_equal(straight, resumed)
+
+
+def test_mesh_kill_and_resume_bit_exact(tmp_path):
+    from fedml_tpu.simulation.parallel.mesh_simulator import MeshFedAvgAPI
+
+    def run(args, ds, model):
+        api = MeshFedAvgAPI(args, None, ds, model)
+        api.train()
+        return np.asarray(tree_flatten_vector(api.global_params))
+
+    args = make_args(rounds=5, backend="mesh")
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    straight = run(args, ds, model)
+
+    args_a = make_args(rounds=2, backend="mesh", ckpt_dir=tmp_path / "ck")
+    run(args_a, ds, model)
+    args_b = make_args(rounds=5, backend="mesh", ckpt_dir=tmp_path / "ck",
+                       resume=True)
+    resumed = run(args_b, ds, model)
+    np.testing.assert_array_equal(straight, resumed)
+
+
+def test_cross_silo_server_resume(tmp_path):
+    from fedml_tpu.cross_silo.run_inproc import run_cross_silo_inproc
+
+    def cs_args(rounds, ckpt=None, resume=False, run_id="cs_ck"):
+        extra = {"checkpoint_dir": str(ckpt), "resume": resume} if ckpt else {}
+        return _fresh_init(load_arguments_from_dict({
+            "common_args": {"training_type": "cross_silo", "random_seed": 0,
+                            "run_id": run_id},
+            "data_args": {"dataset": "synthetic", "train_size": 400,
+                          "test_size": 100, "class_num": 4, "feature_dim": 12},
+            "model_args": {"model": "lr"},
+            "train_args": {"federated_optimizer": "FedOpt",
+                           "server_optimizer": "sgd", "server_lr": 1.0,
+                           "server_momentum": 0.9,
+                           "client_num_in_total": 3, "client_num_per_round": 3,
+                           "comm_round": rounds, "epochs": 1, "batch_size": 32,
+                           "learning_rate": 0.3, **extra},
+        }))
+
+    args = cs_args(4, run_id="cs_straight")
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    straight = run_cross_silo_inproc(args, ds, model, timeout=120)
+
+    a1 = cs_args(2, ckpt=tmp_path / "ck", run_id="cs_part1")
+    run_cross_silo_inproc(a1, ds, model, timeout=120)
+    a2 = cs_args(4, ckpt=tmp_path / "ck", resume=True, run_id="cs_part2")
+    resumed = run_cross_silo_inproc(a2, ds, model, timeout=120)
+    assert resumed is not None and straight is not None
+    # FedOpt server momentum is part of the checkpoint: the resumed run's
+    # rounds 2-3 apply the same accumulated momentum as the straight run
+    assert resumed["test_loss"] == straight["test_loss"]
+    assert resumed["test_acc"] == straight["test_acc"]
+
+    # resuming a FINISHED run must not train an extra round: the server
+    # reports and finishes, and no round_4 checkpoint appears
+    from fedml_tpu.core.checkpoint import RoundCheckpointer
+
+    before = RoundCheckpointer(str(tmp_path / "ck")).saved_rounds()
+    a3 = cs_args(4, ckpt=tmp_path / "ck", resume=True, run_id="cs_part3")
+    done = run_cross_silo_inproc(a3, ds, model, timeout=120)
+    assert done is not None and done["rounds"] == 4
+    assert RoundCheckpointer(str(tmp_path / "ck")).saved_rounds() == before
+
+
+def test_checkpointer_prunes_old_rounds(tmp_path):
+    from fedml_tpu.core.checkpoint import RoundCheckpointer
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), keep=2)
+    for r in range(5):
+        ck.save(r, {"x": np.arange(3, dtype=np.float32) + r})
+    assert ck.saved_rounds() == [3, 4]
+    state = ck.restore(4, {"x": np.zeros(3, np.float32)})
+    np.testing.assert_array_equal(state["x"], np.arange(3, dtype=np.float32) + 4)

@@ -1,0 +1,91 @@
+"""Federated analytics: every task e2e over the in-proc FSM, checked
+against the centralized computation on the pooled data."""
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.fa import run_fa_inproc
+
+
+def make_args(task, run_id, **extra):
+    return fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "federated_analytics",
+                        "random_seed": 0, "run_id": run_id},
+        "fa_args": {"fa_task": task, **extra},
+    }))
+
+
+@pytest.fixture()
+def numeric_data():
+    rng = np.random.default_rng(0)
+    return {r: rng.normal(loc=r, scale=2.0, size=50 + 10 * r)
+            for r in (1, 2, 3)}
+
+
+def pooled(data):
+    return np.concatenate([np.asarray(v, np.float64) for v in data.values()])
+
+
+def test_fa_avg(numeric_data):
+    args = make_args("avg", "fa_avg")
+    res = run_fa_inproc(args, numeric_data)
+    assert res is not None
+    np.testing.assert_allclose(res["avg"], pooled(numeric_data).mean(), rtol=1e-12)
+
+
+def test_fa_frequency_estimation():
+    data = {1: list("aabbc"), 2: list("bbccd"), 3: list("ccdda")}
+    args = make_args("frequency_estimation", "fa_freq")
+    res = run_fa_inproc(args, data)
+    allv = "".join("".join(v) for v in data.values())
+    for ch in "abcd":
+        assert abs(res["frequencies"][ch] - allv.count(ch) / len(allv)) < 1e-12
+
+
+def test_fa_union_intersection_cardinality():
+    data = {1: ["x", "y", "z"], 2: ["y", "z", "w"], 3: ["z", "q"]}
+    res = run_fa_inproc(make_args("union", "fa_u"), data)
+    assert res["union"] == ["q", "w", "x", "y", "z"]
+    res = run_fa_inproc(make_args("intersection", "fa_i"), data)
+    assert res["intersection"] == ["z"]
+    res = run_fa_inproc(make_args("cardinality", "fa_c"), data)
+    assert res["cardinality"] == 5
+
+
+def test_fa_histogram(numeric_data):
+    args = make_args("histogram", "fa_h", fa_hist_bins=8)
+    res = run_fa_inproc(args, numeric_data)
+    all_vals = pooled(numeric_data)
+    expect, _ = np.histogram(all_vals, bins=np.asarray(res["edges"]))
+    np.testing.assert_array_equal(np.asarray(res["counts"]), expect)
+    assert res["rounds"] == 2  # range discovery + count round
+
+
+def test_fa_k_percentile(numeric_data):
+    args = make_args("k_percentile_element", "fa_p",
+                     fa_k_percentile=75, fa_percentile_tol=1e-6)
+    res = run_fa_inproc(args, numeric_data)
+    all_vals = np.sort(pooled(numeric_data))
+    rank = int(np.ceil(0.75 * len(all_vals)))
+    true_val = all_vals[rank - 1]
+    # bisection converges to a value v with |{x ≤ v}| == rank; v sits within
+    # tol of the true order statistic's position in the value axis
+    below = np.searchsorted(all_vals, res["value"], side="right")
+    assert below >= rank
+    assert res["value"] >= true_val - 1e-5
+
+
+def test_fa_heavy_hitter_triehh():
+    words = ["spam"] * 6 + ["ham"] * 5 + ["eggs"] * 2 + ["rare"]
+    rng = np.random.default_rng(1)
+    rng.shuffle(words)
+    data = {1: words[:5], 2: words[5:10], 3: words[10:]}
+    args = make_args("heavy_hitter_triehh", "fa_hh", fa_theta=4)
+    res = run_fa_inproc(args, data)
+    assert set(res["heavy_hitters"]) == {"spam", "ham"}
+
+
+def test_fa_unknown_task_raises():
+    with pytest.raises(ValueError):
+        run_fa_inproc(make_args("nope", "fa_x"), {1: [1.0]})

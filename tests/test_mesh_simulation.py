@@ -234,3 +234,9 @@ def test_mesh_matches_sp_trimmed_mean_f32_edge():
         "enable_defense": True, "defense_type": "trimmed_mean", "beta": 0.35,
         "client_num_in_total": 20, "client_num_per_round": 20,
     })
+
+
+def test_mesh_matches_sp_fednova():
+    """FedNova's normalized updates + τ_eff rescale agree across backends."""
+    _sp_vs_mesh({"federated_optimizer": "FedNova",
+                 "client_num_in_total": 6, "client_num_per_round": 6})
