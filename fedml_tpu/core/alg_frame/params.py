@@ -38,6 +38,7 @@ class Context(Params):
     KEY_CLIENT_NUM_IN_THIS_ROUND = "client_num_in_this_round"
     KEY_METRICS_ON_AGGREGATED_MODEL = "metrics_on_aggregated_model"
     KEY_METRICS_ON_LAST_ROUND = "metrics_on_last_round"
+    KEY_CLIENT_CONTRIBUTIONS = "client_contributions"
 
     _instance: "Context | None" = None
 

@@ -1,0 +1,11 @@
+"""Contribution assessment — Shapley-style data valuation.
+
+Parity: reference ``core/contribution/`` (GTG-Shapley, leave-one-out,
+``contribution_assessor_manager.py:9``).
+"""
+from fedml_tpu.core.contribution.contribution_assessor_manager import (
+    ContributionAssessorManager,
+)
+from fedml_tpu.core.contribution.gtg_shapley import gtg_shapley, leave_one_out
+
+__all__ = ["ContributionAssessorManager", "gtg_shapley", "leave_one_out"]

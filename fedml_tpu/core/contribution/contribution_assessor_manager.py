@@ -1,0 +1,79 @@
+"""ContributionAssessorManager — per-round participant valuation.
+
+Parity: ``core/contribution/contribution_assessor_manager.py:9`` — invoked
+from the server after aggregation with the round's client models; the
+utility of a coalition is the validation metric of that coalition's
+count-weighted aggregate. Accumulated values land in the Context and the
+metrics sink so the MLOps plane can show per-client contribution.
+
+Config:
+  contribution_args:
+    enable_contribution: true
+    contribution_method: gtg_shapley | leave_one_out
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.alg_frame.params import Context
+from fedml_tpu.core.contribution.gtg_shapley import gtg_shapley, leave_one_out
+
+Pytree = Any
+
+logger = logging.getLogger(__name__)
+
+
+class ContributionAssessorManager:
+    def __init__(self, args: Any):
+        self.args = args
+        self.enabled = bool(getattr(args, "enable_contribution", False))
+        self.method = str(
+            getattr(args, "contribution_method", "gtg_shapley")
+        ).lower()
+        self.max_permutations = int(getattr(args, "contribution_max_perms", 32))
+        self.eps = float(getattr(args, "contribution_trunc_eps", 1e-3))
+        self.accumulated: Dict[int, float] = {}
+
+    def is_enabled(self) -> bool:
+        return self.enabled
+
+    def run(
+        self,
+        client_ids: Sequence[int],
+        w_locals: List[Tuple[int, Pytree]],
+        utility_of_params: Callable[[Pytree], float],
+        utility_empty: float,
+        round_idx: int = 0,
+    ) -> Dict[int, float]:
+        """w_locals: the round's [(n_samples, params)] in client_ids order."""
+        from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+
+        def utility(subset: Sequence[int]) -> float:
+            if not len(subset):
+                return utility_empty
+            agg = FedMLAggOperator.agg(
+                self.args, [w_locals[i] for i in subset]
+            )
+            return float(utility_of_params(agg))
+
+        n = len(w_locals)
+        if self.method == "leave_one_out":
+            phi = leave_one_out(n, utility)
+        else:
+            phi = gtg_shapley(
+                n, utility, utility_empty,
+                max_permutations=self.max_permutations, eps=self.eps,
+                seed=int(getattr(self.args, "random_seed", 0)) + round_idx,
+            )
+        values = {int(cid): float(phi[i]) for i, cid in enumerate(client_ids)}
+        for cid, val in values.items():
+            self.accumulated[cid] = self.accumulated.get(cid, 0.0) + val
+        Context().add(Context.KEY_CLIENT_CONTRIBUTIONS, dict(self.accumulated))
+        from fedml_tpu.core.mlops import metrics as mlops
+
+        mlops.log({"round": round_idx, "contributions": values})
+        logger.info("round %d contributions: %s", round_idx, values)
+        return values

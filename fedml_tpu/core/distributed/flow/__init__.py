@@ -1,0 +1,191 @@
+"""FedMLAlgorithmFlow — declarative multi-step FL over the comm layer.
+
+Parity: reference ``core/distributed/flow/fedml_flow.py:20`` — register
+named flow steps bound to a role (SERVER / CLIENT), sequence them (with a
+loop section for the round body), and run them as a message-driven
+federation: server steps run on the server; for a client step the server
+broadcasts the current payload, every client executes the step's function
+and sends its result back, and the server collects all results before the
+next step. The transport is the standard ``FedMLCommManager`` stack, so a
+flow runs unchanged over LOCAL / BROKER / gRPC.
+
+    flow = FedMLAlgorithmFlow(args, n_clients=4)
+    flow.add_flow("init", FLOW_SERVER, init_fn)        # (ctx, inputs)->out
+    flow.add_flow("train", FLOW_CLIENT, train_fn)      # (ctx, payload)->out
+    flow.add_flow("agg", FLOW_SERVER, agg_fn)          # (ctx, [outs])->out
+    flow.set_loop(["train", "agg"], rounds=10)
+    result = flow.run_inproc()
+"""
+from __future__ import annotations
+
+import copy
+import logging
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.communication.local_comm import LocalBroker
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+
+logger = logging.getLogger(__name__)
+
+FLOW_SERVER = "server"
+FLOW_CLIENT = "client"
+
+MSG_FLOW_EXEC = "MSG_FLOW_EXEC"
+MSG_FLOW_RESULT = "MSG_FLOW_RESULT"
+MSG_FLOW_FINISH = "MSG_FLOW_FINISH"
+MSG_FLOW_READY = "MSG_TYPE_CONNECTION_IS_READY"
+
+
+@dataclass
+class FlowStep:
+    name: str
+    role: str
+    fn: Callable
+
+
+@dataclass
+class FlowContext:
+    args: Any
+    rank: int
+    round_idx: int
+
+
+class _FlowClientManager(FedMLCommManager):
+    def __init__(self, args, steps: Dict[str, FlowStep], rank, size,
+                 backend=constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, None, rank, size, backend)
+        self.steps = steps
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_FLOW_READY, lambda m: None)
+        self.register_message_receive_handler(MSG_FLOW_EXEC, self.handle_exec)
+        self.register_message_receive_handler(
+            MSG_FLOW_FINISH, lambda m: self.finish())
+
+    def handle_exec(self, msg: Message) -> None:
+        step = self.steps[msg.get("step")]
+        ctx = FlowContext(self.args, self.rank, int(msg.get("round", 0)))
+        out = step.fn(ctx, msg.get("payload"))
+        reply = Message(MSG_FLOW_RESULT, self.get_sender_id(), 0)
+        reply.add_params("step", step.name)
+        reply.add_params("round", msg.get("round", 0))
+        reply.add_params("payload", out)
+        self.send_message(reply)
+
+
+class _FlowServerManager(FedMLCommManager):
+    def __init__(self, args, schedule: List[FlowStep], n_clients, rounds,
+                 loop_names: List[str],
+                 backend=constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, None, 0, n_clients + 1, backend)
+        self.schedule = schedule
+        self.n_clients = n_clients
+        self.rounds = rounds
+        self.loop_names = set(loop_names)
+        self.result: Any = None
+        self._step_idx = 0
+        self._round = 0
+        self._payload: Any = None
+        self._collected: Dict[int, Any] = {}
+        self._started = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_FLOW_READY, self.handle_ready)
+        self.register_message_receive_handler(MSG_FLOW_RESULT, self.handle_result)
+
+    def handle_ready(self, msg: Message) -> None:
+        if not self._started:
+            self._started = True
+            self._advance()
+
+    def _advance(self) -> None:
+        """Run server steps until a client step needs the federation."""
+        while self._step_idx < len(self.schedule):
+            step = self.schedule[self._step_idx]
+            ctx = FlowContext(self.args, 0, self._round)
+            if step.role == FLOW_SERVER:
+                self._payload = step.fn(ctx, self._payload)
+                self._step_idx += 1
+                continue
+            # client step: broadcast, wait for all results
+            self._collected = {}
+            for cid in range(1, self.n_clients + 1):
+                m = Message(MSG_FLOW_EXEC, 0, cid)
+                m.add_params("step", step.name)
+                m.add_params("round", self._round)
+                m.add_params("payload", self._payload)
+                self.send_message(m)
+            return  # resume in handle_result
+        self._finish_or_loop()
+
+    def handle_result(self, msg: Message) -> None:
+        if int(msg.get("round", 0)) != self._round:
+            return
+        self._collected[msg.get_sender_id()] = msg.get("payload")
+        if len(self._collected) < self.n_clients:
+            return
+        self._payload = [self._collected[c] for c in sorted(self._collected)]
+        self._step_idx += 1
+        self._advance()
+
+    def _finish_or_loop(self) -> None:
+        self._round += 1
+        if self._round < self.rounds and self.loop_names:
+            self._step_idx = next(
+                i for i, s in enumerate(self.schedule)
+                if s.name in self.loop_names
+            )
+            self._advance()
+            return
+        self.result = self._payload
+        for cid in range(1, self.n_clients + 1):
+            self.send_message(Message(MSG_FLOW_FINISH, 0, cid))
+        self.finish()
+
+
+class FedMLAlgorithmFlow:
+    def __init__(self, args: Any, n_clients: Optional[int] = None):
+        self.args = args
+        self.n_clients = int(
+            n_clients
+            if n_clients is not None
+            else getattr(args, "client_num_per_round", 2)
+        )
+        self.steps: List[FlowStep] = []
+        self.loop_names: List[str] = []
+        self.rounds = 1
+
+    def add_flow(self, name: str, role: str, fn: Callable) -> "FedMLAlgorithmFlow":
+        self.steps.append(FlowStep(name, role, fn))
+        return self
+
+    def set_loop(self, names: List[str], rounds: int) -> "FedMLAlgorithmFlow":
+        """The named contiguous tail section repeats ``rounds`` times total."""
+        self.loop_names = list(names)
+        self.rounds = int(rounds)
+        return self
+
+    def build(self) -> "FedMLAlgorithmFlow":  # reference API parity
+        return self
+
+    def run_inproc(self, timeout: float = 300.0) -> Any:
+        from fedml_tpu.cross_silo.run_inproc import run_managers_to_completion
+
+        run_id = str(getattr(self.args, "run_id", "flow"))
+        LocalBroker.destroy(run_id)
+        step_map = {s.name: s for s in self.steps}
+        server = _FlowServerManager(
+            self.args, self.steps, self.n_clients, self.rounds, self.loop_names
+        )
+        clients = []
+        for rank in range(1, self.n_clients + 1):
+            cargs = copy.copy(self.args)
+            cargs.rank = rank
+            clients.append(_FlowClientManager(
+                cargs, step_map, rank, self.n_clients + 1))
+        return run_managers_to_completion(
+            [server] + clients, run_id, MSG_FLOW_READY, timeout
+        )

@@ -27,6 +27,7 @@ class FedMLDifferentialPrivacy:
         self.dp_solution = None
         self.frame = None
         self.clipping_norm = None
+        self.accountant = None  # RDP budget accountant (gaussian mechanism)
         self._rng_counter = 0
         self._seed = 0
 
@@ -46,6 +47,10 @@ class FedMLDifferentialPrivacy:
         from fedml_tpu.core.dp.frames import build_dp_frame
 
         self.frame = build_dp_frame(self.dp_solution, args)
+        if str(getattr(args, "mechanism_type", "gaussian")).lower() == "gaussian":
+            from fedml_tpu.core.dp.budget_accountant import BudgetAccountant
+
+            self.accountant = BudgetAccountant(args)
         logging.info("DP enabled: %s", self.dp_solution)
 
     # -- predicates -------------------------------------------------------
@@ -74,17 +79,30 @@ class FedMLDifferentialPrivacy:
         The mesh simulator stages these onto devices so LDP noise drawn
         *inside* the compiled round is bit-identical to the sequential sp
         path calling :meth:`add_local_noise` once per client in order.
+        Each key is one noise release — accounted like add_local_noise.
         """
+        self._account(n)
         import numpy as np
 
         return np.stack(
             [np.asarray(jax.random.key_data(self._next_key())) for _ in range(n)]
         )
 
+    def _account(self, n: int = 1) -> None:
+        if self.accountant is not None:
+            self.accountant.check_budget()
+            self.accountant.record_release(n)
+
+    def epsilon_spent(self) -> float:
+        """Total (ε, δ)-DP spend so far (RDP-composed); 0 when untracked."""
+        return self.accountant.epsilon_spent() if self.accountant else 0.0
+
     def add_local_noise(self, params: Pytree) -> Pytree:
+        self._account()
         return self.frame.add_local_noise(params, self._next_key())
 
     def add_global_noise(self, params: Pytree) -> Pytree:
+        self._account()
         return self.frame.add_global_noise(params, self._next_key())
 
     def global_clip(

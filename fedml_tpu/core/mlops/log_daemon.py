@@ -1,0 +1,86 @@
+"""Runtime log daemon — tails run logs and ships them to the sink.
+
+Parity: ``core/mlops/mlops_runtime_log_daemon.py`` (504 LoC: tail run log
+files, batch lines, POST to the MLOps backend). Local-sink edition: a
+daemon thread follows the file from its current end, batches appended
+lines, and writes them into the JSONL metrics sink tagged with the run id
+— the same stream the scheduler agent and endpoint monitor use, so one
+`tail -f` of the sink shows a run's logs, status, and metrics together.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, List, Optional
+
+from fedml_tpu.core.mlops.metrics import MLOpsMetrics
+
+
+class MLOpsRuntimeLogDaemon:
+    def __init__(self, run_id: str, log_path: str, args: Any = None,
+                 sink_dir: Optional[str] = None,
+                 poll_interval: float = 0.2, batch_lines: int = 64):
+        self.run_id = str(run_id)
+        self.log_path = os.path.abspath(log_path)
+        self._metrics = MLOpsMetrics(args, sink_dir=sink_dir)
+        self._poll = float(poll_interval)
+        self._batch = int(batch_lines)
+        self._offset = 0
+        self._line_no = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    def start(self, from_beginning: bool = True) -> "MLOpsRuntimeLogDaemon":
+        if not from_beginning and os.path.exists(self.log_path):
+            self._offset = os.path.getsize(self.log_path)
+        if self._thread is None:
+            self._stopping.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.flush()
+
+    def flush(self) -> int:
+        """Ship anything appended since the last poll; returns lines shipped."""
+        if not os.path.exists(self.log_path):
+            return 0
+        size = os.path.getsize(self.log_path)
+        if size < self._offset:  # truncated/rotated: restart from the top
+            self._offset = 0
+        if size == self._offset:
+            return 0
+        with open(self.log_path, "rb") as f:
+            f.seek(self._offset)
+            data = f.read(size - self._offset)
+        # only complete lines ship; a partial trailing line waits
+        last_nl = data.rfind(b"\n")
+        if last_nl < 0:
+            return 0
+        self._offset += last_nl + 1
+        lines = data[: last_nl + 1].decode(errors="replace").splitlines()
+        shipped = 0
+        for i in range(0, len(lines), self._batch):
+            chunk = lines[i : i + self._batch]
+            self._metrics.log({
+                "run_id": self.run_id,
+                "log_lines": chunk,
+                "line_start": self._line_no,
+            })
+            self._line_no += len(chunk)
+            shipped += len(chunk)
+        return shipped
+
+    def _loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                self.flush()
+            except OSError:
+                pass
+            time.sleep(self._poll)

@@ -1,0 +1,101 @@
+"""System/device performance sampling.
+
+Parity: ``core/mlops/mlops_device_perfs.py`` + ``system_stats.py`` (psutil
+CPU/mem/disk/net + GPU utilization shipped to the backend). TPU edition:
+psutil host stats plus per-device HBM occupancy from
+``jax.Device.memory_stats()`` (the TPU equivalent of nvidia-smi memory),
+sampled on a daemon thread into the local JSONL sink.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.core.mlops.metrics import MLOpsMetrics
+
+
+def sample_system_stats() -> Dict:
+    out: Dict = {"ts": time.time()}
+    try:
+        import psutil
+
+        out["cpu_percent"] = psutil.cpu_percent(interval=None)
+        vm = psutil.virtual_memory()
+        out["mem_percent"] = vm.percent
+        out["mem_used_gb"] = round(vm.used / 2**30, 3)
+        try:
+            io = psutil.net_io_counters()
+            out["net_sent_mb"] = round(io.bytes_sent / 2**20, 2)
+            out["net_recv_mb"] = round(io.bytes_recv / 2**20, 2)
+        except Exception:
+            pass
+    except Exception:
+        out["psutil"] = "unavailable"
+    return out
+
+
+def sample_device_stats() -> List[Dict]:
+    devices = []
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            entry: Dict = {"id": d.id, "kind": d.device_kind,
+                           "platform": d.platform}
+            try:
+                ms = d.memory_stats() or {}
+                if "bytes_in_use" in ms:
+                    entry["hbm_used_gb"] = round(ms["bytes_in_use"] / 2**30, 3)
+                if "bytes_limit" in ms:
+                    entry["hbm_limit_gb"] = round(ms["bytes_limit"] / 2**30, 3)
+            except Exception:
+                pass
+            devices.append(entry)
+    except Exception:
+        pass
+    return devices
+
+
+class SysStatsSampler:
+    """Periodic sampler → metrics sink (`{"sys_stats": ..., "devices": ...}`)."""
+
+    def __init__(self, args: Any = None, sink_dir: Optional[str] = None,
+                 interval_s: float = 10.0, run_id: str = "0"):
+        self.run_id = str(run_id)
+        self._metrics = MLOpsMetrics(args, sink_dir=sink_dir)
+        self._interval = float(interval_s)
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self.samples = 0
+
+    def sample_once(self) -> Dict:
+        entry = {
+            "run_id": self.run_id,
+            "sys_stats": sample_system_stats(),
+            "devices": sample_device_stats(),
+        }
+        self._metrics.log(entry)
+        self.samples += 1
+        return entry
+
+    def start(self) -> "SysStatsSampler":
+        if self._thread is None:
+            self._stopping.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                self.sample_once()
+            except Exception:
+                pass
+            self._stopping.wait(self._interval)

@@ -25,6 +25,7 @@ def create_attacker(name: str, args: Any):
         byzantine,
         dlg,
         label_flipping,
+        lazy_worker,
         model_replacement,
     )
 

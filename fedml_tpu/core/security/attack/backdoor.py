@@ -37,3 +37,26 @@ class BackdoorAttack(BaseAttack):
             x[idx, :t] = self.trigger_value
         y[idx] = self.target_class
         return (x, y)
+
+
+@register("edge_case_backdoor")
+class EdgeCaseBackdoorAttack(BackdoorAttack):
+    """Edge-case backdoor (Wang et al., NeurIPS'20): poison with inputs from
+    the tail of the data distribution — samples far from the local data
+    mean — relabeled to the target class. Unlike the trigger patch, the
+    poisons are valid-looking rare inputs, which evades norm-based
+    defenses. Parity: ``core/security/attack/edge_case_backdoor_attack.py``.
+    """
+
+    def poison_data(self, dataset: Any) -> Any:
+        x, y = np.array(dataset[0], copy=True), np.array(dataset[1], copy=True)
+        n = len(y)
+        n_poison = max(1, int(self.ratio * n))
+        flat = x.reshape(n, -1).astype(np.float64)
+        center = flat.mean(axis=0)
+        dist = np.linalg.norm(flat - center[None], axis=1)
+        tail = np.argsort(dist)[-n_poison:]  # the distribution's edge cases
+        # amplify the edge samples outward and pin them to the target label
+        x[tail] = x[tail] + (x[tail] - center.reshape(x.shape[1:]).astype(x.dtype))
+        y[tail] = self.target_class
+        return (x, y)

@@ -1,0 +1,55 @@
+"""Lazy-worker attack — free-riding clients that skip training.
+
+Parity: ``core/security/attack/lazy_worker.py`` in the reference (the only
+*fault-injection*-style attack it ships): a lazy client uploads the global
+model it received — optionally with small gaussian camouflage noise so a
+naive exact-duplicate check misses it — instead of a trained update.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+from fedml_tpu.core.alg_frame.params import Context
+from fedml_tpu.core.security.attack import register
+from fedml_tpu.core.security.attack.base import BaseAttack
+
+Pytree = Any
+
+
+@register("lazy_worker")
+class LazyWorkerAttack(BaseAttack):
+    is_model_attack = True
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.n_lazy = int(getattr(args, "lazy_worker_num", 1))
+        self.camouflage_std = float(getattr(args, "lazy_camouflage_std", 1e-3))
+        self._rng = np.random.default_rng(
+            int(getattr(args, "random_seed", 0)) + 41
+        )
+
+    def attack_model(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        base = extra_auxiliary_info
+        if base is None:
+            base = Context().get("global_model_for_defense")
+        if base is None:  # nothing to free-ride on: no-op
+            return raw_client_grad_list
+        out = list(raw_client_grad_list)
+        std = self.camouflage_std
+        for i in range(min(self.n_lazy, len(out))):
+            n, _ = out[i]
+            lazy = jax.tree.map(
+                lambda x: np.asarray(x)
+                + self._rng.normal(0.0, std, np.shape(x)).astype(np.asarray(x).dtype)
+                if np.asarray(x).dtype.kind == "f" else np.asarray(x),
+                base,
+            )
+            out[i] = (n, lazy)
+        return out

@@ -28,6 +28,7 @@ def create_defender(name: str, args: Any) -> BaseDefense:
     from fedml_tpu.core.security.defense import (  # noqa: F401
         bulyan,
         cclip,
+        cross_round,
         coord_median,
         crfl,
         foolsgold,

@@ -1,0 +1,53 @@
+"""Cross-round consistency defense.
+
+Parity: ``core/security/defense/cross_round_defense.py``: clients whose
+update *direction* is wildly inconsistent with their own previous rounds
+(cosine similarity below a threshold) are down-weighted — a client that
+suddenly flips its gradient direction is either compromised or unstable.
+State (per-client history) lives across rounds in the defense instance.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.security.defense import register
+from fedml_tpu.core.security.defense.base import (
+    BaseDefense,
+    stack_updates,
+    unstack_to_list,
+)
+
+Pytree = Any
+
+
+@register("cross_round")
+class CrossRoundDefense(BaseDefense):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.sim_threshold = float(getattr(args, "cross_round_sim_threshold", -0.2))
+        self._history: Dict[int, np.ndarray] = {}
+
+    def defend_before_aggregation(
+        self,
+        raw_client_grad_list: List[Tuple[int, Pytree]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[int, Pytree]]:
+        vecs, counts, template = stack_updates(raw_client_grad_list)
+        vecs_np = np.asarray(vecs)
+        keep = []
+        for i in range(vecs_np.shape[0]):
+            prev = self._history.get(i)
+            ok = True
+            if prev is not None:
+                denom = (np.linalg.norm(prev) * np.linalg.norm(vecs_np[i]) + 1e-12)
+                cos = float(prev @ vecs_np[i]) / denom
+                ok = cos >= self.sim_threshold
+            self._history[i] = vecs_np[i]
+            if ok:
+                keep.append(i)
+        if not keep:  # never reject the whole round
+            keep = list(range(vecs_np.shape[0]))
+        return [raw_client_grad_list[i] for i in keep]

@@ -44,6 +44,9 @@ class FedMLAggregator:
         self.client_num = int(client_num)
         self.device = device
         self.server_opt = ServerOptimizer(args)
+        from fedml_tpu.core.contribution import ContributionAssessorManager
+
+        self._contrib = ContributionAssessorManager(args)
         self.global_params: Optional[Pytree] = None
         self.model_dict: Dict[int, Pytree] = {}
         self.sample_num_dict: Dict[int, int] = {}
@@ -84,6 +87,8 @@ class FedMLAggregator:
         raw_list: List[Tuple[int, Pytree]] = [
             (self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)
         ]
+        client_idxs = sorted(self.model_dict)
+        prev_global = self.global_params
         Context().add("global_model_for_defense", self.global_params)
         w_list, _ = self.aggregator.on_before_aggregation(raw_list)
         w_agg = self.aggregator.aggregate(w_list)
@@ -101,6 +106,14 @@ class FedMLAggregator:
         self.global_params = self.server_opt.step(
             self.global_params, w_agg, tau_eff=tau_eff
         )
+        if self._contrib.is_enabled():
+            util = lambda params: self.aggregator.test(
+                params, self.test_global, self.device, self.args
+            ).get("test_acc", 0.0)
+            self._contrib.run(
+                client_idxs, raw_list, util, util(prev_global),
+                int(getattr(self.args, "round_idx", 0)),
+            )
         self.model_dict.clear()
         self.sample_num_dict.clear()
         self.local_steps_dict.clear()

@@ -1,0 +1,86 @@
+"""DARTS-style differentiable NAS cell — flax.
+
+Parity: reference ``model/cv/darts/`` (the FedNAS search space). One
+searchable cell: every edge mixes candidate ops with softmax-weighted
+architecture parameters ("alphas") that live in the SAME params tree as
+the weights, so federated averaging of alphas == the FedNAS search step
+(the reference exchanges alphas and weights exactly this way).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+OPS = ("skip", "conv3", "conv5", "maxpool", "zero")
+
+
+class MixedOp(nn.Module):
+    channels: int
+
+    @nn.compact
+    def __call__(self, x, alpha):
+        outs = []
+        for op in OPS:
+            if op == "skip":
+                outs.append(x)
+            elif op == "conv3":
+                h = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False)(x)
+                h = nn.GroupNorm(num_groups=min(8, self.channels))(h)
+                outs.append(nn.relu(h))
+            elif op == "conv5":
+                h = nn.Conv(self.channels, (5, 5), padding=2, use_bias=False)(x)
+                h = nn.GroupNorm(num_groups=min(8, self.channels))(h)
+                outs.append(nn.relu(h))
+            elif op == "maxpool":
+                outs.append(
+                    nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+                )
+            else:  # zero
+                outs.append(jnp.zeros_like(x))
+        w = nn.softmax(alpha)
+        return sum(wi * o for wi, o in zip(w, outs))
+
+
+class DARTSCell(nn.Module):
+    channels: int
+    n_nodes: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        # alphas: one op-mix vector per (node, predecessor) edge; stored as a
+        # normal parameter so they federate/aggregate like weights
+        n_edges = sum(i + 1 for i in range(self.n_nodes))
+        alphas = self.param(
+            "alphas", nn.initializers.zeros, (n_edges, len(OPS)), jnp.float32
+        )
+        states = [x]
+        e = 0
+        for i in range(self.n_nodes):
+            acc = 0.0
+            for prev in states:
+                acc = acc + MixedOp(self.channels)(prev, alphas[e])
+                e += 1
+            states.append(acc)
+        return jnp.concatenate(states[1:], axis=-1)
+
+
+class DARTSNetwork(nn.Module):
+    """Stem → searchable cells → classifier (FedNAS search network)."""
+
+    output_dim: int = 10
+    channels: int = 16
+    n_cells: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False)(x)
+        h = nn.GroupNorm(num_groups=8)(h)
+        for i in range(self.n_cells):
+            h = DARTSCell(self.channels, name=f"cell_{i}")(h)
+            h = nn.Conv(self.channels, (1, 1), use_bias=False)(h)  # re-project
+            if i < self.n_cells - 1:
+                h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.output_dim)(h)

@@ -1,0 +1,69 @@
+"""EfficientNet-lite (B0-class) — flax.
+
+Parity: reference ``model/cv/efficientnet.py``. MBConv stack with the lite
+simplifications (no SE in lite variants; GroupNorm for federation — no
+running batch stats to ship).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MBConv(nn.Module):
+    expand_ratio: int
+    out_ch: int
+    kernel: int
+    stride: int
+    groups: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        mid = inp * self.expand_ratio
+        h = x
+        if self.expand_ratio != 1:
+            h = nn.Conv(mid, (1, 1), use_bias=False)(h)
+            h = nn.GroupNorm(num_groups=min(self.groups, mid))(h)
+            h = nn.relu6(h)
+        h = nn.Conv(mid, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride),
+                    feature_group_count=mid, use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=min(self.groups, mid))(h)
+        h = nn.relu6(h)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=min(self.groups, self.out_ch))(h)
+        if self.stride == 1 and inp == self.out_ch:
+            h = h + x
+        return h
+
+
+class EfficientNetLite0(nn.Module):
+    output_dim: int = 10
+
+    # (expand, out, kernel, stride, repeats) — B0 table
+    CFG: Sequence[Tuple[int, int, int, int, int]] = (
+        (1, 16, 3, 1, 1),
+        (6, 24, 3, 2, 2),
+        (6, 40, 5, 2, 2),
+        (6, 80, 3, 2, 3),
+        (6, 112, 5, 1, 3),
+        (6, 192, 5, 2, 4),
+        (6, 320, 3, 1, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(32, (3, 3), strides=(2, 2), use_bias=False)(x)
+        h = nn.GroupNorm(num_groups=8)(h)
+        h = nn.relu6(h)
+        for e, o, k, s, r in self.CFG:
+            for i in range(r):
+                h = MBConv(e, o, k, s if i == 0 else 1)(h)
+        h = nn.Conv(1280, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=8)(h)
+        h = nn.relu6(h)
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.output_dim)(h)

@@ -1,0 +1,100 @@
+"""MobileNetV3 (small) — flax, TPU-friendly.
+
+Parity: reference ``model/cv/mobilenet.py`` / ``mobilenet_v3.py``. Inverted
+residual blocks with squeeze-excite and hard-swish; GroupNorm instead of
+BatchNorm (no running stats to federate — the same reasoning the reference
+applies with its group_norm resnet variants).
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def hard_swish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+def hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Dense(max(c // self.reduce, 8))(s)
+        s = nn.relu(s)
+        s = nn.Dense(c)(s)
+        return x * hard_sigmoid(s)
+
+
+class InvertedResidual(nn.Module):
+    expand: int
+    out_ch: int
+    kernel: int
+    stride: int
+    use_se: bool
+    use_hs: bool
+    groups: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        act = hard_swish if self.use_hs else nn.relu
+        inp = x.shape[-1]
+        h = x
+        if self.expand != inp:
+            h = nn.Conv(self.expand, (1, 1), use_bias=False)(h)
+            h = nn.GroupNorm(num_groups=min(self.groups, self.expand))(h)
+            h = act(h)
+        h = nn.Conv(
+            self.expand, (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            feature_group_count=self.expand, use_bias=False,
+        )(h)
+        h = nn.GroupNorm(num_groups=min(self.groups, self.expand))(h)
+        if self.use_se:
+            h = SqueezeExcite()(h)
+        h = act(h)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=min(self.groups, self.out_ch))(h)
+        if self.stride == 1 and inp == self.out_ch:
+            h = h + x
+        return h
+
+
+class MobileNetV3Small(nn.Module):
+    """Input [B, H, W, C] → logits [B, output_dim]."""
+
+    output_dim: int = 10
+
+    # (kernel, expand, out, SE, HS, stride) — MobileNetV3-small table
+    CFG: Sequence[Tuple[int, int, int, bool, bool, int]] = (
+        (3, 16, 16, True, False, 2),
+        (3, 72, 24, False, False, 2),
+        (3, 88, 24, False, False, 1),
+        (5, 96, 40, True, True, 2),
+        (5, 240, 40, True, True, 1),
+        (5, 120, 48, True, True, 1),
+        (5, 288, 96, True, True, 2),
+    )
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(16, (3, 3), strides=(2, 2), use_bias=False)(x)
+        h = nn.GroupNorm(num_groups=8)(h)
+        h = hard_swish(h)
+        for k, e, o, se, hs, s in self.CFG:
+            h = InvertedResidual(e, o, k, s, se, hs)(h)
+        h = nn.Conv(576, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=8)(h)
+        h = hard_swish(h)
+        h = jnp.mean(h, axis=(1, 2))
+        h = nn.Dense(1024)(h)
+        h = hard_swish(h)
+        return nn.Dense(self.output_dim)(h)

@@ -1,0 +1,45 @@
+"""VGG-11/16 — flax, GroupNorm variant for federation.
+
+Parity: reference ``model/cv/vgg.py``.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFGS = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    output_dim: int = 10
+    groups: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for v in self.cfg:
+            if v == "M":
+                h = nn.max_pool(h, (2, 2), strides=(2, 2))
+            else:
+                h = nn.Conv(int(v), (3, 3), padding=1, use_bias=False)(h)
+                h = nn.GroupNorm(num_groups=min(self.groups, int(v)))(h)
+                h = nn.relu(h)
+        h = jnp.mean(h, axis=(1, 2))  # adaptive pool → classifier
+        h = nn.Dense(512)(h)
+        h = nn.relu(h)
+        return nn.Dense(self.output_dim)(h)
+
+
+def vgg11(output_dim: int = 10) -> VGG:
+    return VGG(cfg=_CFGS["vgg11"], output_dim=output_dim)
+
+
+def vgg16(output_dim: int = 10) -> VGG:
+    return VGG(cfg=_CFGS["vgg16"], output_dim=output_dim)

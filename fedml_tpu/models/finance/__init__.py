@@ -1,0 +1,6 @@
+from fedml_tpu.models.finance.vfl_models import (
+    VFLFeatureExtractor,
+    VFLTopModel,
+)
+
+__all__ = ["VFLFeatureExtractor", "VFLTopModel"]

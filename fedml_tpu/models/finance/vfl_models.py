@@ -1,0 +1,38 @@
+"""Vertical-FL finance models.
+
+Parity: reference ``model/finance/vfl_*.py`` (lending-club / NUS-WIDE
+vertical models): each party owns a feature extractor over ITS feature
+columns; the label party runs the top model on the concatenated
+embeddings. Only embeddings/gradients cross parties — never raw features.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VFLFeatureExtractor(nn.Module):
+    """One party's bottom model: its feature slice → embedding."""
+
+    embed_dim: int = 16
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden)(x)
+        h = nn.relu(h)
+        return nn.Dense(self.embed_dim)(h)
+
+
+class VFLTopModel(nn.Module):
+    """Label party: concatenated party embeddings → logits."""
+
+    output_dim: int = 2
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, embeddings):
+        h = jnp.concatenate(embeddings, axis=-1)
+        h = nn.Dense(self.hidden)(h)
+        h = nn.relu(h)
+        return nn.Dense(self.output_dim)(h)

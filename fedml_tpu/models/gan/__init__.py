@@ -1,0 +1,3 @@
+from fedml_tpu.models.gan.gan import Discriminator, Generator
+
+__all__ = ["Generator", "Discriminator"]

@@ -1,0 +1,36 @@
+"""GAN pair for federated GAN training (FedGAN).
+
+Parity: reference ``model/gan/`` used by ``simulation/mpi/fedgan``. MLP
+generator/discriminator sized by data dim — federated GAN averages both
+nets across clients each round.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    out_dim: int
+    latent_dim: int = 32
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, z):
+        h = nn.Dense(self.hidden)(z)
+        h = nn.leaky_relu(h, 0.2)
+        h = nn.Dense(self.hidden)(h)
+        h = nn.leaky_relu(h, 0.2)
+        return nn.tanh(nn.Dense(self.out_dim)(h))
+
+
+class Discriminator(nn.Module):
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden)(x)
+        h = nn.leaky_relu(h, 0.2)
+        h = nn.Dense(self.hidden)(h)
+        h = nn.leaky_relu(h, 0.2)
+        return nn.Dense(1)(h)  # logit

@@ -40,6 +40,26 @@ def create(args: Any, output_dim: int = 10) -> nn.Module:
         return resnet20(output_dim=output_dim, groups=groups)
     if name in ("resnet56", "resnet56_gn"):
         return resnet56(output_dim=output_dim, groups=groups)
+    if name in ("mobilenet", "mobilenet_v3", "mobilenetv3"):
+        from fedml_tpu.models.cv.mobilenet import MobileNetV3Small
+
+        return MobileNetV3Small(output_dim=output_dim)
+    if name in ("efficientnet", "efficientnet_b0", "efficientnet_lite0"):
+        from fedml_tpu.models.cv.efficientnet import EfficientNetLite0
+
+        return EfficientNetLite0(output_dim=output_dim)
+    if name in ("vgg11", "vgg16", "vgg"):
+        from fedml_tpu.models.cv.vgg import vgg11, vgg16
+
+        return vgg16(output_dim) if name == "vgg16" else vgg11(output_dim)
+    if name in ("darts", "fednas"):
+        from fedml_tpu.models.cv.darts import DARTSNetwork
+
+        return DARTSNetwork(
+            output_dim=output_dim,
+            channels=int(getattr(args, "darts_channels", 16)),
+            n_cells=int(getattr(args, "darts_cells", 2)),
+        )
     if name in ("rnn", "lstm"):
         if "stackoverflow" in dataset or "reddit" in dataset:
             return RNNStackOverflow(vocab_size=max(output_dim, 4))

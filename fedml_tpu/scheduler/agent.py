@@ -158,6 +158,12 @@ class LocalAgent:
                 log_f.close()  # child holds its own fd
         rec.pid = rec.proc.pid
         rec.fsm.transition(RunStatus.RUNNING, f"pid {rec.proc.pid}")
+        # ship the run's log lines into the same sink as its status events
+        from fedml_tpu.core.mlops.log_daemon import MLOpsRuntimeLogDaemon
+
+        rec.log_daemon = MLOpsRuntimeLogDaemon(
+            run_id, log_path, sink_dir=os.path.join(self.workdir, "mlops")
+        ).start()
         with self._lock:
             self._runs[run_id] = rec
         self._persist_table()
@@ -266,5 +272,8 @@ class LocalAgent:
                     rec.fsm.transition(RunStatus.FINISHED, "rc=0")
                 else:
                     rec.fsm.transition(RunStatus.FAILED, f"rc={rc}")
+                daemon = getattr(rec, "log_daemon", None)
+                if daemon is not None:
+                    daemon.stop()  # final flush of the tail
                 self._persist_table()
             time.sleep(self._poll_interval)

@@ -45,9 +45,36 @@ SimulatorMPI = SimulatorMesh
 SimulatorNCCL = SimulatorMesh
 
 
+class _APIRunner:
+    def __init__(self, api):
+        self.fl_trainer = api
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
 def create_simulator(args: Any, device, dataset, model,
                      client_trainer=None, server_aggregator=None):
     backend = str(getattr(args, "backend", constants.FEDML_SIMULATION_TYPE_SP))
+    # algorithm-shaped engines (reference: one sp/ directory per algorithm)
+    fed_opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+    if fed_opt in ("hierarchical_fl", "hierarchicalfl", "turbo_aggregate",
+                   "turboaggregate"):
+        from fedml_tpu.simulation.hierarchical import HierarchicalFedAvgAPI
+
+        return _APIRunner(HierarchicalFedAvgAPI(args, device, dataset, model))
+    if fed_opt in ("vertical_fl", "vfl", "classical_vertical"):
+        from fedml_tpu.simulation.vfl import VerticalFedAPI
+
+        return _APIRunner(VerticalFedAPI(args, device, dataset))
+    if fed_opt in ("split_nn", "splitnn"):
+        from fedml_tpu.simulation.split_nn import SplitNNAPI
+
+        return _APIRunner(SplitNNAPI(args, device, dataset))
+    if fed_opt in ("decentralized", "decentralized_fl", "gossip"):
+        from fedml_tpu.simulation.decentralized import DecentralizedFedAPI
+
+        return _APIRunner(DecentralizedFedAPI(args, device, dataset, model))
     if backend == constants.FEDML_SIMULATION_TYPE_SP:
         return SimulatorSingleProcess(
             args, device, dataset, model, client_trainer, server_aggregator

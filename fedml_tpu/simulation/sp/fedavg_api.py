@@ -64,6 +64,10 @@ class FedAvgAPI:
         self._mime_beta = float(getattr(args, "mime_beta", 0.9))
         self.event = MLOpsProfilerEvent(args)
 
+        from fedml_tpu.core.contribution import ContributionAssessorManager
+
+        self._contrib = ContributionAssessorManager(args)
+
         # round checkpoint/resume (SURVEY §5 improvement over the reference)
         from fedml_tpu.core.checkpoint import engine_checkpointer
 
@@ -74,6 +78,18 @@ class FedAvgAPI:
             if restored is not None:
                 _, state = restored
                 self._apply_ckpt_state(state)
+
+    def _assess_contributions(self, client_ids, w_locals, round_idx) -> None:
+        """Per-client Shapley valuation after aggregation (reference hook:
+        ``on_after_aggregation`` → ContributionAssessorManager)."""
+        if self._contrib is None or not self._contrib.is_enabled():
+            return
+        util = lambda params: self.aggregator.test(
+            params, self.dataset.test_data_global, self.device, self.args
+        ).get("test_acc", 0.0)
+        self._contrib.run(
+            client_ids, w_locals, util, util(self.global_params), round_idx
+        )
 
     # -- round checkpoint state ------------------------------------------
     def _ckpt_state(self) -> dict:
@@ -144,6 +160,7 @@ class FedAvgAPI:
         w_list, _ = self.aggregator.on_before_aggregation(w_locals)
         w_agg = self.aggregator.aggregate(w_list)
         w_agg = self.aggregator.on_after_aggregation(w_agg)
+        self._assess_contributions(client_ids, w_locals, round_idx)
         tau_eff = None
         if str(getattr(self.args, "federated_optimizer", "")) == "FedNova" and taus:
             counts = np.asarray([float(n) for n, _ in w_locals])

@@ -1,0 +1,128 @@
+"""Vertical federated learning — two-party split-feature training.
+
+Parity: reference ``simulation/sp/classical_vertical_fl`` (host/guest
+parties over lending-club / NUS-WIDE): party A holds one feature view and
+no labels; party B holds its own view + the labels + the top model. Per
+batch, A sends ONLY its embedding; B returns ONLY the gradient at that
+embedding (the privacy boundary — raw features never cross).
+
+TPU re-design: each party's backward is an explicit ``jax.vjp`` cut at the
+embedding, so the exchange is precisely the tensors a real two-party
+deployment would ship, while both parties' steps are jitted.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.data.dataset import FederatedDataset
+from fedml_tpu.models.finance.vfl_models import VFLFeatureExtractor, VFLTopModel
+
+logger = logging.getLogger(__name__)
+
+
+class VerticalFedAPI:
+    def __init__(self, args: Any, device: Any, dataset: FederatedDataset):
+        self.args = args
+        self.dataset = dataset
+        embed = int(getattr(args, "vfl_embed_dim", 16))
+        self.party_a = VFLFeatureExtractor(embed_dim=embed)
+        self.party_b = VFLFeatureExtractor(embed_dim=embed)
+        self.top = VFLTopModel(output_dim=int(dataset.class_num))
+        xa, _ = dataset.train_data_local_dict[0]
+        xb, _ = dataset.train_data_local_dict[1]
+        k = jax.random.key(int(getattr(args, "random_seed", 0)))
+        ka, kb, kt = jax.random.split(k, 3)
+        self.pa = self.party_a.init(ka, jnp.asarray(xa[:1]))
+        self.pb = self.party_b.init(kb, jnp.asarray(xb[:1]))
+        ea = self.party_a.apply(self.pa, jnp.asarray(xa[:1]))
+        eb = self.party_b.apply(self.pb, jnp.asarray(xb[:1]))
+        self.pt = self.top.init(kt, [ea, eb])
+        lr = float(getattr(args, "learning_rate", 0.05))
+        self.tx_a, self.tx_b, self.tx_t = (optax.adam(lr) for _ in range(3))
+        self.st_a = self.tx_a.init(self.pa)
+        self.st_b = self.tx_b.init(self.pb)
+        self.st_t = self.tx_t.init(self.pt)
+        self.batch_size = int(getattr(args, "batch_size", 64))
+        self._compile()
+        self.test_history: List[dict] = []
+
+    def _compile(self):
+        party_a, party_b, top = self.party_a, self.party_b, self.top
+        tx_a, tx_b, tx_t = self.tx_a, self.tx_b, self.tx_t
+
+        @jax.jit
+        def step(pa, pb, pt, sa, sb, st, xa, xb, y):
+            # party A fwd with vjp cut: B never sees A's params or features
+            ea, vjp_a = jax.vjp(lambda p: party_a.apply(p, xa), pa)
+            eb, vjp_b = jax.vjp(lambda p: party_b.apply(p, xb), pb)
+
+            def top_loss(pt, ea, eb):
+                logits = top.apply(pt, [ea, eb])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+
+            (loss, (g_t, g_ea, g_eb)) = (
+                top_loss(pt, ea, eb),
+                jax.grad(top_loss, argnums=(0, 1, 2))(pt, ea, eb),
+            )
+            # B returns ONLY g_ea to A; each party updates locally
+            (ga,) = vjp_a(g_ea)
+            (gb,) = vjp_b(g_eb)
+            ua, sa = tx_a.update(ga, sa)
+            ub, sb = tx_b.update(gb, sb)
+            ut, st = tx_t.update(g_t, st)
+            return (optax.apply_updates(pa, ua), optax.apply_updates(pb, ub),
+                    optax.apply_updates(pt, ut), sa, sb, st, loss)
+
+        self._step = step
+
+        @jax.jit
+        def evaluate(pa, pb, pt, xa, xb, y):
+            logits = top.apply(pt, [party_a.apply(pa, xa), party_b.apply(pb, xb)])
+            acc = jnp.mean(jnp.argmax(logits, -1) == y)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return loss, acc
+
+        self._evaluate = evaluate
+
+    def train_one_epoch(self, epoch: int) -> dict:
+        xa, y = self.dataset.train_data_local_dict[0]
+        xb, _ = self.dataset.train_data_local_dict[1]
+        xa, xb, y = np.asarray(xa), np.asarray(xb), np.asarray(y)
+        rng = np.random.default_rng(
+            int(getattr(self.args, "random_seed", 0)) + epoch)
+        order = rng.permutation(len(y))
+        losses = []
+        b = self.batch_size
+        for i in range(0, len(order) - b + 1, b):
+            idx = order[i : i + b]
+            (self.pa, self.pb, self.pt, self.st_a, self.st_b, self.st_t,
+             loss) = self._step(
+                self.pa, self.pb, self.pt, self.st_a, self.st_b, self.st_t,
+                jnp.asarray(xa[idx]), jnp.asarray(xb[idx]), jnp.asarray(y[idx]),
+            )
+            losses.append(float(loss))
+        xa_t, y_t = self.dataset.test_data_local_dict[0]
+        xb_t, _ = self.dataset.test_data_local_dict[1]
+        tl, ta = self._evaluate(
+            self.pa, self.pb, self.pt,
+            jnp.asarray(np.asarray(xa_t)), jnp.asarray(np.asarray(xb_t)),
+            jnp.asarray(np.asarray(y_t)),
+        )
+        report = {"epoch": epoch, "train_loss": float(np.mean(losses)),
+                  "test_loss": float(tl), "test_acc": float(ta)}
+        self.test_history.append(report)
+        return report
+
+    def train(self) -> dict:
+        t0 = time.time()
+        for e in range(int(getattr(self.args, "comm_round", 5))):
+            self.train_one_epoch(e)
+        return {"wall_clock_sec": time.time() - t0, **self.test_history[-1]}

@@ -1,0 +1,122 @@
+"""Contribution assessment: exact Shapley on known games + FL e2e where a
+poisoned client must be valued below honest clients."""
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models as models_mod
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.core.contribution import (
+    ContributionAssessorManager,
+    gtg_shapley,
+    leave_one_out,
+)
+from fedml_tpu.data import load_federated
+
+
+def test_exact_shapley_additive_game():
+    """For an additive game v(S)=Σ w_i, Shapley == the weights exactly."""
+    w = np.asarray([3.0, 1.0, 2.0])
+    phi = gtg_shapley(3, lambda s: float(sum(w[list(s)])), 0.0)
+    np.testing.assert_allclose(phi, w, atol=1e-12)
+
+
+def test_exact_shapley_glove_game():
+    """Classic glove game: v=1 iff {0} (left) pairs with a right glove
+    {1,2}. Shapley: left=2/3, rights=1/6 each."""
+    def v(s):
+        s = set(s)
+        return 1.0 if 0 in s and (1 in s or 2 in s) else 0.0
+
+    phi = gtg_shapley(3, v, 0.0)
+    np.testing.assert_allclose(phi, [2 / 3, 1 / 6, 1 / 6], atol=1e-12)
+
+
+def test_mc_shapley_matches_exact_on_larger_game():
+    rng = np.random.default_rng(0)
+    w = rng.uniform(0, 1, size=8)
+
+    def v(s):
+        return float(sum(w[list(s)]))
+
+    exact = w
+    mc = gtg_shapley(8, v, 0.0, max_permutations=200, eps=0.0,
+                     convergence_tol=0.0, exact_threshold=5, seed=1)
+    np.testing.assert_allclose(mc, exact, atol=1e-9)  # additive: any perm exact
+
+
+def test_leave_one_out():
+    def v(s):
+        return float(len(s)) ** 2  # superadditive
+
+    phi = leave_one_out(4, v)
+    np.testing.assert_allclose(phi, [16 - 9] * 4)
+
+
+def test_truncation_caches_and_truncates():
+    calls = []
+
+    def v(s):
+        calls.append(tuple(s))
+        return 1.0  # constant utility: every marginal after ∅ is 0
+
+    gtg_shapley(6, v, 1.0, max_permutations=50, eps=1e-3, exact_threshold=2)
+    # with |v_full - v_prev| < eps from the start, only the full-coalition
+    # evaluation is ever needed
+    assert len(calls) == 1
+
+
+def test_fl_contribution_ranks_poisoned_client_last():
+    """sp FL with 1 label-poisoned client: its Shapley value must rank at
+    the bottom (and go negative or ~0 while honest clients are positive)."""
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic", "train_size": 600,
+                      "test_size": 150, "class_num": 4, "feature_dim": 16},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 3, "client_num_per_round": 3,
+                       "comm_round": 3, "epochs": 2, "batch_size": 16,
+                       "learning_rate": 0.2},
+        "contribution_args": {"enable_contribution": True,
+                              "contribution_method": "gtg_shapley"},
+    }))
+    ds = load_federated(args)
+    # poison client 2: shuffle its labels so it contributes noise
+    x2, y2 = ds.train_data_local_dict[2]
+    rng = np.random.default_rng(0)
+    ds.train_data_local_dict[2] = (x2, rng.permutation(np.asarray(y2)))
+    model = models_mod.create(args, ds.class_num)
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    api = FedAvgAPI(args, None, ds, model)
+    api.train()
+    acc = api._contrib.accumulated
+    assert set(acc) == {0, 1, 2}
+    assert acc[2] == min(acc.values()), acc
+    assert max(acc.values()) > acc[2] + 0.05, acc
+
+
+def test_contribution_context_and_loo_method():
+    from fedml_tpu.core.alg_frame.params import Context
+
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic", "train_size": 300,
+                      "test_size": 80, "class_num": 3, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 3, "client_num_per_round": 3,
+                       "comm_round": 1, "epochs": 1, "batch_size": 16,
+                       "learning_rate": 0.2},
+        "contribution_args": {"enable_contribution": True,
+                              "contribution_method": "leave_one_out"},
+    }))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    api = FedAvgAPI(args, None, ds, model)
+    api.train_one_round(0)
+    ctx = Context().get(Context.KEY_CLIENT_CONTRIBUTIONS)
+    assert ctx is not None and len(ctx) == 3
