@@ -42,8 +42,14 @@ class LocalDirObjectStore(ObjectStore):
         os.makedirs(self.root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        safe = key.replace("..", "_")
-        path = os.path.join(self.root, safe)
+        # Keys arrive off the wire (BrokerCommManager hands the store key
+        # straight to get/delete): reject anything that escapes the root.
+        if os.path.isabs(key) or os.path.splitdrive(key)[0]:
+            raise ValueError(f"object key must be relative: {key!r}")
+        path = os.path.realpath(os.path.join(self.root, key))
+        root = os.path.realpath(self.root)
+        if not (path == root or path.startswith(root + os.sep)):
+            raise ValueError(f"object key escapes store root: {key!r}")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         return path
 

@@ -70,12 +70,14 @@ class BudgetAccountant:
     def epsilon_spent(self) -> float:
         return self.rdp.get_epsilon(self.delta)
 
-    def check_budget(self) -> None:
-        """Raise BudgetExceeded if the NEXT release would break the budget."""
+    def check_budget(self, pending: int = 1) -> None:
+        """Raise BudgetExceeded if the next ``pending`` releases would break
+        the budget (a batched release — e.g. mesh LDP keys for n clients —
+        must be probed as n compositions, not 1)."""
         if self.max_epsilon is None:
             return
         probe = RDPAccountant(self.noise_multiplier)
-        probe.steps = self.rdp.steps + 1
+        probe.steps = self.rdp.steps + max(1, int(pending))
         if probe.get_epsilon(self.delta) > self.max_epsilon:
             raise BudgetExceededError(
                 f"next DP release would exceed max_epsilon={self.max_epsilon} "

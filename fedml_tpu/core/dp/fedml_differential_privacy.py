@@ -90,7 +90,7 @@ class FedMLDifferentialPrivacy:
 
     def _account(self, n: int = 1) -> None:
         if self.accountant is not None:
-            self.accountant.check_budget()
+            self.accountant.check_budget(pending=n)
             self.accountant.record_release(n)
 
     def epsilon_spent(self) -> float:

@@ -24,7 +24,7 @@ import numpy as np
 from fedml_tpu import constants
 from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
 from fedml_tpu.core.distributed.message import Message
-from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, tree_to_finite
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, mulmod, tree_to_finite
 from fedml_tpu.core.mpc.secagg import SecAggClient
 from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage
 
@@ -56,6 +56,7 @@ class SAClientManager(FedMLCommManager):
         self.held_shares: Dict[int, np.ndarray] = {}  # owner rank → my share
         self.global_params = None
         self.silo_idx = None
+        self.reconstruction_answered = False
 
     # -- registration ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -144,6 +145,11 @@ class SAClientManager(FedMLCommManager):
         self.adapter.update_dataset(self.silo_idx)
         weights, n_samples = self.adapter.train(self.round_idx, self.global_params)
         x_finite, _ = tree_to_finite(weights, self.q_bits, self.p)
+        # Count-weighted FedAvg under the masks: pre-scale by n_k in the
+        # field (exact: n·round(x·2^q) mod p); the server divides the
+        # unmasked SUM by Σ n_k. Overflow bound (see finite.dequantize):
+        # |Σ n_k·x| · 2^q_bits < p/2.
+        x_finite = mulmod(x_finite, np.int64(int(n_samples)), self.p)
         self.sa.dim = int(x_finite.shape[0])
         masked = self.sa.mask(x_finite)
         up = Message(M.MSG_TYPE_C2S_SEND_MASKED_MODEL, self.get_sender_id(), 0)
@@ -167,14 +173,33 @@ class SAClientManager(FedMLCommManager):
         M = SAMessage
         if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
             return
+        if self.reconstruction_answered:
+            # One reveal per round, ever: answering a second request would
+            # let a malicious server split the survivor/dropped overlap
+            # across two individually-disjoint requests and still collect
+            # both halves of a victim's mask.
+            logger.error("SecAgg: refusing second reconstruction request "
+                         "in round %d", self.round_idx)
+            return
         survivors = [int(s) for s in msg.get(M.MSG_ARG_KEY_SURVIVORS)]
         dropped = [int(d) for d in msg.get(M.MSG_ARG_KEY_DROPPED)]
+        overlap = set(survivors) & set(dropped)
+        if overlap:
+            # A client in both lists would have its self mask reconstructed
+            # AND its pairwise seeds revealed — enough to unmask its
+            # individual model. Refuse the whole request (a malicious or
+            # buggy server must not be able to elicit either half).
+            logger.error(
+                "SecAgg: refusing reconstruction — clients %s appear in both "
+                "survivors and dropped", sorted(overlap))
+            return
         self_shares = {
             owner: self.held_shares[owner]
             for owner in survivors if owner in self.held_shares
         }
         pairwise = {d: self.sa.pairwise_seed(d) for d in dropped
                     if d in self.sa.pairwise}
+        self.reconstruction_answered = True
         m = Message(M.MSG_TYPE_C2S_SEND_RECONSTRUCTION, self.get_sender_id(), 0)
         m.add_params(M.MSG_ARG_KEY_SELF_SHARES, self_shares)
         m.add_params(M.MSG_ARG_KEY_PAIRWISE_SEEDS, pairwise)

@@ -137,7 +137,22 @@ class SAServerManager(FedMLCommManager):
         M = SAMessage
         if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
             return
-        self.dropped.add(msg.get_sender_id())
+        if self.reconstruction_requested:
+            # Too late: reveal requests already went out against a snapshot
+            # of survivors/dropped. Mutating the sets now would desync the
+            # reveals (missing pairwise seeds, uncancelled masks); the
+            # client uploaded, so it is safely treated as a survivor.
+            logger.warning("SecAgg: dropout notice from %d after "
+                           "reconstruction started — ignored",
+                           msg.get_sender_id())
+            return
+        sender = msg.get_sender_id()
+        self.dropped.add(sender)
+        # A late dropout must also void any model the client already
+        # uploaded: keeping it while revealing the client's pairwise seeds
+        # would let the server unmask that individual model.
+        self.masked_models.pop(sender, None)
+        self.sample_nums.pop(sender, None)
         self._maybe_request_reconstruction()
 
     def handle_masked_model(self, msg: Message) -> None:
@@ -145,6 +160,8 @@ class SAServerManager(FedMLCommManager):
         if int(msg.get(M.MSG_ARG_KEY_ROUND, self.args.round_idx)) != self.args.round_idx:
             return
         sender = msg.get_sender_id()
+        if sender in self.dropped:
+            return  # dropout already recorded; its model may not be used
         self.masked_models[sender] = np.asarray(
             msg.get(M.MSG_ARG_KEY_MASKED_MODEL), np.int64)
         self.sample_nums[sender] = int(msg.get(M.MSG_ARG_KEY_NUM_SAMPLES))
@@ -224,8 +241,28 @@ class SAServerManager(FedMLCommManager):
                                 n_summands=len(survivors))
         import jax
 
-        n_active = float(len(survivors))
-        averaged = jax.tree.map(lambda x: x / n_active, summed)
+        # Clients pre-scale by n_k in the field, so the unmasked sum is
+        # Σ n_k·x_k: divide by Σ n_k for the count-weighted FedAvg that
+        # matches the plain cross-silo path on non-uniform datasets.
+        total_samples = float(sum(self.sample_nums[s] for s in survivors))
+        if total_samples <= 0:
+            raise RuntimeError(
+                "SecAgg: all survivors reported 0 samples; aggregate undefined")
+        # Wrap guard: decoding needs |Σ n_k·x| · 2^q_bits < p/2 and a wrap
+        # is undetectable after the fact (see finite.dequantize). Refuse
+        # when even unit-magnitude weights could wrap; warn within 8×.
+        headroom = (self.p / 2.0) / (total_samples * float(1 << self.q_bits))
+        if headroom < 1.0:
+            raise RuntimeError(
+                f"SecAgg: Σ n_k = {int(total_samples)} leaves |x| < "
+                f"{headroom:.3f} before field wrap at q_bits={self.q_bits}; "
+                f"lower sa_q_bits or raise sa_prime")
+        if headroom < 8.0:
+            logger.warning(
+                "SecAgg: weighted sum headroom only |x| < %.1f before field "
+                "wrap (Σ n_k = %d, q_bits=%d)", headroom, int(total_samples),
+                self.q_bits)
+        averaged = jax.tree.map(lambda x: x / total_samples, summed)
         self.aggregator.set_global_model_params(averaged)
 
         metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)

@@ -170,6 +170,52 @@ def test_lightsecagg_inproc_protocol():
     assert result["test_acc"] > 0.4
 
 
+def test_secagg_client_refuses_overlapping_reconstruction():
+    """A client named in BOTH survivors and dropped must reveal nothing:
+    self-share + pairwise seed together unmask that client's model."""
+    import numpy as np
+    from fedml_tpu.core.distributed.message import Message
+    from fedml_tpu.core.mpc.secagg import SecAggClient
+    from fedml_tpu.cross_silo.secagg.sa_client_manager import SAClientManager
+    from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage as M
+
+    mgr = object.__new__(SAClientManager)
+    mgr.rank = 1
+    mgr.round_idx = 0
+    mgr.sa = SecAggClient(client_id=1, n_clients=3, threshold=1, dim=4)
+    mgr.sa.set_peer_keys({2: SecAggClient(2, 3, 1, 4).pk,
+                          3: SecAggClient(3, 3, 1, 4).pk})
+    mgr.held_shares = {1: np.zeros(2, np.int64), 2: np.zeros(2, np.int64)}
+    mgr.reconstruction_answered = False
+    sent = []
+    mgr.send_message = sent.append
+    mgr.get_sender_id = lambda: 1
+
+    msg = Message(M.MSG_TYPE_S2C_REQUEST_RECONSTRUCTION, 0, 1)
+    msg.add_params(M.MSG_ARG_KEY_SURVIVORS, [1, 2])
+    msg.add_params(M.MSG_ARG_KEY_DROPPED, [2, 3])  # 2 overlaps
+    msg.add_params(M.MSG_ARG_KEY_ROUND, 0)
+    mgr.handle_reconstruction(msg)
+    assert sent == [], "client revealed secrets despite survivor/dropped overlap"
+
+    # disjoint request still answered
+    ok = Message(M.MSG_TYPE_S2C_REQUEST_RECONSTRUCTION, 0, 1)
+    ok.add_params(M.MSG_ARG_KEY_SURVIVORS, [1, 2])
+    ok.add_params(M.MSG_ARG_KEY_DROPPED, [3])
+    ok.add_params(M.MSG_ARG_KEY_ROUND, 0)
+    mgr.handle_reconstruction(ok)
+    assert len(sent) == 1
+
+    # one reveal per round: a second (individually disjoint) request could
+    # split the overlap across requests — must be refused
+    ok2 = Message(M.MSG_TYPE_S2C_REQUEST_RECONSTRUCTION, 0, 1)
+    ok2.add_params(M.MSG_ARG_KEY_SURVIVORS, [1])
+    ok2.add_params(M.MSG_ARG_KEY_DROPPED, [2])
+    ok2.add_params(M.MSG_ARG_KEY_ROUND, 0)
+    mgr.handle_reconstruction(ok2)
+    assert len(sent) == 1, "client answered a second reconstruction request"
+
+
 def test_secagg_inproc_protocol_with_dropout():
     """Full Bonawitz SecAgg manager FSM e2e over the LOCAL transport, with a
     client dropping after key/share distribution in round 0: the server only
@@ -228,7 +274,12 @@ def test_secagg_inproc_protocol_with_dropout():
             w0, ds.train_data_local_dict[rank - 1], None, args2
         )
         ws.append(w)
-    expected = jax.tree.map(lambda *xs: sum(xs) / len(xs), *ws)
+    # clients pre-scale by n_k under the masks → count-weighted FedAvg,
+    # same weighting as the plain cross-silo path
+    ns = [float(ds.train_data_local_num_dict[rank - 1]) for rank in survivors]
+    total = sum(ns)
+    expected = jax.tree.map(
+        lambda *xs: sum(n * x for n, x in zip(ns, xs)) / total, *ws)
     # reproduce the SecAgg round-0 state by re-running one secure round
     args3 = make_args()
     args3.comm_round = 1

@@ -59,6 +59,20 @@ def test_object_store_roundtrip(tmp_path):
         store.get_object(key)
 
 
+def test_object_store_rejects_escaping_keys(tmp_path):
+    """Keys arrive off the wire; absolute or traversal keys must not reach
+    the filesystem outside the store root."""
+    store = LocalDirObjectStore(str(tmp_path / "root"))
+    for bad in ("/etc/passwd", "../outside", "a/../../outside", "a/../../../b"):
+        with pytest.raises(ValueError):
+            store.get_object(bad)
+        with pytest.raises(ValueError):
+            store.put_object(bad, b"x")
+    # normal nested keys still work
+    store.put_object("a/b/c", b"ok")
+    assert store.get_object("a/b/c") == b"ok"
+
+
 def test_broker_comm_offloads_large_payloads(broker, tmp_path):
     """Model pytrees above the threshold ride the object store, not the
     broker frame — the MQTT+S3 split."""
