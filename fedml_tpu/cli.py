@@ -132,6 +132,160 @@ def jobs(workdir: str) -> None:
         click.echo(json.dumps(row))
 
 
+@cli.group()
+def model() -> None:
+    """Model cards + deployment (reference: `fedml model ...`)."""
+
+
+def _cards(registry):
+    from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+    return FedMLModelCards(registry)
+
+
+@model.command("create")
+@click.argument("name")
+@click.argument("workspace")
+@click.option("--registry", default=None, help="model card registry dir")
+def model_create(name: str, workspace: str, registry) -> None:
+    card = _cards(registry).create_model(name, workspace)
+    click.echo(json.dumps(card))
+
+
+@model.command("list")
+@click.option("--registry", default=None)
+def model_list(registry) -> None:
+    for row in _cards(registry).list_models():
+        click.echo(json.dumps(row))
+
+
+@model.command("delete")
+@click.argument("name")
+@click.option("--version", default=None, type=int)
+@click.option("--registry", default=None)
+def model_delete(name: str, version, registry) -> None:
+    ok = _cards(registry).delete_model(name, version)
+    click.echo("deleted" if ok else "no such model")
+    if not ok:
+        raise SystemExit(1)
+
+
+@model.command("deploy")
+@click.argument("name")
+@click.option("--broker", default="127.0.0.1:18923", show_default=True,
+              help="deploy-plane broker host:port")
+@click.option("--replicas", default=1, show_default=True)
+@click.option("--registry", default=None)
+@click.option("--store-dir", default=None, help="object store dir")
+@click.option("--cache", "cache_path", default=".fedml_deploy/endpoints.json",
+              show_default=True)
+@click.option("--timeout", default=180.0, show_default=True)
+@click.option("--with-token", is_flag=True)
+def model_deploy(name: str, broker: str, replicas: int, registry, store_dir,
+                 cache_path: str, timeout: float, with_token: bool) -> None:
+    """Deploy a model card onto live deploy workers (ephemeral master)."""
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+    from fedml_tpu.deploy import DeployMaster, EndpointCache
+
+    host, port = broker.rsplit(":", 1)
+    master = DeployMaster(
+        host, int(port), LocalDirObjectStore(store_dir),
+        EndpointCache(cache_path), cards=_cards(registry),
+    ).start()
+    try:
+        master.wait_for_workers(replicas, timeout=min(30.0, timeout))
+        record = master.deploy(name, n_replicas=replicas, timeout=timeout,
+                               with_token=with_token)
+        click.echo(json.dumps(record))
+    finally:
+        master.shutdown()
+
+
+@model.command("endpoints")
+@click.option("--cache", "cache_path", default=".fedml_deploy/endpoints.json",
+              show_default=True)
+def model_endpoints(cache_path: str) -> None:
+    from fedml_tpu.deploy import EndpointCache
+
+    for row in EndpointCache(cache_path).list_endpoints():
+        click.echo(json.dumps(row))
+
+
+@model.command("undeploy")
+@click.argument("endpoint_id")
+@click.option("--broker", default="127.0.0.1:18923", show_default=True)
+@click.option("--cache", "cache_path", default=".fedml_deploy/endpoints.json",
+              show_default=True)
+def model_undeploy(endpoint_id: str, broker: str, cache_path: str) -> None:
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+    from fedml_tpu.deploy import DeployMaster, EndpointCache
+
+    host, port = broker.rsplit(":", 1)
+    master = DeployMaster(host, int(port), LocalDirObjectStore(None),
+                          EndpointCache(cache_path))
+    ok = master.undeploy(endpoint_id)
+    master.shutdown()
+    click.echo("undeployed" if ok else "no such endpoint")
+    if not ok:
+        raise SystemExit(1)
+
+
+@cli.group()
+def deploy() -> None:
+    """Deploy-plane daemons: broker, worker agent, gateway."""
+
+
+@deploy.command("broker")
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=18923, show_default=True)
+def deploy_broker(host: str, port: int) -> None:
+    """Run the deploy-plane pub/sub broker (blocking)."""
+    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+
+    broker = PubSubBroker(host, port).start()
+    click.echo(f"broker on {broker.address[0]}:{broker.address[1]}")
+    while True:
+        time.sleep(3600)
+
+
+@deploy.command("worker")
+@click.option("--id", "worker_id", required=True)
+@click.option("--broker", default="127.0.0.1:18923", show_default=True)
+@click.option("--store-dir", default=None)
+@click.option("--workdir", default=".fedml_deploy", show_default=True)
+@click.option("--capacity", default=4, show_default=True)
+def deploy_worker(worker_id: str, broker: str, store_dir, workdir: str,
+                  capacity: int) -> None:
+    """Run a deploy worker agent (blocking)."""
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+    from fedml_tpu.deploy import DeployWorkerAgent
+
+    host, port = broker.rsplit(":", 1)
+    DeployWorkerAgent(worker_id, host, int(port),
+                      LocalDirObjectStore(store_dir), workdir=workdir,
+                      capacity=capacity).serve_forever()
+
+
+@deploy.command("gateway")
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=18080, show_default=True)
+@click.option("--cache", "cache_path", default=".fedml_deploy/endpoints.json",
+              show_default=True)
+def deploy_gateway(host: str, port: int, cache_path: str) -> None:
+    """Run the inference gateway (blocking)."""
+    from fedml_tpu.deploy import EndpointCache, InferenceGateway
+
+    gw = InferenceGateway(EndpointCache(cache_path), host=host, port=port)
+    click.echo(f"gateway on http://{host}:{gw.port}")
+    gw.run()
+
+
 @cli.command()
 @click.option("--model", "model_size", default="tiny", show_default=True,
               help="llama preset: tiny/llama2_7b/llama2_13b/llama3_8b")

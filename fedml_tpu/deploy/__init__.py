@@ -1,0 +1,40 @@
+"""Model-deploy control plane — the "Deploy" quarter of the product.
+
+Parity target: the reference's ``computing/scheduler/model_scheduler/``
+(its single largest subsystem, ~10.1k LoC): model cards CRUD +
+``serve_model_on_premise`` (``device_model_cards.py:24,:37``), deploy
+master/worker agents (``device_server_runner.py``,
+``device_client_runner.py``), the deployment executor
+(``device_model_deployment.py:528`` — docker/Triton there), the FastAPI
+inference gateway with per-endpoint routing/auth/metrics
+(``device_model_inference.py:52-132``), and the redis endpoint cache
+(``device_model_cache.py``).
+
+TPU-native re-design:
+
+- the container boundary (docker/Triton) becomes a **subprocess with its
+  own JAX/XLA runtime** (one process per endpoint replica ⇒ one TPU
+  client per replica; XLA owns the chip, so co-locating replicas in one
+  process would serialize them anyway);
+- the MQTT control plane is the in-tree broker transport; model packages
+  ride the object store (the S3 seam);
+- redis becomes a JSON-file endpoint cache readable across processes
+  (gateway, master, CLI);
+- the gateway is a stdlib threading HTTP server (no ASGI stack in this
+  environment) that proxies ``/inference/{endpoint_id}`` to healthy
+  replicas with per-endpoint metrics and failure-driven re-routing.
+"""
+from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
+from fedml_tpu.deploy.gateway import InferenceGateway
+from fedml_tpu.deploy.master import DeployMaster
+from fedml_tpu.deploy.model_cards import FedMLModelCards
+from fedml_tpu.deploy.worker import DeployWorkerAgent
+
+__all__ = [
+    "DeployMaster",
+    "DeployWorkerAgent",
+    "EndpointCache",
+    "EndpointStatus",
+    "FedMLModelCards",
+    "InferenceGateway",
+]

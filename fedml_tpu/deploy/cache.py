@@ -1,0 +1,152 @@
+"""Endpoint cache — cross-process endpoint state table.
+
+Parity target: ``model_scheduler/device_model_cache.py`` (redis hash of
+deployment results/statuses per endpoint, idle-device pick, endpoint
+tokens). Re-design: a JSON file with atomic replace + mtime-based reload,
+readable by master, gateway, and CLI in separate processes — redis
+without the dependency, at the scale a single deploy master handles.
+"""
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class EndpointStatus:
+    DEPLOYING = "DEPLOYING"
+    DEPLOYED = "DEPLOYED"
+    FAILED = "FAILED"
+    OFFLINE = "OFFLINE"
+    DELETED = "DELETED"
+
+
+class EndpointCache:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._lock_path = self.path + ".lock"
+        self._mtime = 0.0
+        self._table: Dict[str, Dict[str, Any]] = {}
+        self._reload_if_stale()
+
+    @contextlib.contextmanager
+    def _fs_lock(self):
+        """Inter-process write lock: master, gateway, and CLI all mutate the
+        table from separate processes; without flock a read-modify-write
+        would silently erase another process's update."""
+        fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # -- write ------------------------------------------------------------
+    def upsert_endpoint(self, endpoint_id: str, *, endpoint_name: str,
+                        model_name: str, model_version: int,
+                        status: str, token: Optional[str] = None) -> Dict:
+        with self._fs_lock(), self._lock:
+            self._reload_locked()
+            ep = self._table.setdefault(endpoint_id, {
+                "endpoint_id": endpoint_id,
+                "created_at": time.time(),
+                "replicas": {},
+            })
+            ep.update({
+                "endpoint_name": endpoint_name,
+                "model_name": model_name,
+                "model_version": int(model_version),
+                "status": status,
+            })
+            if token is not None:
+                ep["token"] = token
+            self._persist_locked()
+            return dict(ep)
+
+    def set_status(self, endpoint_id: str, status: str) -> None:
+        with self._fs_lock(), self._lock:
+            self._reload_locked()
+            if endpoint_id in self._table:
+                self._table[endpoint_id]["status"] = status
+                self._persist_locked()
+
+    def set_replica(self, endpoint_id: str, worker_id: str, *,
+                    url: Optional[str], status: str) -> None:
+        with self._fs_lock(), self._lock:
+            self._reload_locked()
+            ep = self._table.get(endpoint_id)
+            if ep is None:
+                return
+            ep.setdefault("replicas", {})[worker_id] = {
+                "worker_id": worker_id,
+                "url": url,
+                "status": status,
+                "updated_at": time.time(),
+            }
+            self._persist_locked()
+
+    def delete_endpoint(self, endpoint_id: str) -> bool:
+        with self._fs_lock(), self._lock:
+            self._reload_locked()
+            existed = self._table.pop(endpoint_id, None) is not None
+            if existed:
+                self._persist_locked()
+            return existed
+
+    # -- read -------------------------------------------------------------
+    def get(self, endpoint_id: str) -> Optional[Dict[str, Any]]:
+        self._reload_if_stale()
+        ep = self._table.get(endpoint_id)
+        return json.loads(json.dumps(ep)) if ep else None
+
+    def list_endpoints(self) -> List[Dict[str, Any]]:
+        self._reload_if_stale()
+        return [json.loads(json.dumps(e)) for e in self._table.values()]
+
+    def healthy_replicas(self, endpoint_id: str) -> List[Dict[str, Any]]:
+        ep = self.get(endpoint_id)
+        if not ep:
+            return []
+        return [r for r in ep.get("replicas", {}).values()
+                if r.get("status") == EndpointStatus.DEPLOYED and r.get("url")]
+
+    @staticmethod
+    def new_token() -> str:
+        return secrets.token_urlsafe(16)
+
+    # -- persistence ------------------------------------------------------
+    def _persist_locked(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._table, f)
+        os.replace(tmp, self.path)
+        try:
+            self._mtime = os.stat(self.path).st_mtime
+        except OSError:
+            pass
+
+    def _reload_if_stale(self) -> None:
+        with self._lock:
+            self._reload_locked()
+
+    def _reload_locked(self) -> None:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return
+        if mtime == self._mtime:
+            return
+        try:
+            with open(self.path) as f:
+                self._table = json.load(f)
+            self._mtime = mtime
+        except (OSError, ValueError):
+            pass  # mid-replace read; next call picks it up

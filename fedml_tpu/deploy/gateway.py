@@ -1,0 +1,235 @@
+"""Inference gateway — one front door for all deployed endpoints.
+
+Parity target: ``model_scheduler/device_model_inference.py:52-132`` (the
+FastAPI gateway: ``/inference/{end_point_id}`` + OpenAI-style subpaths,
+per-endpoint auth token, redis lookups for the target device, request
+metrics). Re-design: a stdlib threading HTTP server that resolves
+replicas through the EndpointCache, round-robins across healthy ones,
+proxies with streaming passthrough, and on connection failure marks the
+replica OFFLINE (health-driven re-route) before trying the next — a dead
+worker 503s only its own endpoint.
+"""
+from __future__ import annotations
+
+import hmac
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
+from fedml_tpu.serving.monitor import EndpointMonitor
+
+_STREAMING_TYPES = ("application/x-ndjson", "text/event-stream")
+
+
+class InferenceGateway:
+    def __init__(self, cache: EndpointCache, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout: float = 120.0):
+        self.cache = cache
+        self.request_timeout = request_timeout
+        self._rr = itertools.count()
+        self._monitors: Dict[str, EndpointMonitor] = {}
+        self._mon_lock = threading.Lock()
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.rstrip("/")
+                if path in ("", "/ready"):
+                    self._json(200, {"ready": True})
+                elif path == "/endpoints":
+                    self._json(200, gw.describe_endpoints())
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) < 2 or parts[0] != "inference":
+                    self._json(404, {"error": "not found"})
+                    return
+                endpoint_id, subpath = parts[1], "/".join(parts[2:])
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                gw._proxy(self, endpoint_id, subpath, body)
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "InferenceGateway":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- introspection ----------------------------------------------------
+    def describe_endpoints(self):
+        out = []
+        live = {ep["endpoint_id"] for ep in self.cache.list_endpoints()}
+        with self._mon_lock:  # evict monitors of undeployed endpoints
+            for eid in list(self._monitors):
+                if eid not in live:
+                    del self._monitors[eid]
+        for ep in self.cache.list_endpoints():
+            eid = ep["endpoint_id"]
+            row = {k: ep.get(k) for k in
+                   ("endpoint_id", "endpoint_name", "model_name",
+                    "model_version", "status")}
+            row["replicas"] = [
+                {"worker_id": r.get("worker_id"), "status": r.get("status")}
+                for r in ep.get("replicas", {}).values()
+            ]
+            row["metrics"] = self._monitor(eid).snapshot()
+            out.append(row)
+        return out
+
+    def _monitor(self, endpoint_id: str) -> EndpointMonitor:
+        with self._mon_lock:
+            mon = self._monitors.get(endpoint_id)
+            if mon is None:
+                mon = self._monitors[endpoint_id] = EndpointMonitor()
+            return mon
+
+    # -- proxy ------------------------------------------------------------
+    @staticmethod
+    def _target_path(subpath: str) -> str:
+        # OpenAI-style subpaths map onto the replica's /v1 surface
+        # (reference routes /inference/{id}/chat/completions the same way)
+        if subpath in ("completions", "chat/completions"):
+            return "/v1/" + subpath
+        return "/" + subpath if subpath else "/predict"
+
+    def _authorized(self, handler, ep: Dict) -> bool:
+        token = ep.get("token")
+        if not token:
+            return True
+        auth = handler.headers.get("Authorization", "")
+        return hmac.compare_digest(auth, f"Bearer {token}")
+
+    def _proxy(self, handler, endpoint_id: str, subpath: str,
+               body: bytes) -> None:
+        t0 = time.time()
+        ep = self.cache.get(endpoint_id)
+        if ep is None:
+            # no monitor for unknown ids — scanners must not grow state
+            self._reply_json(handler, 404,
+                             {"error": f"no such endpoint {endpoint_id}"})
+            return
+        mon = self._monitor(endpoint_id)
+        if not self._authorized(handler, ep):
+            self._reply_json(handler, 401, {"error": "invalid token"})
+            mon.record_request(time.time() - t0, False)
+            return
+
+        replicas = self.cache.healthy_replicas(endpoint_id)
+        if replicas:
+            start = next(self._rr) % len(replicas)
+            replicas = replicas[start:] + replicas[:start]
+        ok = False
+        for rep in replicas:
+            sent, ok = self._try_replica(handler, rep, subpath, body)
+            if sent:
+                break
+            # connection-level failure: mark OFFLINE so every later request
+            # (and other gateway processes) skips it until the health loop
+            # sees it recover
+            self.cache.set_replica(endpoint_id, rep["worker_id"],
+                                   url=rep.get("url"),
+                                   status=EndpointStatus.OFFLINE)
+        else:
+            self._reply_json(handler, 503, {
+                "error": f"no healthy replica for endpoint {endpoint_id}"})
+        if not self.cache.healthy_replicas(endpoint_id):
+            self.cache.set_status(endpoint_id, EndpointStatus.OFFLINE)
+        mon.record_request(time.time() - t0, ok)
+
+    def _try_replica(self, handler, rep: Dict, subpath: str,
+                     body: bytes) -> Tuple[bool, bool]:
+        """Returns (response_sent, response_ok)."""
+        url = rep["url"] + self._target_path(subpath)
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.request_timeout)
+        except urllib.error.HTTPError as e:
+            # upstream answered (replica alive): forward its error verbatim
+            payload = e.read()
+            handler.send_response(e.code)
+            handler.send_header(
+                "Content-Type", e.headers.get("Content-Type", "application/json"))
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return True, False
+        except (urllib.error.URLError, OSError):
+            return False, False  # dead replica → caller re-routes
+        with resp:
+            ctype = resp.headers.get("Content-Type", "application/json")
+            if any(ctype.startswith(t) for t in _STREAMING_TYPES):
+                handler.send_response(resp.status)
+                handler.send_header("Content-Type", ctype)
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                try:
+                    while True:
+                        chunk = resp.read(8192)
+                        if not chunk:
+                            break
+                        handler.wfile.write(
+                            f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                    handler.wfile.write(b"0\r\n\r\n")
+                except BrokenPipeError:
+                    return True, False
+                return True, True
+            payload = resp.read()
+            handler.send_response(resp.status)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.end_headers()
+            try:
+                handler.wfile.write(payload)
+            except BrokenPipeError:
+                return True, False
+            return True, True
+
+    @staticmethod
+    def _reply_json(handler, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)

@@ -1,0 +1,247 @@
+"""Deploy master — orchestrates endpoint deployments across workers.
+
+Parity target: ``model_scheduler/device_server_runner.py`` (deploy master
+agent: dispatches deployments to worker agents, aggregates results,
+maintains endpoint state) + the scheduling half of
+``device_model_cards.py:37`` ``serve_model_on_premise``. Re-design: the
+master holds a worker registry fed by broker heartbeats, ships model
+packages via the object store, and writes endpoint state into the
+JSON-file EndpointCache that the gateway and CLI read.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+import uuid
+from typing import Dict, List, Optional
+
+from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.distributed.communication.object_store import ObjectStore
+from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
+from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+logger = logging.getLogger(__name__)
+
+
+class DeployMaster:
+    def __init__(self, broker_host: str, broker_port: int, store: ObjectStore,
+                 cache: EndpointCache, cards: Optional[FedMLModelCards] = None,
+                 cluster: str = "default", worker_timeout_s: float = 6.0,
+                 health_interval_s: float = 1.0):
+        self.cluster = cluster
+        self.store = store
+        self.cache = cache
+        self.cards = cards or FedMLModelCards()
+        self.worker_timeout_s = worker_timeout_s
+        self.workers: Dict[str, Dict] = {}  # worker_id → {last_seen, capacity}
+        self._results: Dict[str, Dict[str, Dict]] = {}  # eid → worker → result
+        self._events: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._client = BrokerClient(broker_host, broker_port)
+        self._client.subscribe(f"deploy/{cluster}/master", self._on_message)
+        self._health_interval_s = health_interval_s
+        self._health_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "DeployMaster":
+        if self._health_thread is None:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True)
+            self._health_thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self._client.close()
+
+    # -- worker registry --------------------------------------------------
+    def live_workers(self) -> List[str]:
+        now = time.time()
+        with self._lock:
+            return sorted(
+                wid for wid, info in self.workers.items()
+                if now - info["last_seen"] < self.worker_timeout_s
+            )
+
+    def wait_for_workers(self, n: int, timeout: float = 30.0) -> List[str]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            live = self.live_workers()
+            if len(live) >= n:
+                return live
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"only {len(self.live_workers())}/{n} deploy workers online")
+
+    # -- deployment API ---------------------------------------------------
+    def deploy(self, model_name: str, *, endpoint_name: Optional[str] = None,
+               version: Optional[int] = None, n_replicas: int = 1,
+               workers: Optional[List[str]] = None, timeout: float = 180.0,
+               with_token: bool = False) -> Dict:
+        """Deploy a model card to ``n_replicas`` workers; returns the
+        endpoint record once every replica reported (or raises)."""
+        card = self.cards.get_card(model_name, version)
+        version = card["model_version"]
+        endpoint_id = uuid.uuid4().hex[:12]
+        endpoint_name = endpoint_name or f"{model_name}-{endpoint_id[:4]}"
+
+        targets = workers or self._pick_workers(n_replicas)
+        token = EndpointCache.new_token() if with_token else None
+        self.cache.upsert_endpoint(
+            endpoint_id, endpoint_name=endpoint_name, model_name=model_name,
+            model_version=version, status=EndpointStatus.DEPLOYING,
+            token=token)
+
+        zip_path = self.cards.package(model_name, version)
+        key = self.store.new_key(f"deploy/{endpoint_id}")
+        with open(zip_path, "rb") as f:
+            self.store.put_object(key, f.read())
+
+        event = threading.Event()
+        with self._lock:
+            self._results[endpoint_id] = {}
+            self._events[endpoint_id] = event
+        for wid in targets:
+            self.cache.set_replica(endpoint_id, wid, url=None,
+                                   status=EndpointStatus.DEPLOYING)
+            self._send(wid, {"type": "deploy", "endpoint_id": endpoint_id,
+                             "model_name": model_name,
+                             "model_version": version, "package_key": key})
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                results = dict(self._results.get(endpoint_id, {}))
+            if len(results) == len(targets):
+                break
+            event.wait(timeout=0.2)
+            event.clear()
+        with self._lock:
+            results = self._results.pop(endpoint_id, {})
+            self._events.pop(endpoint_id, None)
+        self.store.delete_object(key)
+
+        ok = [w for w, r in results.items() if r.get("ok")]
+        status = EndpointStatus.DEPLOYED if ok else EndpointStatus.FAILED
+        self.cache.set_status(endpoint_id, status)
+        record = self.cache.get(endpoint_id)
+        if not ok:
+            errors = {w: r.get("error") for w, r in results.items()}
+            raise RuntimeError(
+                f"deployment of {model_name} failed on all workers: {errors}"
+                if results else
+                f"deployment of {model_name} timed out after {timeout}s "
+                f"(targets {targets})")
+        return record
+
+    def undeploy(self, endpoint_id: str) -> bool:
+        ep = self.cache.get(endpoint_id)
+        if ep is None:
+            return False
+        for wid in ep.get("replicas", {}):
+            self._send(wid, {"type": "undeploy", "endpoint_id": endpoint_id})
+        self.cache.delete_endpoint(endpoint_id)
+        return True
+
+    def list_endpoints(self) -> List[Dict]:
+        return self.cache.list_endpoints()
+
+    # -- internals --------------------------------------------------------
+    def _pick_workers(self, n: int) -> List[str]:
+        live = self.live_workers()
+        if len(live) < n:
+            raise RuntimeError(
+                f"need {n} workers, only {len(live)} online: {live}")
+        # least-loaded first (reference: scheduler_matcher / idle-device
+        # pick in device_model_cache.get_idle_device), respecting each
+        # worker's advertised capacity
+        load: Dict[str, int] = {w: 0 for w in live}
+        for ep in self.cache.list_endpoints():
+            for wid in ep.get("replicas", {}):
+                if wid in load:
+                    load[wid] += 1
+        with self._lock:
+            caps = {w: int(self.workers.get(w, {}).get("capacity", 4))
+                    for w in live}
+        free = [w for w in live if load[w] < caps[w]]
+        if len(free) < n:
+            raise RuntimeError(
+                f"need {n} workers with free capacity, only {len(free)} "
+                f"available (load {load}, caps {caps})")
+        return sorted(free, key=lambda w: (load[w], w))[:n]
+
+    def _send(self, worker_id: str, msg: Dict) -> None:
+        self._client.publish(
+            f"deploy/{self.cluster}/worker/{worker_id}",
+            json.dumps(msg).encode())
+
+    def _on_message(self, body: bytes) -> None:
+        try:
+            msg = json.loads(body)
+        except ValueError:
+            return
+        mtype = msg.get("type")
+        wid = str(msg.get("worker_id", ""))
+        if mtype in ("worker_online", "heartbeat"):
+            with self._lock:
+                info = self.workers.setdefault(wid, {"capacity": 4})
+                info["last_seen"] = time.time()
+                if "capacity" in msg:
+                    info["capacity"] = int(msg["capacity"])
+        elif mtype == "deploy_result":
+            eid = str(msg["endpoint_id"])
+            self.cache.set_replica(
+                eid, wid, url=msg.get("url"),
+                status=(EndpointStatus.DEPLOYED if msg.get("ok")
+                        else EndpointStatus.FAILED))
+            with self._lock:
+                if eid in self._results:
+                    self._results[eid][wid] = msg
+                event = self._events.get(eid)
+            if event is not None:
+                event.set()
+        elif mtype == "replica_down":
+            eid = str(msg["endpoint_id"])
+            self.cache.set_replica(eid, wid, url=None,
+                                   status=EndpointStatus.OFFLINE)
+            if not self.cache.healthy_replicas(eid):
+                self.cache.set_status(eid, EndpointStatus.OFFLINE)
+        elif mtype == "undeploy_result":
+            pass  # cache entry already dropped in undeploy()
+
+    def _health_loop(self) -> None:
+        """Poll replica /ready and flip statuses — the reference's
+        ``device_model_monitor.py`` / JobMonitor endpoint liveness."""
+        while not self._stopping.is_set():
+            for ep in self.cache.list_endpoints():
+                eid = ep["endpoint_id"]
+                healthy = 0
+                for wid, rep in ep.get("replicas", {}).items():
+                    url = rep.get("url")
+                    if not url or rep.get("status") not in (
+                            EndpointStatus.DEPLOYED, EndpointStatus.OFFLINE):
+                        continue
+                    ok = False
+                    try:
+                        with urllib.request.urlopen(url + "/ready",
+                                                    timeout=2) as r:
+                            ok = bool(json.loads(r.read()).get("ready"))
+                    except (OSError, ValueError):
+                        ok = False
+                    if ok:
+                        healthy += 1
+                    new = (EndpointStatus.DEPLOYED if ok
+                           else EndpointStatus.OFFLINE)
+                    if new != rep.get("status"):
+                        self.cache.set_replica(eid, wid, url=url, status=new)
+                if ep.get("status") in (EndpointStatus.DEPLOYED,
+                                        EndpointStatus.OFFLINE):
+                    new_ep = (EndpointStatus.DEPLOYED if healthy
+                              else EndpointStatus.OFFLINE)
+                    if new_ep != ep.get("status"):
+                        self.cache.set_status(eid, new_ep)
+            time.sleep(self._health_interval_s)

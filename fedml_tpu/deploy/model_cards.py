@@ -1,0 +1,153 @@
+"""Model cards — the local model registry behind create/list/delete/deploy.
+
+Parity target: ``model_scheduler/device_model_cards.py:24`` (CRUD over
+``~/.fedml/fedml-model-client/fedml/models/<name>``, zip packaging, and
+``serve_model_on_premise`` :37 kicking off deployment). Re-design: a
+versioned directory registry + zip packaging into the object store; no
+hosted ModelOps backend — deployment goes straight to the deploy master.
+
+A model card is a directory containing ``model_config.yaml``:
+
+    entry_module: my_predictor     # python file in the card (no .py)
+    entry_class: MyPredictor       # FedMLPredictor subclass
+    params: {...}                  # kwargs passed to the constructor
+
+plus whatever code/weights the predictor needs. Builtin cards (no user
+code) may instead specify ``builtin: llama`` with preset params.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+MODEL_CONFIG_FILE = "model_config.yaml"
+
+
+class FedMLModelCards:
+    def __init__(self, root: Optional[str] = None):
+        self.root = os.path.abspath(
+            root or os.path.join(os.path.expanduser("~"), ".fedml_tpu", "models")
+        )
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- CRUD -------------------------------------------------------------
+    def create_model(self, name: str, workspace: str) -> Dict[str, Any]:
+        """Register ``workspace`` as a new version of model ``name``.
+
+        Reference: ``device_model_cards.py`` ``create_model``/
+        ``add_model_files`` — recreating an existing card bumps its version.
+        """
+        self._check_name(name)
+        cfg_path = os.path.join(workspace, MODEL_CONFIG_FILE)
+        if not os.path.isfile(cfg_path):
+            raise FileNotFoundError(
+                f"model workspace must contain {MODEL_CONFIG_FILE}: {workspace}")
+        with open(cfg_path) as f:
+            cfg = yaml.safe_load(f) or {}
+        if "builtin" not in cfg and (
+                "entry_module" not in cfg or "entry_class" not in cfg):
+            raise ValueError(
+                f"{MODEL_CONFIG_FILE} needs either 'builtin' or "
+                f"'entry_module' + 'entry_class'")
+        version = self._next_version(name)
+        dst = self._version_dir(name, version)
+        shutil.copytree(workspace, dst)
+        card = {
+            "model_name": name,
+            "model_version": version,
+            "created_at": time.time(),
+            "config": cfg,
+        }
+        with open(os.path.join(dst, "card.json"), "w") as f:
+            json.dump(card, f)
+        return card
+
+    def list_models(self) -> List[Dict[str, Any]]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            versions = self.list_versions(name)
+            if versions:
+                out.append({
+                    "model_name": name,
+                    "versions": versions,
+                    "latest": versions[-1],
+                })
+        return out
+
+    def list_versions(self, name: str) -> List[int]:
+        d = os.path.join(self.root, name)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            int(v[1:]) for v in os.listdir(d)
+            if v.startswith("v") and v[1:].isdigit()
+        )
+
+    def get_card(self, name: str, version: Optional[int] = None) -> Dict[str, Any]:
+        version = version or self._latest_version(name)
+        path = os.path.join(self._version_dir(name, version), "card.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def delete_model(self, name: str, version: Optional[int] = None) -> bool:
+        self._check_name(name)
+        if version is None:
+            d = os.path.join(self.root, name)
+        else:
+            d = self._version_dir(name, version)
+        if not os.path.isdir(d):
+            return False
+        shutil.rmtree(d)
+        return True
+
+    # -- packaging --------------------------------------------------------
+    def package(self, name: str, version: Optional[int] = None,
+                out_dir: Optional[str] = None) -> str:
+        """Zip a card version for shipping to a deploy worker (the
+        reference's build step before the S3 upload)."""
+        version = version or self._latest_version(name)
+        src = self._version_dir(name, version)
+        out_dir = out_dir or self.root
+        zip_path = os.path.join(out_dir, f"{name}-v{version}.zip")
+        with zipfile.ZipFile(zip_path, "w", zipfile.ZIP_DEFLATED) as z:
+            for base, _, files in os.walk(src):
+                for fn in files:
+                    full = os.path.join(base, fn)
+                    z.write(full, os.path.relpath(full, src))
+        return zip_path
+
+    @staticmethod
+    def unpack(zip_path: str, dst: str) -> str:
+        os.makedirs(dst, exist_ok=True)
+        with zipfile.ZipFile(zip_path) as z:
+            for info in z.infolist():
+                # zip-slip guard: entries must stay under dst
+                target = os.path.realpath(os.path.join(dst, info.filename))
+                if not target.startswith(os.path.realpath(dst) + os.sep):
+                    raise ValueError(f"zip entry escapes target: {info.filename}")
+            z.extractall(dst)
+        return dst
+
+    # -- internals --------------------------------------------------------
+    def _check_name(self, name: str) -> None:
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"bad model name: {name!r}")
+
+    def _version_dir(self, name: str, version: int) -> str:
+        return os.path.join(self.root, name, f"v{version}")
+
+    def _latest_version(self, name: str) -> int:
+        versions = self.list_versions(name)
+        if not versions:
+            raise FileNotFoundError(f"no such model card: {name}")
+        return versions[-1]
+
+    def _next_version(self, name: str) -> int:
+        versions = self.list_versions(name)
+        return (versions[-1] + 1) if versions else 1

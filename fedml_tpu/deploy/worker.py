@@ -1,0 +1,236 @@
+"""Deploy worker agent — boots endpoint replicas on its node.
+
+Parity target: ``model_scheduler/device_client_runner.py`` (worker deploy
+agent: receives deployment over MQTT, runs the model container, reports
+result) + the executor ``device_model_deployment.py:528`` (docker/Triton
+there). Re-design: the replica is a **subprocess** running
+``fedml_tpu.deploy.worker_entry`` — its own Python/JAX runtime owns the
+accelerator, the parent supervises it — and the model package arrives
+through the object store (the S3 seam), control through the broker.
+
+Wire protocol (JSON over broker topics):
+
+  worker → ``deploy/{cluster}/master``:
+      worker_online {worker_id, capacity}
+      heartbeat     {worker_id}
+      deploy_result {worker_id, endpoint_id, ok, url|error}
+      undeploy_result {worker_id, endpoint_id, ok}
+      replica_down  {worker_id, endpoint_id, rc}
+  master → ``deploy/{cluster}/worker/{worker_id}``:
+      deploy   {endpoint_id, model_name, model_version, package_key}
+      undeploy {endpoint_id}
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, Optional
+
+from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.distributed.communication.object_store import ObjectStore
+from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+logger = logging.getLogger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Replica:
+    def __init__(self, endpoint_id: str, proc: subprocess.Popen, url: str):
+        self.endpoint_id = endpoint_id
+        self.proc = proc
+        self.url = url
+
+
+class DeployWorkerAgent:
+    def __init__(self, worker_id: str, broker_host: str, broker_port: int,
+                 store: ObjectStore, workdir: str = ".fedml_deploy",
+                 cluster: str = "default", capacity: int = 4,
+                 heartbeat_s: float = 2.0):
+        self.worker_id = worker_id
+        self.cluster = cluster
+        self.capacity = capacity
+        self.store = store
+        self.workdir = os.path.abspath(os.path.join(workdir, worker_id))
+        os.makedirs(self.workdir, exist_ok=True)
+        self.replicas: Dict[str, _Replica] = {}
+        self._heartbeat_s = heartbeat_s
+        self._stopping = threading.Event()
+        self._client = BrokerClient(broker_host, broker_port)
+        self._client.subscribe(
+            f"deploy/{cluster}/worker/{worker_id}", self._on_message)
+        self._threads = []
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "DeployWorkerAgent":
+        self._publish({"type": "worker_online", "worker_id": self.worker_id,
+                       "capacity": self.capacity})
+        for target in (self._heartbeat_loop, self._supervise_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        for rep in list(self.replicas.values()):
+            self._kill_replica(rep)
+        self.replicas.clear()
+        self._client.close()
+
+    def serve_forever(self) -> None:
+        """Blocking daemon loop (CLI `deploy worker` entry)."""
+        self.start()
+        try:
+            while not self._stopping.is_set():
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            self.shutdown()
+
+    # -- control-plane handlers ------------------------------------------
+    def _on_message(self, body: bytes) -> None:
+        try:
+            msg = json.loads(body)
+        except ValueError:
+            logger.warning("deploy worker %s: bad frame", self.worker_id)
+            return
+        mtype = msg.get("type")
+        if mtype == "deploy":
+            threading.Thread(
+                target=self._handle_deploy, args=(msg,), daemon=True).start()
+        elif mtype == "undeploy":
+            self._handle_undeploy(msg)
+
+    def _handle_deploy(self, msg: Dict) -> None:
+        endpoint_id = str(msg["endpoint_id"])
+        if len(self.replicas) >= self.capacity:
+            # each replica is a JAX/XLA process; oversubscription is what
+            # --capacity exists to prevent
+            self._publish({"type": "deploy_result", "worker_id": self.worker_id,
+                           "endpoint_id": endpoint_id, "ok": False,
+                           "error": f"worker at capacity {self.capacity}"})
+            return
+        try:
+            url = self._boot_replica(endpoint_id, msg)
+            self._publish({"type": "deploy_result", "worker_id": self.worker_id,
+                           "endpoint_id": endpoint_id, "ok": True, "url": url})
+        except Exception as e:
+            logger.exception("deploy of %s failed", endpoint_id)
+            self._publish({"type": "deploy_result", "worker_id": self.worker_id,
+                           "endpoint_id": endpoint_id, "ok": False,
+                           "error": str(e)})
+
+    def _boot_replica(self, endpoint_id: str, msg: Dict) -> str:
+        pkg_key = msg["package_key"]
+        pkg_dir = os.path.join(self.workdir, "endpoints", endpoint_id)
+        zip_path = pkg_dir + ".zip"
+        os.makedirs(os.path.dirname(zip_path), exist_ok=True)
+        with open(zip_path, "wb") as f:
+            f.write(self.store.get_object(pkg_key))
+        FedMLModelCards.unpack(zip_path, pkg_dir)
+        os.unlink(zip_path)
+
+        port = _free_port()
+        url = f"http://127.0.0.1:{port}"
+        env = dict(os.environ)
+        env["FEDML_ENDPOINT_ID"] = endpoint_id
+        # the replica's cwd is the package dir; make sure it can still
+        # import fedml_tpu (tests/dev run from a source checkout)
+        import fedml_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(fedml_tpu.__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_root, env.get("PYTHONPATH")) if p)
+        log_path = os.path.join(self.workdir, f"{endpoint_id}.log")
+        with open(log_path, "ab") as log_f:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "fedml_tpu.deploy.worker_entry",
+                 "--package", pkg_dir, "--host", "127.0.0.1",
+                 "--port", str(port)],
+                cwd=pkg_dir, env=env, stdout=log_f,
+                stderr=subprocess.STDOUT, start_new_session=True,
+            )
+        deadline = time.time() + float(msg.get("boot_timeout", 120))
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica exited rc={proc.returncode} during boot "
+                    f"(log: {log_path})")
+            try:
+                with urllib.request.urlopen(url + "/ready", timeout=2) as r:
+                    if json.loads(r.read()).get("ready"):
+                        self.replicas[endpoint_id] = _Replica(
+                            endpoint_id, proc, url)
+                        return url
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.2)
+        # group-kill + reap: the replica may have spawned helpers, and an
+        # unreaped child would sit as a zombie in this agent's table
+        self._kill_replica(_Replica(endpoint_id, proc, url))
+        raise TimeoutError(f"replica for {endpoint_id} never became ready")
+
+    def _handle_undeploy(self, msg: Dict) -> None:
+        endpoint_id = str(msg["endpoint_id"])
+        rep = self.replicas.pop(endpoint_id, None)
+        if rep is not None:
+            self._kill_replica(rep)
+        self._publish({"type": "undeploy_result", "worker_id": self.worker_id,
+                       "endpoint_id": endpoint_id, "ok": rep is not None})
+
+    def _kill_replica(self, rep: _Replica, grace_s: float = 3.0) -> None:
+        if rep.proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(rep.proc.pid), signal.SIGTERM)
+            deadline = time.time() + grace_s
+            while time.time() < deadline and rep.proc.poll() is None:
+                time.sleep(0.05)
+            if rep.proc.poll() is None:
+                os.killpg(os.getpgid(rep.proc.pid), signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            rep.proc.wait(timeout=5)  # reap; no zombie in our table
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+    # -- background loops -------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stopping.is_set():
+            self._publish({"type": "heartbeat", "worker_id": self.worker_id,
+                           "endpoints": sorted(self.replicas)})
+            time.sleep(self._heartbeat_s)
+
+    def _supervise_loop(self) -> None:
+        """Report replica crashes upstream (JobMonitor twin,
+        ``comm_utils/job_monitor.py:37`` in the reference)."""
+        while not self._stopping.is_set():
+            for eid, rep in list(self.replicas.items()):
+                rc = rep.proc.poll()
+                if rc is not None:
+                    del self.replicas[eid]
+                    self._publish({"type": "replica_down",
+                                   "worker_id": self.worker_id,
+                                   "endpoint_id": eid, "rc": rc})
+            time.sleep(0.5)
+
+    def _publish(self, msg: Dict) -> None:
+        try:
+            self._client.publish(
+                f"deploy/{self.cluster}/master", json.dumps(msg).encode())
+        except OSError:
+            pass

@@ -1,0 +1,68 @@
+"""Replica entry point — runs one endpoint's predictor in its own process.
+
+Parity target: the code the reference runs *inside* the inference
+container (``serving/fedml_inference_runner.py`` wrapped by the docker
+image built in ``device_model_deployment.py``). Here the "image" is a
+model-card package directory: ``model_config.yaml`` names either a
+builtin predictor or a user ``FedMLPredictor`` subclass shipped in the
+card.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml
+
+from fedml_tpu.deploy.model_cards import MODEL_CONFIG_FILE
+from fedml_tpu.serving.inference_runner import FedMLInferenceRunner
+
+
+def build_predictor(package_dir: str):
+    with open(os.path.join(package_dir, MODEL_CONFIG_FILE)) as f:
+        cfg = yaml.safe_load(f) or {}
+    params = cfg.get("params") or {}
+    builtin = cfg.get("builtin")
+    if builtin == "llama":
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+        from fedml_tpu.serving import ContinuousBatchingEngine, LlamaPredictor
+
+        class _A:
+            pass
+
+        a = _A()
+        a.model_size = params.get("model_size", "tiny")
+        a.lora_rank = params.get("lora_rank") or None
+        model = LlamaForCausalLM(LlamaConfig.from_args(a))
+        weights = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+        engine = ContinuousBatchingEngine(
+            model, weights,
+            batch_slots=int(params.get("batch_slots", 4)),
+            max_len=int(params.get("max_len", 512)),
+        )
+        return LlamaPredictor(engine)
+    if builtin is not None:
+        raise ValueError(f"unknown builtin predictor: {builtin}")
+    sys.path.insert(0, package_dir)
+    module = __import__(cfg["entry_module"])
+    cls = getattr(module, cfg["entry_class"])
+    return cls(**params)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--package", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    args = ap.parse_args(argv)
+    predictor = build_predictor(os.path.abspath(args.package))
+    runner = FedMLInferenceRunner(predictor, host=args.host, port=args.port)
+    runner.run()
+
+
+if __name__ == "__main__":
+    main()
