@@ -133,6 +133,59 @@ def jobs(workdir: str) -> None:
 
 
 @cli.group()
+def cluster() -> None:
+    """Multi-node scheduling: node agents + job submission."""
+
+
+@cluster.command("node")
+@click.option("--id", "node_id", required=True)
+@click.option("--broker", default="127.0.0.1:18923", show_default=True)
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+@click.option("--slots", default=1, show_default=True)
+def cluster_node(node_id: str, broker: str, workdir: str, slots: int) -> None:
+    """Run a node agent daemon (blocking)."""
+    from fedml_tpu.scheduler.node_agent import NodeAgent
+
+    host, port = _broker_addr(broker)
+    NodeAgent(node_id, host, port, workdir=workdir,
+              slots=slots).serve_forever()
+
+
+@cluster.command("submit")
+@click.argument("yaml_path")
+@click.option("--broker", default="127.0.0.1:18923", show_default=True)
+@click.option("--ranks", default=1, show_default=True)
+@click.option("--nodes", default=None, help="comma-separated node ids")
+@click.option("--wait/--no-wait", default=True, show_default=True)
+@click.option("--timeout", default=86400.0, show_default=True)
+def cluster_submit(yaml_path: str, broker: str, ranks: int, nodes,
+                   wait: bool, timeout: float) -> None:
+    """Submit a job yaml across the cluster (ephemeral master)."""
+    from fedml_tpu.scheduler.job_yaml import JobSpec
+    from fedml_tpu.scheduler.master_agent import MasterAgent
+
+    host, port = _broker_addr(broker)
+    master = MasterAgent(host, port).start()
+    try:
+        want = len(nodes.split(",")) if nodes else 1
+        master.wait_for_nodes(want, timeout=min(30.0, timeout))
+        job_id = master.submit_job(
+            JobSpec.load(yaml_path), n_ranks=ranks,
+            nodes=nodes.split(",") if nodes else None)
+        click.echo(f"job_id: {job_id}")
+        if wait:
+            result = master.wait_job(job_id, timeout=timeout)
+            click.echo(json.dumps(result))
+            for rid, log in master.job_logs(job_id).items():
+                click.echo(f"--- {rid} ---")
+                click.echo(log)
+            if result["status"] != "FINISHED":
+                raise SystemExit(1)
+    finally:
+        master.shutdown()
+
+
+@cli.group()
 def model() -> None:
     """Model cards + deployment (reference: `fedml model ...`)."""
 
@@ -141,6 +194,13 @@ def _cards(registry):
     from fedml_tpu.deploy.model_cards import FedMLModelCards
 
     return FedMLModelCards(registry)
+
+
+def _broker_addr(broker: str):
+    host, _, port = broker.rpartition(":")
+    if not host or not port.isdigit():
+        raise click.BadParameter(f"expected host:port, got {broker!r}")
+    return host, int(port)
 
 
 @model.command("create")
@@ -189,9 +249,9 @@ def model_deploy(name: str, broker: str, replicas: int, registry, store_dir,
     )
     from fedml_tpu.deploy import DeployMaster, EndpointCache
 
-    host, port = broker.rsplit(":", 1)
+    host, port = _broker_addr(broker)
     master = DeployMaster(
-        host, int(port), LocalDirObjectStore(store_dir),
+        host, port, LocalDirObjectStore(store_dir),
         EndpointCache(cache_path), cards=_cards(registry),
     ).start()
     try:
@@ -224,8 +284,8 @@ def model_undeploy(endpoint_id: str, broker: str, cache_path: str) -> None:
     )
     from fedml_tpu.deploy import DeployMaster, EndpointCache
 
-    host, port = broker.rsplit(":", 1)
-    master = DeployMaster(host, int(port), LocalDirObjectStore(None),
+    host, port = _broker_addr(broker)
+    master = DeployMaster(host, port, LocalDirObjectStore(None),
                           EndpointCache(cache_path))
     ok = master.undeploy(endpoint_id)
     master.shutdown()
@@ -266,8 +326,8 @@ def deploy_worker(worker_id: str, broker: str, store_dir, workdir: str,
     )
     from fedml_tpu.deploy import DeployWorkerAgent
 
-    host, port = broker.rsplit(":", 1)
-    DeployWorkerAgent(worker_id, host, int(port),
+    host, port = _broker_addr(broker)
+    DeployWorkerAgent(worker_id, host, port,
                       LocalDirObjectStore(store_dir), workdir=workdir,
                       capacity=capacity).serve_forever()
 

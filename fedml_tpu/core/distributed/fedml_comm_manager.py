@@ -45,9 +45,12 @@ class FedMLCommManager(Observer):
     def register_comm_manager(self, comm_manager: BaseCommunicationManager) -> None:
         self.com_manager = comm_manager
 
+    MSG_TYPE_CONNECTION_IS_READY = "MSG_TYPE_CONNECTION_IS_READY"
+
     def run(self) -> None:
         self.register_message_receive_handlers()
         logger.debug("rank %d running (%s backend)", self.rank, self.backend)
+        self._notify_connection_ready()
         self.com_manager.handle_receive_message()
 
     def run_async(self) -> threading.Thread:
@@ -57,6 +60,24 @@ class FedMLCommManager(Observer):
         t.start()
         self._receive_thread = t
         return t
+
+    def _notify_connection_ready(self) -> None:
+        """Self-deliver CONNECTION_IS_READY on distributed backends.
+
+        Parity: the reference's MQTT manager dispatches
+        MSG_TYPE_CONNECTION_IS_READY from its on_connect callback, which
+        is what kicks each rank's FSM in a standalone multi-process run.
+        The in-proc LOCAL path keeps its explicit orchestration (run
+        helpers kick after ALL managers are up, which the deterministic
+        tests rely on)."""
+        if str(self.backend).upper() in (
+            constants.COMM_BACKEND_BROKER,
+            constants.COMM_BACKEND_GRPC,
+        ):
+            self.receive_message(
+                self.MSG_TYPE_CONNECTION_IS_READY,
+                Message(self.MSG_TYPE_CONNECTION_IS_READY, self.rank, self.rank),
+            )
 
     def get_sender_id(self) -> int:
         return self.rank

@@ -82,8 +82,11 @@ class DeployMaster:
                version: Optional[int] = None, n_replicas: int = 1,
                workers: Optional[List[str]] = None, timeout: float = 180.0,
                with_token: bool = False) -> Dict:
-        """Deploy a model card to ``n_replicas`` workers; returns the
-        endpoint record once every replica reported (or raises)."""
+        """Deploy a model card to ``n_replicas`` workers.
+
+        Raises if NO replica came up; otherwise returns the endpoint
+        record (possibly degraded — replicas that failed or never
+        reported inside ``timeout`` are marked FAILED in the cache)."""
         card = self.cards.get_card(model_name, version)
         version = card["model_version"]
         endpoint_id = uuid.uuid4().hex[:12]
@@ -125,6 +128,13 @@ class DeployMaster:
             self._events.pop(endpoint_id, None)
         self.store.delete_object(key)
 
+        # a target that never reported is a failed replica, not a phantom
+        # left DEPLOYING forever (the health loop only polls replicas that
+        # have a url)
+        for wid in targets:
+            if wid not in results:
+                self.cache.set_replica(endpoint_id, wid, url=None,
+                                       status=EndpointStatus.FAILED)
         ok = [w for w, r in results.items() if r.get("ok")]
         status = EndpointStatus.DEPLOYED if ok else EndpointStatus.FAILED
         self.cache.set_status(endpoint_id, status)
@@ -136,6 +146,10 @@ class DeployMaster:
                 if results else
                 f"deployment of {model_name} timed out after {timeout}s "
                 f"(targets {targets})")
+        if len(ok) < len(targets):
+            logger.warning(
+                "endpoint %s deployed degraded: %d/%d replicas ok",
+                endpoint_id, len(ok), len(targets))
         return record
 
     def undeploy(self, endpoint_id: str) -> bool:
@@ -161,8 +175,11 @@ class DeployMaster:
         # worker's advertised capacity
         load: Dict[str, int] = {w: 0 for w in live}
         for ep in self.cache.list_endpoints():
-            for wid in ep.get("replicas", {}):
-                if wid in load:
+            for wid, rep in ep.get("replicas", {}).items():
+                # FAILED/OFFLINE replicas run no process — they must not
+                # eat capacity forever
+                if wid in load and rep.get("status") in (
+                        EndpointStatus.DEPLOYED, EndpointStatus.DEPLOYING):
                     load[wid] += 1
         with self._lock:
             caps = {w: int(self.workers.get(w, {}).get("capacity", 4))

@@ -66,6 +66,8 @@ class DeployWorkerAgent:
         self.workdir = os.path.abspath(os.path.join(workdir, worker_id))
         os.makedirs(self.workdir, exist_ok=True)
         self.replicas: Dict[str, _Replica] = {}
+        self._cap_lock = threading.Lock()
+        self._inflight = 0  # boots in progress count toward capacity
         self._heartbeat_s = heartbeat_s
         self._stopping = threading.Event()
         self._client = BrokerClient(broker_host, broker_port)
@@ -115,12 +117,21 @@ class DeployWorkerAgent:
 
     def _handle_deploy(self, msg: Dict) -> None:
         endpoint_id = str(msg["endpoint_id"])
-        if len(self.replicas) >= self.capacity:
-            # each replica is a JAX/XLA process; oversubscription is what
+        with self._cap_lock:
+            # in-flight boots count too: boots take up to boot_timeout, and
+            # each replica is a JAX/XLA process — oversubscription is what
             # --capacity exists to prevent
+            if len(self.replicas) + self._inflight >= self.capacity:
+                error = f"worker at capacity {self.capacity}"
+            elif endpoint_id in self.replicas:
+                error = f"endpoint {endpoint_id} already deployed here"
+            else:
+                error = None
+                self._inflight += 1
+        if error is not None:
             self._publish({"type": "deploy_result", "worker_id": self.worker_id,
                            "endpoint_id": endpoint_id, "ok": False,
-                           "error": f"worker at capacity {self.capacity}"})
+                           "error": error})
             return
         try:
             url = self._boot_replica(endpoint_id, msg)
@@ -131,6 +142,9 @@ class DeployWorkerAgent:
             self._publish({"type": "deploy_result", "worker_id": self.worker_id,
                            "endpoint_id": endpoint_id, "ok": False,
                            "error": str(e)})
+        finally:
+            with self._cap_lock:
+                self._inflight -= 1
 
     def _boot_replica(self, endpoint_id: str, msg: Dict) -> str:
         pkg_key = msg["package_key"]
@@ -222,10 +236,13 @@ class DeployWorkerAgent:
             for eid, rep in list(self.replicas.items()):
                 rc = rep.proc.poll()
                 if rc is not None:
-                    del self.replicas[eid]
-                    self._publish({"type": "replica_down",
-                                   "worker_id": self.worker_id,
-                                   "endpoint_id": eid, "rc": rc})
+                    # pop, not del: a concurrent undeploy may have removed
+                    # the key already, and a KeyError here would silently
+                    # kill supervision for every future replica
+                    if self.replicas.pop(eid, None) is not None:
+                        self._publish({"type": "replica_down",
+                                       "worker_id": self.worker_id,
+                                       "endpoint_id": eid, "rc": rc})
             time.sleep(0.5)
 
     def _publish(self, msg: Dict) -> None:

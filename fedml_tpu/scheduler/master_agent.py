@@ -1,0 +1,257 @@
+"""Master agent — multi-node run orchestration.
+
+Parity target: ``master/server_runner.py`` (``FedMLServerRunner`` :68 —
+``run`` :427 drives a run across edges, ``callback_start_train`` :1462;
+status aggregation back from the slaves). Re-design: the master keeps a
+node registry fed by broker heartbeats, fans a multi-rank job out as one
+run per node (each rank gets FEDML_RANK/FEDML_NUM_RANKS env — the
+TPU-era replacement for the reference's MQTT-dispatched train configs),
+aggregates per-rank status FSMs into one job status, detects dead nodes
+by heartbeat loss, and pulls every rank's logs into one run view.
+
+Job status semantics:
+  RUNNING  while any rank is non-terminal and no rank has failed
+  FINISHED when ALL ranks finished
+  FAILED   as soon as any rank FAILED/EXCEPTION, or its node went dark
+  KILLED   after stop_job()
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.mlops.status import RunStatus
+from fedml_tpu.scheduler.job_yaml import JobSpec
+
+logger = logging.getLogger(__name__)
+
+
+class JobView:
+    """Aggregated state of one multi-rank job."""
+
+    def __init__(self, job_id: str, ranks: Dict[str, str]):
+        self.job_id = job_id
+        self.ranks = ranks  # run_id → node_id
+        self.rank_status: Dict[str, str] = {r: RunStatus.QUEUED for r in ranks}
+        self.rank_rc: Dict[str, Optional[int]] = {r: None for r in ranks}
+        self.logs: Dict[str, str] = {}
+        self.stopped = False
+
+    @property
+    def status(self) -> str:
+        statuses = set(self.rank_status.values())
+        if self.stopped:
+            return RunStatus.KILLED
+        if statuses & {RunStatus.FAILED, RunStatus.EXCEPTION}:
+            return RunStatus.FAILED
+        if RunStatus.KILLED in statuses:
+            return RunStatus.KILLED
+        if statuses == {RunStatus.FINISHED}:
+            return RunStatus.FINISHED
+        return RunStatus.RUNNING
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.status in RunStatus.TERMINAL
+
+    def describe(self) -> Dict:
+        return {
+            "job_id": self.job_id,
+            "status": self.status,
+            "ranks": [
+                {"run_id": rid, "node_id": self.ranks[rid],
+                 "status": self.rank_status[rid],
+                 "returncode": self.rank_rc[rid]}
+                for rid in sorted(self.ranks)
+            ],
+        }
+
+
+class MasterAgent:
+    def __init__(self, broker_host: str, broker_port: int,
+                 cluster: str = "default", node_timeout_s: float = 5.0):
+        self.cluster = cluster
+        self.node_timeout_s = node_timeout_s
+        self.nodes: Dict[str, Dict] = {}  # node_id → {last_seen, slots}
+        self.jobs: Dict[str, JobView] = {}
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._log_events: Dict[str, threading.Event] = {}
+        self._client = BrokerClient(broker_host, broker_port)
+        self._client.subscribe(f"sched/{cluster}/master", self._on_message)
+        self._watch: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "MasterAgent":
+        if self._watch is None:
+            self._watch = threading.Thread(target=self._watch_loop, daemon=True)
+            self._watch.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self._client.close()
+
+    # -- node registry ----------------------------------------------------
+    def live_nodes(self) -> List[str]:
+        now = time.time()
+        with self._lock:
+            return sorted(n for n, info in self.nodes.items()
+                          if now - info["last_seen"] < self.node_timeout_s)
+
+    def wait_for_nodes(self, n: int, timeout: float = 30.0) -> List[str]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            live = self.live_nodes()
+            if len(live) >= n:
+                return live
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"only {len(self.live_nodes())}/{n} nodes online")
+
+    # -- job control ------------------------------------------------------
+    def submit_job(self, spec: JobSpec, n_ranks: int = 1,
+                   nodes: Optional[List[str]] = None,
+                   extra_env: Optional[Dict[str, Dict[str, str]]] = None,
+                   ) -> str:
+        """Fan ``spec`` out as ``n_ranks`` runs over the given (or all
+        live) nodes, round-robin. Each rank's process sees FEDML_RANK /
+        FEDML_NUM_RANKS / FEDML_JOB_ID; ``extra_env`` maps rank (as str)
+        to additional env overrides."""
+        live = self.live_nodes()
+        if nodes:
+            missing = sorted(set(nodes) - set(live))
+            if missing:
+                raise RuntimeError(
+                    f"requested nodes not online: {missing} (live: {live})")
+        targets = nodes or live
+        if not targets:
+            raise RuntimeError("no live nodes to schedule on")
+        job_id = uuid.uuid4().hex[:10]
+        ranks: Dict[str, str] = {}
+        assignments = []
+        for rank in range(n_ranks):
+            node_id = targets[rank % len(targets)]
+            run_id = f"{job_id}-r{rank}"
+            ranks[run_id] = node_id
+            env = {
+                "FEDML_JOB_ID": job_id,
+                "FEDML_RANK": str(rank),
+                "FEDML_NUM_RANKS": str(n_ranks),
+            }
+            env.update((extra_env or {}).get(str(rank), {}))
+            assignments.append((node_id, run_id, env))
+        view = JobView(job_id, ranks)
+        with self._lock:
+            self.jobs[job_id] = view
+        for node_id, run_id, env in assignments:
+            self._send(node_id, {
+                "type": "start_run", "run_id": run_id,
+                "spec": {
+                    "job_name": spec.job_name, "job": spec.job,
+                    "workspace": spec.workspace,
+                    "bootstrap": spec.bootstrap, "env": spec.env,
+                },
+                "env": env,
+            })
+        return job_id
+
+    def stop_job(self, job_id: str) -> bool:
+        view = self.jobs.get(job_id)
+        if view is None:
+            return False
+        view.stopped = True
+        for run_id, node_id in view.ranks.items():
+            self._send(node_id, {"type": "stop_run", "run_id": run_id})
+        return True
+
+    def job_status(self, job_id: str) -> Optional[Dict]:
+        view = self.jobs.get(job_id)
+        return view.describe() if view else None
+
+    def wait_job(self, job_id: str, timeout: float = 600.0) -> Dict:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            view = self.jobs.get(job_id)
+            if view is not None and view.is_terminal:
+                return view.describe()
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} not terminal after {timeout}s")
+
+    def job_logs(self, job_id: str, tail: Optional[int] = 200,
+                 timeout: float = 10.0) -> Dict[str, str]:
+        """One run view: pull each rank's log from its node."""
+        view = self.jobs.get(job_id)
+        if view is None:
+            return {}
+        pending = []
+        for run_id, node_id in view.ranks.items():
+            event = threading.Event()
+            self._log_events[run_id] = event
+            pending.append((run_id, event))
+            self._send(node_id, {"type": "get_logs", "run_id": run_id,
+                                 "tail": tail})
+        deadline = time.time() + timeout
+        for run_id, event in pending:
+            event.wait(timeout=max(0.0, deadline - time.time()))
+            self._log_events.pop(run_id, None)
+        return dict(view.logs)
+
+    # -- internals --------------------------------------------------------
+    def _send(self, node_id: str, msg: Dict) -> None:
+        self._client.publish(f"sched/{self.cluster}/node/{node_id}",
+                             json.dumps(msg).encode())
+
+    def _on_message(self, body: bytes) -> None:
+        try:
+            msg = json.loads(body)
+        except ValueError:
+            return
+        mtype = msg.get("type")
+        nid = str(msg.get("node_id", ""))
+        if mtype in ("node_online", "heartbeat"):
+            with self._lock:
+                info = self.nodes.setdefault(nid, {"slots": 1})
+                info["last_seen"] = time.time()
+                if "slots" in msg:
+                    info["slots"] = int(msg["slots"])
+        elif mtype == "run_status":
+            rid = str(msg["run_id"])
+            for view in self.jobs.values():
+                if rid in view.rank_status:
+                    view.rank_status[rid] = str(msg["status"])
+                    view.rank_rc[rid] = msg.get("returncode")
+                    break
+        elif mtype == "run_logs":
+            rid = str(msg["run_id"])
+            for view in self.jobs.values():
+                if rid in view.ranks:
+                    view.logs[rid] = str(msg.get("data", ""))
+                    break
+            event = self._log_events.get(rid)
+            if event is not None:
+                event.set()
+
+    def _watch_loop(self) -> None:
+        """Dead-node detection: a node that stops heartbeating takes its
+        non-terminal ranks to FAILED (the reference master's edge-offline
+        handling)."""
+        while not self._stopping.is_set():
+            now = time.time()
+            with self._lock:
+                dark = {n for n, info in self.nodes.items()
+                        if now - info["last_seen"] >= self.node_timeout_s}
+                views = list(self.jobs.values())
+            for view in views:
+                for rid, node_id in view.ranks.items():
+                    if (node_id in dark
+                            and view.rank_status[rid] not in RunStatus.TERMINAL):
+                        logger.warning("job %s rank %s lost: node %s dark",
+                                       view.job_id, rid, node_id)
+                        view.rank_status[rid] = RunStatus.FAILED
+            time.sleep(0.5)

@@ -1,0 +1,138 @@
+"""Node agent — a LocalAgent exposed to the cluster over the broker.
+
+Parity target: the reference slave agent's remote-control surface
+(``slave/client_runner.py`` — MQTT callbacks ``callback_start_train``
+:893 / ``callback_stop_train`` :982, status reporting back to the master,
+log shipping via the log daemon). Re-design: the in-process LocalAgent
+keeps doing the process supervision; this wrapper speaks the scheduler
+wire protocol so a MasterAgent on another machine can start/stop runs
+here and see their status and logs.
+
+Wire protocol (JSON over broker topics):
+
+  node → ``sched/{cluster}/master``:
+      node_online {node_id, slots}
+      heartbeat   {node_id, runs: {run_id: status}}
+      run_status  {node_id, run_id, status, returncode}
+      run_logs    {node_id, run_id, data}
+  master → ``sched/{cluster}/node/{node_id}``:
+      start_run {run_id, spec {job_name, job, workspace, bootstrap, env},
+                 env {..extra per-rank env..}}
+      stop_run  {run_id}
+      get_logs  {run_id, tail}
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict
+
+from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.scheduler.agent import LocalAgent
+from fedml_tpu.scheduler.job_yaml import JobSpec
+
+logger = logging.getLogger(__name__)
+
+
+class NodeAgent:
+    def __init__(self, node_id: str, broker_host: str, broker_port: int,
+                 workdir: str = ".fedml_runs", cluster: str = "default",
+                 slots: int = 1, heartbeat_s: float = 1.0):
+        self.node_id = node_id
+        self.cluster = cluster
+        self.slots = slots
+        self.agent = LocalAgent(workdir=os.path.join(workdir, node_id))
+        self._heartbeat_s = heartbeat_s
+        self._stopping = threading.Event()
+        self._reported: Dict[str, str] = {}  # run_id → last status sent
+        self._client = BrokerClient(broker_host, broker_port)
+        self._client.subscribe(
+            f"sched/{cluster}/node/{node_id}", self._on_message)
+        self._threads = []
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "NodeAgent":
+        self.agent.start()
+        self._publish({"type": "node_online", "node_id": self.node_id,
+                       "slots": self.slots})
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def shutdown(self, kill_running: bool = True) -> None:
+        self._stopping.set()
+        self.agent.shutdown(kill_running=kill_running)
+        self._client.close()
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stopping.is_set():
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            self.shutdown()
+
+    # -- handlers ---------------------------------------------------------
+    def _on_message(self, body: bytes) -> None:
+        try:
+            msg = json.loads(body)
+        except ValueError:
+            return
+        mtype = msg.get("type")
+        if mtype == "start_run":
+            self._handle_start(msg)
+        elif mtype == "stop_run":
+            self.agent.kill(str(msg["run_id"]))
+        elif mtype == "get_logs":
+            rid = str(msg["run_id"])
+            self._publish({"type": "run_logs", "node_id": self.node_id,
+                           "run_id": rid,
+                           "data": self.agent.logs(rid, tail=msg.get("tail"))})
+
+    def _handle_start(self, msg: Dict) -> None:
+        rid = str(msg["run_id"])
+        raw = msg.get("spec") or {}
+        spec = JobSpec(
+            job_name=str(raw.get("job_name", rid)),
+            job=str(raw.get("job", "")),
+            workspace=str(raw.get("workspace", ".")),
+            bootstrap=raw.get("bootstrap"),
+            env={k: str(v) for k, v in (raw.get("env") or {}).items()},
+        )
+        try:
+            self.agent.start_run(spec, run_id=rid,
+                                 extra_env=msg.get("env") or {})
+        except Exception as e:
+            logger.exception("node %s failed to start %s", self.node_id, rid)
+            self._publish({"type": "run_status", "node_id": self.node_id,
+                           "run_id": rid, "status": "FAILED",
+                           "returncode": None, "error": str(e)})
+
+    # -- status shipping --------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stopping.is_set():
+            runs = {}
+            for row in self.agent.list_runs():
+                rid, status = row["run_id"], row["status"]
+                runs[rid] = status
+                if self._reported.get(rid) != status:
+                    self._reported[rid] = status
+                    self._publish({
+                        "type": "run_status", "node_id": self.node_id,
+                        "run_id": rid, "status": status,
+                        "returncode": row.get("returncode"),
+                    })
+            self._publish({"type": "heartbeat", "node_id": self.node_id,
+                           "runs": runs})
+            time.sleep(self._heartbeat_s)
+
+    def _publish(self, msg: Dict) -> None:
+        try:
+            self._client.publish(
+                f"sched/{self.cluster}/master", json.dumps(msg).encode())
+        except OSError:
+            pass

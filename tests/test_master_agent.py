@@ -1,0 +1,154 @@
+"""Multi-node master agent e2e.
+
+VERDICT round-3 contract: master + 2 node agents in separate processes
+run a cross-silo federation job (server + client ranks) to completion;
+plus the kill-one-agent failure path (dead node → job FAILED).
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+from fedml_tpu.scheduler.job_yaml import JobSpec
+from fedml_tpu.scheduler.master_agent import MasterAgent
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_node(node_id, broker_addr, workdir):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO_ROOT, env.get("PYTHONPATH")) if p)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu.cli", "cluster", "node",
+         "--id", node_id, "--broker", f"{broker_addr[0]}:{broker_addr[1]}",
+         "--workdir", workdir],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+@pytest.fixture
+def two_node_cluster(tmp_path):
+    broker = PubSubBroker().start()
+    nodes = [_spawn_node(f"n{i}", broker.address, str(tmp_path / "agents"))
+             for i in (1, 2)]
+    master = MasterAgent(*broker.address, node_timeout_s=4.0).start()
+    yield {"master": master, "nodes": nodes, "broker": broker,
+           "tmp": tmp_path}
+    master.shutdown()
+    for p in nodes:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            p.wait(timeout=10)
+    broker.stop()
+
+
+def test_cross_silo_job_across_two_nodes(two_node_cluster, tmp_path):
+    """3 ranks (server + 2 clients) placed round-robin on 2 node agents,
+    rendezvousing over the same broker (the federation plane), complete a
+    2-round FedAvg — the reference's run_cross_silo.sh technique run
+    through the scheduler instead of nohup."""
+    master = two_node_cluster["master"]
+    host, port = two_node_cluster["broker"].address
+
+    ws = tmp_path / "job_ws"
+    ws.mkdir()
+    (ws / "cfg.yaml").write_text(textwrap.dedent(f"""
+        common_args: {{training_type: "cross_silo", random_seed: 0,
+                       run_id: "sched_e2e"}}
+        data_args: {{dataset: "synthetic", train_size: 300, test_size: 80,
+                     class_num: 4, feature_dim: 12}}
+        model_args: {{model: "lr"}}
+        train_args:
+          federated_optimizer: "FedAvg"
+          comm_backend: "BROKER"
+          broker_host: "{host}"
+          broker_port: {port}
+          object_store_dir: "{tmp_path / 'store'}"
+          client_num_in_total: 2
+          client_num_per_round: 2
+          comm_round: 2
+          epochs: 1
+          batch_size: 32
+          learning_rate: 0.3
+    """))
+    (ws / "job.py").write_text(textwrap.dedent("""
+        import os, sys
+        rank = int(os.environ["FEDML_RANK"])
+        sys.argv = ["job", "--cf", "cfg.yaml", "--rank", str(rank),
+                    "--role", "server" if rank == 0 else "client"]
+        import fedml_tpu
+        if rank == 0:
+            result = fedml_tpu.run_cross_silo_server()
+            assert result is not None and result["test_acc"] > 0.4, result
+            print("SERVER_DONE", result["test_acc"])
+        else:
+            fedml_tpu.run_cross_silo_client()
+            print("CLIENT_DONE", rank)
+    """))
+    spec = JobSpec(
+        job_name="cross-silo-e2e", job="python job.py", workspace=str(ws),
+        env={"JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO_ROOT + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+    )
+
+    master.wait_for_nodes(2, timeout=30)
+    job_id = master.submit_job(spec, n_ranks=3)
+    result = master.wait_job(job_id, timeout=300)
+    logs = master.job_logs(job_id)
+    assert result["status"] == "FINISHED", (result, logs)
+    # ranks landed on both nodes
+    assert {r["node_id"] for r in result["ranks"]} == {"n1", "n2"}
+    # one aggregated run view with every rank's log
+    server_log = logs[f"{job_id}-r0"]
+    assert "SERVER_DONE" in server_log, server_log
+    assert any("CLIENT_DONE" in logs[f"{job_id}-r{i}"] for i in (1, 2))
+
+
+def test_dead_node_fails_job(two_node_cluster):
+    master = two_node_cluster["master"]
+    spec = JobSpec(job_name="sleeper", job="sleep 300", workspace=".")
+    master.wait_for_nodes(2, timeout=30)
+    job_id = master.submit_job(spec, n_ranks=2)
+
+    # wait until both ranks are RUNNING
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = master.job_status(job_id)
+        if all(r["status"] == "RUNNING" for r in st["ranks"]):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"ranks never started: {master.job_status(job_id)}")
+
+    # SIGKILL one node agent (its sleeper subprocess dies with the pg)
+    victim = two_node_cluster["nodes"][0]
+    os.killpg(os.getpgid(victim.pid), signal.SIGKILL)
+    victim.wait(timeout=10)
+
+    result = master.wait_job(job_id, timeout=60)
+    assert result["status"] == "FAILED"
+    failed = [r for r in result["ranks"] if r["status"] == "FAILED"]
+    assert len(failed) == 1 and failed[0]["node_id"] == "n1"
+
+    # cleanup: stop the surviving rank
+    master.stop_job(job_id)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = master.job_status(job_id)
+        other = [r for r in st["ranks"] if r["node_id"] == "n2"][0]
+        if other["status"] in ("KILLED", "FINISHED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert st["status"] == "KILLED"
