@@ -110,6 +110,16 @@ def load_arguments_from_dict(
     return args
 
 
+def load_arguments_from_yaml_path(
+    path: str, training_type: Optional[str] = None
+) -> Arguments:
+    """Programmatic entry: build args straight from a yaml file (no CLI)."""
+    args = Arguments(training_type=training_type)
+    args.load_yaml_config(path)
+    _apply_defaults(args)
+    return args
+
+
 _DEFAULTS = dict(
     training_type=constants.FEDML_TRAINING_PLATFORM_SIMULATION,
     backend=constants.FEDML_SIMULATION_TYPE_SP,

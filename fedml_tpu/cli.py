@@ -174,7 +174,15 @@ def cluster_submit(yaml_path: str, broker: str, ranks: int, nodes,
             nodes=nodes.split(",") if nodes else None)
         click.echo(f"job_id: {job_id}")
         if wait:
-            result = master.wait_job(job_id, timeout=timeout)
+            try:
+                result = master.wait_job(job_id, timeout=timeout)
+            except (TimeoutError, KeyboardInterrupt) as e:
+                # the ephemeral master's job table dies with this process:
+                # stop the ranks now or they run orphaned on every node
+                master.stop_job(job_id)
+                time.sleep(1.0)  # let stop_run messages reach the nodes
+                click.echo(f"aborted: {e}; sent stop to all ranks")
+                raise SystemExit(1)
             click.echo(json.dumps(result))
             for rid, log in master.job_logs(job_id).items():
                 click.echo(f"--- {rid} ---")

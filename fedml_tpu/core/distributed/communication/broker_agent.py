@@ -1,0 +1,95 @@
+"""Shared scaffolding for broker-connected control-plane agents.
+
+Both control planes (the deploy plane's master/workers and the scheduler
+plane's master/node agents) need the same primitives: a JSON-over-topic
+broker client, a heartbeat-fed peer registry with liveness timeouts, and
+a stoppable background-thread lifecycle. Keeping one implementation
+prevents the two planes' liveness semantics from drifting.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from fedml_tpu.core.distributed.communication.broker import BrokerClient
+
+logger = logging.getLogger(__name__)
+
+
+class BrokerJsonAgent:
+    """A broker participant exchanging JSON control messages."""
+
+    def __init__(self, broker_host: str, broker_port: int):
+        self._client = BrokerClient(broker_host, broker_port)
+        self._stopping = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def subscribe_json(self, topic: str, handler: Callable[[Dict], None]) -> None:
+        def _on_frame(body: bytes) -> None:
+            try:
+                msg = json.loads(body)
+            except ValueError:
+                logger.warning("%s: bad frame on %s", type(self).__name__, topic)
+                return
+            handler(msg)
+
+        self._client.subscribe(topic, _on_frame)
+
+    def publish_json(self, topic: str, msg: Dict) -> None:
+        try:
+            self._client.publish(topic, json.dumps(msg).encode())
+        except OSError:
+            pass  # broker blip; callers rely on periodic resend (heartbeats)
+
+    def spawn_loop(self, target: Callable[[], None]) -> None:
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop_agent(self) -> None:
+        self._stopping.set()
+        self._client.close()
+
+
+class PeerRegistry:
+    """Heartbeat-fed liveness registry (peer_id → attrs + last_seen)."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        self._peers: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+
+    def touch(self, peer_id: str, **attrs) -> None:
+        with self._lock:
+            info = self._peers.setdefault(peer_id, {})
+            info["last_seen"] = time.time()
+            info.update(attrs)
+
+    def get(self, peer_id: str) -> Dict:
+        with self._lock:
+            return dict(self._peers.get(peer_id, {}))
+
+    def live(self) -> List[str]:
+        now = time.time()
+        with self._lock:
+            return sorted(p for p, info in self._peers.items()
+                          if now - info.get("last_seen", 0) < self.timeout_s)
+
+    def dark(self) -> List[str]:
+        now = time.time()
+        with self._lock:
+            return sorted(p for p, info in self._peers.items()
+                          if now - info.get("last_seen", 0) >= self.timeout_s)
+
+    def wait_for(self, n: int, timeout: float = 30.0,
+                 what: str = "peers") -> List[str]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            live = self.live()
+            if len(live) >= n:
+                return live
+            time.sleep(0.1)
+        raise TimeoutError(f"only {len(self.live())}/{n} {what} online")

@@ -1,0 +1,16 @@
+"""Cross-device ("BeeHive") engine: server + on-device client runtime."""
+from fedml_tpu.cross_device.client import (
+    DeviceClient,
+    FedMLBaseTrainer,
+    JaxDeviceTrainer,
+    build_device_client,
+)
+from fedml_tpu.cross_device.server import ServerCrossDevice
+
+__all__ = [
+    "DeviceClient",
+    "FedMLBaseTrainer",
+    "JaxDeviceTrainer",
+    "ServerCrossDevice",
+    "build_device_client",
+]

@@ -1,0 +1,247 @@
+"""Cross-device ("BeeHive") client runtime.
+
+Parity target: the reference's on-device C++ stack —
+``android/fedmlsdk/MobileNN/includes/train/FedMLBaseTrainer.h:1`` (the
+train-loop abstraction with progress/accuracy/loss callbacks and a stop
+flag, implemented over two NN engines: MNN and torch-mobile),
+``src/FedMLClientManager.cpp`` (orchestrates the round against the
+server), and ``src/train/FedMLTrainerSA.cpp`` (the SecAgg on-device
+variant). Re-design for this build:
+
+- :class:`FedMLBaseTrainer` keeps the C++ interface shape — ``init``
+  with host callbacks, ``train``, ``get_epoch_and_loss``,
+  ``stop_training`` — as the pluggable engine seam; the in-tree engine
+  is :class:`JaxDeviceTrainer`, a compact per-epoch jitted SGD loop
+  (epoch-granular on purpose: a device reports progress per epoch, so
+  the host loop is per-epoch with one compiled step program — unlike the
+  datacenter trainer that scans all epochs inside one XLA program).
+- :class:`DeviceClient` is the FedMLClientManager twin: it binds the
+  trainer to the cross-silo wire protocol (plain rounds) or the Bonawitz
+  SecAgg FSM (``secure_aggregation: true``) over any federation
+  transport — so the same server (``ServerCrossDevice``) drives phones,
+  sim processes, or CI subprocesses identically.
+
+Run standalone:  ``python -m fedml_tpu.cross_device.client --cf cfg.yaml
+--rank N``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+ProgressCallback = Callable[[float], None]
+EpochMetricCallback = Callable[[int, float], None]
+
+
+class FedMLBaseTrainer:
+    """On-device train-loop abstraction (FedMLBaseTrainer.h parity).
+
+    Subclasses implement :meth:`train`; the host (JNI bridge in the
+    reference, the DeviceClient here) drives ``init`` → per-round
+    ``set_model``/``train`` and may poll ``get_epoch_and_loss`` or flip
+    the stop flag from another thread.
+    """
+
+    def init(self, dataset: Any, train_size: int, batch_size: int,
+             learning_rate: float, epochs: int,
+             progress_callback: Optional[ProgressCallback] = None,
+             accuracy_callback: Optional[EpochMetricCallback] = None,
+             loss_callback: Optional[EpochMetricCallback] = None) -> None:
+        self.dataset = dataset
+        self.train_size = int(train_size)
+        self.batch_size = int(batch_size)
+        self.learning_rate = float(learning_rate)
+        self.epochs = int(epochs)
+        self.progress_callback = progress_callback
+        self.accuracy_callback = accuracy_callback
+        self.loss_callback = loss_callback
+        self.cur_epoch = 0
+        self.cur_loss = 0.0
+        self._stop_flag = False
+
+    def set_model(self, params: Pytree) -> None:
+        """Load the round's global model (the .mnn file write parity)."""
+        self.params = params
+
+    def train(self) -> Tuple[Pytree, int]:
+        """Run local training; returns (new_params, n_samples)."""
+        raise NotImplementedError
+
+    def get_epoch_and_loss(self) -> Tuple[int, float]:
+        return self.cur_epoch, self.cur_loss
+
+    def stop_training(self) -> bool:
+        self._stop_flag = True
+        return True
+
+
+class JaxDeviceTrainer(FedMLBaseTrainer):
+    """The in-tree on-device engine: per-epoch jitted minibatch SGD."""
+
+    def __init__(self, apply_fn: Callable):
+        self.apply_fn = apply_fn
+        self._epoch_step = None
+
+    def _build(self) -> None:
+        from fedml_tpu.ml.trainer.local_sgd import softmax_ce_loss
+
+        loss_fn = softmax_ce_loss(self.apply_fn)
+        opt = optax.sgd(self.learning_rate)
+
+        def epoch(params, opt_state, xs, ys, mask):
+            def step(carry, batch):
+                params, opt_state = carry
+                x, y, m = batch
+                (loss, (correct, denom)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, x, y, m)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state), (
+                    loss * denom, correct, denom)
+            (params, opt_state), (losses, corrects, denoms) = jax.lax.scan(
+                step, (params, opt_state), (xs, ys, mask))
+            total = jnp.maximum(jnp.sum(denoms), 1.0)
+            return params, opt_state, {
+                "loss": jnp.sum(losses) / total,
+                "acc": jnp.sum(corrects) / total,
+            }
+
+        self._epoch_step = jax.jit(epoch)
+        self._opt = opt
+
+    def train(self) -> Tuple[Pytree, int]:
+        if self._epoch_step is None:
+            self._build()
+        x, y = self.dataset
+        n = min(self.train_size, len(x)) or len(x)
+        x, y = np.asarray(x[:n]), np.asarray(y[:n])
+        steps = max(1, math.ceil(n / self.batch_size))
+        pad = steps * self.batch_size - n
+        mask = np.concatenate([np.ones(n, np.float32),
+                               np.zeros(pad, np.float32)])
+        xs = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        ys = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        xs = xs.reshape((steps, self.batch_size) + x.shape[1:])
+        ys = ys.reshape((steps, self.batch_size) + y.shape[1:])
+        mask = mask.reshape(steps, self.batch_size)
+
+        params = self.params
+        opt_state = self._opt.init(params)
+        for epoch in range(self.epochs):
+            if self._stop_flag:
+                logger.info("device trainer: stop flag set at epoch %d", epoch)
+                break
+            params, opt_state, metrics = self._epoch_step(
+                params, opt_state, xs, ys, mask)
+            self.cur_epoch = epoch
+            self.cur_loss = float(metrics["loss"])
+            if self.loss_callback:
+                self.loss_callback(epoch, self.cur_loss)
+            if self.accuracy_callback:
+                self.accuracy_callback(epoch, float(metrics["acc"]))
+            if self.progress_callback:
+                self.progress_callback((epoch + 1) / self.epochs)
+        return params, n
+
+
+class _DeviceAdapter:
+    """Presents the manager-side adapter interface (update_dataset/train)
+    over a FedMLBaseTrainer — the FedMLClientManager glue."""
+
+    def __init__(self, trainer: FedMLBaseTrainer):
+        self.trainer = trainer
+        self.client_index = None
+
+    def update_dataset(self, client_index: int) -> None:
+        # the device owns its data; the server-sent index is recorded only
+        # for logging parity with silo clients
+        self.client_index = int(client_index)
+
+    def train(self, round_idx: int, global_params: Pytree) -> Tuple[Pytree, int]:
+        self.trainer.set_model(global_params)
+        return self.trainer.train()
+
+
+class DeviceClient:
+    """FedMLClientManager twin: trainer + wire protocol for one device.
+
+    ``args.secure_aggregation`` selects the SecAgg FSM
+    (FedMLClientManagerSA / FedMLTrainerSA parity) — masking happens
+    on-device in ``core/mpc/secagg``; the server never sees this
+    device's raw update.
+    """
+
+    def __init__(self, args: Any, trainer: FedMLBaseTrainer):
+        self.args = args
+        from fedml_tpu import constants
+
+        backend = str(getattr(args, "comm_backend", None)
+                      or getattr(args, "backend", "LOCAL"))
+        rank = int(getattr(args, "rank", 1))
+        n_clients = int(getattr(args, "client_num_per_round",
+                                getattr(args, "client_num_in_total", 1)))
+        adapter = _DeviceAdapter(trainer)
+        if bool(getattr(args, "secure_aggregation", False)):
+            from fedml_tpu.cross_silo.secagg.sa_client_manager import (
+                SAClientManager,
+            )
+
+            self.manager = SAClientManager(
+                args, adapter, rank=rank, size=n_clients + 1, backend=backend)
+        else:
+            from fedml_tpu.cross_silo.client.fedml_client_master_manager import (
+                ClientMasterManager,
+            )
+
+            self.manager = ClientMasterManager(
+                args, adapter, rank=rank, size=n_clients + 1, backend=backend)
+
+    def run(self) -> None:
+        self.manager.run()
+
+    def run_async(self):
+        return self.manager.run_async()
+
+
+def build_device_client(args: Any) -> DeviceClient:
+    """Assemble a device client from flat args: local data shard + model
+    apply fn + JaxDeviceTrainer + wire manager."""
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.data import load_federated
+
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    rank = int(getattr(args, "rank", 1))
+    local = ds.train_data_local_dict[rank - 1]
+    trainer = JaxDeviceTrainer(model.apply)
+    trainer.init(
+        dataset=local,
+        train_size=int(getattr(args, "train_size_device", 0)) or len(local[0]),
+        batch_size=int(getattr(args, "batch_size", 32)),
+        learning_rate=float(getattr(args, "learning_rate", 0.03)),
+        epochs=int(getattr(args, "epochs", 1)),
+    )
+    return DeviceClient(args, trainer)
+
+
+def main(argv=None) -> None:
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+
+    args = load_arguments(None, None)
+    args = fedml_tpu.init(args)
+    client = build_device_client(args)
+    client.run()
+
+
+if __name__ == "__main__":
+    main()

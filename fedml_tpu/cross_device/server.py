@@ -9,8 +9,9 @@ protocol (handshake → init → per-round sync/upload → finish) is identical;
 what differs on-device is the client runtime, not the server. Mobile/edge
 clients speak the same typed-message wire format (pickle-free, see
 ``utils/serialization.py``) over a broker transport, and upload plain
-pytree deltas instead of ``.mnn`` files. A reference-style lightweight
-client runtime lives in ``cross_device/client.py``.
+pytree deltas instead of ``.mnn`` files. The device-side runtime
+(FedMLBaseTrainer engine seam, JAX engine, plain + SecAgg managers) is
+:mod:`fedml_tpu.cross_device.client`.
 """
 from __future__ import annotations
 

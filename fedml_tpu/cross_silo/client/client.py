@@ -20,9 +20,21 @@ class Client:
         rank = int(getattr(args, "rank", 1))
         client_num = int(getattr(args, "client_num_per_round", 1))
         adapter = TrainerDistAdapter(args, device, rank, model, dataset, client_trainer)
-        self.manager = ClientMasterManager(
-            args, adapter, rank=rank, size=client_num + 1, backend=backend
-        )
+        if bool(getattr(args, "secure_aggregation", False)):
+            # mirror the server facade: secure_aggregation selects the
+            # Bonawitz SecAgg FSM — a plain manager against an SecAgg
+            # server would upload UNMASKED models and hang the round
+            from fedml_tpu.cross_silo.secagg.sa_client_manager import (
+                SAClientManager,
+            )
+
+            self.manager = SAClientManager(
+                args, adapter, rank=rank, size=client_num + 1, backend=backend
+            )
+        else:
+            self.manager = ClientMasterManager(
+                args, adapter, rank=rank, size=client_num + 1, backend=backend
+            )
 
     def run(self):
         self.manager.run()

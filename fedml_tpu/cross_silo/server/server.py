@@ -43,7 +43,16 @@ class Server:
         use_async = bool(getattr(args, "async_aggregation", False)) or (
             str(getattr(args, "federated_optimizer", "")) == "AsyncFedAvg"
         )
-        if use_async:
+        if bool(getattr(args, "secure_aggregation", False)):
+            from fedml_tpu.cross_silo.secagg.sa_server_manager import (
+                SAServerManager,
+            )
+
+            self.manager = SAServerManager(
+                args, self.fedml_aggregator, client_rank=0,
+                client_num=client_num, backend=backend,
+            )
+        elif use_async:
             from fedml_tpu.cross_silo.server.async_server_manager import (
                 AsyncFedMLServerManager,
             )

@@ -18,7 +18,10 @@ import urllib.request
 import uuid
 from typing import Dict, List, Optional
 
-from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.distributed.communication.broker_agent import (
+    BrokerJsonAgent,
+    PeerRegistry,
+)
 from fedml_tpu.core.distributed.communication.object_store import ObjectStore
 from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
 from fedml_tpu.deploy.model_cards import FedMLModelCards
@@ -26,56 +29,40 @@ from fedml_tpu.deploy.model_cards import FedMLModelCards
 logger = logging.getLogger(__name__)
 
 
-class DeployMaster:
+class DeployMaster(BrokerJsonAgent):
     def __init__(self, broker_host: str, broker_port: int, store: ObjectStore,
                  cache: EndpointCache, cards: Optional[FedMLModelCards] = None,
                  cluster: str = "default", worker_timeout_s: float = 6.0,
                  health_interval_s: float = 1.0):
+        super().__init__(broker_host, broker_port)
         self.cluster = cluster
         self.store = store
         self.cache = cache
         self.cards = cards or FedMLModelCards()
-        self.worker_timeout_s = worker_timeout_s
-        self.workers: Dict[str, Dict] = {}  # worker_id → {last_seen, capacity}
+        self.registry = PeerRegistry(worker_timeout_s)
         self._results: Dict[str, Dict[str, Dict]] = {}  # eid → worker → result
         self._events: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
-        self._stopping = threading.Event()
-        self._client = BrokerClient(broker_host, broker_port)
-        self._client.subscribe(f"deploy/{cluster}/master", self._on_message)
+        self.subscribe_json(f"deploy/{cluster}/master", self._on_message)
         self._health_interval_s = health_interval_s
-        self._health_thread: Optional[threading.Thread] = None
+        self._health_started = False
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "DeployMaster":
-        if self._health_thread is None:
-            self._health_thread = threading.Thread(
-                target=self._health_loop, daemon=True)
-            self._health_thread.start()
+        if not self._health_started:
+            self._health_started = True
+            self.spawn_loop(self._health_loop)
         return self
 
     def shutdown(self) -> None:
-        self._stopping.set()
-        self._client.close()
+        self.stop_agent()
 
     # -- worker registry --------------------------------------------------
     def live_workers(self) -> List[str]:
-        now = time.time()
-        with self._lock:
-            return sorted(
-                wid for wid, info in self.workers.items()
-                if now - info["last_seen"] < self.worker_timeout_s
-            )
+        return self.registry.live()
 
     def wait_for_workers(self, n: int, timeout: float = 30.0) -> List[str]:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            live = self.live_workers()
-            if len(live) >= n:
-                return live
-            time.sleep(0.1)
-        raise TimeoutError(
-            f"only {len(self.live_workers())}/{n} deploy workers online")
+        return self.registry.wait_for(n, timeout, what="deploy workers")
 
     # -- deployment API ---------------------------------------------------
     def deploy(self, model_name: str, *, endpoint_name: Optional[str] = None,
@@ -181,9 +168,8 @@ class DeployMaster:
                 if wid in load and rep.get("status") in (
                         EndpointStatus.DEPLOYED, EndpointStatus.DEPLOYING):
                     load[wid] += 1
-        with self._lock:
-            caps = {w: int(self.workers.get(w, {}).get("capacity", 4))
-                    for w in live}
+        caps = {w: int(self.registry.get(w).get("capacity", 4))
+                for w in live}
         free = [w for w in live if load[w] < caps[w]]
         if len(free) < n:
             raise RuntimeError(
@@ -192,23 +178,16 @@ class DeployMaster:
         return sorted(free, key=lambda w: (load[w], w))[:n]
 
     def _send(self, worker_id: str, msg: Dict) -> None:
-        self._client.publish(
-            f"deploy/{self.cluster}/worker/{worker_id}",
-            json.dumps(msg).encode())
+        self.publish_json(f"deploy/{self.cluster}/worker/{worker_id}", msg)
 
-    def _on_message(self, body: bytes) -> None:
-        try:
-            msg = json.loads(body)
-        except ValueError:
-            return
+    def _on_message(self, msg: Dict) -> None:
         mtype = msg.get("type")
         wid = str(msg.get("worker_id", ""))
         if mtype in ("worker_online", "heartbeat"):
-            with self._lock:
-                info = self.workers.setdefault(wid, {"capacity": 4})
-                info["last_seen"] = time.time()
-                if "capacity" in msg:
-                    info["capacity"] = int(msg["capacity"])
+            if "capacity" in msg:
+                self.registry.touch(wid, capacity=int(msg["capacity"]))
+            else:
+                self.registry.touch(wid)
         elif mtype == "deploy_result":
             eid = str(msg["endpoint_id"])
             self.cache.set_replica(

@@ -32,9 +32,9 @@ import sys
 import threading
 import time
 import urllib.request
-from typing import Dict, Optional
+from typing import Dict
 
-from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.distributed.communication.broker_agent import BrokerJsonAgent
 from fedml_tpu.core.distributed.communication.object_store import ObjectStore
 from fedml_tpu.deploy.model_cards import FedMLModelCards
 
@@ -54,11 +54,12 @@ class _Replica:
         self.url = url
 
 
-class DeployWorkerAgent:
+class DeployWorkerAgent(BrokerJsonAgent):
     def __init__(self, worker_id: str, broker_host: str, broker_port: int,
                  store: ObjectStore, workdir: str = ".fedml_deploy",
                  cluster: str = "default", capacity: int = 4,
                  heartbeat_s: float = 2.0):
+        super().__init__(broker_host, broker_port)
         self.worker_id = worker_id
         self.cluster = cluster
         self.capacity = capacity
@@ -69,20 +70,15 @@ class DeployWorkerAgent:
         self._cap_lock = threading.Lock()
         self._inflight = 0  # boots in progress count toward capacity
         self._heartbeat_s = heartbeat_s
-        self._stopping = threading.Event()
-        self._client = BrokerClient(broker_host, broker_port)
-        self._client.subscribe(
+        self.subscribe_json(
             f"deploy/{cluster}/worker/{worker_id}", self._on_message)
-        self._threads = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "DeployWorkerAgent":
         self._publish({"type": "worker_online", "worker_id": self.worker_id,
                        "capacity": self.capacity})
-        for target in (self._heartbeat_loop, self._supervise_loop):
-            t = threading.Thread(target=target, daemon=True)
-            t.start()
-            self._threads.append(t)
+        self.spawn_loop(self._heartbeat_loop)
+        self.spawn_loop(self._supervise_loop)
         return self
 
     def shutdown(self) -> None:
@@ -90,7 +86,7 @@ class DeployWorkerAgent:
         for rep in list(self.replicas.values()):
             self._kill_replica(rep)
         self.replicas.clear()
-        self._client.close()
+        self.stop_agent()
 
     def serve_forever(self) -> None:
         """Blocking daemon loop (CLI `deploy worker` entry)."""
@@ -102,12 +98,7 @@ class DeployWorkerAgent:
             self.shutdown()
 
     # -- control-plane handlers ------------------------------------------
-    def _on_message(self, body: bytes) -> None:
-        try:
-            msg = json.loads(body)
-        except ValueError:
-            logger.warning("deploy worker %s: bad frame", self.worker_id)
-            return
+    def _on_message(self, msg: Dict) -> None:
         mtype = msg.get("type")
         if mtype == "deploy":
             threading.Thread(
@@ -246,8 +237,4 @@ class DeployWorkerAgent:
             time.sleep(0.5)
 
     def _publish(self, msg: Dict) -> None:
-        try:
-            self._client.publish(
-                f"deploy/{self.cluster}/master", json.dumps(msg).encode())
-        except OSError:
-            pass
+        self.publish_json(f"deploy/{self.cluster}/master", msg)

@@ -17,14 +17,16 @@ Job status semantics:
 """
 from __future__ import annotations
 
-import json
 import logging
 import threading
 import time
 import uuid
 from typing import Dict, List, Optional
 
-from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.distributed.communication.broker_agent import (
+    BrokerJsonAgent,
+    PeerRegistry,
+)
 from fedml_tpu.core.mlops.status import RunStatus
 from fedml_tpu.scheduler.job_yaml import JobSpec
 
@@ -72,47 +74,34 @@ class JobView:
         }
 
 
-class MasterAgent:
+class MasterAgent(BrokerJsonAgent):
     def __init__(self, broker_host: str, broker_port: int,
                  cluster: str = "default", node_timeout_s: float = 5.0):
+        super().__init__(broker_host, broker_port)
         self.cluster = cluster
-        self.node_timeout_s = node_timeout_s
-        self.nodes: Dict[str, Dict] = {}  # node_id → {last_seen, slots}
+        self.registry = PeerRegistry(node_timeout_s)
         self.jobs: Dict[str, JobView] = {}
         self._lock = threading.Lock()
-        self._stopping = threading.Event()
         self._log_events: Dict[str, threading.Event] = {}
-        self._client = BrokerClient(broker_host, broker_port)
-        self._client.subscribe(f"sched/{cluster}/master", self._on_message)
-        self._watch: Optional[threading.Thread] = None
+        self.subscribe_json(f"sched/{cluster}/master", self._on_message)
+        self._watch_started = False
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "MasterAgent":
-        if self._watch is None:
-            self._watch = threading.Thread(target=self._watch_loop, daemon=True)
-            self._watch.start()
+        if not self._watch_started:
+            self._watch_started = True
+            self.spawn_loop(self._watch_loop)
         return self
 
     def shutdown(self) -> None:
-        self._stopping.set()
-        self._client.close()
+        self.stop_agent()
 
     # -- node registry ----------------------------------------------------
     def live_nodes(self) -> List[str]:
-        now = time.time()
-        with self._lock:
-            return sorted(n for n, info in self.nodes.items()
-                          if now - info["last_seen"] < self.node_timeout_s)
+        return self.registry.live()
 
     def wait_for_nodes(self, n: int, timeout: float = 30.0) -> List[str]:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            live = self.live_nodes()
-            if len(live) >= n:
-                return live
-            time.sleep(0.1)
-        raise TimeoutError(
-            f"only {len(self.live_nodes())}/{n} nodes online")
+        return self.registry.wait_for(n, timeout, what="nodes")
 
     # -- job control ------------------------------------------------------
     def submit_job(self, spec: JobSpec, n_ranks: int = 1,
@@ -120,9 +109,9 @@ class MasterAgent:
                    extra_env: Optional[Dict[str, Dict[str, str]]] = None,
                    ) -> str:
         """Fan ``spec`` out as ``n_ranks`` runs over the given (or all
-        live) nodes, round-robin. Each rank's process sees FEDML_RANK /
-        FEDML_NUM_RANKS / FEDML_JOB_ID; ``extra_env`` maps rank (as str)
-        to additional env overrides."""
+        live) nodes, respecting each node's advertised slots. Each rank's
+        process sees FEDML_RANK / FEDML_NUM_RANKS / FEDML_JOB_ID;
+        ``extra_env`` maps rank (as str) to additional env overrides."""
         live = self.live_nodes()
         if nodes:
             missing = sorted(set(nodes) - set(live))
@@ -132,11 +121,27 @@ class MasterAgent:
         targets = nodes or live
         if not targets:
             raise RuntimeError("no live nodes to schedule on")
+        # expand nodes by their advertised slots (a slot = one rank; each
+        # rank is its own JAX/XLA process, so slots bound oversubscription
+        # the way the deploy plane's --capacity does), interleaved so
+        # ranks spread across nodes before doubling up
+        remaining = {n: max(1, int(self.registry.get(n).get("slots", 1)))
+                     for n in targets}
+        slot_list: List[str] = []
+        while any(remaining.values()):
+            for node_id in targets:
+                if remaining[node_id] > 0:
+                    remaining[node_id] -= 1
+                    slot_list.append(node_id)
+        if n_ranks > len(slot_list):
+            raise RuntimeError(
+                f"job needs {n_ranks} slots, cluster offers {len(slot_list)} "
+                f"across {targets}")
         job_id = uuid.uuid4().hex[:10]
         ranks: Dict[str, str] = {}
         assignments = []
         for rank in range(n_ranks):
-            node_id = targets[rank % len(targets)]
+            node_id = slot_list[rank]
             run_id = f"{job_id}-r{rank}"
             ranks[run_id] = node_id
             env = {
@@ -204,29 +209,31 @@ class MasterAgent:
 
     # -- internals --------------------------------------------------------
     def _send(self, node_id: str, msg: Dict) -> None:
-        self._client.publish(f"sched/{self.cluster}/node/{node_id}",
-                             json.dumps(msg).encode())
+        self.publish_json(f"sched/{self.cluster}/node/{node_id}", msg)
 
-    def _on_message(self, body: bytes) -> None:
-        try:
-            msg = json.loads(body)
-        except ValueError:
-            return
+    def _apply_rank_status(self, run_id: str, status: str,
+                           returncode=None) -> None:
+        for view in self.jobs.values():
+            if run_id in view.rank_status:
+                if view.rank_status[run_id] not in RunStatus.TERMINAL:
+                    view.rank_status[run_id] = status
+                    view.rank_rc[run_id] = returncode
+                break
+
+    def _on_message(self, msg: Dict) -> None:
         mtype = msg.get("type")
         nid = str(msg.get("node_id", ""))
-        if mtype in ("node_online", "heartbeat"):
-            with self._lock:
-                info = self.nodes.setdefault(nid, {"slots": 1})
-                info["last_seen"] = time.time()
-                if "slots" in msg:
-                    info["slots"] = int(msg["slots"])
+        if mtype == "node_online":
+            self.registry.touch(nid, slots=int(msg.get("slots", 1)))
+        elif mtype == "heartbeat":
+            self.registry.touch(nid)
+            # reconcile from the heartbeat's run table too: a lost one-shot
+            # run_status message must not leave a rank RUNNING forever
+            for rid, status in (msg.get("runs") or {}).items():
+                self._apply_rank_status(str(rid), str(status))
         elif mtype == "run_status":
-            rid = str(msg["run_id"])
-            for view in self.jobs.values():
-                if rid in view.rank_status:
-                    view.rank_status[rid] = str(msg["status"])
-                    view.rank_rc[rid] = msg.get("returncode")
-                    break
+            self._apply_rank_status(str(msg["run_id"]), str(msg["status"]),
+                                    msg.get("returncode"))
         elif mtype == "run_logs":
             rid = str(msg["run_id"])
             for view in self.jobs.values():
@@ -242,10 +249,8 @@ class MasterAgent:
         non-terminal ranks to FAILED (the reference master's edge-offline
         handling)."""
         while not self._stopping.is_set():
-            now = time.time()
+            dark = set(self.registry.dark())
             with self._lock:
-                dark = {n for n, info in self.nodes.items()
-                        if now - info["last_seen"] >= self.node_timeout_s}
                 views = list(self.jobs.values())
             for view in views:
                 for rid, node_id in view.ranks.items():

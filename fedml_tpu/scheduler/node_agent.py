@@ -23,50 +23,43 @@ Wire protocol (JSON over broker topics):
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
-import threading
 import time
 from typing import Dict
 
-from fedml_tpu.core.distributed.communication.broker import BrokerClient
+from fedml_tpu.core.distributed.communication.broker_agent import BrokerJsonAgent
 from fedml_tpu.scheduler.agent import LocalAgent
 from fedml_tpu.scheduler.job_yaml import JobSpec
 
 logger = logging.getLogger(__name__)
 
 
-class NodeAgent:
+class NodeAgent(BrokerJsonAgent):
     def __init__(self, node_id: str, broker_host: str, broker_port: int,
                  workdir: str = ".fedml_runs", cluster: str = "default",
                  slots: int = 1, heartbeat_s: float = 1.0):
+        super().__init__(broker_host, broker_port)
         self.node_id = node_id
         self.cluster = cluster
         self.slots = slots
         self.agent = LocalAgent(workdir=os.path.join(workdir, node_id))
         self._heartbeat_s = heartbeat_s
-        self._stopping = threading.Event()
         self._reported: Dict[str, str] = {}  # run_id → last status sent
-        self._client = BrokerClient(broker_host, broker_port)
-        self._client.subscribe(
+        self.subscribe_json(
             f"sched/{cluster}/node/{node_id}", self._on_message)
-        self._threads = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "NodeAgent":
         self.agent.start()
         self._publish({"type": "node_online", "node_id": self.node_id,
                        "slots": self.slots})
-        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        self.spawn_loop(self._heartbeat_loop)
         return self
 
     def shutdown(self, kill_running: bool = True) -> None:
-        self._stopping.set()
         self.agent.shutdown(kill_running=kill_running)
-        self._client.close()
+        self.stop_agent()
 
     def serve_forever(self) -> None:
         self.start()
@@ -77,11 +70,7 @@ class NodeAgent:
             self.shutdown()
 
     # -- handlers ---------------------------------------------------------
-    def _on_message(self, body: bytes) -> None:
-        try:
-            msg = json.loads(body)
-        except ValueError:
-            return
+    def _on_message(self, msg: Dict) -> None:
         mtype = msg.get("type")
         if mtype == "start_run":
             self._handle_start(msg)
@@ -131,8 +120,4 @@ class NodeAgent:
             time.sleep(self._heartbeat_s)
 
     def _publish(self, msg: Dict) -> None:
-        try:
-            self._client.publish(
-                f"sched/{self.cluster}/master", json.dumps(msg).encode())
-        except OSError:
-            pass
+        self.publish_json(f"sched/{self.cluster}/master", msg)

@@ -1,0 +1,172 @@
+"""Cross-device ("BeeHive") runtime e2e.
+
+VERDICT round-3 contract: 2 device clients as subprocesses complete
+3 rounds against ServerCrossDevice, including a SecAgg round; the device
+trainer keeps the FedMLBaseTrainer callback/stop-flag shape.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _device_cfg(host, port, tmp_path, *, rounds, secure=False):
+    return textwrap.dedent(f"""
+        common_args: {{training_type: "cross_device", random_seed: 0,
+                       run_id: "beehive_{'sa' if secure else 'plain'}"}}
+        data_args: {{dataset: "synthetic", train_size: 300, test_size: 80,
+                     class_num: 4, feature_dim: 12}}
+        model_args: {{model: "lr"}}
+        train_args:
+          federated_optimizer: "FedAvg"
+          comm_backend: "BROKER"
+          broker_host: "{host}"
+          broker_port: {port}
+          object_store_dir: "{tmp_path / 'store'}"
+          client_num_in_total: 2
+          client_num_per_round: 2
+          comm_round: {rounds}
+          epochs: 2
+          batch_size: 32
+          learning_rate: 0.3
+          secure_aggregation: {str(secure).lower()}
+    """)
+
+
+def _spawn_device_client(cfg_path, rank):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO_ROOT, env.get("PYTHONPATH")) if p)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu.cross_device.client",
+         "--cf", cfg_path, "--rank", str(rank), "--role", "client"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True, env=env, text=True,
+    )
+
+
+def _run_server_against_subprocess_clients(tmp_path, *, rounds, secure):
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    cfg_path = str(tmp_path / "device_cfg.yaml")
+    with open(cfg_path, "w") as f:
+        f.write(_device_cfg(host, port, tmp_path, rounds=rounds,
+                            secure=secure))
+
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_yaml_path
+    from fedml_tpu.cross_device import ServerCrossDevice
+    from fedml_tpu.data import load_federated
+
+    args = fedml_tpu.init(load_arguments_from_yaml_path(cfg_path))
+    args.role = "server"
+    args.rank = 0
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    server = ServerCrossDevice(args, None, ds, model)
+
+    clients = [_spawn_device_client(cfg_path, r) for r in (1, 2)]
+    t = threading.Thread(target=server.manager.run, daemon=True)
+    t.start()
+    try:
+        t.join(timeout=240)
+        assert not t.is_alive(), "cross-device server FSM hung"
+        for p in clients:
+            out, _ = p.communicate(timeout=60)
+            assert p.returncode == 0, f"device client failed:\n{out}"
+        return server.manager.result
+    finally:
+        for p in clients:
+            if p.poll() is None:
+                p.kill()
+        broker.stop()
+
+
+def test_two_device_subprocesses_three_rounds(tmp_path):
+    result = _run_server_against_subprocess_clients(
+        tmp_path, rounds=3, secure=False)
+    assert result is not None
+    assert result["rounds"] == 3
+    assert result["test_acc"] > 0.4
+
+
+def test_device_secagg_round(tmp_path):
+    """SecAgg on-device (FedMLTrainerSA parity): devices upload masked
+    updates only; the server FSM unmasks the SUM."""
+    result = _run_server_against_subprocess_clients(
+        tmp_path, rounds=1, secure=True)
+    assert result is not None
+    assert result["rounds"] == 1
+    assert result["test_acc"] > 0.4
+
+
+def test_device_trainer_callbacks_and_stop():
+    """FedMLBaseTrainer.h shape: per-epoch loss/accuracy/progress
+    callbacks fire; the stop flag halts the loop."""
+    import jax
+
+    from fedml_tpu.cross_device import JaxDeviceTrainer
+    from fedml_tpu.models import model_hub
+
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "cross_device", "random_seed": 0},
+        "data_args": {"dataset": "synthetic", "train_size": 200,
+                      "test_size": 40, "class_num": 3, "feature_dim": 8},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 1, "client_num_per_round": 1,
+                       "comm_round": 1, "epochs": 4, "batch_size": 16,
+                       "learning_rate": 0.3},
+    }))
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.data import load_federated
+
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    x, y = ds.train_data_local_dict[0]
+    w0 = model_hub.init_params(model, args, x[:16])
+
+    events = {"loss": [], "acc": [], "progress": []}
+    trainer = JaxDeviceTrainer(model.apply)
+    trainer.init(
+        dataset=(x, y), train_size=len(x), batch_size=16,
+        learning_rate=0.3, epochs=4,
+        progress_callback=lambda p: events["progress"].append(p),
+        accuracy_callback=lambda e, a: events["acc"].append((e, a)),
+        loss_callback=lambda e, l: events["loss"].append((e, l)),
+    )
+    trainer.set_model(w0)
+    params, n = trainer.train()
+    assert n == len(x)
+    assert len(events["loss"]) == 4 and len(events["progress"]) == 4
+    assert events["progress"][-1] == 1.0
+    # loss decreased over epochs
+    assert events["loss"][-1][1] < events["loss"][0][1]
+    epoch, loss = trainer.get_epoch_and_loss()
+    assert epoch == 3 and loss == events["loss"][-1][1]
+    # params actually moved
+    moved = jax.tree.map(lambda a, b: float(np.abs(a - b).max()), w0, params)
+    assert max(jax.tree.leaves(moved)) > 0
+
+    # stop flag: a fresh trainer stopped before training does zero epochs
+    t2 = JaxDeviceTrainer(model.apply)
+    t2.init(dataset=(x, y), train_size=len(x), batch_size=16,
+            learning_rate=0.3, epochs=4)
+    t2.set_model(w0)
+    t2.stop_training()
+    params2, _ = t2.train()
+    unchanged = jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), w0, params2)
+    assert max(jax.tree.leaves(unchanged)) == 0

@@ -28,7 +28,7 @@ def _spawn_node(node_id, broker_addr, workdir):
     return subprocess.Popen(
         [sys.executable, "-m", "fedml_tpu.cli", "cluster", "node",
          "--id", node_id, "--broker", f"{broker_addr[0]}:{broker_addr[1]}",
-         "--workdir", workdir],
+         "--workdir", workdir, "--slots", "2"],
         stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
         start_new_session=True,
     )
