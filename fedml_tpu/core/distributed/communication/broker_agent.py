@@ -38,11 +38,20 @@ class BrokerJsonAgent:
 
         self._client.subscribe(topic, _on_frame)
 
-    def publish_json(self, topic: str, msg: Dict) -> None:
+    def publish_json(self, topic: str, msg: Dict,
+                     best_effort: bool = False) -> None:
+        """Publish a JSON control message.
+
+        ``best_effort=True`` is for periodic traffic (heartbeats, status
+        re-sends) where the next tick retransmits anyway. One-shot
+        commands (start_run, stop_run, deploy...) must NOT set it: a
+        silently dropped command strands the caller waiting forever.
+        """
         try:
             self._client.publish(topic, json.dumps(msg).encode())
         except OSError:
-            pass  # broker blip; callers rely on periodic resend (heartbeats)
+            if not best_effort:
+                raise
 
     def spawn_loop(self, target: Callable[[], None]) -> None:
         t = threading.Thread(target=target, daemon=True)

@@ -1,54 +1,35 @@
 """FedMLFHE — homomorphic-encryption aggregation facade.
 
-Parity: ``core/fhe/fhe_agg.py:10`` (TenSEAL CKKS in the reference). TenSEAL
-is not available in this environment, so the default backend is a
-deterministic additive-masking scheme with the same algebra (ciphertexts can
-be summed; decryption removes the aggregate mask) — adequate for protocol
-and pipeline testing. A real CKKS backend can be slotted in behind the same
-``fhe_enc/fhe_dec/fhe_fedavg`` surface when the library is present.
+Parity: ``core/fhe/fhe_agg.py:10`` (TenSEAL CKKS in the reference).
+Backend: the in-tree CKKS implementation (:mod:`fedml_tpu.core.fhe.ckks`
+— real RLWE/CKKS algebra in numpy; see its docstring for parameters and
+noise bounds). The deployment model mirrors the reference's shared
+pickled TenSEAL context: every participant derives the SAME context
+(keys included) from ``fhe_key_seed``/``random_seed``, clients encrypt
+their updates, the server aggregates **without decrypting** (ciphertext
+scalar-times-weight + ciphertext adds), and clients decrypt the
+aggregate on receipt (``ClientTrainer.on_before_local_training``).
+
+Wire format of an encrypted pytree (plain dict of numpy arrays, so the
+pickle-free serializer ships it unchanged):
+
+    {"__fhe_ckks__": True, "cts": [{"c0": int64[N], "c1": int64[N]}...],
+     "length": D, "scale": float, "template": zeros-like pytree}
 """
 from __future__ import annotations
 
 import logging
 from typing import Any, List, Tuple
 
-import jax
-import jax.numpy as jnp
-
-from fedml_tpu.utils.tree import tree_stack, weighted_tree_sum
+import numpy as np
 
 Pytree = Any
 
+_WEIGHT_SCALE = 256  # plaintext weights quantized to 1/256
 
-class _AdditiveMaskCipher:
-    """Toy additive-HE stand-in: enc(x) = x + PRG(key); sum of ciphertexts
-    decrypts with the sum of masks. NOT cryptographically meaningful on its
-    own (see core/mpc for the real SecAgg protocols); exists to exercise the
-    FHE code path without TenSEAL."""
 
-    def __init__(self, seed: int):
-        self.seed = seed
-        self._counter = 0
-
-    def _mask_for(self, counter: int, leaf: jax.Array) -> jax.Array:
-        key = jax.random.fold_in(jax.random.key(self.seed), counter)
-        return jax.random.normal(key, leaf.shape, dtype=leaf.dtype)
-
-    def enc(self, params: Pytree) -> Pytree:
-        self._counter += 1
-        c = self._counter
-        leaves, treedef = jax.tree.flatten(params)
-        out = [leaf + self._mask_for(c * 1000 + i, leaf) for i, leaf in enumerate(leaves)]
-        tagged = jax.tree.unflatten(treedef, out)
-        return {"__fhe__": True, "counter": c, "payload": tagged}
-
-    def dec(self, cipher: Any) -> Pytree:
-        if not (isinstance(cipher, dict) and cipher.get("__fhe__")):
-            return cipher
-        c = cipher["counter"]
-        leaves, treedef = jax.tree.flatten(cipher["payload"])
-        out = [leaf - self._mask_for(c * 1000 + i, leaf) for i, leaf in enumerate(leaves)]
-        return jax.tree.unflatten(treedef, out)
+def _is_cipher(obj: Any) -> bool:
+    return isinstance(obj, dict) and obj.get("__fhe_ckks__") is True
 
 
 class FedMLFHE:
@@ -56,7 +37,7 @@ class FedMLFHE:
 
     def __init__(self):
         self.is_enabled = False
-        self._cipher = None
+        self.ctx = None
 
     @classmethod
     def get_instance(cls) -> "FedMLFHE":
@@ -66,26 +47,107 @@ class FedMLFHE:
 
     def init(self, args: Any) -> None:
         self.is_enabled = bool(getattr(args, "enable_fhe", False))
-        if self.is_enabled:
-            self._cipher = _AdditiveMaskCipher(int(getattr(args, "random_seed", 0)))
-            logging.info("FHE enabled (additive-mask backend)")
+        if not self.is_enabled:
+            return
+        from fedml_tpu.core.fhe.ckks import CKKSContext
+
+        seed = int(getattr(args, "fhe_key_seed",
+                           getattr(args, "random_seed", 0))) + 40487
+        self.ctx = CKKSContext(
+            n=int(getattr(args, "fhe_poly_degree", 1024)),
+            delta=int(getattr(args, "fhe_scale", 1 << 19)),
+            seed=seed,
+        ).keygen()
+        logging.info("FHE enabled: CKKS n=%d slots=%d", self.ctx.n,
+                     self.ctx.slots)
 
     def is_fhe_enabled(self) -> bool:
         return self.is_enabled
 
+    # -- pytree <-> cipher -------------------------------------------------
     def fhe_enc(self, params: Pytree) -> Pytree:
-        return self._cipher.enc(params)
+        import jax
+
+        from fedml_tpu.utils.tree import tree_flatten_vector
+
+        if _is_cipher(params):
+            return params
+        vec = np.asarray(tree_flatten_vector(params), np.float64)
+        # aggregation multiplies ciphertexts by quantized weights (Σ≈256),
+        # shrinking the safe range by that factor — enforce the POST-
+        # aggregation bound here, where the plaintext is still visible
+        # (after fhe_fedavg a wrap would be silent garbage)
+        agg_limit = self.ctx.q / (2.0 * self.ctx.delta * _WEIGHT_SCALE * 2.0)
+        peak = float(np.abs(vec).max()) if vec.size else 0.0
+        if peak >= agg_limit:
+            raise ValueError(
+                f"model weight magnitude {peak:.2f} exceeds the encrypted-"
+                f"aggregation range |x| < {agg_limit:.2f}; lower fhe_scale "
+                f"(delta) or clip the update before encryption")
+        cts = self.ctx.encrypt_vector(vec)
+        template = jax.tree.map(
+            lambda x: np.zeros(np.shape(x), np.float32), params)
+        return {
+            "__fhe_ckks__": True,
+            "cts": [{"c0": ct.c0, "c1": ct.c1} for ct in cts],
+            "length": int(vec.size),
+            "scale": float(self.ctx.delta),
+            "template": template,
+        }
 
     def fhe_dec(self, params: Pytree) -> Pytree:
-        return self._cipher.dec(params)
+        from fedml_tpu.core.fhe.ckks import CKKSCiphertext
+        from fedml_tpu.utils.tree import tree_unflatten_vector
 
+        if not _is_cipher(params):
+            return params
+        cts = [CKKSCiphertext(np.asarray(c["c0"], np.int64),
+                              np.asarray(c["c1"], np.int64))
+               for c in params["cts"]]
+        save_delta = self.ctx.delta
+        try:
+            # effective scale after plaintext-weight multiplication
+            self.ctx.delta = params.get("scale", save_delta)
+            vec = self.ctx.decrypt_vector(cts, int(params["length"]))
+        finally:
+            self.ctx.delta = save_delta
+        import jax.numpy as jnp
+
+        return tree_unflatten_vector(jnp.asarray(vec, jnp.float32),
+                                     params["template"])
+
+    # -- encrypted FedAvg --------------------------------------------------
     def fhe_fedavg(self, raw_client_model_list: List[Tuple[int, Pytree]]) -> Pytree:
-        # Weighted mean over ciphertexts: decrypt each (masks are server-side
-        # in this stand-in), then average — mirrors the encrypted FedAvg shape.
-        counts = jnp.asarray([float(num) for num, _ in raw_client_model_list])
-        weights = counts / jnp.sum(counts)
-        plains = [self._cipher.dec(p) for _, p in raw_client_model_list]
-        return weighted_tree_sum(tree_stack(plains), weights)
+        """Count-weighted FedAvg over ciphertexts, never decrypting:
+        acc = Σ round(w_k·256)·ct_k, recorded at scale Δ·Σ round(w_k·256)
+        so decryption yields the (quantized-)weighted mean directly."""
+        ciphers = [p for _, p in raw_client_model_list]
+        if not all(_is_cipher(p) for p in ciphers):
+            raise ValueError("fhe_fedavg expects encrypted client payloads")
+        counts = np.asarray([float(n) for n, _ in raw_client_model_list])
+        weights = counts / counts.sum()
+        wq = np.maximum(1, np.rint(weights * _WEIGHT_SCALE)).astype(np.int64)
+
+        q = self.ctx.q
+        acc = None
+        for w, cipher in zip(wq, ciphers):
+            scaled = [{"c0": np.mod(c["c0"] * int(w), q),
+                       "c1": np.mod(c["c1"] * int(w), q)}
+                      for c in cipher["cts"]]
+            if acc is None:
+                acc = scaled
+            else:
+                acc = [{"c0": np.mod(a["c0"] + s["c0"], q),
+                        "c1": np.mod(a["c1"] + s["c1"], q)}
+                       for a, s in zip(acc, scaled)]
+        first = ciphers[0]
+        return {
+            "__fhe_ckks__": True,
+            "cts": acc,
+            "length": first["length"],
+            "scale": float(first["scale"]) * float(np.sum(wq)),
+            "template": first["template"],
+        }
 
     @classmethod
     def reset(cls) -> None:

@@ -27,6 +27,7 @@ def create_attacker(name: str, args: Any):
         label_flipping,
         lazy_worker,
         model_replacement,
+        revealing_labels,
     )
 
     key = name.strip().lower()

@@ -1,8 +1,14 @@
-"""Three-sigma outlier rejection on update-norm scores.
+"""Three-sigma outlier rejection over pluggable client scores.
 
-Parity: ``core/security/defense/three_sigma_defense.py`` (+ geomedian/krum
-scored variants): compute a per-client score, drop clients whose score is
-more than 3 sigma from the mean.
+Parity: ``core/security/defense/three_sigma_defense.py`` +
+``three_sigma_geomedian_defense.py`` + ``three_sigma_foolsgold_defense.py``:
+compute a per-client score, drop clients whose score is more than k·sigma
+beyond the mean. Scores:
+
+  geomedian — distance to the geometric median (magnitude outliers)
+  mean      — distance to the coordinate mean
+  foolsgold — max pairwise cosine similarity (sybil colluders, who are
+              suspiciously ALIGNED rather than far away)
 """
 from __future__ import annotations
 
@@ -20,10 +26,26 @@ Pytree = Any
 @register("3sigma")
 @register("three_sigma")
 class ThreeSigmaDefense(BaseDefense):
+    score_override = None
+
     def __init__(self, args: Any):
         super().__init__(args)
-        self.score = str(getattr(args, "three_sigma_score", "geomedian")).lower()
+        self.score = (self.score_override or
+                      str(getattr(args, "three_sigma_score", "geomedian"))).lower()
         self.k_sigma = float(getattr(args, "k_sigma", 3.0))
+
+    def _scores(self, vecs: jnp.ndarray, counts) -> jnp.ndarray:
+        if self.score == "foolsgold":
+            # sybil indicator: near-duplicate update directions ⇒ max
+            # cosine similarity to any other client spikes toward 1
+            normed = vecs / (jnp.linalg.norm(vecs, axis=1, keepdims=True) + 1e-12)
+            cs = normed @ normed.T - jnp.eye(vecs.shape[0])
+            return jnp.max(cs, axis=1)
+        if self.score == "geomedian":
+            center = geometric_median(vecs, counts)
+        else:
+            center = jnp.mean(vecs, axis=0)
+        return jnp.linalg.norm(vecs - center[None, :], axis=1)
 
     def defend_before_aggregation(
         self,
@@ -31,12 +53,22 @@ class ThreeSigmaDefense(BaseDefense):
         extra_auxiliary_info: Any = None,
     ) -> List[Tuple[int, Pytree]]:
         vecs, counts, _ = stack_updates(raw_client_grad_list)
-        if self.score == "geomedian":
-            center = geometric_median(vecs, counts)
-        else:
-            center = jnp.mean(vecs, axis=0)
-        scores = jnp.linalg.norm(vecs - center[None, :], axis=1)
+        scores = self._scores(vecs, counts)
         mu, sigma = jnp.mean(scores), jnp.std(scores) + 1e-12
         keep = scores <= mu + self.k_sigma * sigma
         kept = [raw_client_grad_list[i] for i in range(len(raw_client_grad_list)) if bool(keep[i])]
         return kept if kept else raw_client_grad_list
+
+
+@register("three_sigma_geomedian")
+class ThreeSigmaGeoMedianDefense(ThreeSigmaDefense):
+    """Parity: ``three_sigma_geomedian_defense.py``."""
+
+    score_override = "geomedian"
+
+
+@register("three_sigma_foolsgold")
+class ThreeSigmaFoolsGoldDefense(ThreeSigmaDefense):
+    """Parity: ``three_sigma_foolsgold_defense.py``."""
+
+    score_override = "foolsgold"

@@ -182,8 +182,6 @@ class DeviceClient:
 
     def __init__(self, args: Any, trainer: FedMLBaseTrainer):
         self.args = args
-        from fedml_tpu import constants
-
         backend = str(getattr(args, "comm_backend", None)
                       or getattr(args, "backend", "LOCAL"))
         rank = int(getattr(args, "rank", 1))

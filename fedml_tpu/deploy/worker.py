@@ -237,4 +237,6 @@ class DeployWorkerAgent(BrokerJsonAgent):
             time.sleep(0.5)
 
     def _publish(self, msg: Dict) -> None:
-        self.publish_json(f"deploy/{self.cluster}/master", msg)
+        # daemon side: raising in a heartbeat/handler thread would kill
+        # the loop; master deploy timeouts cover a lost result
+        self.publish_json(f"deploy/{self.cluster}/master", msg, best_effort=True)

@@ -123,10 +123,20 @@ class MasterAgent(BrokerJsonAgent):
             raise RuntimeError("no live nodes to schedule on")
         # expand nodes by their advertised slots (a slot = one rank; each
         # rank is its own JAX/XLA process, so slots bound oversubscription
-        # the way the deploy plane's --capacity does), interleaved so
-        # ranks spread across nodes before doubling up
-        remaining = {n: max(1, int(self.registry.get(n).get("slots", 1)))
-                     for n in targets}
+        # the way the deploy plane's --capacity does), deducting ranks
+        # still running from OTHER jobs, interleaved so ranks spread
+        # across nodes before doubling up
+        in_use: Dict[str, int] = {}
+        with self._lock:
+            for view in self.jobs.values():
+                for rid, node_id in view.ranks.items():
+                    if view.rank_status[rid] not in RunStatus.TERMINAL:
+                        in_use[node_id] = in_use.get(node_id, 0) + 1
+        remaining = {
+            n: max(0, max(1, int(self.registry.get(n).get("slots", 1)))
+                   - in_use.get(n, 0))
+            for n in targets
+        }
         slot_list: List[str] = []
         while any(remaining.values()):
             for node_id in targets:
@@ -215,8 +225,15 @@ class MasterAgent(BrokerJsonAgent):
                            returncode=None) -> None:
         for view in self.jobs.values():
             if run_id in view.rank_status:
-                if view.rank_status[run_id] not in RunStatus.TERMINAL:
+                current = view.rank_status[run_id]
+                if current not in RunStatus.TERMINAL:
                     view.rank_status[run_id] = status
+                    view.rank_rc[run_id] = returncode
+                elif (current == status and returncode is not None
+                      and view.rank_rc[run_id] is None):
+                    # heartbeat reconciliation may latch a terminal status
+                    # before the one-shot run_status carrying the rc lands;
+                    # accept the rc for the SAME status
                     view.rank_rc[run_id] = returncode
                 break
 

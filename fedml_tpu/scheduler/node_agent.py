@@ -120,4 +120,6 @@ class NodeAgent(BrokerJsonAgent):
             time.sleep(self._heartbeat_s)
 
     def _publish(self, msg: Dict) -> None:
-        self.publish_json(f"sched/{self.cluster}/master", msg)
+        # daemon side: raising in a heartbeat/handler thread would kill
+        # the loop; master timeouts + heartbeat reconciliation cover losses
+        self.publish_json(f"sched/{self.cluster}/master", msg, best_effort=True)

@@ -84,6 +84,15 @@ class FedAvgAPI:
         ``on_after_aggregation`` → ContributionAssessorManager)."""
         if self._contrib is None or not self._contrib.is_enabled():
             return
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            # w_locals are ciphertexts; Shapley re-aggregation over subsets
+            # would tree-average RLWE polynomials. The reference has no
+            # FHE+contribution path either — skip loudly.
+            logger.warning("contribution assessment skipped: client updates "
+                           "are FHE-encrypted")
+            return
         util = lambda params: self.aggregator.test(
             params, self.dataset.test_data_global, self.device, self.args
         ).get("test_acc", 0.0)
@@ -160,6 +169,15 @@ class FedAvgAPI:
         w_list, _ = self.aggregator.on_before_aggregation(w_locals)
         w_agg = self.aggregator.aggregate(w_list)
         w_agg = self.aggregator.on_after_aggregation(w_agg)
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+
+        fhe = FedMLFHE.get_instance()
+        if fhe.is_fhe_enabled():
+            # the simulation co-locates server and clients in one process,
+            # so decrypt here for the server-side FedOpt step and tests; in
+            # cross-silo the aggregate ships encrypted and the CLIENT hook
+            # decrypts (on_before_local_training)
+            w_agg = fhe.fhe_dec(w_agg)
         self._assess_contributions(client_ids, w_locals, round_idx)
         tau_eff = None
         if str(getattr(self.args, "federated_optimizer", "")) == "FedNova" and taus:
