@@ -48,6 +48,17 @@ def init(args: Optional[Arguments] = None, check_env: bool = True) -> Arguments:
     random.seed(seed)
     np.random.seed(seed)
 
+    # multi-host slice init must precede any backend use (parity: the
+    # reference's torchrun env parsing at __init__.py:353-360)
+    from fedml_tpu.parallel.multihost import maybe_initialize_multihost
+
+    maybe_initialize_multihost(args)
+    # per-silo override yamls (parity: _update_client_specific_args /
+    # hierarchical server/client_silo config paths)
+    from fedml_tpu.arguments import update_client_specific_args
+
+    update_client_specific_args(args)
+
     from fedml_tpu.core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
     from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
     from fedml_tpu.core.mlops import metrics as mlops_metrics
@@ -99,15 +110,29 @@ def run_cross_silo_client():
     return _run_cross_silo(constants.ROLE_CLIENT)
 
 
-def _run_cross_silo(role: str):
+def run_cross_cloud_server():
+    """Parity: ``_init_cross_cloud`` (ref ``__init__.py:392``) server role."""
+    return _run_cross_silo(constants.ROLE_SERVER,
+                           constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD)
+
+
+def run_cross_cloud_client():
+    return _run_cross_silo(constants.ROLE_CLIENT,
+                           constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD)
+
+
+def _run_cross_silo(role: str, training_type: Optional[str] = None):
     from fedml_tpu import data as data_mod
     from fedml_tpu import device as device_mod
     from fedml_tpu import models as models_mod
 
     global _global_training_type
-    _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+    _global_training_type = (training_type
+                             or constants.FEDML_TRAINING_PLATFORM_CROSS_SILO)
     args = load_arguments(_global_training_type, None)
     args.role = role
+    if training_type is not None:  # cross-cloud launcher overrides the yaml
+        args.training_type = training_type
     args = init(args)
     device = device_mod.get_device(args)
     dataset = data_mod.load_federated(args)
@@ -124,6 +149,8 @@ __all__ = [
     "load_arguments",
     "load_arguments_from_dict",
     "run_simulation",
+    "run_cross_cloud_client",
+    "run_cross_cloud_server",
     "run_cross_silo_client",
     "run_cross_silo_server",
 ]

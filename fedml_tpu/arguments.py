@@ -110,6 +110,45 @@ def load_arguments_from_dict(
     return args
 
 
+def update_client_specific_args(args: Arguments) -> None:
+    """Per-silo yaml overrides (parity: reference ``arguments.py:171-183``
+    hierarchical ``server_config_path``/``client_silo_config_paths`` and
+    ``__init__.py:187-211`` ``data_silo_config``).
+
+    ``data_silo_config`` lists one yaml per client silo; rank r > 0 loads
+    entry r-1 on top of the global config — the cross-cloud story, where
+    every silo brings its own transport/compute/data settings.
+    Relative paths resolve against the main yaml's directory.
+    """
+    rank = int(getattr(args, "rank", 0))
+
+    def _apply(path: str) -> None:
+        if not os.path.isabs(path):
+            base = os.path.dirname(
+                (getattr(args, "yaml_paths", None) or [""])[0])
+            path = os.path.join(base, path) if base else path
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        args.set_attr_from_config(cfg)
+
+    silo_cfgs = getattr(args, "data_silo_config", None)
+    if silo_cfgs:
+        args.worker_num = len(silo_cfgs)
+        if rank > 0:
+            if rank > len(silo_cfgs):
+                raise ValueError(
+                    f"rank {rank} but data_silo_config lists only "
+                    f"{len(silo_cfgs)} silos")
+            _apply(str(silo_cfgs[rank - 1]))
+    elif str(getattr(args, "scenario", "")) == "hierarchical":
+        if rank == 0 and getattr(args, "server_config_path", None):
+            _apply(str(args.server_config_path))
+        elif rank > 0 and getattr(args, "client_silo_config_paths", None):
+            paths = args.client_silo_config_paths
+            if rank <= len(paths):
+                _apply(str(paths[rank - 1]))
+
+
 def load_arguments_from_yaml_path(
     path: str, training_type: Optional[str] = None
 ) -> Arguments:

@@ -1,0 +1,47 @@
+"""Cross-cloud ("Cheetah") engine — multi-cloud federated training.
+
+Parity target: ``python/fedml/cross_cloud/`` (client/server managers,
+``__init__.py:392`` ``_init_cross_cloud``) — the reference's Cheetah runs
+the cross-silo horizontal protocol where each "silo" is a cloud GPU
+cluster. TPU-native re-design:
+
+- a silo = a cloud TPU slice. Each silo process initializes the
+  multi-host runtime for ITS slice (``parallel/multihost.py``, env
+  FEDML_COORDINATOR_ADDRESS/...), so local training shards over the
+  whole slice via the existing NamedSharding paths;
+- federation across clouds rides whichever transport each silo can
+  reach (broker over TCP/DCN, gRPC), with **per-silo overrides** from
+  ``data_silo_config`` yamls (``arguments.update_client_specific_args``)
+  — each cloud brings its own broker address, batch size, data paths;
+- the round FSM is exactly the cross-silo one: the protocol does not
+  change because the silos live in different clouds, only the transport
+  configuration and the compute inside each silo do.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu.cross_silo.client.client import Client
+from fedml_tpu.cross_silo.server.server import Server
+
+
+class CloudServer(Server):
+    """Cross-cloud aggregation server (cross-silo FSM; cloud silos)."""
+
+
+class CloudClient(Client):
+    """One cloud silo: multi-host slice compute + federation transport.
+
+    ``fedml_tpu.init`` has already applied this silo's override yaml and
+    initialized the slice runtime by the time this constructor runs; the
+    Client base then builds the trainer adapter (sharded over every
+    device the runtime exposes) and the wire manager from the
+    (overridden) transport args.
+    """
+
+    def __init__(self, args: Any, device: Any, dataset: Any, model: Any,
+                 client_trainer=None):
+        super().__init__(args, device, dataset, model, client_trainer)
+
+
+__all__ = ["CloudClient", "CloudServer"]

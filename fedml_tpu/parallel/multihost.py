@@ -1,0 +1,82 @@
+"""Multi-host JAX runtime initialization (the DCN story).
+
+Parity target: the reference's hierarchical cross-silo init parses the
+torchrun environment to size a silo's DDP process group
+(``python/fedml/__init__.py:353-360`` reading WORLD_SIZE/LOCAL_RANK/RANK).
+The TPU-native equivalent of "DDP inside a silo" is "a silo IS a
+multi-host TPU slice": each host process calls
+``jax.distributed.initialize`` against the slice coordinator, after
+which ``jax.devices()`` spans the whole slice and the existing
+NamedSharding/pjit paths (FSDP×TP×SP in train/llm, silo data sharding in
+TrainerDistAdapter) scale across hosts with NO code changes — XLA routes
+collectives over ICI within a host-block and DCN between them.
+
+Environment (mirrors the torchrun triplet; JAX-standard names also work):
+
+  FEDML_COORDINATOR_ADDRESS  host:port of process 0   (or args.coordinator_address)
+  FEDML_NUM_PROCESSES        world size               (or args.num_processes)
+  FEDML_PROCESS_ID           this host's rank         (or args.process_id)
+
+On TPU pods with the cloud metadata server present, plain
+``jax.distributed.initialize()`` auto-discovers everything — set only
+FEDML_MULTIHOST=auto for that.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def multihost_config(args: Any = None) -> Optional[dict]:
+    """Resolve the multi-host triplet from env/args; None = single host."""
+    def pick(env: str, attr: str):
+        v = os.environ.get(env)
+        if v is None and args is not None:
+            v = getattr(args, attr, None)
+        return v
+
+    if str(os.environ.get("FEDML_MULTIHOST", "")).lower() == "auto":
+        return {"auto": True}
+    coord = pick("FEDML_COORDINATOR_ADDRESS", "coordinator_address")
+    nproc = pick("FEDML_NUM_PROCESSES", "num_processes")
+    pid = pick("FEDML_PROCESS_ID", "process_id")
+    if coord is None or nproc is None:
+        return None
+    return {
+        "coordinator_address": str(coord),
+        "num_processes": int(nproc),
+        "process_id": int(pid or 0),
+    }
+
+
+def maybe_initialize_multihost(args: Any = None) -> bool:
+    """Call ``jax.distributed.initialize`` when configured; idempotent.
+
+    Returns True when running (or already running) multi-host.
+    """
+    global _initialized
+    cfg = multihost_config(args)
+    if cfg is None:
+        return False
+    import jax
+
+    if _initialized:
+        return True
+    if cfg.get("auto"):
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(
+            coordinator_address=cfg["coordinator_address"],
+            num_processes=cfg["num_processes"],
+            process_id=cfg["process_id"],
+        )
+    _initialized = True
+    logger.info(
+        "multi-host JAX up: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()))
+    return True

@@ -33,13 +33,20 @@ class FedMLRunner:
             constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD,
         ):
             role = str(getattr(args, "role", constants.ROLE_CLIENT))
-            if role == constants.ROLE_SERVER or int(getattr(args, "rank", 0)) == 0:
+            is_server = (role == constants.ROLE_SERVER
+                         or int(getattr(args, "rank", 0)) == 0)
+            if tt == constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD:
+                from fedml_tpu.cross_cloud import CloudClient, CloudServer
+
+                cls = CloudServer if is_server else CloudClient
+            else:
+                from fedml_tpu.cross_silo.client.client import Client
                 from fedml_tpu.cross_silo.server.server import Server
 
-                return Server(args, device, dataset, model, server_aggregator)
-            from fedml_tpu.cross_silo.client.client import Client
-
-            return Client(args, device, dataset, model, client_trainer)
+                cls = Server if is_server else Client
+            if is_server:
+                return cls(args, device, dataset, model, server_aggregator)
+            return cls(args, device, dataset, model, client_trainer)
         if tt == constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
             from fedml_tpu.cross_device.server import ServerCrossDevice
 
