@@ -157,6 +157,23 @@ def load_synthetic(args: Any) -> FederatedDataset:
     return _partition_and_pack(args, xtr, ytr, xte, yte, class_num)
 
 
+@register_dataset("synthetic_image")
+def load_synthetic_image(args: Any) -> FederatedDataset:
+    """Class-clustered synthetic images at a configurable size — the
+    CPU-friendly stand-in for CV-model tests (image_size=8 keeps conv
+    stacks fast where a 28x28 input buys nothing)."""
+    class_num = int(getattr(args, "class_num", 10))
+    size = int(getattr(args, "image_size", 8))
+    channels = int(getattr(args, "image_channels", 1))
+    n_train = int(getattr(args, "train_size", 256))
+    n_test = int(getattr(args, "test_size", 64))
+    seed = int(getattr(args, "random_seed", 0))
+    xtr, ytr, xte, yte = _make_classification_arrays(
+        n_train, n_test, (size, size, channels), class_num, seed
+    )
+    return _partition_and_pack(args, xtr, ytr, xte, yte, class_num)
+
+
 @register_dataset("mnist")
 def load_mnist(args: Any) -> FederatedDataset:
     """MNIST: real ``mnist.npz`` if cached locally, else synthetic 28×28."""

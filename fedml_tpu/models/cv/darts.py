@@ -76,7 +76,7 @@ class DARTSNetwork(nn.Module):
     @nn.compact
     def __call__(self, x):
         h = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False)(x)
-        h = nn.GroupNorm(num_groups=8)(h)
+        h = nn.GroupNorm(num_groups=min(8, self.channels))(h)
         for i in range(self.n_cells):
             h = DARTSCell(self.channels, name=f"cell_{i}")(h)
             h = nn.Conv(self.channels, (1, 1), use_bias=False)(h)  # re-project

@@ -14,6 +14,7 @@ class Generator(nn.Module):
     out_dim: int
     latent_dim: int = 32
     hidden: int = 128
+    bounded: bool = False  # tanh output for [-1,1]-scaled image data
 
     @nn.compact
     def __call__(self, z):
@@ -21,7 +22,8 @@ class Generator(nn.Module):
         h = nn.leaky_relu(h, 0.2)
         h = nn.Dense(self.hidden)(h)
         h = nn.leaky_relu(h, 0.2)
-        return nn.tanh(nn.Dense(self.out_dim)(h))
+        out = nn.Dense(self.out_dim)(h)
+        return nn.tanh(out) if self.bounded else out
 
 
 class Discriminator(nn.Module):

@@ -58,11 +58,27 @@ def create_simulator(args: Any, device, dataset, model,
     backend = str(getattr(args, "backend", constants.FEDML_SIMULATION_TYPE_SP))
     # algorithm-shaped engines (reference: one sp/ directory per algorithm)
     fed_opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
-    if fed_opt in ("hierarchical_fl", "hierarchicalfl", "turbo_aggregate",
-                   "turboaggregate"):
+    if fed_opt in ("hierarchical_fl", "hierarchicalfl"):
         from fedml_tpu.simulation.hierarchical import HierarchicalFedAvgAPI
 
         return _APIRunner(HierarchicalFedAvgAPI(args, device, dataset, model))
+    if fed_opt in ("turbo_aggregate", "turboaggregate"):
+        from fedml_tpu.simulation.sp.turboaggregate import TurboAggregateAPI
+
+        return _APIRunner(TurboAggregateAPI(
+            args, device, dataset, model, client_trainer, server_aggregator))
+    if fed_opt == "fedgkt":
+        from fedml_tpu.simulation.sp.fedgkt import FedGKTAPI
+
+        return _APIRunner(FedGKTAPI(args, device, dataset, model))
+    if fed_opt == "fednas":
+        from fedml_tpu.simulation.sp.fednas import FedNASAPI
+
+        return _APIRunner(FedNASAPI(args, device, dataset, model))
+    if fed_opt == "fedgan":
+        from fedml_tpu.simulation.sp.fedgan import FedGANAPI
+
+        return _APIRunner(FedGANAPI(args, device, dataset, model))
     if fed_opt in ("vertical_fl", "vfl", "classical_vertical"):
         from fedml_tpu.simulation.vfl import VerticalFedAPI
 

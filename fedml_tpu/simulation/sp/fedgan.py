@@ -1,0 +1,139 @@
+"""FedGAN — federated generative adversarial training.
+
+Parity target: ``simulation/mpi/fedgan/`` (per-client GAN steps, server
+averages generator+discriminator each round; Rasouli et al.). TPU-native
+re-design: one jitted program runs the client's alternating D/G
+minibatch steps under ``lax.scan``; the federated exchange is the
+ordinary count-weighted pytree average of BOTH nets.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.gan.gan import Discriminator, Generator
+
+logger = logging.getLogger(__name__)
+
+
+def _bce_logits(logits, target_ones: bool):
+    if target_ones:
+        return jnp.mean(jax.nn.softplus(-logits))
+    return jnp.mean(jax.nn.softplus(logits))
+
+
+class FedGANAPI:
+    def __init__(self, args: Any, device, dataset, model=None):
+        self.args = args
+        self.dataset = dataset
+        self.n_clients = int(getattr(args, "client_num_in_total", 2))
+        self.rounds = int(getattr(args, "comm_round", 3))
+        self.steps = int(getattr(args, "gan_local_steps", 50))
+        self.batch = int(getattr(args, "batch_size", 32))
+        self.latent = int(getattr(args, "gan_latent_dim", 16))
+        lr = float(getattr(args, "gan_learning_rate",
+                           getattr(args, "learning_rate", 2e-3)))
+
+        x0 = np.asarray(dataset.train_data_local_dict[0][0])
+        self.data_dim = int(np.prod(x0.shape[1:]))
+        self.gen = Generator(self.data_dim, latent_dim=self.latent)
+        self.disc = Discriminator()
+        key = jax.random.key(int(getattr(args, "random_seed", 0)))
+        kg, kd = jax.random.split(key)
+        self.g_params = self.gen.init(kg, jnp.zeros((2, self.latent)))
+        self.d_params = self.disc.init(kd, jnp.zeros((2, self.data_dim)))
+        self.g_opt = optax.adam(lr, b1=0.5)
+        self.d_opt = optax.adam(lr, b1=0.5)
+        self._build_step()
+
+    def _build_step(self):
+        gen, disc = self.gen, self.disc
+        latent, batch = self.latent, self.batch
+
+        def d_loss(dp, gp, x_real, key):
+            z = jax.random.normal(key, (batch, latent))
+            x_fake = gen.apply(gp, z)
+            return (_bce_logits(disc.apply(dp, x_real), True)
+                    + _bce_logits(disc.apply(dp, x_fake), False))
+
+        def g_loss(gp, dp, key):
+            z = jax.random.normal(key, (batch, latent))
+            return _bce_logits(disc.apply(dp, gen.apply(gp, z)), True)
+
+        def local_run(gp, dp, data, key):
+            g_state = self.g_opt.init(gp)
+            d_state = self.d_opt.init(dp)
+
+            def step(carry, key):
+                gp, dp, g_state, d_state = carry
+                kd_, kb, kg_ = jax.random.split(key, 3)
+                idx = jax.random.randint(kb, (batch,), 0, data.shape[0])
+                x_real = data[idx]
+                dl, dg = jax.value_and_grad(d_loss)(dp, gp, x_real, kd_)
+                du, d_state = self.d_opt.update(dg, d_state)
+                dp = optax.apply_updates(dp, du)
+                gl, gg = jax.value_and_grad(g_loss)(gp, dp, kg_)
+                gu, g_state = self.g_opt.update(gg, g_state)
+                gp = optax.apply_updates(gp, gu)
+                return (gp, dp, g_state, d_state), (dl, gl)
+
+            keys = jax.random.split(key, self.steps)
+            (gp, dp, _, _), (dls, gls) = jax.lax.scan(
+                step, (gp, dp, g_state, d_state), keys)
+            return gp, dp, dls.mean(), gls.mean()
+
+        self._local_run = jax.jit(local_run)
+        self._sample = jax.jit(
+            lambda gp, key, n: gen.apply(gp, jax.random.normal(
+                key, (n, latent))),
+            static_argnums=2)
+
+    # -- round -------------------------------------------------------------
+    def train(self) -> dict:
+        t0 = time.time()
+        key = jax.random.key(int(getattr(self.args, "random_seed", 0)) + 1)
+        history = []
+        for rnd in range(self.rounds):
+            gs, ds, weights = [], [], []
+            for c in range(self.n_clients):
+                x = np.asarray(self.dataset.train_data_local_dict[c][0])
+                data = jnp.asarray(x.reshape(x.shape[0], -1), jnp.float32)
+                key, sub = jax.random.split(key)
+                gp, dp, dl, gl = self._local_run(
+                    self.g_params, self.d_params, data, sub)
+                gs.append(gp)
+                ds.append(dp)
+                weights.append(float(x.shape[0]))
+            total = sum(weights)
+            avg = lambda trees: jax.tree.map(
+                lambda *xs: sum(w * x for w, x in zip(weights, xs)) / total,
+                *trees)
+            self.g_params = avg(gs)
+            self.d_params = avg(ds)
+            metrics = self.evaluate()
+            metrics.update(round=rnd, d_loss=float(dl), g_loss=float(gl))
+            history.append(metrics)
+            logger.info("FedGAN round %d: %s", rnd, metrics)
+        final = history[-1] if history else {}
+        return {"wall_clock_sec": time.time() - t0, "rounds": self.rounds,
+                "history": history, **final}
+
+    def evaluate(self, n: int = 512) -> dict:
+        """Distribution match: distance between generated and real moments
+        (the behavioral metric the tests track across rounds)."""
+        key = jax.random.key(1234)
+        samples = np.asarray(self._sample(self.g_params, key, n))
+        real = np.concatenate([
+            np.asarray(self.dataset.train_data_local_dict[c][0]).reshape(
+                len(self.dataset.train_data_local_dict[c][0]), -1)
+            for c in range(self.n_clients)
+        ])
+        mean_gap = float(np.linalg.norm(samples.mean(0) - real.mean(0)))
+        std_gap = float(np.linalg.norm(samples.std(0) - real.std(0)))
+        return {"moment_gap": mean_gap + std_gap}

@@ -1,0 +1,163 @@
+"""FedNAS — federated neural architecture search (He et al.).
+
+Parity target: ``simulation/mpi/fednas/`` + ``model/cv/darts/architect.py``
++ ``genotypes.py``: each client alternates a WEIGHT step (train split)
+with an ARCHITECT step (first-order DARTS: architecture parameters
+updated on the validation split); the server federated-averages both.
+After search, the mixed-op cell is discretized into a genotype (argmax
+op per edge, top-2 edges per node).
+
+TPU-native re-design: the DARTS network keeps its alphas inside the
+params pytree (``models/cv/darts.py``), so the bi-level step is two
+jitted gradient programs over complementary param masks — no optimizer
+surgery, and the federated exchange is the ordinary pytree average.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.cv.darts import OPS, DARTSNetwork
+
+logger = logging.getLogger(__name__)
+
+
+def _alpha_mask(params) -> Any:
+    """Pytree mask: True on architecture params ('alphas'), False on
+    weights — the bi-level split."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def is_alpha(path):
+        return any(getattr(k, "key", None) == "alphas" for k in path)
+
+    treedef = jax.tree.structure(params)
+    return jax.tree.unflatten(treedef,
+                              [is_alpha(path) for path, _ in flat])
+
+
+class FedNASAPI:
+    def __init__(self, args: Any, device, dataset, model=None):
+        self.args = args
+        self.dataset = dataset
+        self.n_clients = int(getattr(args, "client_num_in_total", 2))
+        self.rounds = int(getattr(args, "comm_round", 2))
+        self.epochs = int(getattr(args, "epochs", 1))
+        w_lr = float(getattr(args, "learning_rate", 0.05))
+        a_lr = float(getattr(args, "arch_learning_rate", 3e-2))
+
+        self.model = model if isinstance(model, DARTSNetwork) else DARTSNetwork(
+            output_dim=dataset.class_num,
+            channels=int(getattr(args, "nas_channels", 8)),
+            n_cells=int(getattr(args, "nas_cells", 1)),
+        )
+        key = jax.random.key(int(getattr(args, "random_seed", 0)))
+        sample_x = jnp.asarray(
+            np.asarray(dataset.train_data_local_dict[0][0][:2]))
+        self.global_params = self.model.init(key, sample_x)
+        mask = _alpha_mask(self.global_params)
+        # two disjoint optimizers over one pytree: weights ↔ alphas
+        # (global-norm clip keeps the momentum step stable on the mixed-op
+        # landscape — unclipped DARTS weight steps diverge readily)
+        self.w_opt = optax.masked(
+            optax.chain(optax.clip_by_global_norm(5.0),
+                        optax.sgd(w_lr, momentum=0.9)),
+            jax.tree.map(lambda m: not m, mask))
+        self.a_opt = optax.masked(
+            optax.chain(optax.clip_by_global_norm(5.0), optax.adam(a_lr)),
+            mask)
+        self._build_steps()
+
+    def _build_steps(self):
+        apply_fn = self.model.apply
+
+        def loss_fn(p, x, y):
+            logits = apply_fn(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        def step(opt):
+            def _s(p, opt_state, x, y):
+                loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+                updates, opt_state = opt.update(g, opt_state, p)
+                return optax.apply_updates(p, updates), opt_state, loss
+            return jax.jit(_s)
+
+        self._w_step = step(self.w_opt)
+        self._a_step = step(self.a_opt)
+        self._loss = jax.jit(loss_fn)
+
+    # -- round -------------------------------------------------------------
+    def train(self) -> dict:
+        t0 = time.time()
+        history = []
+        for rnd in range(self.rounds):
+            new_params, weights = [], []
+            for c in range(self.n_clients):
+                x, y = self.dataset.train_data_local_dict[c]
+                x = jnp.asarray(np.asarray(x))
+                y = jnp.asarray(np.asarray(y))
+                # bi-level split of the LOCAL data: first half trains
+                # weights, second half is the validation split that
+                # drives the architect step (first-order DARTS)
+                half = max(1, x.shape[0] // 2)
+                xt, yt, xv, yv = x[:half], y[:half], x[half:], y[half:]
+                if xv.shape[0] == 0:
+                    xv, yv = xt, yt
+                p = self.global_params
+                w_state = self.w_opt.init(p)
+                a_state = self.a_opt.init(p)
+                for _ in range(self.epochs):
+                    # architect step on validation, then weight step
+                    p, a_state, _ = self._a_step(p, a_state, xv, yv)
+                    p, w_state, _ = self._w_step(p, w_state, xt, yt)
+                new_params.append(p)
+                weights.append(float(len(y)))
+            total = sum(weights)
+            self.global_params = jax.tree.map(
+                lambda *xs: sum(w * x for w, x in zip(weights, xs)) / total,
+                *new_params)
+            metrics = self.evaluate()
+            metrics["round"] = rnd
+            history.append(metrics)
+            logger.info("FedNAS round %d: %s", rnd, metrics)
+        final = history[-1] if history else {}
+        return {"wall_clock_sec": time.time() - t0, "rounds": self.rounds,
+                "genotype": self.derive_genotype(), "history": history,
+                **final}
+
+    def evaluate(self) -> dict:
+        x, y = self.dataset.test_data_global
+        logits = self.model.apply(self.global_params,
+                                  jnp.asarray(np.asarray(x)))
+        acc = float((np.asarray(logits).argmax(-1) == np.asarray(y)).mean())
+        return {"test_acc": acc}
+
+    # -- genotype derivation (ref model/cv/darts/genotypes.py) -------------
+    def alphas(self) -> Dict[str, np.ndarray]:
+        out = {}
+        flat = jax.tree_util.tree_flatten_with_path(self.global_params)[0]
+        for path, leaf in flat:
+            keys = [getattr(k, "key", str(k)) for k in path]
+            if "alphas" in keys:
+                cell = next((k for k in keys if str(k).startswith("cell")),
+                            "cell_0")
+                out[str(cell)] = np.asarray(leaf)
+        return out
+
+    def derive_genotype(self) -> Dict[str, List[str]]:
+        """Discretize: per edge, the argmax non-zero op."""
+        genotype = {}
+        for cell, alpha in self.alphas().items():
+            ops = []
+            for e in range(alpha.shape[0]):
+                ranked = np.argsort(-alpha[e])
+                best = next(int(i) for i in ranked if OPS[i] != "zero")
+                ops.append(OPS[best])
+            genotype[cell] = ops
+        return genotype

@@ -1,0 +1,109 @@
+"""TurboAggregate — masked multi-group ring aggregation.
+
+Parity target: ``simulation/sp/turboaggregate/`` (TA_trainer.py +
+mpc_function.py). The reference ships the Lagrange-coding utilities and a
+FedAvg loop whose ``TA_topology_vanilla`` is an empty stub; this module
+implements the actual Turbo-Aggregate shape (So et al., "Breaking the
+Quadratic Aggregation Barrier"): clients are partitioned into L groups
+arranged in a ring, each group adds its (count-weighted, quantized)
+updates PLUS a fresh group mask and strips the previous group's mask, so
+every inter-group message is masked while the masks telescope away in
+the final unmasking. Group mask seeds are Shamir-shared inside the group
+(threshold = majority), so any group member dropping does not lose the
+mask — reconstruction needs only a quorum of its peers.
+
+The whole ring is simulated in-process (this is the sp engine), but the
+protocol artifacts — masked partials, per-group seed shares — are kept
+on the API object so tests can assert the privacy and dropout-recovery
+properties rather than just the arithmetic.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.mpc.finite import (
+    DEFAULT_PRIME,
+    finite_to_tree,
+    mulmod,
+    tree_to_finite,
+)
+from fedml_tpu.core.mpc.secagg import prg_mask, shamir_reconstruct, shamir_share
+from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+Pytree = Any
+
+
+class TurboAggregateAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model,
+                 client_trainer=None, server_aggregator=None):
+        super().__init__(args, device, dataset, model,
+                         client_trainer, server_aggregator)
+        self.n_groups = int(getattr(args, "ta_num_groups", 3))
+        self.q_bits = int(getattr(args, "ta_q_bits", 16))
+        self.p = int(getattr(args, "ta_prime", DEFAULT_PRIME))
+        self._rng = np.random.default_rng(
+            int(getattr(args, "random_seed", 0)) + 7717)
+        # protocol artifacts exposed for tests
+        self.last_masked_partials: List[np.ndarray] = []
+        self.last_groups: List[List[int]] = []
+        self.last_seed_shares: List[np.ndarray] = []
+        # the ring protocol replaces plain aggregation (the hook chain's
+        # before/after stages — DP, defenses — still run around it)
+        self.aggregator.aggregate = self.turbo_aggregate
+
+    # -- the ring protocol -------------------------------------------------
+    def turbo_aggregate(self, w_list: List[Tuple[int, Pytree]]) -> Pytree:
+        n = len(w_list)
+        L = max(1, min(self.n_groups, n))
+        groups = [[i for i in range(n) if i % L == g] for g in range(L)]
+        self.last_groups = groups
+
+        template = w_list[0][1]
+        finite = []
+        for n_k, tree in w_list:
+            vec, _ = tree_to_finite(tree, self.q_bits, self.p)
+            finite.append(mulmod(vec, np.int64(int(n_k)), self.p))
+        dim = finite[0].shape[0]
+
+        # per-group mask seed, Shamir-shared among the group (any majority
+        # of the group can reconstruct — the dropout story)
+        seeds = [int(self._rng.integers(1, self.p)) for _ in range(L)]
+        self.last_seed_shares = []
+        for g, group in enumerate(groups):
+            n_holders = max(2, len(group))
+            thresh = max(1, n_holders // 2)
+            self.last_seed_shares.append(
+                shamir_share(np.array([seeds[g]], np.int64), n_holders,
+                             thresh, self.p))
+
+        # ring pass: s_l = s_{l-1} + Σ_{i∈group l} x_i + m_l − m_{l-1}
+        self.last_masked_partials = []
+        s = np.zeros(dim, np.int64)
+        prev_mask = np.zeros(dim, np.int64)
+        for g, group in enumerate(groups):
+            group_sum = np.zeros(dim, np.int64)
+            for i in group:
+                group_sum = np.mod(group_sum + finite[i], self.p)
+            mask = prg_mask(seeds[g], dim, self.p)
+            s = np.mod(s + group_sum + mask - prev_mask, self.p)
+            self.last_masked_partials.append(s.copy())
+            prev_mask = mask
+
+        # final unmask: reconstruct the LAST group's seed from a share
+        # quorum (exercising the recovery path every round)
+        last = L - 1
+        shares = self.last_seed_shares[last]
+        thresh = max(1, max(2, len(groups[last])) // 2)
+        # degree-t polynomial ⇒ t+1 shares reconstruct
+        seed_rec = int(shamir_reconstruct(
+            shares[: thresh + 1], list(range(1, thresh + 2)), self.p)[0])
+        total = np.mod(s - prg_mask(seed_rec, dim, self.p), self.p)
+
+        total_samples = float(sum(int(n_k) for n_k, _ in w_list))
+        summed = finite_to_tree(total, template, self.q_bits, self.p,
+                                n_summands=n)
+        import jax
+
+        return jax.tree.map(lambda x: x / total_samples, summed)
