@@ -387,10 +387,14 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
     engine = ContinuousBatchingEngine(
         model, params, batch_slots=batch_slots, max_len=max_len
     )
+    from fedml_tpu.serving.openai_protocol import OpenAIServing
+
     runner = FedMLInferenceRunner(
-        LlamaPredictor(engine), host=host, port=port
+        LlamaPredictor(engine), host=host, port=port,
+        openai=OpenAIServing(engine, model_name=model_size),
     )
-    click.echo(f"serving {model_size} on http://{host}:{runner.port}")
+    click.echo(f"serving {model_size} on http://{host}:{runner.port} "
+               f"(/predict + /v1/completions + /v1/chat/completions)")
     runner.run()
 
 

@@ -60,7 +60,16 @@ def main(argv=None) -> None:
     ap.add_argument("--port", type=int, required=True)
     args = ap.parse_args(argv)
     predictor = build_predictor(os.path.abspath(args.package))
-    runner = FedMLInferenceRunner(predictor, host=args.host, port=args.port)
+    # LLM predictors also get the OpenAI-compatible surface, which the
+    # gateway's /inference/{id}/chat/completions route forwards to
+    openai = None
+    engine = getattr(predictor, "engine", None)
+    if engine is not None:
+        from fedml_tpu.serving.openai_protocol import OpenAIServing
+
+        openai = OpenAIServing(engine)
+    runner = FedMLInferenceRunner(predictor, host=args.host, port=args.port,
+                                  openai=openai)
     runner.run()
 
 

@@ -9,6 +9,13 @@ runner is a stdlib ``ThreadingHTTPServer`` with the same endpoint contract:
                   delimited JSON chunks (chunked transfer encoding)
   GET  /ready     {"ready": bool} — liveness for the deploy plane
 
+When constructed with ``openai=OpenAIServing(...)`` the runner also
+exposes the OpenAI-compatible surface (parity:
+``templates/hf_template/src/main_openai.py``):
+
+  POST /v1/completions        text completion (JSON or SSE stream)
+  POST /v1/chat/completions   chat completion (JSON or SSE stream)
+
 Every request is recorded in the EndpointMonitor (latency, errors), which
 mirrors the reference's endpoint monitoring into the local metrics sink.
 """
@@ -31,9 +38,11 @@ class FedMLInferenceRunner:
         host: str = "127.0.0.1",
         port: int = 0,
         monitor: Optional[EndpointMonitor] = None,
+        openai=None,
     ):
         self.predictor = predictor
         self.monitor = monitor or EndpointMonitor()
+        self.openai = openai  # OpenAIServing adapter (optional)
         runner = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,7 +69,9 @@ class FedMLInferenceRunner:
                     self.send_error(404)
 
             def do_POST(self):
-                if self.path.rstrip("/") != "/predict":
+                path = self.path.rstrip("/")
+                is_openai = runner.openai is not None and path.startswith("/v1/")
+                if path != "/predict" and not is_openai:
                     self.send_error(404)
                     return
                 t0 = time.time()
@@ -68,7 +79,32 @@ class FedMLInferenceRunner:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(n) or b"{}")
-                    result = runner.predictor.predict(request)
+                    if is_openai:
+                        result = runner.openai.handle(path, request)
+                        from fedml_tpu.serving.openai_protocol import SSEStream
+
+                        if isinstance(result, SSEStream):
+                            self.send_response(200)
+                            self.send_header("Content-Type",
+                                             "text/event-stream")
+                            self.send_header("Cache-Control", "no-cache")
+                            self.send_header("Transfer-Encoding", "chunked")
+                            self.end_headers()
+                            for event in result.events:
+                                data = (
+                                    "data: " + json.dumps(event) + "\n\n"
+                                ).encode()
+                                self.wfile.write(
+                                    f"{len(data):x}\r\n".encode() + data
+                                    + b"\r\n")
+                            done = b"data: [DONE]\n\n"
+                            self.wfile.write(
+                                f"{len(done):x}\r\n".encode() + done
+                                + b"\r\n")
+                            self.wfile.write(b"0\r\n\r\n")
+                            return
+                    else:
+                        result = runner.predictor.predict(request)
                     if hasattr(result, "__next__"):  # streaming
                         self.send_response(200)
                         self.send_header(

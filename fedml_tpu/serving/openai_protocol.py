@@ -1,0 +1,164 @@
+"""OpenAI-compatible serving protocol over the continuous-batching engine.
+
+Parity target: ``serving/templates/hf_template/src/protocol/openai.py`` +
+``main_openai.py`` in the reference (the de-facto client contract for an
+LLM endpoint): ``/v1/completions`` and ``/v1/chat/completions``, JSON
+responses shaped like the OpenAI API, and SSE streaming
+(``data: {chunk}\\n\\n`` frames ending with ``data: [DONE]``).
+
+The engine is tokenizer-agnostic; callers plug any ``encode/decode`` pair
+(the deployed model's real tokenizer in production). ``ByteTokenizer``
+is the dependency-free default: UTF-8 bytes shifted past the special ids,
+reversible for any text, usable with any vocab ≥ 259.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+
+
+class SSEStream:
+    """Marker the HTTP runner turns into a text/event-stream response."""
+
+    def __init__(self, events: Iterator[Any]):
+        self.events = events  # dicts; the runner adds the `data:` framing
+
+
+class ByteTokenizer:
+    """Reversible text↔ids with zero vocabulary assets.
+
+    ids 0..2 are pad/bos/eos; byte b maps to 3 + b.
+    """
+
+    bos_id = 1
+    eos_id = 2
+    vocab_size = 259
+
+    def encode(self, text: str) -> List[int]:
+        return [self.bos_id] + [3 + b for b in text.encode("utf-8")]
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i - 3 for i in ids if 3 <= i < 259)
+        return data.decode("utf-8", errors="replace")
+
+
+class OpenAIServing:
+    """Protocol adapter: OpenAI request dicts → engine calls → OpenAI
+    response dicts / SSE chunk streams."""
+
+    def __init__(self, engine: ContinuousBatchingEngine,
+                 tokenizer: Any = None, model_name: str = "fedml-tpu-llm",
+                 max_tokens_cap: Optional[int] = None):
+        self.engine = engine
+        self.tok = tokenizer or ByteTokenizer()
+        self.model_name = model_name
+        self.max_tokens_cap = max_tokens_cap
+        engine.start()
+
+    # -- routing -----------------------------------------------------------
+    def handle(self, path: str, request: Dict) -> Any:
+        path = path.rstrip("/")
+        if path.endswith("/chat/completions"):
+            return self.chat_completions(request)
+        if path.endswith("/completions"):
+            return self.completions(request)
+        raise ValueError(f"unknown OpenAI route {path!r}")
+
+    # -- /v1/completions ---------------------------------------------------
+    def completions(self, request: Dict) -> Any:
+        prompt = request.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        return self._run(str(prompt), request, chat=False)
+
+    # -- /v1/chat/completions ----------------------------------------------
+    def chat_completions(self, request: Dict) -> Any:
+        messages = request.get("messages") or []
+        prompt = self._apply_chat_template(messages)
+        return self._run(prompt, request, chat=True)
+
+    @staticmethod
+    def _apply_chat_template(messages: List[Dict]) -> str:
+        # the hf_template's minimal chat format: role-tagged turns + the
+        # assistant cue (a deployed model card can override the tokenizer
+        # AND this template together)
+        parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+                 for m in messages]
+        parts.append("assistant:")
+        return "\n".join(parts)
+
+    # -- core --------------------------------------------------------------
+    def _gen_params(self, request: Dict):
+        max_tokens = int(request.get("max_tokens", 16))
+        if self.max_tokens_cap:
+            max_tokens = min(max_tokens, self.max_tokens_cap)
+        temperature = float(request.get("temperature", 0.0))
+        seed = int(request.get("seed", 0))
+        return max_tokens, temperature, seed
+
+    def _run(self, prompt: str, request: Dict, chat: bool) -> Any:
+        max_tokens, temperature, seed = self._gen_params(request)
+        prompt_ids = self.tok.encode(prompt)
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        created = int(time.time())
+        obj = "chat.completion" if chat else "text_completion"
+
+        if request.get("stream"):
+            q = self.engine.submit(prompt_ids, max_tokens, temperature,
+                                   seed, eos_id=self.tok.eos_id)
+
+            def events():
+                if chat:  # role preamble chunk, as the OpenAI API sends
+                    yield self._chunk(rid, created, {"role": "assistant"},
+                                      None)
+                while True:
+                    tok = q.get()
+                    if tok is None or tok == self.tok.eos_id:
+                        if chat:
+                            yield self._chunk(rid, created, {}, "stop")
+                        else:
+                            yield self._text_chunk(rid, created, "", "stop")
+                        return
+                    piece = self.tok.decode([tok])
+                    if chat:
+                        yield self._chunk(rid, created, {"content": piece},
+                                          None)
+                    else:
+                        yield self._text_chunk(rid, created, piece, None)
+
+            return SSEStream(events())
+
+        out_ids = self.engine.generate(prompt_ids, max_tokens, temperature,
+                                       seed, eos_id=self.tok.eos_id)
+        text = self.tok.decode(out_ids)
+        finish = "stop" if (out_ids and out_ids[-1] == self.tok.eos_id) \
+            else "length"
+        usage = {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(out_ids),
+            "total_tokens": len(prompt_ids) + len(out_ids),
+        }
+        if chat:
+            choice = {"index": 0, "finish_reason": finish,
+                      "message": {"role": "assistant", "content": text}}
+        else:
+            choice = {"index": 0, "finish_reason": finish, "text": text,
+                      "logprobs": None}
+        return {"id": rid, "object": obj, "created": created,
+                "model": request.get("model", self.model_name),
+                "choices": [choice], "usage": usage}
+
+    def _chunk(self, rid, created, delta, finish) -> Dict:
+        return {"id": rid, "object": "chat.completion.chunk",
+                "created": created, "model": self.model_name,
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}]}
+
+    def _text_chunk(self, rid, created, text, finish) -> Dict:
+        return {"id": rid, "object": "text_completion", "created": created,
+                "model": self.model_name,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish, "logprobs": None}]}

@@ -1,0 +1,115 @@
+"""OpenAI-compatible endpoint over the continuous-batching engine."""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+from fedml_tpu.serving import ContinuousBatchingEngine, FedMLInferenceRunner
+from fedml_tpu.serving.llm_predictor import LlamaPredictor
+from fedml_tpu.serving.openai_protocol import ByteTokenizer, OpenAIServing
+
+
+@pytest.fixture(scope="module")
+def openai_runner():
+    cfg = LlamaConfig.tiny(vocab_size=300, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    engine = ContinuousBatchingEngine(model, params, batch_slots=2,
+                                      max_len=64)
+    runner = FedMLInferenceRunner(
+        LlamaPredictor(engine), openai=OpenAIServing(engine)).start()
+    yield runner
+    runner.stop()
+    engine.stop()
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    for text in ("hello", "héllo wörld", ""):
+        ids = tok.encode(text)
+        assert ids[0] == tok.bos_id
+        assert tok.decode(ids) == text
+
+
+def test_completions_offtheshelf_payload(openai_runner):
+    """The exact payload an openai-python client sends."""
+    url = f"http://127.0.0.1:{openai_runner.port}/v1/completions"
+    with _post(url, {"model": "tiny", "prompt": "Say hi", "max_tokens": 4,
+                     "temperature": 0.0}) as r:
+        body = json.loads(r.read())
+    assert body["object"] == "text_completion"
+    assert body["id"].startswith("cmpl-")
+    assert body["model"] == "tiny"
+    choice = body["choices"][0]
+    assert choice["index"] == 0
+    assert isinstance(choice["text"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    usage = body["usage"]
+    assert usage["total_tokens"] == (usage["prompt_tokens"]
+                                     + usage["completion_tokens"])
+    assert usage["completion_tokens"] <= 4
+
+
+def test_chat_completions_nonstream(openai_runner):
+    url = f"http://127.0.0.1:{openai_runner.port}/v1/chat/completions"
+    with _post(url, {"model": "tiny",
+                     "messages": [
+                         {"role": "system", "content": "Be brief."},
+                         {"role": "user", "content": "Hi!"}],
+                     "max_tokens": 4}) as r:
+        body = json.loads(r.read())
+    assert body["object"] == "chat.completion"
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+
+
+def test_chat_completions_sse_stream(openai_runner):
+    """SSE framing: data: {chunk}\\n\\n ... data: [DONE]; chunk shapes match
+    the OpenAI streaming contract (role preamble, content deltas, stop)."""
+    url = f"http://127.0.0.1:{openai_runner.port}/v1/chat/completions"
+    with _post(url, {"model": "tiny",
+                     "messages": [{"role": "user", "content": "Go"}],
+                     "max_tokens": 4, "stream": True}) as r:
+        assert r.headers.get("Content-Type").startswith("text/event-stream")
+        raw = r.read().decode()
+    frames = [f for f in raw.split("\n\n") if f.strip()]
+    assert all(f.startswith("data: ") for f in frames)
+    assert frames[-1] == "data: [DONE]"
+    chunks = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+    assert chunks[0]["choices"][0]["delta"] == {"role": "assistant"}
+    assert chunks[0]["object"] == "chat.completion.chunk"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    # every id identical across the stream
+    assert len({c["id"] for c in chunks}) == 1
+    content = "".join(c["choices"][0]["delta"].get("content", "")
+                      for c in chunks)
+    assert isinstance(content, str)
+
+
+def test_completions_sse_stream(openai_runner):
+    url = f"http://127.0.0.1:{openai_runner.port}/v1/completions"
+    with _post(url, {"prompt": "x", "max_tokens": 3, "stream": True}) as r:
+        raw = r.read().decode()
+    frames = [f for f in raw.split("\n\n") if f.strip()]
+    assert frames[-1] == "data: [DONE]"
+    chunks = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+    assert all(c["object"] == "text_completion" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_plain_predict_still_works(openai_runner):
+    url = f"http://127.0.0.1:{openai_runner.port}/predict"
+    with _post(url, {"prompt_tokens": [1, 5, 9], "max_new_tokens": 2}) as r:
+        body = json.loads(r.read())
+    assert len(body["tokens"]) <= 2
