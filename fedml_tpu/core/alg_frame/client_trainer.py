@@ -13,7 +13,7 @@ runs local-DP noise and FHE encrypt.
 from __future__ import annotations
 
 import abc
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 Pytree = Any
 

@@ -16,7 +16,6 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
-import numpy as np
 
 from fedml_tpu.core.alg_frame.params import Context
 from fedml_tpu.core.contribution.gtg_shapley import gtg_shapley, leave_one_out

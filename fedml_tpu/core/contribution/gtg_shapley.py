@@ -16,7 +16,6 @@ previous round's global model utility.
 from __future__ import annotations
 
 import itertools
-import math
 from typing import Callable, Dict, List, Sequence
 
 import numpy as np

@@ -7,7 +7,6 @@ MQTT+S3) implements this; engines never see transport details.
 from __future__ import annotations
 
 import abc
-from typing import Any
 
 from fedml_tpu.core.distributed.message import Message
 

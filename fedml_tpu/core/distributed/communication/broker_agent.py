@@ -12,7 +12,7 @@ import json
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 from fedml_tpu.core.distributed.communication.broker import BrokerClient
 

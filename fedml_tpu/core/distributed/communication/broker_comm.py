@@ -21,8 +21,7 @@ from __future__ import annotations
 
 import logging
 import queue
-import threading
-from typing import Any, List, Optional
+from typing import List, Optional
 
 from fedml_tpu.core.distributed.communication.base_com_manager import (
     BaseCommunicationManager,

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import logging
 import queue
-import threading
 from concurrent import futures
 from typing import Dict, List, Optional
 

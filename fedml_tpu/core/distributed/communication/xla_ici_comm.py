@@ -21,12 +21,10 @@ payload device_put rides ICI, preserving the same interface.
 from __future__ import annotations
 
 import logging
-from typing import List
 
 import jax
 
 from fedml_tpu.core.distributed.communication.local_comm import (
-    LocalBroker,
     LocalCommManager,
 )
 from fedml_tpu.core.distributed.message import Message

@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Optional
 
 from fedml_tpu.core.mlops.metrics import MLOpsMetrics
 

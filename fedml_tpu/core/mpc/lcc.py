@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, mod_inv_vec, mulmod
+from fedml_tpu.core.mpc.finite import DEFAULT_PRIME, mulmod
 
 logger = logging.getLogger(__name__)
 

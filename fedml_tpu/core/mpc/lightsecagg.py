@@ -24,7 +24,6 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-from fedml_tpu.core.mpc.finite import DEFAULT_PRIME
 from fedml_tpu.core.mpc.lcc import lcc_decode, lcc_encode
 
 Pytree = dict

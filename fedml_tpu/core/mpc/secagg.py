@@ -26,7 +26,7 @@ transport.
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import numpy as np
 from cryptography.hazmat.primitives.asymmetric.x25519 import (

@@ -8,16 +8,14 @@ State (per-client history) lives across rounds in the defense instance.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Dict, List, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core.security.defense import register
 from fedml_tpu.core.security.defense.base import (
     BaseDefense,
     stack_updates,
-    unstack_to_list,
 )
 
 Pytree = Any

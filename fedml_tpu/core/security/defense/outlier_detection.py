@@ -30,7 +30,9 @@ class OutlierDetectionDefense(BaseDefense):
     ) -> List[Tuple[int, Pytree]]:
         vecs, _, _ = stack_updates(raw_client_grad_list)
         mean = jnp.mean(vecs, axis=0)
-        ref = self._prev_mean if self._prev_mean is not None and self._prev_mean.shape == mean.shape else mean
+        has_prev = (self._prev_mean is not None
+                    and self._prev_mean.shape == mean.shape)
+        ref = self._prev_mean if has_prev else mean
         self._prev_mean = mean
         cos = (vecs @ ref) / (
             jnp.linalg.norm(vecs, axis=1) * (jnp.linalg.norm(ref) + 1e-12) + 1e-12

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import logging
 import platform
-from typing import Any, Optional
+from typing import Any
 
 from fedml_tpu import constants
 from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager

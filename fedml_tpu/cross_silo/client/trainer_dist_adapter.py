@@ -11,10 +11,8 @@ from __future__ import annotations
 
 import logging
 import math
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
-import jax
-import numpy as np
 
 from fedml_tpu.data.dataset import FederatedDataset
 from fedml_tpu.ml.trainer.trainer_creator import create_model_trainer

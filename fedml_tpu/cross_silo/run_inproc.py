@@ -8,7 +8,6 @@ is both the test harness and a legitimate single-host deployment mode.
 """
 from __future__ import annotations
 
-import threading
 from typing import Any, List, Optional
 
 from fedml_tpu.core.distributed.communication.local_comm import LocalBroker

@@ -6,7 +6,7 @@ pseudo-gradient ``g = w_global - w_aggregated`` (Reddi et al., FedOpt).
 """
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import jax
 import optax

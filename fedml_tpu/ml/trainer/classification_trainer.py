@@ -14,7 +14,6 @@ import numpy as np
 from fedml_tpu.core.alg_frame.client_trainer import ClientTrainer
 from fedml_tpu.data.dataset import batch_epochs
 from fedml_tpu.ml.trainer.local_sgd import (
-    LocalState,
     build_evaluator,
     build_local_trainer,
     init_local_state,

@@ -17,14 +17,13 @@ client mesh axis (simulation/parallel) with zero host round-trips.
 """
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from fedml_tpu.utils.tree import tree_scale, tree_sub, tree_zeros_like
+from fedml_tpu.utils.tree import tree_zeros_like
 
 Pytree = Any
 

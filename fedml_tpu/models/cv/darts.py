@@ -8,7 +8,6 @@ the weights, so federated averaging of alphas == the FedNAS search step
 """
 from __future__ import annotations
 
-from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ and it is also friendlier to SPMD (no cross-device batch stats).
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Sequence
+from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ nets across clients each round.
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class Generator(nn.Module):

@@ -5,7 +5,6 @@ Parity: ``model/linear/lr.py`` (reference north-star config #1: LR on MNIST).
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class LogisticRegression(nn.Module):

@@ -45,6 +45,11 @@ class LlamaConfig:
     # training knobs
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full": recompute the whole block in backward (min memory, +1/3
+    # forward flops); "dots": save matmul outputs, recompute elementwise
+    # only (the XLA sweet spot — matmuls are the expensive part and HBM
+    # usually fits their outputs); "none"/remat=False: save everything
+    remat_policy: str = "full"
     use_flash: bool = True
 
     @property
@@ -106,6 +111,8 @@ class LlamaConfig:
                 )
         if getattr(args, "use_flash_attention", None) is not None:
             kw["use_flash"] = bool(args.use_flash_attention)
+        if getattr(args, "remat_policy", None) is not None:
+            kw["remat_policy"] = str(args.remat_policy)
         builder = {
             "tiny": LlamaConfig.tiny,
             "llama2_7b": LlamaConfig.llama2_7b,
@@ -344,8 +351,11 @@ class LlamaForCausalLM(nn.Module):
         cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
         block = LlamaBlock
-        if cfg.remat and kv_caches is None:
-            block = nn.remat(LlamaBlock, static_argnums=(5,))
+        if cfg.remat and cfg.remat_policy != "none" and kv_caches is None:
+            policy = None  # "full": save only block inputs
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = nn.remat(LlamaBlock, static_argnums=(5,), policy=policy)
         new_caches = []
         for i in range(cfg.num_hidden_layers):
             cache_i = kv_caches[i] if kv_caches is not None else None

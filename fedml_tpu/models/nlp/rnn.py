@@ -8,7 +8,6 @@ inside one XLA program.
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class RNNOriginalFedAvg(nn.Module):

@@ -9,7 +9,7 @@ Axes used across the framework (SURVEY §2.10 mapping):
 """
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

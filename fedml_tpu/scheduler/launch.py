@@ -9,7 +9,7 @@ the in-process LocalAgent; the module-level agent keeps `fedml_tpu launch`
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from fedml_tpu.scheduler.agent import LocalAgent
 from fedml_tpu.scheduler.job_yaml import JobSpec

@@ -25,7 +25,7 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Optional
 
 from fedml_tpu.serving.monitor import EndpointMonitor
 from fedml_tpu.serving.predictor import FedMLPredictor

@@ -18,7 +18,7 @@ similarly delegates to the HF tokenizer of the deployed model).
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
 from fedml_tpu.serving.predictor import FedMLPredictor

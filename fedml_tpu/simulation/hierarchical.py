@@ -16,10 +16,9 @@ import math
 import time
 from typing import Any, Dict, List
 
-import jax
 import numpy as np
 
-from fedml_tpu.data.dataset import FederatedDataset, batch_epochs
+from fedml_tpu.data.dataset import FederatedDataset
 from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
 from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
 from fedml_tpu.ml.trainer.trainer_creator import create_model_trainer

@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import math
 import time
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Tuple
 
 import jax
 import numpy as np
@@ -21,7 +21,6 @@ import numpy as np
 from fedml_tpu.core.alg_frame.params import Context
 from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
 from fedml_tpu.data.dataset import FederatedDataset
-from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
 from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
 from fedml_tpu.ml.aggregator.server_optimizer import ServerOptimizer
 from fedml_tpu.ml.trainer.trainer_creator import create_model_trainer

@@ -10,7 +10,7 @@ explicit because XLA needs static shapes.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 
 @dataclasses.dataclass

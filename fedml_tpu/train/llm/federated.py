@@ -16,7 +16,7 @@ traffic of full FedAvg. The exchanged payload is the flat
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np

@@ -13,11 +13,8 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List
 
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
 from fedml_tpu.data.dataset import FederatedDataset

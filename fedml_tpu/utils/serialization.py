@@ -17,7 +17,7 @@ from __future__ import annotations
 import io
 import json
 import struct
-from typing import Any, List, Tuple
+from typing import Any, List
 
 import jax
 import numpy as np

@@ -85,6 +85,7 @@ def test_fedgkt_learns_without_shipping_models():
     assert c_leaves != s_leaves
 
 
+@pytest.mark.slow
 def test_fednas_architect_moves_alphas_and_derives_genotype():
     from fedml_tpu.simulation.sp.fednas import FedNASAPI
 
@@ -117,6 +118,7 @@ def test_fednas_architect_moves_alphas_and_derives_genotype():
         assert ops and all(op in OPS and op != "zero" for op in ops)
 
 
+@pytest.mark.slow
 def test_fedgan_moment_gap_shrinks():
     from fedml_tpu.simulation.sp.fedgan import FedGANAPI
 

@@ -29,7 +29,10 @@ def test_flash_attention_matches_reference(causal):
     assert float(jnp.abs(out - ref).max()) < 2e-2
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    pytest.param(True, marks=pytest.mark.slow),  # causal variant covered
+    False,                                       # fast by the non-ragged test
+])
 def test_flash_attention_ragged_lengths(causal):
     """T not divisible by block sizes: phantom rows/cols must not leak."""
     key = jax.random.key(7)
@@ -80,6 +83,7 @@ def test_llama_forward_and_decode_parity():
     assert float(jnp.abs(stitched - full).max()) < 1e-4
 
 
+@pytest.mark.slow
 def test_llm_trainer_converges_full_ft():
     from fedml_tpu.train.llm.trainer import LLMTrainer
 
@@ -113,6 +117,7 @@ def test_llm_trainer_lora_freezes_base():
                if "lora_b" in k)
 
 
+@pytest.mark.slow
 def test_llm_checkpoint_roundtrip(tmp_path):
     from fedml_tpu.train.llm.trainer import LLMTrainer, extract_lora
 
@@ -131,6 +136,7 @@ def test_llm_checkpoint_roundtrip(tmp_path):
         assert np.allclose(saved[k], np.asarray(v))
 
 
+@pytest.mark.slow
 def test_fedllm_rounds_improve():
     import fedml_tpu
     from fedml_tpu.arguments import load_arguments_from_dict

@@ -53,6 +53,7 @@ def two_node_cluster(tmp_path):
     broker.stop()
 
 
+@pytest.mark.slow
 def test_cross_silo_job_across_two_nodes(two_node_cluster, tmp_path):
     """3 ranks (server + 2 clients) placed round-robin on 2 node agents,
     rendezvousing over the same broker (the federation plane), complete a

@@ -25,7 +25,12 @@ def _args(model="lr", dataset="synthetic", **extra):
 
 
 @pytest.mark.parametrize("name", [
-    "mobilenet_v3", "efficientnet_lite0", "vgg11", "darts",
+    # deep conv stacks are minutes of CPU XLA compile — slow-gated;
+    # darts stays fast and covers the conv/GroupNorm/pool path
+    pytest.param("mobilenet_v3", marks=pytest.mark.slow),
+    pytest.param("efficientnet_lite0", marks=pytest.mark.slow),
+    pytest.param("vgg11", marks=pytest.mark.slow),
+    "darts",
 ])
 def test_cv_models_forward_and_grad(name):
     args = _args(model=name)

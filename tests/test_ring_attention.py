@@ -46,6 +46,7 @@ def test_ring_gradients_match(sp_mesh):
         assert float(jnp.abs(a - b).max()) < 1e-4
 
 
+@pytest.mark.slow
 def test_trainer_with_ring_matches_gspmd_path():
     from fedml_tpu.models.llm.llama import LlamaConfig
     from fedml_tpu.train.llm.trainer import LLMTrainer

@@ -92,6 +92,7 @@ def test_agent_status_lands_in_metrics_sink(agent, tmp_path):
     assert rid in blob and "FINISHED" in blob
 
 
+@pytest.mark.slow
 def test_launch_job_e2e_sp_simulation(tmp_path):
     """`fedml_tpu launch job.yaml` runs the sp sim end-to-end (VERDICT #5)."""
     from fedml_tpu.scheduler import agent as agent_mod

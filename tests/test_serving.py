@@ -41,6 +41,7 @@ def greedy_no_cache(model, params, prompt, n_new):
     return toks[len(prompt):]
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_recompute(tiny_model):
     model, params = tiny_model
     eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=64)
