@@ -68,13 +68,15 @@ def llm_shape(hbm_bytes: float):
     from fedml_tpu.models.llm.llama import LlamaConfig
 
     if hbm_bytes >= 12e9:
-        # ~1.1B params (TinyLlama-class): fp32 masters 4.5GB; remat keeps
-        # activations small; LoRA keeps optimizer state tiny.
+        # ~1.1B params (TinyLlama-class): fp32 masters 4.5GB; LoRA keeps
+        # optimizer state tiny. remat OFF: B8xT1024 activations fit v5e
+        # HBM, and the round-3 sweep (PERF_NOTES.md) measured full-remat
+        # at 545ms/step vs 421ms without — recompute was pure overhead.
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=22, num_attention_heads=32,
             num_key_value_heads=8, max_position_embeddings=2048,
-            lora_rank=16,
+            lora_rank=16, remat=False, remat_policy="none",
         )
         return cfg, 8, 1024  # batch, seq
     # CPU / tiny-dev fallback so the bench always completes
