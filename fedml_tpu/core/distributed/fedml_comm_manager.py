@@ -159,12 +159,32 @@ class FedMLCommManager(Observer):
                 offload_bytes=int(
                     getattr(self.args, "payload_offload_bytes", 64 * 1024)
                 ),
+                protocol=str(getattr(self.args, "broker_protocol", "tcp")),
             )
         elif backend == constants.COMM_BACKEND_MQTT_S3:
-            raise RuntimeError(
-                "MQTT_S3 backend requires paho-mqtt/boto3 (not available in "
-                "this environment); use BROKER (in-tree pub/sub + object "
-                "store, same deployment shape), GRPC, or LOCAL"
+            # the reference's default backend: real MQTT control plane +
+            # storage offload. Same manager, mqtt protocol seam — needs
+            # paho-mqtt installed (mqtt_compat raises with instructions).
+            from fedml_tpu.core.distributed.communication.broker_comm import (
+                BrokerCommManager,
+            )
+            from fedml_tpu.core.distributed.communication.object_store import (
+                create_object_store,
+            )
+
+            self.com_manager = BrokerCommManager(
+                run_id,
+                self.rank,
+                host=str(getattr(self.args, "mqtt_host",
+                                 getattr(self.args, "broker_host",
+                                         "127.0.0.1"))),
+                port=int(getattr(self.args, "mqtt_port",
+                                 getattr(self.args, "broker_port", 1883))),
+                object_store=create_object_store(self.args),
+                offload_bytes=int(
+                    getattr(self.args, "payload_offload_bytes", 64 * 1024)
+                ),
+                protocol="mqtt",
             )
         else:
             raise ValueError(f"unknown comm backend {self.backend!r}")

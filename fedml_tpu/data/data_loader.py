@@ -197,8 +197,87 @@ def load_mnist(args: Any) -> FederatedDataset:
     return _partition_and_pack(args, xtr, ytr, xte, yte, 10)
 
 
+# -- LEAF json (femnist/shakespeare natural per-user partitions) -----------
+
+LEAF_CHARSET = (
+    "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "[]abcdefghijklmnopqrstuvwxyz}" + "".join(chr(c) for c in range(1, 12))
+)  # 90 symbols, matching the shakespeare vocab
+
+
+def leaf_encode(text: str, vocab: int = 90) -> np.ndarray:
+    table = {ch: i for i, ch in enumerate(LEAF_CHARSET[:vocab])}
+    return np.asarray([table.get(ch, 0) for ch in text], np.int32)
+
+
+def _load_leaf_json(cache: str, name: str):
+    """Read LEAF's ``{name}_train.json`` / ``{name}_test.json``:
+    {"users": [...], "user_data": {user: {"x": [...], "y": [...]}}}.
+    Returns (train_user_data, test_user_data) or None."""
+    import json as _json
+
+    out = []
+    for split in ("train", "test"):
+        path = os.path.join(cache, f"{name}_{split}.json") if cache else ""
+        if not path or not os.path.exists(path):
+            return None
+        with open(path) as f:
+            payload = _json.load(f)
+        out.append({u: payload["user_data"][u] for u in payload["users"]})
+    return out
+
+
+def _pack_leaf_users(args, train_users, test_users, to_arrays, class_num,
+                     feature_dim):
+    """LEAF's point is the NATURAL partition: clients = users (grouped
+    round-robin onto client_num buckets when there are more users)."""
+    client_num = int(getattr(args, "client_num_in_total", len(train_users)))
+    users = sorted(train_users)
+    buckets = {i: [] for i in range(client_num)}
+    for j, u in enumerate(users):
+        buckets[j % client_num].append(u)
+
+    def cat(users_list, table):
+        xs, ys = [], []
+        for u in users_list:
+            x, y = to_arrays(table[u])
+            xs.append(x)
+            ys.append(y)
+        return (np.concatenate(xs), np.concatenate(ys)) if xs else \
+            (np.zeros((0, feature_dim), np.float32), np.zeros(0, np.int32))
+
+    train_local = {i: cat(buckets[i], train_users) for i in buckets}
+    test_all_users = sorted(test_users)
+    xte, yte = cat(test_all_users, test_users)
+    xtr = np.concatenate([train_local[i][0] for i in buckets])
+    ytr = np.concatenate([train_local[i][1] for i in buckets])
+    test_local = {i: (xte, yte) for i in buckets}
+    return FederatedDataset(
+        train_data_num=len(ytr),
+        test_data_num=len(yte),
+        train_data_global=(xtr, ytr),
+        test_data_global=(xte, yte),
+        train_data_local_num_dict={i: len(train_local[i][1]) for i in buckets},
+        train_data_local_dict=train_local,
+        test_data_local_dict=test_local,
+        class_num=class_num,
+        feature_dim=feature_dim,
+        stats={"leaf_users": len(users)},
+    )
+
+
 @register_dataset("femnist")
 def load_femnist(args: Any) -> FederatedDataset:
+    """FEMNIST: LEAF json (natural writer partition) if cached, else npz,
+    else synthetic."""
+    cache = str(getattr(args, "data_cache_dir", "") or "")
+    leaf = _load_leaf_json(cache, "femnist")
+    if leaf is not None:
+        def to_arrays(ud):
+            x = np.asarray(ud["x"], np.float32).reshape(-1, 28, 28, 1)
+            return x, np.asarray(ud["y"], np.int32)
+
+        return _pack_leaf_users(args, leaf[0], leaf[1], to_arrays, 62, 784)
     xtr, ytr, xte, yte = _load_image_or_synthetic(args, (28, 28, 1), 62, "femnist")
     return _partition_and_pack(args, xtr, ytr, xte, yte, 62)
 
@@ -243,6 +322,26 @@ def load_shakespeare(args: Any) -> FederatedDataset:
     seq_len = int(getattr(args, "seq_len", 80))
     vocab = 90  # LEAF shakespeare charset size
     cache = str(getattr(args, "data_cache_dir", "") or "")
+    # LEAF json (natural speaker partition): x = seq_len-char strings,
+    # y = the next character
+    leaf = _load_leaf_json(cache, "shakespeare")
+    if leaf is not None:
+        def to_arrays(ud):
+            xs = np.stack([
+                np.pad(leaf_encode(s, vocab)[:seq_len],
+                       (0, max(0, seq_len - len(s))))
+                for s in ud["x"]
+            ])
+            # next-char target broadcast over the sequence positions:
+            # shifted input + final next-char (LEAF's y)
+            ys = np.concatenate(
+                [xs[:, 1:], np.stack([leaf_encode(c, vocab)[:1]
+                                      for c in ud["y"]])], axis=1)
+            return xs.astype(np.int32), ys.astype(np.int32)
+
+        ds = _pack_leaf_users(args, leaf[0], leaf[1], to_arrays, vocab,
+                              seq_len)
+        return ds
     corpus = None
     if cache:
         for fname in ("shakespeare.txt", "all_data.txt"):

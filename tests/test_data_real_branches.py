@@ -1,0 +1,138 @@
+"""Real-file loader branches, exercised with in-test fixtures.
+
+VERDICT weak #6: every loader's npz/LEAF branch previously shipped
+untested — a schema drift would have surfaced only on a user's machine.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.data import load_federated
+
+
+def _args(dataset, cache, **extra):
+    return fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": dataset, "data_cache_dir": str(cache),
+                      **extra},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 3, "client_num_per_round": 3,
+                       "comm_round": 1, "epochs": 1, "batch_size": 16,
+                       "learning_rate": 0.1},
+    }))
+
+
+def _load_no_fallback(args, caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="fedml_tpu.data.data_loader"):
+        ds = load_federated(args)
+    assert not [r for r in caplog.records
+                if "SYNTHETIC STAND-IN" in r.getMessage()], (
+        "real-file branch fell back to synthetic data")
+    return ds
+
+
+def test_mnist_npz_branch(tmp_path, caplog):
+    rng = np.random.default_rng(0)
+    np.savez(tmp_path / "mnist.npz",
+             x_train=rng.integers(0, 256, (120, 28, 28), dtype=np.uint8),
+             y_train=rng.integers(0, 10, 120).astype(np.uint8),
+             x_test=rng.integers(0, 256, (30, 28, 28), dtype=np.uint8),
+             y_test=rng.integers(0, 10, 30).astype(np.uint8))
+    ds = _load_no_fallback(_args("mnist", tmp_path), caplog)
+    assert ds.train_data_num == 120 and ds.test_data_num == 30
+    assert ds.train_data_global[0].shape == (120, 784)
+    assert ds.train_data_global[0].max() <= 1.0  # /255 normalization
+    assert ds.class_num == 10
+
+
+def test_cifar10_npz_branch(tmp_path, caplog):
+    rng = np.random.default_rng(1)
+    np.savez(tmp_path / "cifar10.npz",
+             x_train=rng.integers(0, 256, (90, 32, 32, 3), dtype=np.uint8),
+             y_train=rng.integers(0, 10, (90, 1)).astype(np.uint8),
+             x_test=rng.integers(0, 256, (20, 32, 32, 3), dtype=np.uint8),
+             y_test=rng.integers(0, 10, (20, 1)).astype(np.uint8))
+    ds = _load_no_fallback(_args("cifar10", tmp_path), caplog)
+    assert ds.train_data_global[0].shape == (90, 32, 32, 3)
+    assert ds.train_data_global[1].ndim == 1  # labels raveled
+
+
+def _write_leaf(path, users, make_xy):
+    payload = {"users": users, "num_samples": [], "user_data": {}}
+    for u in users:
+        x, y = make_xy(u)
+        payload["user_data"][u] = {"x": x, "y": y}
+        payload["num_samples"].append(len(y))
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def test_femnist_leaf_json_natural_partition(tmp_path, caplog):
+    rng = np.random.default_rng(2)
+    users = [f"w{i}" for i in range(6)]
+
+    def make_xy(u):
+        n = 5 + int(u[1:])
+        return (rng.random((n, 784)).tolist(),
+                rng.integers(0, 62, n).tolist())
+
+    _write_leaf(tmp_path / "femnist_train.json", users, make_xy)
+    _write_leaf(tmp_path / "femnist_test.json", users[:2], make_xy)
+
+    ds = _load_no_fallback(_args("femnist", tmp_path), caplog)
+    assert ds.class_num == 62
+    # natural partition: 6 writers round-robin onto 3 clients
+    assert ds.stats["leaf_users"] == 6
+    assert set(ds.train_data_local_dict) == {0, 1, 2}
+    total = sum(ds.train_data_local_num_dict.values())
+    assert total == ds.train_data_num == sum(5 + i for i in range(6))
+    x0 = ds.train_data_local_dict[0][0]
+    assert x0.shape[1:] == (28, 28, 1)
+
+
+def test_shakespeare_leaf_json_branch(tmp_path, caplog):
+    users = ["romeo", "juliet", "hamlet"]
+
+    def make_xy(u):
+        xs = [("the quick brown fox " * 4)[:80] for _ in range(4)]
+        ys = ["e"] * 4
+        return xs, ys
+
+    _write_leaf(tmp_path / "shakespeare_train.json", users, make_xy)
+    _write_leaf(tmp_path / "shakespeare_test.json", users[:1], make_xy)
+
+    ds = _load_no_fallback(_args("shakespeare", tmp_path, seq_len=80), caplog)
+    assert ds.class_num == 90
+    assert ds.stats["leaf_users"] == 3
+    x, y = ds.train_data_local_dict[0]
+    assert x.shape[1] == 80 and y.shape[1] == 80
+    # y is x shifted by one with the LEAF next-char appended
+    np.testing.assert_array_equal(x[0, 1:], y[0, :-1])
+    from fedml_tpu.data.data_loader import leaf_encode
+
+    assert y[0, -1] == leaf_encode("e")[0]
+
+
+def test_shakespeare_txt_branch(tmp_path, caplog):
+    (tmp_path / "shakespeare.txt").write_bytes(
+        b"to be or not to be that is the question " * 200)
+    ds = _load_no_fallback(_args("shakespeare", tmp_path, seq_len=20), caplog)
+    assert ds.class_num == 90
+    assert ds.train_data_global[0].shape[1] == 20
+
+
+def test_missing_files_fall_back_loudly(tmp_path, caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="fedml_tpu.data.data_loader"):
+        load_federated(_args("mnist", tmp_path / "empty"))
+    assert any("SYNTHETIC STAND-IN" in r.getMessage()
+               for r in caplog.records)

@@ -59,6 +59,63 @@ def test_object_store_roundtrip(tmp_path):
         store.get_object(key)
 
 
+def test_pubsub_protocol_seam_with_fake_mqtt(tmp_path):
+    """The BrokerCommManager accepts any PubSubClient implementation: a
+    fake 'mqtt' client (in-memory topic fan-out, the paho surface) carries
+    a full message round trip — proving a real paho client drops in."""
+    import numpy as np
+
+    from fedml_tpu.core.distributed.communication.broker_comm import (
+        BrokerCommManager,
+    )
+    from fedml_tpu.core.distributed.communication.mqtt_compat import (
+        PubSubClient,
+    )
+
+    topics = {}
+
+    class FakeMqtt(PubSubClient):
+        def subscribe(self, topic, handler):
+            topics.setdefault(topic, []).append(handler)
+
+        def publish(self, topic, body):
+            for h in topics.get(topic, []):
+                h(body)
+
+        def close(self):
+            pass
+
+    store = LocalDirObjectStore(str(tmp_path))
+    tx = BrokerCommManager("r9", 0, object_store=store, offload_bytes=64,
+                           client=FakeMqtt())
+    rx = BrokerCommManager("r9", 1, object_store=store, offload_bytes=64,
+                           client=FakeMqtt())
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+            rx.stop_receive_message()
+
+    rx.add_observer(Obs())
+    big = {"w": np.arange(64, dtype=np.float32)}
+    m = Message("TYPE_TEST", 0, 1)
+    m.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, big)
+    tx.send_message(m)
+    rx.handle_receive_message()  # drains the one delivered frame
+    assert got and np.array_equal(
+        got[0].get(Message.MSG_ARG_KEY_MODEL_PARAMS)["w"], big["w"])
+
+
+def test_unknown_broker_protocol_rejected():
+    from fedml_tpu.core.distributed.communication.mqtt_compat import (
+        create_pubsub_client,
+    )
+
+    with pytest.raises(ValueError):
+        create_pubsub_client("nats", "127.0.0.1", 1883)
+
+
 def test_object_store_rejects_escaping_keys(tmp_path):
     """Keys arrive off the wire; absolute or traversal keys must not reach
     the filesystem outside the store root."""
