@@ -110,6 +110,45 @@ def run_cross_silo_client():
     return _run_cross_silo(constants.ROLE_CLIENT)
 
 
+def run_hierarchical_cross_silo_server():
+    """Hierarchical cross-silo (reference ``run_hierarchical_cross_silo``):
+    every silo is a (multi-host) device mesh; scenario drives the per-silo
+    config-path overrides."""
+    return _run_cross_silo(constants.ROLE_SERVER, scenario="hierarchical")
+
+
+def run_hierarchical_cross_silo_client():
+    return _run_cross_silo(constants.ROLE_CLIENT, scenario="hierarchical")
+
+
+def run_cross_device_server():
+    """Cross-device ("BeeHive") server launcher.
+
+    Parity: ``fedml.run_mnn_server`` (``launch_cross_device.py``) — the
+    reference boots the MNN-file server for mobile clients; here the
+    server is the cross-silo FSM over the federation transport and the
+    device clients run ``python -m fedml_tpu.cross_device.client``.
+    """
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import device as device_mod
+    from fedml_tpu import models as models_mod
+
+    global _global_training_type
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE
+    args = load_arguments(_global_training_type, None)
+    args.role = constants.ROLE_SERVER
+    args.rank = 0
+    args.training_type = _global_training_type
+    args = init(args)
+    device = device_mod.get_device(args)
+    dataset = data_mod.load_federated(args)
+    model = models_mod.create(args, dataset.class_num)
+    return FedMLRunner(args, device, dataset, model).run()
+
+
+run_mnn_server = run_cross_device_server  # reference launcher name
+
+
 def run_cross_cloud_server():
     """Parity: ``_init_cross_cloud`` (ref ``__init__.py:392``) server role."""
     return _run_cross_silo(constants.ROLE_SERVER,
@@ -121,7 +160,8 @@ def run_cross_cloud_client():
                            constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD)
 
 
-def _run_cross_silo(role: str, training_type: Optional[str] = None):
+def _run_cross_silo(role: str, training_type: Optional[str] = None,
+                    scenario: Optional[str] = None):
     from fedml_tpu import data as data_mod
     from fedml_tpu import device as device_mod
     from fedml_tpu import models as models_mod
@@ -133,6 +173,8 @@ def _run_cross_silo(role: str, training_type: Optional[str] = None):
     args.role = role
     if training_type is not None:  # cross-cloud launcher overrides the yaml
         args.training_type = training_type
+    if scenario is not None:
+        args.scenario = scenario
     args = init(args)
     device = device_mod.get_device(args)
     dataset = data_mod.load_federated(args)
@@ -151,6 +193,10 @@ __all__ = [
     "run_simulation",
     "run_cross_cloud_client",
     "run_cross_cloud_server",
+    "run_cross_device_server",
     "run_cross_silo_client",
     "run_cross_silo_server",
+    "run_hierarchical_cross_silo_client",
+    "run_hierarchical_cross_silo_server",
+    "run_mnn_server",
 ]

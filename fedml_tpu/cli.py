@@ -132,6 +132,21 @@ def jobs(workdir: str) -> None:
         click.echo(json.dumps(row))
 
 
+@cli.command()
+@click.option("--broker", default=None,
+              help="host:port of the federation broker to check")
+@click.option("--store-dir", default=None)
+def diagnosis(broker, store_dir) -> None:
+    """Connectivity checks: broker echo, object store, accelerator
+    (reference: `fedml diagnosis`)."""
+    from fedml_tpu.scheduler.diagnosis import run_diagnosis
+
+    report = run_diagnosis(broker, store_dir)
+    click.echo(json.dumps(report, indent=2))
+    if not report["ok"]:
+        raise SystemExit(1)
+
+
 @cli.group()
 def cluster() -> None:
     """Multi-node scheduling: node agents + job submission."""

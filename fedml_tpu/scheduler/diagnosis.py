@@ -1,0 +1,79 @@
+"""Connectivity diagnosis — `fedml_tpu diagnosis`.
+
+Parity target: ``computing/scheduler/slave/client_diagnosis.py:24`` (the
+reference checks MQTT/S3/backend reachability before a run). TPU-build
+checks: the broker control plane (TCP connect + a pub/sub echo through
+the real frame protocol), the object store (write/read/delete round
+trip), and the JAX accelerator runtime.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict
+
+
+def check_broker(host: str, port: int, timeout: float = 5.0) -> Dict:
+    """Full pub/sub echo through the broker — not just a TCP connect."""
+    t0 = time.time()
+    try:
+        from fedml_tpu.core.distributed.communication.broker import (
+            BrokerClient,
+        )
+
+        client = BrokerClient(host, port, timeout=timeout)
+        topic = f"diagnosis/{uuid.uuid4().hex}"
+        got = threading.Event()
+        client.subscribe(topic, lambda body: got.set())
+        deadline = time.time() + timeout
+        while not got.is_set() and time.time() < deadline:
+            client.publish(topic, b"ping")  # resend: subscribe may race
+            got.wait(0.1)
+        client.close()
+        if not got.is_set():
+            return {"ok": False, "error": "echo timed out (connected, but "
+                                          "no message came back)"}
+        return {"ok": True, "rtt_ms": round((time.time() - t0) * 1000, 1)}
+    except OSError as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def check_object_store(store_dir=None) -> Dict:
+    try:
+        from fedml_tpu.core.distributed.communication.object_store import (
+            LocalDirObjectStore,
+        )
+
+        store = LocalDirObjectStore(store_dir)
+        key = store.new_key("diagnosis")
+        store.put_object(key, b"ping")
+        ok = store.get_object(key) == b"ping"
+        store.delete_object(key)
+        return {"ok": ok, "root": store.root}
+    except OSError as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def check_accelerator() -> Dict:
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {"ok": True, "backend": jax.default_backend(),
+                "devices": len(devs),
+                "kind": devs[0].device_kind if devs else ""}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def run_diagnosis(broker: str = None, store_dir=None) -> Dict:
+    report: Dict = {}
+    if broker:
+        host, _, port = broker.rpartition(":")
+        report["broker"] = check_broker(host, int(port))
+    report["object_store"] = check_object_store(store_dir)
+    report["accelerator"] = check_accelerator()
+    report["ok"] = all(v.get("ok") for v in report.values()
+                       if isinstance(v, dict))
+    return report

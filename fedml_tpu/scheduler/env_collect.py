@@ -39,6 +39,23 @@ def collect_env() -> Dict:
     return info
 
 
+def collect_resources() -> Dict:
+    """Compact accelerator inventory for scheduler heartbeats (parity:
+    the reference agents report GPU inventory into the compute cache,
+    ``scheduler_core/compute_gpu_db.py``)."""
+    out: Dict = {"platform": "cpu", "device_count": 0, "device_kind": ""}
+    try:
+        import jax
+
+        devs = jax.devices()
+        out["platform"] = jax.default_backend()
+        out["device_count"] = len(devs)
+        out["device_kind"] = devs[0].device_kind if devs else ""
+    except Exception as e:
+        out["error"] = str(e)
+    return out
+
+
 def print_env() -> None:
     for k, v in collect_env().items():
         print(f"{k:>18}: {v}")

@@ -121,6 +121,29 @@ class MasterAgent(BrokerJsonAgent):
         targets = nodes or live
         if not targets:
             raise RuntimeError("no live nodes to schedule on")
+        # resource matcher (reference: scheduler_core/scheduler_matcher.py
+        # against the GPU inventory): the job yaml's `computing` block
+        # filters candidate nodes by their advertised inventory
+        req = spec.computing or {}
+        min_chips = int(req.get("minimum_num_chips", 0) or 0)
+        want_platform = str(req.get("platform", "") or "").lower()
+        if min_chips or want_platform:
+            matched = []
+            for n in targets:
+                res = self.registry.get(n).get("resources") or {}
+                if min_chips and int(res.get("device_count", 0)) < min_chips:
+                    continue
+                if (want_platform
+                        and str(res.get("platform", "")).lower()
+                        != want_platform):
+                    continue
+                matched.append(n)
+            if not matched:
+                raise RuntimeError(
+                    f"no node satisfies computing requirements {req}; "
+                    f"inventories: "
+                    f"{ {n: self.registry.get(n).get('resources') for n in targets} }")
+            targets = matched
         # expand nodes by their advertised slots (a slot = one rank; each
         # rank is its own JAX/XLA process, so slots bound oversubscription
         # the way the deploy plane's --capacity does), deducting ranks
@@ -241,7 +264,8 @@ class MasterAgent(BrokerJsonAgent):
         mtype = msg.get("type")
         nid = str(msg.get("node_id", ""))
         if mtype == "node_online":
-            self.registry.touch(nid, slots=int(msg.get("slots", 1)))
+            self.registry.touch(nid, slots=int(msg.get("slots", 1)),
+                                resources=msg.get("resources") or {})
         elif mtype == "heartbeat":
             self.registry.touch(nid)
             # reconcile from the heartbeat's run table too: a lost one-shot

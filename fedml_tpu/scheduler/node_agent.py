@@ -51,9 +51,12 @@ class NodeAgent(BrokerJsonAgent):
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "NodeAgent":
+        from fedml_tpu.scheduler.env_collect import collect_resources
+
         self.agent.start()
         self._publish({"type": "node_online", "node_id": self.node_id,
-                       "slots": self.slots})
+                       "slots": self.slots,
+                       "resources": collect_resources()})
         self.spawn_loop(self._heartbeat_loop)
         return self
 

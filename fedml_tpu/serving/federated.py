@@ -1,0 +1,208 @@
+"""Federated serving engine — distributed inference over the FSM.
+
+Parity target: ``serving/{client,server}/`` in the reference (cross-silo
+manager clones repurposed for inference jobs: the server syncs the model
+to workers and drives them; ``serving/server/fedml_server_manager.py:15``,
+``serving/client/fedml_client_master_manager.py``). TPU-native re-design:
+after the same online-handshake + model sync, the server SCATTERS each
+inference batch across live workers (row ranges), every worker runs its
+shard through its local jitted apply, and the server GATHERS and
+reorders the predictions — data-parallel inference where each worker can
+itself be a TPU host/slice.
+
+All managers ride the standard transports (LOCAL for tests, BROKER/GRPC
+for deployments), so a federation of inference workers deploys exactly
+like a cross-silo training federation.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+
+logger = logging.getLogger(__name__)
+
+
+class InfMessage:
+    MSG_TYPE_CONNECTION_IS_READY = "MSG_TYPE_CONNECTION_IS_READY"
+    MSG_TYPE_S2C_CHECK_WORKER_STATUS = "inf.s2c.check_status"
+    MSG_TYPE_C2S_WORKER_STATUS = "inf.c2s.status"
+    MSG_TYPE_S2C_DEPLOY_MODEL = "inf.s2c.deploy"
+    MSG_TYPE_S2C_INFER_REQUEST = "inf.s2c.request"
+    MSG_TYPE_C2S_INFER_RESPONSE = "inf.c2s.response"
+    MSG_TYPE_S2C_FINISH = "inf.s2c.finish"
+
+    ARG_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
+    ARG_REQ_ID = "req_id"
+    ARG_SHARD = "shard"
+    ARG_X = "x"
+    ARG_PREDS = "preds"
+    ARG_STATUS = "status"
+
+
+class InferenceWorkerManager(FedMLCommManager):
+    """One inference worker: holds the deployed params, answers shards."""
+
+    def __init__(self, args: Any, apply_fn: Callable, comm=None,
+                 rank: int = 1, size: int = 2,
+                 backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, rank, size, backend)
+        self.apply_fn = apply_fn
+        self.params = None
+        self._announced = False
+
+    def register_message_receive_handlers(self) -> None:
+        M = InfMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self._handle_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_CHECK_WORKER_STATUS, self._handle_check)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_DEPLOY_MODEL, self._handle_deploy)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_INFER_REQUEST, self._handle_request)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FINISH, lambda m: self.finish())
+
+    def _send_status(self, receiver: int) -> None:
+        m = Message(InfMessage.MSG_TYPE_C2S_WORKER_STATUS,
+                    self.get_sender_id(), receiver)
+        m.add_params(InfMessage.ARG_STATUS,
+                     "READY" if self.params is not None else "IDLE")
+        self.send_message(m)
+
+    def _handle_ready(self, msg: Message) -> None:
+        if not self._announced:
+            self._announced = True
+            self._send_status(0)
+
+    def _handle_check(self, msg: Message) -> None:
+        self._send_status(msg.get_sender_id())
+
+    def _handle_deploy(self, msg: Message) -> None:
+        self.params = msg.get(InfMessage.ARG_MODEL_PARAMS)
+        logger.info("inference worker %d: model deployed", self.rank)
+        self._send_status(msg.get_sender_id())
+
+    def _handle_request(self, msg: Message) -> None:
+        x = np.asarray(msg.get(InfMessage.ARG_X))
+        preds = np.asarray(self.apply_fn(self.params, x))
+        reply = Message(InfMessage.MSG_TYPE_C2S_INFER_RESPONSE,
+                        self.get_sender_id(), msg.get_sender_id())
+        reply.add_params(InfMessage.ARG_REQ_ID, msg.get(InfMessage.ARG_REQ_ID))
+        reply.add_params(InfMessage.ARG_SHARD, msg.get(InfMessage.ARG_SHARD))
+        reply.add_params(InfMessage.ARG_PREDS, preds)
+        self.send_message(reply)
+
+
+class InferenceServerManager(FedMLCommManager):
+    """Deploys the model to workers, scatters batches, gathers preds."""
+
+    def __init__(self, args: Any, params: Any, comm=None,
+                 worker_num: int = 1,
+                 backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(args, comm, 0, worker_num + 1, backend)
+        self.params = params
+        self.worker_num = worker_num
+        self.online: Dict[int, bool] = {}
+        self.deployed: Dict[int, bool] = {}
+        self.deploy_done = threading.Event()
+        self._req_counter = 0
+        self._pending: Dict[int, Dict] = {}
+        self._lock = threading.Lock()
+
+    def register_message_receive_handlers(self) -> None:
+        M = InfMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_CONNECTION_IS_READY, self._handle_ready)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_WORKER_STATUS, self._handle_status)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_INFER_RESPONSE, self._handle_response)
+
+    # -- deployment --------------------------------------------------------
+    def _handle_ready(self, msg: Message) -> None:
+        for w in range(1, self.worker_num + 1):
+            self.send_message(Message(
+                InfMessage.MSG_TYPE_S2C_CHECK_WORKER_STATUS,
+                self.get_sender_id(), w))
+
+    def _handle_status(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        status = msg.get(InfMessage.ARG_STATUS)
+        if status == "READY":
+            self.deployed[sender] = True
+            if all(self.deployed.get(w) for w in
+                   range(1, self.worker_num + 1)):
+                self.deploy_done.set()
+            return
+        if not self.online.get(sender):
+            self.online[sender] = True
+            m = Message(InfMessage.MSG_TYPE_S2C_DEPLOY_MODEL,
+                        self.get_sender_id(), sender)
+            m.add_params(InfMessage.ARG_MODEL_PARAMS, self.params)
+            self.send_message(m)
+
+    def wait_deployed(self, timeout: float = 60.0) -> None:
+        if not self.deploy_done.wait(timeout):
+            raise TimeoutError(
+                f"only {sorted(self.deployed)} of {self.worker_num} "
+                f"inference workers deployed")
+
+    # -- scatter/gather ----------------------------------------------------
+    def infer(self, x: np.ndarray, timeout: float = 120.0) -> np.ndarray:
+        """Split rows of ``x`` across the workers; return reordered preds."""
+        x = np.asarray(x)
+        workers = sorted(w for w in self.deployed if self.deployed[w])
+        if not workers:
+            raise RuntimeError("no deployed inference workers")
+        bounds = np.linspace(0, len(x), len(workers) + 1).astype(int)
+        shards = [(i, w, slice(bounds[i], bounds[i + 1]))
+                  for i, w in enumerate(workers)
+                  if bounds[i] != bounds[i + 1]]
+        with self._lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            # n_parts is fixed BEFORE any send: a fast worker must not
+            # race the accounting and strand the gather
+            entry = {"event": threading.Event(), "parts": {},
+                     "n_parts": len(shards)}
+            self._pending[req_id] = entry
+        for i, w, sl in shards:
+            m = Message(InfMessage.MSG_TYPE_S2C_INFER_REQUEST,
+                        self.get_sender_id(), w)
+            m.add_params(InfMessage.ARG_REQ_ID, req_id)
+            m.add_params(InfMessage.ARG_SHARD, i)
+            m.add_params(InfMessage.ARG_X, x[sl])
+            self.send_message(m)
+        if not entry["event"].wait(timeout):
+            raise TimeoutError(
+                f"inference request {req_id}: "
+                f"{len(entry['parts'])}/{len(shards)} shards returned")
+        with self._lock:
+            parts = self._pending.pop(req_id)["parts"]
+        return np.concatenate([parts[i] for i in sorted(parts)])
+
+    def _handle_response(self, msg: Message) -> None:
+        req_id = int(msg.get(InfMessage.ARG_REQ_ID))
+        with self._lock:
+            entry = self._pending.get(req_id)
+            if entry is None:
+                return
+            entry["parts"][int(msg.get(InfMessage.ARG_SHARD))] = np.asarray(
+                msg.get(InfMessage.ARG_PREDS))
+            if (entry["n_parts"] and
+                    len(entry["parts"]) >= entry["n_parts"]):
+                entry["event"].set()
+
+    def shutdown(self) -> None:
+        for w in range(1, self.worker_num + 1):
+            self.send_message(Message(
+                InfMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), w))
+        self.finish()

@@ -1,0 +1,109 @@
+"""Connectivity diagnosis + scheduler resource matcher."""
+import json
+
+import pytest
+
+from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+from fedml_tpu.scheduler.diagnosis import (
+    check_broker,
+    check_object_store,
+    run_diagnosis,
+)
+from fedml_tpu.scheduler.job_yaml import JobSpec
+from fedml_tpu.scheduler.master_agent import MasterAgent
+
+
+def test_diagnosis_all_green(tmp_path):
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    try:
+        report = run_diagnosis(f"{host}:{port}", str(tmp_path / "store"))
+        assert report["ok"], report
+        assert report["broker"]["ok"] and report["broker"]["rtt_ms"] >= 0
+        assert report["object_store"]["ok"]
+        assert report["accelerator"]["ok"]
+        assert report["accelerator"]["devices"] >= 1
+    finally:
+        broker.stop()
+
+
+def test_diagnosis_dead_broker():
+    result = check_broker("127.0.0.1", 1)  # nothing listens on port 1
+    assert result["ok"] is False and "error" in result
+
+
+def test_diagnosis_cli(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    try:
+        r = CliRunner().invoke(cli, [
+            "diagnosis", "--broker", f"{host}:{port}",
+            "--store-dir", str(tmp_path)])
+        assert r.exit_code == 0, r.output
+        assert json.loads(r.output)["ok"] is True
+    finally:
+        broker.stop()
+
+
+class _FakeRegistry:
+    def __init__(self, table):
+        self.table = table
+
+    def live(self):
+        return sorted(self.table)
+
+    def get(self, n):
+        return self.table.get(n, {})
+
+
+def _master_with_nodes(table):
+    master = MasterAgent.__new__(MasterAgent)
+    master.registry = _FakeRegistry(table)
+    master.jobs = {}
+    import threading
+
+    master._lock = threading.Lock()
+    master.cluster = "default"
+    sent = []
+    master.publish_json = lambda topic, msg, **kw: sent.append((topic, msg))
+    master._sent = sent
+    return master
+
+
+def test_matcher_filters_by_inventory():
+    master = _master_with_nodes({
+        "cpu1": {"slots": 2, "resources": {"platform": "cpu",
+                                           "device_count": 8}},
+        "tpu1": {"slots": 2, "resources": {"platform": "tpu",
+                                           "device_count": 4}},
+    })
+    spec = JobSpec(job_name="j", job="true", workspace=".",
+                   computing={"platform": "tpu", "minimum_num_chips": 4})
+    job_id = master.submit_job(spec, n_ranks=1)
+    # the single rank landed on the only TPU node
+    view = master.jobs[job_id]
+    assert set(view.ranks.values()) == {"tpu1"}
+
+
+def test_matcher_rejects_unsatisfiable():
+    master = _master_with_nodes({
+        "cpu1": {"slots": 2, "resources": {"platform": "cpu",
+                                           "device_count": 8}},
+    })
+    spec = JobSpec(job_name="j", job="true", workspace=".",
+                   computing={"minimum_num_chips": 16})
+    with pytest.raises(RuntimeError, match="computing requirements"):
+        master.submit_job(spec, n_ranks=1)
+
+
+def test_matcher_ignores_empty_requirements():
+    master = _master_with_nodes({
+        "n1": {"slots": 1, "resources": {}},
+    })
+    spec = JobSpec(job_name="j", job="true", workspace=".")
+    job_id = master.submit_job(spec, n_ranks=1)
+    assert master.jobs[job_id].ranks
