@@ -76,9 +76,11 @@ class JobView:
 
 class MasterAgent(BrokerJsonAgent):
     def __init__(self, broker_host: str, broker_port: int,
-                 cluster: str = "default", node_timeout_s: float = 5.0):
+                 cluster: str = "default", node_timeout_s: float = 5.0,
+                 store=None):
         super().__init__(broker_host, broker_port)
         self.cluster = cluster
+        self._store = store  # lazily created for OTA pushes
         self.registry = PeerRegistry(node_timeout_s)
         self.jobs: Dict[str, JobView] = {}
         self._lock = threading.Lock()
@@ -240,6 +242,44 @@ class MasterAgent(BrokerJsonAgent):
             self._log_events.pop(run_id, None)
         return dict(view.logs)
 
+    # -- OTA --------------------------------------------------------------
+    def push_upgrade(self, package: bytes, version: str,
+                     nodes: Optional[List[str]] = None,
+                     timeout: float = 60.0) -> Dict[str, str]:
+        """Ship a code package to node agents for staged upgrade
+        (slave daemon_ota_upgrade parity). Returns node → staged version
+        once every target acked (or raises)."""
+        if self._store is None:
+            from fedml_tpu.core.distributed.communication.object_store import (
+                create_object_store,
+            )
+
+            self._store = create_object_store()
+        targets = nodes or self.live_nodes()
+        if not targets:
+            raise RuntimeError("no live nodes to upgrade")
+        key = self._store.new_key(f"ota/{version}")
+        self._store.put_object(key, package)
+        for n in targets:
+            self._send(n, {"type": "ota_upgrade", "package_key": key,
+                           "version": str(version)})
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            staged = {n: self.registry.get(n).get("ota_version")
+                      for n in targets}
+            errors = {n: self.registry.get(n).get("ota_error")
+                      for n in targets if self.registry.get(n).get("ota_error")}
+            if errors:
+                raise RuntimeError(f"OTA staging failed: {errors}")
+            if all(v == str(version) for v in staged.values()):
+                self._store.delete_object(key)
+                return staged
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"OTA {version}: staged on "
+            f"{[n for n, v in staged.items() if v == str(version)]} "
+            f"of {targets}")
+
     # -- internals --------------------------------------------------------
     def _send(self, node_id: str, msg: Dict) -> None:
         self.publish_json(f"sched/{self.cluster}/node/{node_id}", msg)
@@ -275,6 +315,12 @@ class MasterAgent(BrokerJsonAgent):
         elif mtype == "run_status":
             self._apply_rank_status(str(msg["run_id"]), str(msg["status"]),
                                     msg.get("returncode"))
+        elif mtype == "ota_staged":
+            if msg.get("ok"):
+                self.registry.touch(nid, ota_version=str(msg.get("version")),
+                                    ota_error=None)
+            else:
+                self.registry.touch(nid, ota_error=str(msg.get("error")))
         elif mtype == "run_logs":
             rid = str(msg["run_id"])
             for view in self.jobs.values():

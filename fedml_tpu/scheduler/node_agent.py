@@ -38,14 +38,22 @@ logger = logging.getLogger(__name__)
 class NodeAgent(BrokerJsonAgent):
     def __init__(self, node_id: str, broker_host: str, broker_port: int,
                  workdir: str = ".fedml_runs", cluster: str = "default",
-                 slots: int = 1, heartbeat_s: float = 1.0):
+                 slots: int = 1, heartbeat_s: float = 1.0, store=None):
         super().__init__(broker_host, broker_port)
         self.node_id = node_id
         self.cluster = cluster
         self.slots = slots
-        self.agent = LocalAgent(workdir=os.path.join(workdir, node_id))
+        self.workdir = os.path.join(workdir, node_id)
+        self.agent = LocalAgent(workdir=self.workdir)
         self._heartbeat_s = heartbeat_s
         self._reported: Dict[str, str] = {}  # run_id → last status sent
+        if store is None:
+            from fedml_tpu.core.distributed.communication.object_store import (
+                create_object_store,
+            )
+
+            store = create_object_store()
+        self.store = store
         self.subscribe_json(
             f"sched/{cluster}/node/{node_id}", self._on_message)
 
@@ -84,6 +92,25 @@ class NodeAgent(BrokerJsonAgent):
             self._publish({"type": "run_logs", "node_id": self.node_id,
                            "run_id": rid,
                            "data": self.agent.logs(rid, tail=msg.get("tail"))})
+        elif mtype == "ota_upgrade":
+            self._handle_ota(msg)
+
+    def _handle_ota(self, msg: Dict) -> None:
+        """Stage a code upgrade (slave daemon_ota_upgrade parity): unpack
+        the shipped package, record it, report; applied on next restart."""
+        from fedml_tpu.scheduler import ota
+
+        version = str(msg.get("version", "unknown"))
+        try:
+            record = ota.stage_upgrade(
+                self.store, str(msg["package_key"]), version, self.workdir)
+            self._publish({"type": "ota_staged", "node_id": self.node_id,
+                           "version": record["version"], "ok": True})
+        except Exception as e:
+            logger.exception("node %s: OTA staging failed", self.node_id)
+            self._publish({"type": "ota_staged", "node_id": self.node_id,
+                           "version": version, "ok": False,
+                           "error": str(e)})
 
     def _handle_start(self, msg: Dict) -> None:
         rid = str(msg["run_id"])
@@ -95,9 +122,13 @@ class NodeAgent(BrokerJsonAgent):
             bootstrap=raw.get("bootstrap"),
             env={k: str(v) for k, v in (raw.get("env") or {}).items()},
         )
+        from fedml_tpu.scheduler import ota
+
         try:
-            self.agent.start_run(spec, run_id=rid,
-                                 extra_env=msg.get("env") or {})
+            # staged OTA code (if any) leads PYTHONPATH for the job process
+            self.agent.start_run(
+                spec, run_id=rid,
+                extra_env=ota.apply_env(self.workdir, msg.get("env") or {}))
         except Exception as e:
             logger.exception("node %s failed to start %s", self.node_id, rid)
             self._publish({"type": "run_status", "node_id": self.node_id,

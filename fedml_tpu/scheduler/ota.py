@@ -1,0 +1,65 @@
+"""OTA upgrade — staged code updates for node agents.
+
+Parity target: ``slave/client_daemon.py:48`` ``daemon_ota_upgrade`` (the
+reference's agents pull a newer fedml package and restart themselves).
+Re-design for this build: the master ships a code package (zip) through
+the object store, each node agent STAGES it — unpack to a versioned
+directory, record ``pending_upgrade.json`` — and applies it on its next
+restart by prepending the staged directory to PYTHONPATH. Staging and
+applying are split on purpose: an agent mid-run must not yank its own
+code, and a bad package must be inspectable rather than half-installed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+PENDING_FILE = "pending_upgrade.json"
+
+
+def stage_upgrade(store, package_key: str, version: str,
+                  workdir: str) -> Dict:
+    """Fetch + unpack the package; record it as the pending upgrade."""
+    ota_root = os.path.join(os.path.abspath(workdir), "ota")
+    target = os.path.join(ota_root, str(version))
+    os.makedirs(ota_root, exist_ok=True)
+    zip_path = target + ".zip"
+    with open(zip_path, "wb") as f:
+        f.write(store.get_object(package_key))
+    FedMLModelCards.unpack(zip_path, target)  # zip-slip-guarded extract
+    os.unlink(zip_path)
+    record = {"version": str(version), "path": target,
+              "staged_at": time.time()}
+    tmp = os.path.join(ota_root, PENDING_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, os.path.join(ota_root, PENDING_FILE))
+    return record
+
+
+def pending_upgrade(workdir: str) -> Optional[Dict]:
+    path = os.path.join(os.path.abspath(workdir), "ota", PENDING_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def apply_env(workdir: str, env: Dict[str, str]) -> Dict[str, str]:
+    """Apply a staged upgrade to a child-process environment: the staged
+    code dir leads PYTHONPATH (how the agent's next restart — and every
+    job process it spawns — picks the new code up)."""
+    staged = pending_upgrade(workdir)
+    if staged and os.path.isdir(staged["path"]):
+        env = dict(env)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (staged["path"], env.get("PYTHONPATH")) if p)
+        env["FEDML_OTA_VERSION"] = staged["version"]
+    return env

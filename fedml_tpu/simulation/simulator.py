@@ -79,6 +79,10 @@ def create_simulator(args: Any, device, dataset, model,
         from fedml_tpu.simulation.sp.fedgan import FedGANAPI
 
         return _APIRunner(FedGANAPI(args, device, dataset, model))
+    if fed_opt == "fedseg":
+        from fedml_tpu.simulation.sp.fedseg import FedSegAPI
+
+        return _APIRunner(FedSegAPI(args, device, dataset, model))
     if fed_opt in ("vertical_fl", "vfl", "classical_vertical"):
         from fedml_tpu.simulation.vfl import VerticalFedAPI
 
