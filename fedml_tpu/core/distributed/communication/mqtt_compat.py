@@ -80,9 +80,12 @@ class PahoMqttClient(PubSubClient):
         self._handlers = {}
         self._lock = threading.Lock()
         self._connected = threading.Event()
-        self._client = mqtt.Client(
-            client_id=client_id or f"fedml-tpu-{uuid.uuid4().hex[:8]}",
-            clean_session=True)
+        cid = client_id or f"fedml-tpu-{uuid.uuid4().hex[:8]}"
+        if hasattr(mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
+            self._client = mqtt.Client(
+                mqtt.CallbackAPIVersion.VERSION1, client_id=cid)
+        else:  # paho-mqtt 1.x
+            self._client = mqtt.Client(client_id=cid, clean_session=True)
         if username:
             self._client.username_pw_set(username, password)
         self._client.on_connect = self._on_connect

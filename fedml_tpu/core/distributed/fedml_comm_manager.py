@@ -142,7 +142,12 @@ class FedMLCommManager(Observer):
             )
 
             self.com_manager = XlaIciCommManager(run_id, self.rank, self.size)
-        elif backend == constants.COMM_BACKEND_BROKER:
+        elif backend in (constants.COMM_BACKEND_BROKER,
+                         constants.COMM_BACKEND_MQTT_S3):
+            # one manager, two protocols: BROKER = in-tree TCP pub/sub (or
+            # broker_protocol: mqtt); MQTT_S3 = the reference's default
+            # backend, forcing the paho-mqtt protocol (mqtt_compat raises
+            # with instructions when paho is absent)
             from fedml_tpu.core.distributed.communication.broker_comm import (
                 BrokerCommManager,
             )
@@ -150,41 +155,26 @@ class FedMLCommManager(Observer):
                 create_object_store,
             )
 
+            if backend == constants.COMM_BACKEND_MQTT_S3:
+                protocol = "mqtt"
+                host = getattr(self.args, "mqtt_host",
+                               getattr(self.args, "broker_host", "127.0.0.1"))
+                port = getattr(self.args, "mqtt_port",
+                               getattr(self.args, "broker_port", 1883))
+            else:
+                protocol = str(getattr(self.args, "broker_protocol", "tcp"))
+                host = getattr(self.args, "broker_host", "127.0.0.1")
+                port = getattr(self.args, "broker_port", 1883)
             self.com_manager = BrokerCommManager(
                 run_id,
                 self.rank,
-                host=str(getattr(self.args, "broker_host", "127.0.0.1")),
-                port=int(getattr(self.args, "broker_port", 1883)),
+                host=str(host),
+                port=int(port),
                 object_store=create_object_store(self.args),
                 offload_bytes=int(
                     getattr(self.args, "payload_offload_bytes", 64 * 1024)
                 ),
-                protocol=str(getattr(self.args, "broker_protocol", "tcp")),
-            )
-        elif backend == constants.COMM_BACKEND_MQTT_S3:
-            # the reference's default backend: real MQTT control plane +
-            # storage offload. Same manager, mqtt protocol seam — needs
-            # paho-mqtt installed (mqtt_compat raises with instructions).
-            from fedml_tpu.core.distributed.communication.broker_comm import (
-                BrokerCommManager,
-            )
-            from fedml_tpu.core.distributed.communication.object_store import (
-                create_object_store,
-            )
-
-            self.com_manager = BrokerCommManager(
-                run_id,
-                self.rank,
-                host=str(getattr(self.args, "mqtt_host",
-                                 getattr(self.args, "broker_host",
-                                         "127.0.0.1"))),
-                port=int(getattr(self.args, "mqtt_port",
-                                 getattr(self.args, "broker_port", 1883))),
-                object_store=create_object_store(self.args),
-                offload_bytes=int(
-                    getattr(self.args, "payload_offload_bytes", 64 * 1024)
-                ),
-                protocol="mqtt",
+                protocol=protocol,
             )
         else:
             raise ValueError(f"unknown comm backend {self.backend!r}")

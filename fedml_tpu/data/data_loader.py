@@ -231,8 +231,17 @@ def _pack_leaf_users(args, train_users, test_users, to_arrays, class_num,
                      feature_dim):
     """LEAF's point is the NATURAL partition: clients = users (grouped
     round-robin onto client_num buckets when there are more users)."""
-    client_num = int(getattr(args, "client_num_in_total", len(train_users)))
     users = sorted(train_users)
+    client_num = int(getattr(args, "client_num_in_total", len(users)))
+    if client_num > len(users):
+        # more clients than LEAF users cannot be satisfied — an empty
+        # client would crash concatenation and train on nothing anyway
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "LEAF partition: %d clients requested but only %d users; "
+            "using %d clients", client_num, len(users), len(users))
+        client_num = len(users)
     buckets = {i: [] for i in range(client_num)}
     for j, u in enumerate(users):
         buckets[j % client_num].append(u)

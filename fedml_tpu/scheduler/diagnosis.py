@@ -71,7 +71,12 @@ def run_diagnosis(broker: str = None, store_dir=None) -> Dict:
     report: Dict = {}
     if broker:
         host, _, port = broker.rpartition(":")
-        report["broker"] = check_broker(host, int(port))
+        if not host or not port.isdigit():
+            report["broker"] = {
+                "ok": False,
+                "error": f"expected host:port, got {broker!r}"}
+        else:
+            report["broker"] = check_broker(host, int(port))
     report["object_store"] = check_object_store(store_dir)
     report["accelerator"] = check_accelerator()
     report["ok"] = all(v.get("ok") for v in report.values()

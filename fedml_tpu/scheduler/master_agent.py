@@ -258,6 +258,8 @@ class MasterAgent(BrokerJsonAgent):
         targets = nodes or self.live_nodes()
         if not targets:
             raise RuntimeError("no live nodes to upgrade")
+        for n in targets:  # clear stale state from any previous push
+            self.registry.touch(n, ota_version=None, ota_error=None)
         key = self._store.new_key(f"ota/{version}")
         self._store.put_object(key, package)
         for n in targets:

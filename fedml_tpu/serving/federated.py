@@ -182,6 +182,8 @@ class InferenceServerManager(FedMLCommManager):
             m.add_params(InfMessage.ARG_X, x[sl])
             self.send_message(m)
         if not entry["event"].wait(timeout):
+            with self._lock:  # drop the entry or stragglers leak into it
+                self._pending.pop(req_id, None)
             raise TimeoutError(
                 f"inference request {req_id}: "
                 f"{len(entry['parts'])}/{len(shards)} shards returned")
