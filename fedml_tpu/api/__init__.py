@@ -1,0 +1,177 @@
+"""``fedml_tpu.api`` — programmatic control surface.
+
+Parity target: ``python/fedml/api/__init__.py`` (``launch_job`` :42,
+``run_stop`` :121, ``run_list``/``run_status``/``run_logs`` :125-135,
+``model_deploy`` :266, storage upload/download :181-204). The reference
+routes everything through the hosted Nexus backend; here the same verbs
+drive the local/cluster schedulers, the deploy plane, and the object
+store directly — no login, no REST hop.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+DEFAULT_WORKDIR = ".fedml_runs"
+
+
+# -- jobs (local agent) ------------------------------------------------------
+
+def launch_job(yaml_file: str, workdir: str = DEFAULT_WORKDIR) -> str:
+    """Run a job yaml on the local agent; returns the run id."""
+    from fedml_tpu.scheduler.launch import launch_job as _launch
+
+    return _launch(yaml_file, workdir=workdir)
+
+
+def run_stop(run_id: str, workdir: str = DEFAULT_WORKDIR) -> bool:
+    from fedml_tpu.scheduler.launch import run_stop as _stop
+
+    return _stop(run_id, workdir=workdir)
+
+
+def run_status(run_id: str, workdir: str = DEFAULT_WORKDIR) -> Optional[str]:
+    from fedml_tpu.scheduler.launch import run_status as _status
+
+    return _status(run_id, workdir=workdir)
+
+
+def run_logs(run_id: str, tail: Optional[int] = None,
+             workdir: str = DEFAULT_WORKDIR) -> str:
+    from fedml_tpu.scheduler.launch import run_logs as _logs
+
+    return _logs(run_id, tail=tail, workdir=workdir)
+
+
+def run_list(workdir: str = DEFAULT_WORKDIR) -> List[Dict]:
+    from fedml_tpu.scheduler.launch import list_jobs
+
+    return list_jobs(workdir=workdir)
+
+
+# -- cluster jobs (master agent) ---------------------------------------------
+
+def launch_job_on_cluster(yaml_file: str, broker: str, n_ranks: int = 1,
+                          nodes: Optional[List[str]] = None,
+                          wait: bool = True, timeout: float = 3600.0) -> Dict:
+    """Submit a job yaml across node agents; returns the job view."""
+    from fedml_tpu.scheduler.job_yaml import JobSpec
+    from fedml_tpu.scheduler.master_agent import MasterAgent
+
+    host, _, port = broker.rpartition(":")
+    master = MasterAgent(host, int(port)).start()
+    try:
+        master.wait_for_nodes(len(nodes) if nodes else 1,
+                              timeout=min(30.0, timeout))
+        job_id = master.submit_job(JobSpec.load(yaml_file), n_ranks=n_ranks,
+                                   nodes=nodes)
+        if not wait:
+            return {"job_id": job_id, "status": "RUNNING"}
+        try:
+            return master.wait_job(job_id, timeout=timeout)
+        except TimeoutError:
+            master.stop_job(job_id)
+            raise
+    finally:
+        master.shutdown()
+
+
+# -- model cards + deployment ------------------------------------------------
+
+def model_create(name: str, workspace: str,
+                 registry: Optional[str] = None) -> Dict:
+    from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+    return FedMLModelCards(registry).create_model(name, workspace)
+
+
+def model_list(registry: Optional[str] = None) -> List[Dict]:
+    from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+    return FedMLModelCards(registry).list_models()
+
+
+def model_delete(name: str, version: Optional[int] = None,
+                 registry: Optional[str] = None) -> bool:
+    from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+    return FedMLModelCards(registry).delete_model(name, version)
+
+
+def model_deploy(name: str, broker: str, n_replicas: int = 1,
+                 registry: Optional[str] = None,
+                 store_dir: Optional[str] = None,
+                 cache_path: str = ".fedml_deploy/endpoints.json",
+                 timeout: float = 180.0, with_token: bool = False) -> Dict:
+    """Deploy a model card to live deploy workers (reference
+    ``api.model_deploy`` :266 / ``serve_model_on_premise``)."""
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+    from fedml_tpu.deploy import DeployMaster, EndpointCache
+    from fedml_tpu.deploy.model_cards import FedMLModelCards
+
+    host, _, port = broker.rpartition(":")
+    master = DeployMaster(
+        host, int(port), LocalDirObjectStore(store_dir),
+        EndpointCache(cache_path), cards=FedMLModelCards(registry),
+    ).start()
+    try:
+        master.wait_for_workers(n_replicas, timeout=min(30.0, timeout))
+        return master.deploy(name, n_replicas=n_replicas, timeout=timeout,
+                             with_token=with_token)
+    finally:
+        master.shutdown()
+
+
+# -- storage (object store) --------------------------------------------------
+
+def upload(data_path: str, name: Optional[str] = None,
+           store_dir: Optional[str] = None) -> str:
+    """Store a local file; returns its key (reference ``api.upload``)."""
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+
+    store = LocalDirObjectStore(store_dir)
+    key = f"storage/{name or os.path.basename(data_path)}"
+    with open(data_path, "rb") as f:
+        store.put_object(key, f.read())
+    return key
+
+
+def download(key: str, dest_path: str,
+             store_dir: Optional[str] = None) -> str:
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+
+    store = LocalDirObjectStore(store_dir)
+    with open(dest_path, "wb") as f:
+        f.write(store.get_object(key))
+    return dest_path
+
+
+def delete(key: str, store_dir: Optional[str] = None) -> None:
+    from fedml_tpu.core.distributed.communication.object_store import (
+        LocalDirObjectStore,
+    )
+
+    LocalDirObjectStore(store_dir).delete_object(key)
+
+
+__all__ = [
+    "delete",
+    "download",
+    "launch_job",
+    "launch_job_on_cluster",
+    "model_create",
+    "model_delete",
+    "model_deploy",
+    "model_list",
+    "run_list",
+    "run_logs",
+    "run_status",
+    "run_stop",
+    "upload",
+]

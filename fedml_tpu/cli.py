@@ -133,6 +133,23 @@ def jobs(workdir: str) -> None:
 
 
 @cli.command()
+@click.option("--source-folder", required=True)
+@click.option("--entry-point", required=True,
+              help="job entry file inside the source folder")
+@click.option("--dest-folder", default="dist", show_default=True)
+@click.option("--config-folder", default=None)
+@click.option("--name", "package_name", default=None)
+def build(source_folder: str, entry_point: str, dest_folder: str,
+          config_folder, package_name) -> None:
+    """Package a job for distribution (reference: `fedml build`)."""
+    from fedml_tpu.scheduler.build import build_package
+
+    path = build_package(source_folder, entry_point, dest_folder,
+                         config_folder, package_name)
+    click.echo(path)
+
+
+@cli.command()
 @click.option("--broker", default=None,
               help="host:port of the federation broker to check")
 @click.option("--store-dir", default=None)

@@ -78,3 +78,46 @@ def log(metrics: Dict) -> None:
 
 def log_metric(metrics: Dict) -> None:
     _global_sink().log(metrics)
+
+
+def log_artifact(path: str, artifact_name: str = None,
+                 artifact_type: str = "general") -> str:
+    """``fedml.mlops.log_artifact`` parity: copy the file into the sink's
+    artifacts dir and record it. Returns the stored path."""
+    import shutil
+
+    sink = _global_sink()
+    name = artifact_name or os.path.basename(path)
+    dst_dir = os.path.join(sink._dir, "artifacts")
+    os.makedirs(dst_dir, exist_ok=True)
+    dst = os.path.join(dst_dir, name)
+    shutil.copy2(path, dst)
+    sink._write("artifact", {"name": name, "type": artifact_type,
+                             "path": dst})
+    return dst
+
+
+def log_model(model_name: str, params: Any) -> str:
+    """``fedml.mlops.log_model`` parity: persist a params pytree into the
+    artifacts dir (pickle-free serializer). Returns the stored path."""
+    from fedml_tpu.utils.serialization import safe_dumps
+
+    sink = _global_sink()
+    dst_dir = os.path.join(sink._dir, "artifacts")
+    os.makedirs(dst_dir, exist_ok=True)
+    dst = os.path.join(dst_dir, f"{model_name}.fedml")
+    with open(dst, "wb") as f:
+        f.write(safe_dumps(params))
+    sink._write("model", {"name": model_name, "path": dst})
+    return dst
+
+
+def log_llm_record(record: Dict, record_type: str = "inference") -> None:
+    """``fedml.mlops.log_llm_record`` parity: prompt/response telemetry."""
+    _global_sink()._write("llm_record", {"record_type": record_type,
+                                         **record})
+
+
+def log_round_info(total_rounds: int, round_idx: int) -> None:
+    _global_sink()._write("round_info", {"total_rounds": int(total_rounds),
+                                         "round_idx": int(round_idx)})

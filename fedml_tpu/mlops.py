@@ -1,0 +1,53 @@
+"""``fedml_tpu.mlops`` — public observability API.
+
+Parity target: ``python/fedml/mlops/__init__.py:10-196`` (``mlops.log``,
+``log_metric``, ``log_artifact``, ``log_model``, ``log_llm_record``,
+``event`` spans). Everything lands in the local JSONL sink
+(``core/mlops/metrics.py``) — the hosted-MQTT backend's role here —
+with optional wandb mirroring.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
+from fedml_tpu.core.mlops.metrics import (  # noqa: F401
+    init,
+    log,
+    log_artifact,
+    log_llm_record,
+    log_metric,
+    log_model,
+    log_round_info,
+)
+
+_event_singleton = None
+
+
+def _events() -> MLOpsProfilerEvent:
+    global _event_singleton
+    if _event_singleton is None:
+        _event_singleton = MLOpsProfilerEvent(None)
+    return _event_singleton
+
+
+@contextlib.contextmanager
+def event(name: str, event_value=None):
+    """Span context manager (reference: ``mlops.event(..., started/ended)``)."""
+    _events().log_event_started(name, event_value)
+    try:
+        yield
+    finally:
+        _events().log_event_ended(name, event_value)
+
+
+__all__ = [
+    "event",
+    "init",
+    "log",
+    "log_artifact",
+    "log_llm_record",
+    "log_metric",
+    "log_model",
+    "log_round_info",
+]

@@ -33,6 +33,31 @@ class CNNFemnist(nn.Module):
         return nn.Dense(self.output_dim)(x)
 
 
+class LeNet5(nn.Module):
+    """Classic LeNet-5 — the cross-device on-device model.
+
+    Parity: ``model/mobile/mnn_lenet`` (the reference ships LeNet as the
+    .mnn file BeeHive phones train); here it is the same architecture in
+    flax for the JAX device runtime.
+    """
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:  # flat 784 → 28×28×1
+            side = int(jnp.sqrt(x.shape[-1]))
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.relu(nn.Conv(6, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.output_dim)(x)
+
+
 class CNNCifar(nn.Module):
     output_dim: int = 10
 

@@ -34,6 +34,16 @@ def create(args: Any, output_dim: int = 10) -> nn.Module:
         if "cifar" in dataset or "cinic" in dataset:
             return CNNCifar(output_dim=output_dim)
         return CNNFemnist(output_dim=output_dim)
+    if name in ("lenet", "lenet5", "mnn_lenet"):
+        # cross-device on-device model (reference: model/mobile/mnn_lenet)
+        from fedml_tpu.models.cv.cnn import LeNet5
+
+        return LeNet5(output_dim=output_dim)
+    if name in ("segnet", "deeplab", "unet"):
+        from fedml_tpu.simulation.sp.fedseg import SegNet
+
+        return SegNet(n_classes=output_dim,
+                      width=int(getattr(args, "seg_width", 16)))
     if name in ("resnet18", "resnet18_gn"):
         return resnet18(output_dim=output_dim, groups=groups)
     if name in ("resnet20",):

@@ -1,0 +1,60 @@
+"""`fedml_tpu build` — package a job for distribution.
+
+Parity target: ``fedml build`` (``cli/modules/build.py`` →
+``api.fedml_build``): zip a source folder + entry point + optional
+config folder into a self-describing package that `launch`/OTA/deploy
+can ship through the object store.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import zipfile
+from typing import Dict, Optional
+
+MANIFEST = "fedml_package.json"
+
+
+def build_package(source_folder: str, entry_point: str,
+                  dest_folder: str, config_folder: Optional[str] = None,
+                  package_name: Optional[str] = None) -> str:
+    """Returns the path of the built zip."""
+    source_folder = os.path.abspath(source_folder)
+    if not os.path.isdir(source_folder):
+        raise FileNotFoundError(f"no such source folder: {source_folder}")
+    entry_path = os.path.join(source_folder, entry_point)
+    if not os.path.isfile(entry_path):
+        raise FileNotFoundError(
+            f"entry point {entry_point!r} not found in {source_folder}")
+    os.makedirs(dest_folder, exist_ok=True)
+    name = package_name or os.path.basename(source_folder.rstrip(os.sep))
+    zip_path = os.path.join(os.path.abspath(dest_folder), f"{name}.zip")
+
+    manifest: Dict = {
+        "package_name": name,
+        "entry_point": entry_point,
+        "built_at": time.time(),
+    }
+    with zipfile.ZipFile(zip_path, "w", zipfile.ZIP_DEFLATED) as z:
+        for base, dirs, files in os.walk(source_folder):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".fedml_runs")]
+            for fn in files:
+                full = os.path.join(base, fn)
+                z.write(full, os.path.relpath(full, source_folder))
+        if config_folder:
+            cfg = os.path.abspath(config_folder)
+            for base, _, files in os.walk(cfg):
+                for fn in files:
+                    full = os.path.join(base, fn)
+                    z.write(full, os.path.join(
+                        "config", os.path.relpath(full, cfg)))
+            manifest["config_folder"] = "config"
+        z.writestr(MANIFEST, json.dumps(manifest))
+    return zip_path
+
+
+def read_manifest(zip_path: str) -> Dict:
+    with zipfile.ZipFile(zip_path) as z:
+        return json.loads(z.read(MANIFEST))

@@ -1,0 +1,135 @@
+"""Public API surfaces: fedml_tpu.api verbs + fedml_tpu.mlops."""
+import json
+import os
+import textwrap
+import time
+
+import numpy as np
+
+from fedml_tpu import api, mlops
+
+
+def test_api_job_lifecycle(tmp_path):
+    job = tmp_path / "job.yaml"
+    job.write_text(textwrap.dedent("""
+        job_name: api-test
+        workspace: .
+        job: |
+          echo API_JOB_RAN
+    """))
+    workdir = str(tmp_path / "runs")
+    rid = api.launch_job(str(job), workdir=workdir)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if api.run_status(rid, workdir=workdir) in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert api.run_status(rid, workdir=workdir) == "FINISHED"
+    assert "API_JOB_RAN" in api.run_logs(rid, workdir=workdir)
+    rows = api.run_list(workdir=workdir)
+    assert any(r["run_id"] == rid for r in rows)
+    assert api.run_stop(rid, workdir=workdir) is False  # already done
+
+
+def test_api_model_cards(tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "p.py").write_text(
+        "from fedml_tpu.serving.predictor import FedMLPredictor\n"
+        "class P(FedMLPredictor):\n"
+        "    def predict(self, request):\n"
+        "        return request\n")
+    (ws / "model_config.yaml").write_text(
+        "entry_module: p\nentry_class: P\n")
+    reg = str(tmp_path / "reg")
+    card = api.model_create("m", str(ws), registry=reg)
+    assert card["model_version"] == 1
+    assert api.model_list(registry=reg)[0]["model_name"] == "m"
+    assert api.model_delete("m", registry=reg)
+
+
+def test_api_storage_roundtrip(tmp_path):
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"\x00\x01payload")
+    store = str(tmp_path / "store")
+    key = api.upload(str(src), store_dir=store)
+    dst = str(tmp_path / "out.bin")
+    api.download(key, dst, store_dir=store)
+    assert open(dst, "rb").read() == b"\x00\x01payload"
+    api.delete(key, store_dir=store)
+
+
+def test_build_package_and_lenet(tmp_path):
+    from click.testing import CliRunner
+
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.cli import cli
+    from fedml_tpu.scheduler.build import read_manifest
+
+    src = tmp_path / "app"
+    src.mkdir()
+    (src / "train.py").write_text("print('hi')\n")
+    (src / "helper.py").write_text("X = 1\n")
+    cfg = tmp_path / "cfg"
+    cfg.mkdir()
+    (cfg / "fedml_config.yaml").write_text("train_args: {epochs: 1}\n")
+    r = CliRunner().invoke(cli, [
+        "build", "--source-folder", str(src), "--entry-point", "train.py",
+        "--dest-folder", str(tmp_path / "dist"),
+        "--config-folder", str(cfg)])
+    assert r.exit_code == 0, r.output
+    zip_path = r.output.strip()
+    assert os.path.exists(zip_path)
+    manifest = read_manifest(zip_path)
+    assert manifest["entry_point"] == "train.py"
+    import zipfile
+
+    names = set(zipfile.ZipFile(zip_path).namelist())
+    assert {"train.py", "helper.py", "config/fedml_config.yaml"} <= names
+
+    # lenet model-zoo entry (mnn-lenet parity) forwards on 28x28
+    import jax
+
+    from fedml_tpu import models as models_mod
+
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "model_args": {"model": "lenet"},
+        "train_args": {"client_num_in_total": 1, "client_num_per_round": 1,
+                       "comm_round": 1, "epochs": 1},
+    }))
+    model = models_mod.create(args, output_dim=10)
+    x = np.zeros((2, 784), np.float32)
+    params = model.init(jax.random.key(0), x)
+    assert model.apply(params, x).shape == (2, 10)
+
+
+def test_mlops_surface(tmp_path, monkeypatch):
+    from fedml_tpu.core.mlops import metrics as core_metrics
+
+    class A:
+        run_id = "mlops_api"
+        mlops_sink_dir = str(tmp_path / "sink")
+
+    mlops.init(A())
+    mlops.log({"acc": 0.9})
+    mlops.log_metric({"loss": 0.1})
+    mlops.log_llm_record({"prompt": "hi", "response": "yo"})
+    artifact = tmp_path / "report.txt"
+    artifact.write_text("hello")
+    stored = mlops.log_artifact(str(artifact))
+    assert os.path.exists(stored)
+    model_path = mlops.log_model("mymodel", {"w": np.ones(3, np.float32)})
+    assert os.path.exists(model_path)
+    from fedml_tpu.utils.serialization import safe_loads
+
+    restored = safe_loads(open(model_path, "rb").read())
+    np.testing.assert_array_equal(restored["w"], np.ones(3, np.float32))
+    with mlops.event("round", 0):
+        pass
+
+    sink_file = os.path.join(core_metrics._global_sink()._dir,
+                             "metrics.jsonl")
+    kinds = [json.loads(l)["kind"] for l in open(sink_file)]
+    for expect in ("metric", "llm_record", "artifact", "model"):
+        assert expect in kinds, (expect, kinds)
