@@ -72,11 +72,16 @@ def llm_shape(hbm_bytes: float):
         # optimizer state tiny. remat OFF: B8xT1024 activations fit v5e
         # HBM, and the round-3 sweep (PERF_NOTES.md) measured full-remat
         # at 545ms/step vs 421ms without — recompute was pure overhead.
+        import jax.numpy as jnp
+
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=22, num_attention_heads=32,
             num_key_value_heads=8, max_position_embeddings=2048,
             lora_rank=16, remat=False, remat_policy="none",
+            # frozen base needs no fp32 master: bf16 storage halves cast
+            # traffic (PERF_NOTES.md; LoRA adapters keep fp32 masters)
+            param_dtype=jnp.bfloat16,
         )
         return cfg, 8, 1024  # batch, seq
     # CPU / tiny-dev fallback so the bench always completes

@@ -44,6 +44,11 @@ class LlamaConfig:
     lora_alpha: float = 16.0
     # training knobs
     dtype: Any = jnp.bfloat16
+    # storage dtype of the FROZEN base weights. fp32 default (full-FT
+    # masters); LoRA fine-tuning can store the base in bf16 — frozen
+    # weights need no master copy, and bf16 halves both HBM residency
+    # and the per-step cast traffic (see PERF_NOTES.md)
+    param_dtype: Any = jnp.float32
     remat: bool = True
     # "full": recompute the whole block in backward (min memory, +1/3
     # forward flops); "dots": save matmul outputs, recompute elementwise
@@ -113,6 +118,8 @@ class LlamaConfig:
             kw["use_flash"] = bool(args.use_flash_attention)
         if getattr(args, "remat_policy", None) is not None:
             kw["remat_policy"] = str(args.remat_policy)
+        if bool(getattr(args, "base_params_bf16", False)):
+            kw["param_dtype"] = jnp.bfloat16
         builder = {
             "tiny": LlamaConfig.tiny,
             "llama2_7b": LlamaConfig.llama2_7b,
@@ -182,6 +189,7 @@ class LoRADense(nn.Module):
     rank: int = 0
     alpha: float = 16.0
     dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32  # base kernel storage; lora_a/b stay fp32
     kernel_axes: Tuple[str, ...] = ()
 
     @nn.compact
@@ -192,7 +200,7 @@ class LoRADense(nn.Module):
                 nn.initializers.lecun_normal(), self.kernel_axes
             ),
             (x.shape[-1], self.features),
-            jnp.float32,
+            self.param_dtype,
         )
         y = x @ kernel.astype(self.dtype)
         if self.rank > 0:
@@ -229,7 +237,7 @@ class LlamaAttention(nn.Module):
         h, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         dense = lambda feats, name, axes: LoRADense(
             feats, rank=cfg.lora_rank, alpha=cfg.lora_alpha, dtype=cfg.dtype,
-            kernel_axes=axes, name=name,
+            param_dtype=cfg.param_dtype, kernel_axes=axes, name=name,
         )
         q = dense(h * d, "q_proj", ("embed", "heads"))(x)
         k = dense(hkv * d, "k_proj", ("embed", "heads"))(x)
@@ -295,7 +303,8 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         dense = lambda feats, name, axes: LoRADense(
-            feats, rank=0, dtype=cfg.dtype, kernel_axes=axes, name=name
+            feats, rank=0, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_axes=axes, name=name
         )
         gate = dense(cfg.intermediate_size, "gate_proj", ("embed", "mlp"))(x)
         up = dense(cfg.intermediate_size, "up_proj", ("embed", "mlp"))(x)
@@ -343,7 +352,7 @@ class LlamaForCausalLM(nn.Module):
                 nn.initializers.normal(0.02), ("vocab", "embed")
             ),
             (cfg.vocab_size, cfg.hidden_size),
-            jnp.float32,
+            cfg.param_dtype,
         )
         x = emb.astype(cfg.dtype)[tokens]
         if positions is None:
@@ -373,7 +382,7 @@ class LlamaForCausalLM(nn.Module):
                     nn.initializers.normal(0.02), ("embed", "vocab")
                 ),
                 (cfg.hidden_size, cfg.vocab_size),
-                jnp.float32,
+                cfg.param_dtype,
             )
             logits = x @ head.astype(cfg.dtype)
         logits = logits.astype(jnp.float32)
