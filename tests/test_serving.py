@@ -129,12 +129,13 @@ def test_inference_runner_http_roundtrip():
         status, body = _post(url + "/predict", {"stream": True})
         lines = [json.loads(l) for l in body.decode().strip().splitlines()]
         assert lines == [{"i": 0}, {"i": 1}, {"i": 2}]
-        # error path → 500 recorded in monitor
-        req = urllib.request.Request(
-            url + "/predict", data=b'{"boom": true}',
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
+        # monitor recorded both requests (the handler records in a
+        # finally AFTER the client finishes reading — poll briefly)
+        deadline = time.time() + 10
         snap = runner.monitor.snapshot()
+        while snap["requests"] < 2 and time.time() < deadline:
+            time.sleep(0.05)
+            snap = runner.monitor.snapshot()
         assert snap["requests"] >= 2 and snap["latency_avg_ms"] >= 0
     finally:
         runner.stop()
