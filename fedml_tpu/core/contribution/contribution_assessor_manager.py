@@ -9,7 +9,9 @@ metrics sink so the MLOps plane can show per-client contribution.
 Config:
   contribution_args:
     enable_contribution: true
-    contribution_method: gtg_shapley | leave_one_out
+    contribution_method: gtg_shapley | mr_shapley | leave_one_out
+    contribution_round_trunc: 0.01   # MR: skip rounds that moved utility
+                                     # by less than this (ref eps)
 """
 from __future__ import annotations
 
@@ -18,7 +20,11 @@ from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 
 from fedml_tpu.core.alg_frame.params import Context
-from fedml_tpu.core.contribution.gtg_shapley import gtg_shapley, leave_one_out
+from fedml_tpu.core.contribution.gtg_shapley import (
+    gtg_shapley,
+    leave_one_out,
+    mr_shapley,
+)
 
 Pytree = Any
 
@@ -34,6 +40,8 @@ class ContributionAssessorManager:
         ).lower()
         self.max_permutations = int(getattr(args, "contribution_max_perms", 32))
         self.eps = float(getattr(args, "contribution_trunc_eps", 1e-3))
+        self.round_trunc = float(
+            getattr(args, "contribution_round_trunc", 0.01))
         self.accumulated: Dict[int, float] = {}
 
     def is_enabled(self) -> bool:
@@ -61,6 +69,18 @@ class ContributionAssessorManager:
         n = len(w_locals)
         if self.method == "leave_one_out":
             phi = leave_one_out(n, utility)
+        elif self.method in ("mr", "mr_shapley"):
+            # MR round truncation (ref mr_shapley_value.py
+            # round_trunc_threshold): a round that barely moved the
+            # utility contributes ~0 to everyone — skip the 2^n sweep
+            v_full = utility(list(range(n)))
+            if abs(v_full - utility_empty) < self.round_trunc:
+                logger.info("round %d: utility moved %.4f < %.4f — "
+                            "MR-Shapley round truncated", round_idx,
+                            abs(v_full - utility_empty), self.round_trunc)
+                phi = [0.0] * n
+            else:
+                phi = mr_shapley(n, utility, utility_empty)
         else:
             phi = gtg_shapley(
                 n, utility, utility_empty,

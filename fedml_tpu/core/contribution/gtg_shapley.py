@@ -88,3 +88,34 @@ def leave_one_out(
         rest = [j for j in range(n) if j != i]
         out[i] = v_full - float(utility_fn(rest))
     return out
+
+
+def mr_shapley(
+    n: int,
+    utility_fn: Callable[[Sequence[int]], float],
+    utility_empty: float,
+) -> np.ndarray:
+    """Exact per-round Shapley over the full power set.
+
+    Parity: ``core/contribution/mr_shapley_value.py`` (the "MR" assessor
+    enumerates every coalition each round and sums the exact values
+    across rounds; the cross-round summation lives in the manager).
+    φ_i = Σ_{S ∌ i} |S|!·(n−|S|−1)!/n! · [v(S∪{i}) − v(S)].
+    """
+    import math
+
+    members = list(range(n))
+    v: Dict[frozenset, float] = {frozenset(): float(utility_empty)}
+    for r in range(1, n + 1):
+        for subset in itertools.combinations(members, r):
+            v[frozenset(subset)] = float(utility_fn(list(subset)))
+    fact = [math.factorial(k) for k in range(n + 1)]
+    out = np.zeros(n, np.float64)
+    for i in members:
+        others = [j for j in members if j != i]
+        for r in range(0, n):
+            w = fact[r] * fact[n - r - 1] / fact[n]
+            for subset in itertools.combinations(others, r):
+                s = frozenset(subset)
+                out[i] += w * (v[s | {i}] - v[s])
+    return out

@@ -45,6 +45,50 @@ def test_mc_shapley_matches_exact_on_larger_game():
     np.testing.assert_allclose(mc, exact, atol=1e-9)  # additive: any perm exact
 
 
+def test_mr_shapley_exact_on_games():
+    from fedml_tpu.core.contribution.gtg_shapley import mr_shapley
+
+    # additive game: phi == weights
+    w = np.asarray([3.0, 1.0, 2.0])
+    phi = mr_shapley(3, lambda s: float(sum(w[list(s)])), 0.0)
+    np.testing.assert_allclose(phi, w, atol=1e-9)
+    # glove game (L={0,1}, R={2}): phi = (1/6, 1/6, 4/6)
+    glove = lambda s: 1.0 if (set(s) & {0, 1}) and (2 in s) else 0.0
+    phi = mr_shapley(3, glove, 0.0)
+    np.testing.assert_allclose(phi, [1 / 6, 1 / 6, 4 / 6], atol=1e-9)
+    # efficiency: Σ phi == v(N) − v(∅)
+    rng = np.random.default_rng(0)
+    vals = {frozenset(s): rng.random()
+            for r in range(5) for s in __import__("itertools").combinations(
+                range(4), r + 1)}
+    util = lambda s: vals.get(frozenset(s), 0.0)
+    phi = mr_shapley(4, util, 0.25)
+    assert abs(phi.sum() - (util(range(4)) - 0.25)) < 1e-9
+
+
+def test_mr_shapley_round_truncation():
+    """A round that barely moves utility is skipped (0 valuations)."""
+
+    class A:
+        enable_contribution = True
+        contribution_method = "mr_shapley"
+        contribution_round_trunc = 0.05
+        random_seed = 0
+
+    calls = []
+    mgr = ContributionAssessorManager(A())
+    w_locals = [(1, {"w": np.ones(2)}), (1, {"w": np.ones(2)})]
+
+    def util_of_params(p):
+        calls.append(1)
+        return 0.501  # full-coalition utility ≈ empty utility
+
+    values = mgr.run([0, 1], w_locals, util_of_params,
+                     utility_empty=0.5, round_idx=0)
+    assert values == {0: 0.0, 1: 0.0}
+    assert len(calls) == 1  # only v(N) was evaluated — the sweep skipped
+
+
 def test_leave_one_out():
     def v(s):
         return float(len(s)) ** 2  # superadditive
