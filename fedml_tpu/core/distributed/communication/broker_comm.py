@@ -90,7 +90,9 @@ class BrokerCommManager(BaseCommunicationManager):
                 continue
             store_key = self.store.new_key(
                 f"{self.run_id}/r{msg.get_sender_id()}")
-            self.store.put_object(store_key, safe_dumps(payload))
+            # The returned key is authoritative: content-addressed backends
+            # (web3/theta CAS) return a CID, not the advisory key.
+            store_key = self.store.put_object(store_key, safe_dumps(payload))
             del params[key]
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_KEY] = store_key
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = f"store://{store_key}"
@@ -109,7 +111,10 @@ class BrokerCommManager(BaseCommunicationManager):
                 params.pop(Message.MSG_ARG_KEY_MODEL_PARAMS_URL, None)
                 blob = self.store.get_object(store_key)
                 params[Message.MSG_ARG_KEY_MODEL_PARAMS] = safe_loads(blob)
-                self.store.delete_object(store_key)
+                # CAS stores dedup identical broadcasts to one CID — deleting
+                # here would destroy the blob before sibling receivers fetch.
+                if not self.store.content_addressed:
+                    self.store.delete_object(store_key)
             self._inbox.put(Message.construct_from_params(params))
         except Exception:
             logger.exception("rank %d: bad broker frame dropped", self.rank)

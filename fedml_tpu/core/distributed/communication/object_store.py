@@ -16,6 +16,11 @@ from typing import Optional
 
 
 class ObjectStore(abc.ABC):
+    #: Content-addressed stores dedup identical payloads under one key, so a
+    #: receiver must never delete after fetch — another receiver of the same
+    #: broadcast may still need the blob (cleanup = unpin/TTL instead).
+    content_addressed = False
+
     @abc.abstractmethod
     def put_object(self, key: str, data: bytes) -> str:
         """Store bytes; returns the key (S3 parity: upload → url)."""
@@ -78,5 +83,47 @@ class LocalDirObjectStore(ObjectStore):
 
 
 def create_object_store(args=None) -> ObjectStore:
+    """Factory keyed on ``args.remote_storage``.
+
+    local (default) — shared-directory store (NFS/gcsfuse on TPU pods);
+    s3 — real S3 REST protocol w/ SigV4 (``s3_store.S3ObjectStore``);
+    web3 / theta — content-addressed decentralized stores;
+    cas — offline content-addressed twin (CID = sha256).
+    Parity: backend choice in the reference's comm-manager selection
+    (``mqtt_s3`` / ``mqtt_web3`` / ``mqtt_thetastore`` managers).
+    """
+    kind = (getattr(args, "remote_storage", None) or "local").lower()
+    secret = getattr(args, "ipfs_secret_key", None) if args is not None else None
+    if kind == "s3":
+        from fedml_tpu.core.distributed.communication.s3_store import S3ObjectStore
+
+        return S3ObjectStore.from_args(args)
+    if kind in ("web3", "ipfs"):
+        from fedml_tpu.core.distributed.communication.decentralized_storage import (
+            Web3ObjectStore,
+        )
+
+        return Web3ObjectStore(
+            upload_uri=getattr(args, "web3_upload_uri", "https://api.web3.storage/upload"),
+            download_uri=getattr(args, "web3_download_uri", "https://w3s.link"),
+            secret_key=secret,
+        )
+    if kind == "theta":
+        from fedml_tpu.core.distributed.communication.decentralized_storage import (
+            ThetaObjectStore,
+        )
+
+        return ThetaObjectStore(
+            rpc_uri=getattr(args, "theta_rpc_uri", "http://localhost:19888/rpc"),
+            secret_key=secret,
+        )
+    if kind == "cas":
+        from fedml_tpu.core.distributed.communication.decentralized_storage import (
+            LocalCASObjectStore,
+        )
+
+        return LocalCASObjectStore(
+            getattr(args, "object_store_dir", None), secret_key=secret
+        )
     root = getattr(args, "object_store_dir", None) if args is not None else None
     return LocalDirObjectStore(root)

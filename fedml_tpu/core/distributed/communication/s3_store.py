@@ -1,0 +1,157 @@
+"""Real S3-protocol object store — AWS Signature V4 over stdlib HTTP.
+
+Parity target: ``core/distributed/communication/s3/remote_storage.py``
+(the reference's 669-LoC boto3 wrapper that uploads model payloads to S3
+and hands the URL around over MQTT). This build speaks the actual S3 REST
+protocol (path-style ``PUT/GET/DELETE /{bucket}/{key}`` with SigV4
+``Authorization`` headers) so it works against AWS S3 or any
+S3-compatible endpoint (MinIO, GCS interop mode) with zero third-party
+dependencies — boto3 is not in the image, and the wire protocol is small.
+
+Credentials come from the environment (``AWS_ACCESS_KEY_ID`` /
+``AWS_SECRET_ACCESS_KEY``), never from job yaml, mirroring the
+reference's credential handling.
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from fedml_tpu.core.distributed.communication.object_store import ObjectStore
+
+_ALGO = "AWS4-HMAC-SHA256"
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).digest()
+
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def sigv4_headers(
+    method: str,
+    url: str,
+    payload: bytes,
+    access_key: str,
+    secret_key: str,
+    region: str,
+    service: str = "s3",
+    now: Optional[datetime.datetime] = None,
+) -> Dict[str, str]:
+    """Build the SigV4 ``Authorization`` + ``x-amz-*`` headers for a request.
+
+    Pure function of (request, credentials, clock) so tests can verify the
+    canonicalization against an independent implementation.
+    """
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    # S3 canonical URI: the on-the-wire (already URI-encoded) path, verbatim —
+    # S3 disables path normalization/double-encoding in SigV4.
+    canonical_uri = parsed.path or "/"
+    canonical_query = ""
+    if parsed.query:
+        pairs = sorted(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        canonical_query = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in pairs
+        )
+
+    t = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = t.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = t.strftime("%Y%m%d")
+    payload_hash = _sha256_hex(payload)
+
+    signed_headers = "host;x-amz-content-sha256;x-amz-date"
+    canonical_headers = (
+        f"host:{host}\n"
+        f"x-amz-content-sha256:{payload_hash}\n"
+        f"x-amz-date:{amz_date}\n"
+    )
+    canonical_request = "\n".join(
+        [method, canonical_uri, canonical_query, canonical_headers, signed_headers, payload_hash]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [_ALGO, amz_date, scope, _sha256_hex(canonical_request.encode("utf-8"))]
+    )
+    key = ("AWS4" + secret_key).encode("utf-8")
+    for part in (datestamp, region, service, "aws4_request"):
+        key = _hmac(key, part)
+    signature = hmac.new(key, string_to_sign.encode("utf-8"), hashlib.sha256).hexdigest()
+    authorization = (
+        f"{_ALGO} Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}"
+    )
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": authorization,
+    }
+
+
+class S3ObjectStore(ObjectStore):
+    """Path-style S3 client: ``{endpoint}/{bucket}/{key}``."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        region: str = "us-east-1",
+        access_key: Optional[str] = None,
+        secret_key: Optional[str] = None,
+        timeout: float = 30.0,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.region = region
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        self.timeout = timeout
+
+    @classmethod
+    def from_args(cls, args: Any) -> "S3ObjectStore":
+        return cls(
+            endpoint=getattr(args, "s3_endpoint", "https://s3.amazonaws.com"),
+            bucket=getattr(args, "s3_bucket", "fedml-tpu"),
+            region=getattr(args, "s3_region", "us-east-1"),
+        )
+
+    def _url(self, key: str) -> str:
+        if key.startswith("/") or ".." in key.split("/"):
+            raise ValueError(f"invalid object key: {key!r}")
+        return f"{self.endpoint}/{self.bucket}/{urllib.parse.quote(key, safe='/-_.~')}"
+
+    def _request(self, method: str, key: str, payload: bytes = b"") -> bytes:
+        url = self._url(key)
+        headers = sigv4_headers(
+            method, url, payload, self.access_key, self.secret_key, self.region
+        )
+        req = urllib.request.Request(url, data=payload or None, method=method, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(key) from e
+            raise IOError(f"S3 {method} {key}: HTTP {e.code} {e.reason}") from e
+
+    def put_object(self, key: str, data: bytes) -> str:
+        self._request("PUT", key, data)
+        return key
+
+    def get_object(self, key: str) -> bytes:
+        return self._request("GET", key)
+
+    def delete_object(self, key: str) -> None:
+        try:
+            self._request("DELETE", key)
+        except KeyError:
+            pass

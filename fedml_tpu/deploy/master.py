@@ -89,7 +89,8 @@ class DeployMaster(BrokerJsonAgent):
         zip_path = self.cards.package(model_name, version)
         key = self.store.new_key(f"deploy/{endpoint_id}")
         with open(zip_path, "rb") as f:
-            self.store.put_object(key, f.read())
+            # returned key is authoritative (CAS backends return a CID)
+            key = self.store.put_object(key, f.read())
 
         event = threading.Event()
         with self._lock:

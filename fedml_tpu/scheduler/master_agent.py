@@ -261,7 +261,8 @@ class MasterAgent(BrokerJsonAgent):
         for n in targets:  # clear stale state from any previous push
             self.registry.touch(n, ota_version=None, ota_error=None)
         key = self._store.new_key(f"ota/{version}")
-        self._store.put_object(key, package)
+        # returned key is authoritative (CAS backends return a CID)
+        key = self._store.put_object(key, package)
         for n in targets:
             self._send(n, {"type": "ota_upgrade", "package_key": key,
                            "version": str(version)})

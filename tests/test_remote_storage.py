@@ -1,0 +1,364 @@
+"""Remote-storage backends: S3 (SigV4 REST), Web3/IPFS, Theta, local CAS.
+
+Parity: reference `communication/s3/remote_storage.py` (boto3),
+`distributed_storage/web3_storage/web3_storage.py`,
+`distributed_storage/theta_storage/theta_storage.py`. Each backend is
+exercised against an in-process HTTP twin so the wire protocol — not a
+mock of our own client — is what's tested.
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from fedml_tpu.core.distributed.communication.decentralized_storage import (
+    LocalCASObjectStore,
+    ThetaObjectStore,
+    Web3ObjectStore,
+    seal,
+    unseal,
+)
+from fedml_tpu.core.distributed.communication.object_store import create_object_store
+from fedml_tpu.core.distributed.communication.s3_store import S3ObjectStore, sigv4_headers
+
+ACCESS, SECRET, REGION = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG", "us-east-1"
+
+
+# --------------------------------------------------------------------------
+# In-process twins
+# --------------------------------------------------------------------------
+
+
+def _independent_sigv4(method, path, host, amz_date, payload_hash):
+    """SigV4 recomputed from the AWS spec, independently of s3_store.py."""
+    datestamp = amz_date[:8]
+    creq = "\n".join(
+        [
+            method,
+            path,
+            "",
+            f"host:{host}\nx-amz-content-sha256:{payload_hash}\nx-amz-date:{amz_date}\n",
+            "host;x-amz-content-sha256;x-amz-date",
+            payload_hash,
+        ]
+    )
+    scope = f"{datestamp}/{REGION}/s3/aws4_request"
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(creq.encode()).hexdigest(),
+        ]
+    )
+    k = ("AWS4" + SECRET).encode()
+    for part in (datestamp, REGION, "s3", "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+
+
+class _S3Twin(BaseHTTPRequestHandler):
+    blobs: dict = {}
+    auth_failures: list = []
+
+    def _check_auth(self):
+        auth = self.headers.get("Authorization", "")
+        amz_date = self.headers.get("x-amz-date", "")
+        payload_hash = self.headers.get("x-amz-content-sha256", "")
+        host = self.headers.get("Host", "")
+        want = _independent_sigv4(self.command, self.path, host, amz_date, payload_hash)
+        got = auth.rsplit("Signature=", 1)[-1]
+        if got != want:
+            _S3Twin.auth_failures.append((self.command, self.path, got, want))
+            self.send_error(403, "SignatureDoesNotMatch")
+            return False
+        return True
+
+    def do_PUT(self):
+        if not self._check_auth():
+            return
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if hashlib.sha256(body).hexdigest() != self.headers["x-amz-content-sha256"]:
+            self.send_error(400, "XAmzContentSHA256Mismatch")
+            return
+        _S3Twin.blobs[self.path] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._check_auth():
+            return
+        blob = _S3Twin.blobs.get(self.path)
+        if blob is None:
+            self.send_error(404, "NoSuchKey")
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_DELETE(self):
+        if not self._check_auth():
+            return
+        _S3Twin.blobs.pop(self.path, None)
+        self.send_response(204)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+class _IPFSTwin(BaseHTTPRequestHandler):
+    blobs: dict = {}
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if self.path == "/upload":  # web3.storage shape
+            cid = hashlib.sha256(body).hexdigest()
+            _IPFSTwin.blobs[cid] = body
+            reply = json.dumps({"cid": cid}).encode()
+        else:  # theta edgestore JSON-RPC shape
+            envelope = json.loads(body.decode())
+            method, params = envelope["method"], envelope["params"][0]
+            if method == "edgestore.PutData":
+                data = bytes.fromhex(params["val"])
+                cid = hashlib.sha256(data).hexdigest()
+                _IPFSTwin.blobs[cid] = data
+                reply = json.dumps({"id": envelope["id"], "result": {"key": cid}}).encode()
+            elif method == "edgestore.GetData":
+                data = _IPFSTwin.blobs.get(params["key"])
+                result = None if data is None else {"val": data.hex()}
+                reply = json.dumps({"id": envelope["id"], "result": result}).encode()
+            else:
+                reply = json.dumps(
+                    {"id": envelope["id"], "error": f"no method {method}"}
+                ).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(reply)))
+        self.end_headers()
+        self.wfile.write(reply)
+
+    def do_GET(self):
+        cid = self.path.rsplit("/", 1)[-1]
+        blob = _IPFSTwin.blobs.get(cid)
+        if blob is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def s3_twin():
+    _S3Twin.blobs, _S3Twin.auth_failures = {}, []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _S3Twin)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture()
+def ipfs_twin():
+    _IPFSTwin.blobs = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _IPFSTwin)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# S3
+# --------------------------------------------------------------------------
+
+
+def test_sigv4_matches_aws_reference_vector():
+    """Known-answer test against the worked example in the AWS SigV4 docs
+    (GET, empty payload, pinned clock)."""
+    now = datetime.datetime(2013, 5, 24, 0, 0, 0, tzinfo=datetime.timezone.utc)
+    headers = sigv4_headers(
+        "GET",
+        "https://examplebucket.s3.amazonaws.com/test.txt",
+        b"",
+        ACCESS,
+        SECRET + "/bPxRfiCYEXAMPLEKEY",
+        REGION,
+        now=now,
+    )
+    assert headers["x-amz-date"] == "20130524T000000Z"
+    assert headers["x-amz-content-sha256"] == hashlib.sha256(b"").hexdigest()
+    assert headers["Authorization"].startswith(
+        "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20130524/us-east-1/s3/aws4_request, "
+        "SignedHeaders=host;x-amz-content-sha256;x-amz-date, Signature="
+    )
+
+
+def test_s3_roundtrip_with_signature_verification(s3_twin):
+    store = S3ObjectStore(s3_twin, "models", REGION, ACCESS, SECRET)
+    key = store.put_object("run1/r0/weights.bin", b"\x00\x01weights")
+    assert key == "run1/r0/weights.bin"
+    assert store.get_object(key) == b"\x00\x01weights"
+    store.delete_object(key)
+    with pytest.raises(KeyError):
+        store.get_object(key)
+    assert _S3Twin.auth_failures == []  # every request passed SigV4 check
+
+
+def test_s3_rejects_wrong_secret(s3_twin):
+    bad = S3ObjectStore(s3_twin, "models", REGION, ACCESS, "not-the-secret")
+    with pytest.raises(IOError):
+        bad.put_object("k", b"v")
+    assert _S3Twin.auth_failures  # twin recorded the mismatch
+
+
+def test_s3_rejects_traversal_keys(s3_twin):
+    store = S3ObjectStore(s3_twin, "models", REGION, ACCESS, SECRET)
+    for key in ("/abs", "a/../b"):
+        with pytest.raises(ValueError):
+            store.put_object(key, b"x")
+
+
+def test_s3_keys_with_special_chars_survive(s3_twin):
+    store = S3ObjectStore(s3_twin, "models", REGION, ACCESS, SECRET)
+    key = "run 1/model+v2=final.bin"
+    store.put_object(key, b"data")
+    assert store.get_object(key) == b"data"
+    # the twin stored it under the quoted path
+    assert urllib.parse.quote(f"/models/{key}", safe="/-_.~") in _S3Twin.blobs
+
+
+# --------------------------------------------------------------------------
+# Web3 / Theta / CAS
+# --------------------------------------------------------------------------
+
+
+def test_web3_store_returns_cid_and_roundtrips(ipfs_twin):
+    store = Web3ObjectStore(f"{ipfs_twin}/upload", ipfs_twin)
+    cid = store.put_object("advisory-key-ignored", b"model-bytes")
+    assert cid != "advisory-key-ignored" and len(cid) == 64
+    assert store.get_object(cid) == b"model-bytes"
+
+
+def test_web3_store_encrypts_on_the_wire(ipfs_twin):
+    store = Web3ObjectStore(f"{ipfs_twin}/upload", ipfs_twin, secret_key="hunter2")
+    cid = store.put_object("k", b"secret-model")
+    assert _IPFSTwin.blobs[cid] != b"secret-model"  # ciphertext at rest
+    assert store.get_object(cid) == b"secret-model"
+    plain = Web3ObjectStore(f"{ipfs_twin}/upload", ipfs_twin)  # no key
+    with pytest.raises(Exception):
+        unseal(b"wrong", plain.get_object(cid))
+
+
+def test_theta_store_roundtrips_over_jsonrpc(ipfs_twin):
+    store = ThetaObjectStore(f"{ipfs_twin}/rpc")
+    cid = store.put_object("k", b"\xde\xad\xbe\xef")
+    assert store.get_object(cid) == b"\xde\xad\xbe\xef"
+    with pytest.raises(KeyError):
+        store.get_object("0" * 64)
+
+
+def test_local_cas_dedups_and_unpins(tmp_path):
+    store = LocalCASObjectStore(str(tmp_path))
+    c1 = store.put_object("a", b"same-bytes")
+    c2 = store.put_object("b", b"same-bytes")
+    assert c1 == c2  # content-addressed: one blob
+    store.delete_object(c1)
+    with pytest.raises(KeyError):
+        store.get_object(c1)
+
+
+def test_seal_unseal_tamper_detected():
+    blob = seal(b"key-material", b"payload")
+    assert unseal(b"key-material", blob) == b"payload"
+    tampered = blob[:-1] + bytes([blob[-1] ^ 1])
+    with pytest.raises(ValueError):
+        unseal(b"key-material", tampered)
+    with pytest.raises(ValueError):
+        unseal(b"other-key", blob)
+
+
+def test_factory_dispatch(tmp_path):
+    from fedml_tpu.core.distributed.communication.decentralized_storage import (
+        LocalCASObjectStore as CAS,
+    )
+    from fedml_tpu.core.distributed.communication.object_store import LocalDirObjectStore
+
+    assert isinstance(create_object_store(None), LocalDirObjectStore)
+    args = SimpleNamespace(remote_storage="cas", object_store_dir=str(tmp_path))
+    assert isinstance(create_object_store(args), CAS)
+    args = SimpleNamespace(remote_storage="s3", s3_endpoint="http://x", s3_bucket="b")
+    assert isinstance(create_object_store(args), S3ObjectStore)
+    args = SimpleNamespace(remote_storage="theta")
+    assert isinstance(create_object_store(args), ThetaObjectStore)
+    args = SimpleNamespace(remote_storage="web3")
+    assert isinstance(create_object_store(args), Web3ObjectStore)
+
+
+def test_broker_ships_cas_cid_not_advisory_key(tmp_path):
+    """BrokerCommManager must treat put_object's return as the wire key —
+    that's what makes content-addressed backends drop in."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+    from fedml_tpu.core.distributed.communication.broker_comm import BrokerCommManager
+    from fedml_tpu.core.distributed.message import Message
+
+    broker = PubSubBroker(port=0).start()
+    host, port = broker.address
+    store = LocalCASObjectStore(str(tmp_path))
+    tx = BrokerCommManager("rcas", 0, host, port, store, offload_bytes=64)
+    rx1 = BrokerCommManager("rcas", 1, host, port, store, offload_bytes=64)
+    rx2 = BrokerCommManager("rcas", 2, host, port, store, offload_bytes=64)
+    time.sleep(0.1)
+    try:
+        got = {1: [], 2: []}
+
+        def obs(rank):
+            class Obs:
+                def receive_message(self, t, m):
+                    got[rank].append(m)
+
+            return Obs()
+
+        rx1.add_observer(obs(1))
+        rx2.add_observer(obs(2))
+        threading.Thread(target=rx1.handle_receive_message, daemon=True).start()
+        threading.Thread(target=rx2.handle_receive_message, daemon=True).start()
+        # Broadcast the IDENTICAL payload to both ranks: CAS dedups to one
+        # CID, so the first receiver's cleanup must not destroy the blob
+        # before the second fetches it.
+        payload = {"w": np.arange(256, dtype=np.float32)}
+        for rank in (1, 2):
+            msg = Message("sync", 0, rank)
+            msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+            tx.send_message(msg)
+
+        deadline = time.time() + 10
+        while (not got[1] or not got[2]) and time.time() < deadline:
+            time.sleep(0.02)
+        assert got[1] and got[2], f"broadcast lost: {sorted(k for k in got if got[k])}"
+        for rank in (1, 2):
+            out = got[rank][0].get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+            np.testing.assert_array_equal(out["w"], payload["w"])
+    finally:
+        rx1.stop_receive_message()
+        rx2.stop_receive_message()
+        tx.client.close()
+        broker.stop()
