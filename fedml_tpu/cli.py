@@ -125,7 +125,19 @@ def logs(run_id: str, tail, follow: bool, workdir: str) -> None:
 
 @cli.command()
 @click.option("--workdir", default=".fedml_runs", show_default=True)
-def jobs(workdir: str) -> None:
+@click.option("--history", is_flag=True,
+              help="all runs ever recorded in the cross-run cache, "
+                   "plus the node device inventory")
+def jobs(workdir: str, history: bool) -> None:
+    if history:
+        from fedml_tpu.scheduler.compute_store import ComputeStore
+
+        store = ComputeStore(workdir)
+        for dev in store.inventory():
+            click.echo(json.dumps({"device": dev}))
+        for row in store.runs():
+            click.echo(json.dumps(row))
+        return
     from fedml_tpu.scheduler.launch import list_jobs
 
     for row in list_jobs(workdir=workdir):

@@ -56,6 +56,11 @@ class BrokerCommManager(BaseCommunicationManager):
         self.rank = int(rank)
         self.store = object_store or create_object_store()
         self.offload_bytes = int(offload_bytes)
+        # CAS reclamation: receivers can't delete (a dedup'd CID may still
+        # be awaited by sibling receivers), so the sender unpins its own
+        # stale generations once they age out of this window.
+        self._cas_keep_last = 8
+        self._cas_sent: List[str] = []
         self._observers: List[Observer] = []
         self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._running = False
@@ -72,6 +77,18 @@ class BrokerCommManager(BaseCommunicationManager):
 
     def _topic(self, rank: int) -> str:
         return f"fedml/{self.run_id}/{rank}"
+
+    def _reclaim_cas(self, cid: str) -> None:
+        """Sender-side unpin of CIDs that aged out of the keep window."""
+        if cid in self._cas_sent:  # re-sent content stays pinned
+            self._cas_sent.remove(cid)
+        self._cas_sent.append(cid)
+        while len(self._cas_sent) > self._cas_keep_last:
+            stale = self._cas_sent.pop(0)
+            try:
+                self.store.delete_object(stale)
+            except Exception:
+                logger.debug("cas unpin failed for %s", stale, exc_info=True)
 
     # -- outbound ---------------------------------------------------------
     def send_message(self, msg: Message) -> None:
@@ -93,6 +110,8 @@ class BrokerCommManager(BaseCommunicationManager):
             # The returned key is authoritative: content-addressed backends
             # (web3/theta CAS) return a CID, not the advisory key.
             store_key = self.store.put_object(store_key, safe_dumps(payload))
+            if self.store.content_addressed:
+                self._reclaim_cas(store_key)
             del params[key]
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_KEY] = store_key
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = f"store://{store_key}"

@@ -16,6 +16,7 @@ import json
 import os
 import signal
 import subprocess
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -59,7 +60,30 @@ class LocalAgent:
         self._monitor: Optional[threading.Thread] = None
         self._stopping = threading.Event()
         self._table_path = os.path.join(self.workdir, "runs.json")
+        # cross-run cache (scheduler_core parity): run history + device
+        # inventory survive this agent process and are queryable by the
+        # CLI / JobMonitor from other processes
+        from fedml_tpu.scheduler.compute_store import ComputeStore
+
+        self.compute_store = ComputeStore(self.workdir)
+        self.node_id = getattr(args, "node_id", None) or "local"
+        self._persist_lock = threading.Lock()
+        # inventory probe runs out-of-process (jax.devices() in this daemon
+        # would grab the TPU the spawned jobs need) and off-thread (so agent
+        # construction stays fast); the row lands when the probe returns
+        self._inventory_thread = threading.Thread(
+            target=self._record_inventory, daemon=True)
+        self._inventory_thread.start()
         self._load_table()
+
+    def _record_inventory(self) -> None:
+        from fedml_tpu.scheduler.env_collect import collect_resources_probe
+
+        try:
+            self.compute_store.record_inventory(
+                self.node_id, collect_resources_probe())
+        except Exception:
+            logger.exception("inventory probe failed")
 
     # -- cross-process run table -----------------------------------------
     # the reference's agents persist run state in sqlite
@@ -76,10 +100,25 @@ class LocalAgent:
                     "status": rec.fsm.status,
                     "returncode": rec.returncode,
                 }
-        tmp = self._table_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(rows, f)
-        os.replace(tmp, self._table_path)
+        # the monitor thread and a wait()ing caller can persist concurrently —
+        # serialize, and write via mkstemp so a torn write can't be promoted
+        with self._persist_lock:
+            fd, tmp = tempfile.mkstemp(dir=self.workdir, suffix=".runs.tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(rows, f)
+            os.replace(tmp, self._table_path)
+            # mirror into the cross-run sqlite cache
+            for rid, row in rows.items():
+                self.compute_store.upsert_run(
+                    rid, job_name=row["job_name"], node_id=self.node_id,
+                    status=row["status"], pid=row["pid"],
+                    returncode=row["returncode"], log_path=row["log_path"],
+                )
+                if row["status"] in RunStatus.TERMINAL:
+                    prev = self.compute_store.get_run(rid)
+                    if prev and prev.get("finished_at") is None:
+                        self.compute_store.upsert_run(
+                            rid, finished_at=time.time())
 
     def _load_table(self) -> None:
         if not os.path.exists(self._table_path):
@@ -100,8 +139,11 @@ class LocalAgent:
             rec.fsm.status = row.get("status", RunStatus.IDLE)
             if (rec.fsm.status == RunStatus.RUNNING and rec.pid
                     and not _pid_alive(rec.pid)):
-                # process died while no agent was watching; exact rc unknown
-                rec.fsm.status = RunStatus.FINISHED
+                # process died while no agent was watching; exact rc unknown.
+                # FAILED, matching JobMonitor.sweep_runs for the same
+                # condition — terminal status must not depend on which
+                # component notices first.
+                rec.fsm.status = RunStatus.FAILED
             self._runs[rid] = rec
 
     # -- lifecycle --------------------------------------------------------
@@ -217,6 +259,10 @@ class LocalAgent:
         while time.time() < deadline:
             rec = self._runs.get(run_id)
             if rec is not None and rec.fsm.is_terminal:
+                # the caller may exit the process right after this returns,
+                # killing the daemon monitor thread mid-persist — make the
+                # terminal state durable before handing back control
+                self._persist_table()
                 return rec.fsm.status
             time.sleep(self._poll_interval / 2)
         raise TimeoutError(f"run {run_id} not terminal after {timeout}s")

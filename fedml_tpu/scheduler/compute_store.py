@@ -1,0 +1,180 @@
+"""Cross-run compute cache — sqlite-backed scheduler state.
+
+Parity targets (reference ``computing/scheduler/scheduler_core/``):
+  ``compute_cache_manager.py`` — redis+sqlite cross-run caches of run
+  info, GPU availability, logs and metrics;
+  ``compute_gpu_db.py``       — per-device inventory DB;
+  ``log_manager.py`` / ``metrics_manager.py`` — query surfaces over the
+  stored logs/metrics.
+
+TPU-era redesign: one sqlite file in the scheduler workdir (WAL mode, so
+agents, CLI and monitors in different processes read/write concurrently —
+the reference also leans on sqlite for exactly this, redis being optional
+infra we don't assume). Inventory rows come from ``collect_resources()``
+(jax device census: TPU chips on real hardware, virtual CPU devices in
+tests) instead of nvidia-smi.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.scheduler.env_collect import collect_resources
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS devices (
+    node_id     TEXT NOT NULL,
+    platform    TEXT NOT NULL,
+    device_kind TEXT NOT NULL DEFAULT '',
+    device_count INTEGER NOT NULL DEFAULT 0,
+    extra       TEXT NOT NULL DEFAULT '{}',
+    updated_at  REAL NOT NULL,
+    PRIMARY KEY (node_id)
+);
+CREATE TABLE IF NOT EXISTS runs (
+    run_id      TEXT PRIMARY KEY,
+    job_name    TEXT NOT NULL DEFAULT '',
+    node_id     TEXT NOT NULL DEFAULT '',
+    status      TEXT NOT NULL DEFAULT 'IDLE',
+    pid         INTEGER,
+    returncode  INTEGER,
+    log_path    TEXT NOT NULL DEFAULT '',
+    started_at  REAL,
+    finished_at REAL
+);
+CREATE TABLE IF NOT EXISTS metrics (
+    run_id  TEXT NOT NULL,
+    ts      REAL NOT NULL,
+    name    TEXT NOT NULL,
+    value   REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS metrics_by_run ON metrics (run_id, name, ts);
+"""
+
+
+class ComputeStore:
+    """One sqlite handle per process; safe for many processes via WAL."""
+
+    def __init__(self, workdir: str = ".fedml_runs",
+                 filename: str = "compute_cache.sqlite"):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.path = os.path.join(self.workdir, filename)
+        self._local = threading.local()
+        with self._conn() as c:
+            c.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=10.0)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    # -- inventory (compute_gpu_db parity) -----------------------------
+    def record_inventory(self, node_id: str,
+                         resources: Optional[Dict] = None) -> Dict:
+        res = dict(resources if resources is not None else collect_resources())
+        known = {k: res.pop(k, d) for k, d in
+                 (("platform", "cpu"), ("device_kind", ""), ("device_count", 0))}
+        with self._conn() as c:
+            c.execute(
+                "INSERT OR REPLACE INTO devices VALUES (?,?,?,?,?,?)",
+                (node_id, known["platform"], known["device_kind"],
+                 int(known["device_count"]), json.dumps(res), time.time()),
+            )
+        return {**known, **res}
+
+    def inventory(self, max_age_s: Optional[float] = None) -> List[Dict]:
+        q = "SELECT * FROM devices"
+        params: tuple = ()
+        if max_age_s is not None:
+            q += " WHERE updated_at >= ?"
+            params = (time.time() - max_age_s,)
+        rows = self._conn().execute(q + " ORDER BY node_id", params).fetchall()
+        return [
+            {**dict(r), "extra": json.loads(r["extra"])} for r in rows
+        ]
+
+    def total_devices(self, platform: Optional[str] = None) -> int:
+        rows = self.inventory()
+        return sum(r["device_count"] for r in rows
+                   if platform is None or r["platform"] == platform)
+
+    # -- run history (compute_cache_manager parity) --------------------
+    def upsert_run(self, run_id: str, **fields: Any) -> None:
+        allowed = {"job_name", "node_id", "status", "pid", "returncode",
+                   "log_path", "started_at", "finished_at"}
+        bad = set(fields) - allowed
+        if bad:
+            raise ValueError(f"unknown run fields: {sorted(bad)}")
+        with self._conn() as c:
+            c.execute("INSERT OR IGNORE INTO runs (run_id, started_at) VALUES (?,?)",
+                      (run_id, time.time()))
+            if fields:
+                sets = ", ".join(f"{k}=?" for k in fields)
+                c.execute(f"UPDATE runs SET {sets} WHERE run_id=?",
+                          (*fields.values(), run_id))
+
+    def finish_run(self, run_id: str, status: str,
+                   returncode: Optional[int] = None) -> None:
+        self.upsert_run(run_id, status=status, returncode=returncode,
+                        finished_at=time.time())
+
+    def get_run(self, run_id: str) -> Optional[Dict]:
+        row = self._conn().execute(
+            "SELECT * FROM runs WHERE run_id=?", (run_id,)).fetchone()
+        return dict(row) if row else None
+
+    def runs(self, status: Optional[str] = None,
+             limit: Optional[int] = None) -> List[Dict]:
+        # sqlite: LIMIT -1 = unlimited — the sweeper and `jobs --history`
+        # must see every row, not a silently-truncated window
+        lim = -1 if limit is None else limit
+        if status is None:
+            rows = self._conn().execute(
+                "SELECT * FROM runs ORDER BY started_at DESC LIMIT ?",
+                (lim,)).fetchall()
+        else:
+            rows = self._conn().execute(
+                "SELECT * FROM runs WHERE status=? "
+                "ORDER BY started_at DESC LIMIT ?", (status, lim)).fetchall()
+        return [dict(r) for r in rows]
+
+    # -- metrics (metrics_manager parity) ------------------------------
+    def log_metric(self, run_id: str, name: str, value: float,
+                   ts: Optional[float] = None) -> None:
+        with self._conn() as c:
+            c.execute("INSERT INTO metrics VALUES (?,?,?,?)",
+                      (run_id, ts if ts is not None else time.time(),
+                       name, float(value)))
+
+    def metrics(self, run_id: str, name: Optional[str] = None) -> List[Dict]:
+        if name is None:
+            rows = self._conn().execute(
+                "SELECT * FROM metrics WHERE run_id=? ORDER BY ts",
+                (run_id,)).fetchall()
+        else:
+            rows = self._conn().execute(
+                "SELECT * FROM metrics WHERE run_id=? AND name=? ORDER BY ts",
+                (run_id, name)).fetchall()
+        return [dict(r) for r in rows]
+
+    def latest_metric(self, run_id: str, name: str) -> Optional[float]:
+        row = self._conn().execute(
+            "SELECT value FROM metrics WHERE run_id=? AND name=? "
+            "ORDER BY ts DESC LIMIT 1", (run_id, name)).fetchone()
+        return None if row is None else row["value"]
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None

@@ -6,9 +6,14 @@ and visible accelerators instead of torch/CUDA.
 """
 from __future__ import annotations
 
+import json
+import os
 import platform
+import subprocess
 import sys
-from typing import Dict
+import tempfile
+import time
+from typing import Dict, Optional
 
 
 def collect_env() -> Dict:
@@ -54,6 +59,62 @@ def collect_resources() -> Dict:
     except Exception as e:
         out["error"] = str(e)
     return out
+
+
+_probe_cache: Optional[Dict] = None
+
+
+def collect_resources_probe(timeout_s: float = 60.0) -> Dict:
+    """``collect_resources()`` in a short-lived subprocess, memoized.
+
+    Agent daemons must NOT call ``jax.devices()`` in-process: on TPU
+    hosts it acquires libtpu exclusively, so the training job the agent
+    spawns next would fail device init (the reference has the same
+    split — agents shell out to nvidia-smi rather than importing torch).
+    """
+    global _probe_cache
+    if _probe_cache is not None:
+        return dict(_probe_cache)
+    # explicit override (tests, constrained deploys): skip the probe
+    override = os.environ.get("FEDML_TPU_RESOURCES")
+    if override:
+        try:
+            _probe_cache = json.loads(override)
+            return dict(_probe_cache)
+        except ValueError:
+            pass
+    # cross-process disk cache: one probe per machine per TTL, not one
+    # per agent construction
+    cache_path = os.path.join(tempfile.gettempdir(),
+                              "fedml_tpu_resource_probe.json")
+    try:
+        if time.time() - os.path.getmtime(cache_path) < 600:
+            with open(cache_path) as f:
+                _probe_cache = json.load(f)
+            return dict(_probe_cache)
+    except (OSError, ValueError):
+        pass
+    code = (
+        "import json; from fedml_tpu.scheduler.env_collect import "
+        "collect_resources; print(json.dumps(collect_resources()))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, check=True,
+        )
+        _probe_cache = json.loads(out.stdout.strip().splitlines()[-1])
+        try:
+            fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir())
+            with os.fdopen(fd, "w") as f:
+                json.dump(_probe_cache, f)
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+    except Exception as e:
+        _probe_cache = {"platform": "unknown", "device_count": 0,
+                        "device_kind": "", "error": str(e)}
+    return dict(_probe_cache)
 
 
 def print_env() -> None:

@@ -1,0 +1,145 @@
+"""JobMonitor — the periodic liveness sweeper, as a process singleton.
+
+Parity target: ``computing/scheduler/comm_utils/job_monitor.py:37`` —
+the reference's ``JobMonitor`` singleton whose timer loop
+(``monitor_slave_run_process_status`` :63) sweeps run processes whose
+pids died without reporting, resets their status, and checks deployed
+endpoint containers' liveness (``:230``), re-marking dead replicas so
+the gateway stops routing to them.
+
+This build sweeps two planes with one loop:
+  * runs — RUNNING rows in the ComputeStore whose pid is gone become
+    FAILED (status reconciliation the agents can't do if they crashed
+    with the run);
+  * endpoints — DEPLOYED replicas in the deploy EndpointCache whose
+    ``/ready`` probe fails become OFFLINE (and flip back to DEPLOYED
+    when the probe recovers — self-healing, which the reference's
+    monitor does by restarting containers).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from fedml_tpu.core.mlops.status import RunStatus
+from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
+from fedml_tpu.scheduler.agent import _pid_alive
+from fedml_tpu.scheduler.compute_store import ComputeStore
+
+logger = logging.getLogger(__name__)
+
+_singleton_lock = threading.Lock()
+_singleton: Optional["JobMonitor"] = None
+
+
+def _probe_ready(url: str, timeout: float) -> bool:
+    try:
+        with urllib.request.urlopen(f"{url.rstrip('/')}/ready",
+                                    timeout=timeout) as resp:
+            return resp.status == 200
+    except (urllib.error.URLError, OSError, ValueError):
+        return False
+
+
+class JobMonitor:
+    """Sweeps run + endpoint liveness; use ``JobMonitor.get_instance()``."""
+
+    def __init__(self, compute_store: Optional[ComputeStore] = None,
+                 endpoint_cache: Optional[EndpointCache] = None,
+                 interval_s: float = 5.0, probe_timeout_s: float = 2.0):
+        self.compute_store = compute_store
+        self.endpoint_cache = endpoint_cache
+        self.interval_s = interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.sweeps = 0
+
+    # -- singleton (reference keeps one monitor per agent process) -----
+    @classmethod
+    def get_instance(cls, **kwargs) -> "JobMonitor":
+        global _singleton
+        with _singleton_lock:
+            if _singleton is None:
+                _singleton = cls(**kwargs)
+            return _singleton
+
+    @classmethod
+    def reset_instance(cls) -> None:
+        global _singleton
+        with _singleton_lock:
+            if _singleton is not None:
+                _singleton.stop()
+            _singleton = None
+
+    # -- sweeps --------------------------------------------------------
+    def sweep_runs(self) -> List[str]:
+        """RUNNING rows whose pid died → FAILED. Returns fixed run ids."""
+        if self.compute_store is None:
+            return []
+        fixed = []
+        for row in self.compute_store.runs(status=RunStatus.RUNNING):
+            pid = row.get("pid")
+            if pid and not _pid_alive(int(pid)):
+                self.compute_store.finish_run(
+                    row["run_id"], RunStatus.FAILED, returncode=None)
+                fixed.append(row["run_id"])
+                logger.warning("job_monitor: run %s pid %s died; -> FAILED",
+                               row["run_id"], pid)
+        return fixed
+
+    def sweep_endpoints(self) -> Dict[str, Dict[str, str]]:
+        """Probe every replica URL; flip DEPLOYED<->OFFLINE on evidence.
+
+        Returns {endpoint_id: {worker_id: new_status}} for flips only.
+        """
+        if self.endpoint_cache is None:
+            return {}
+        flips: Dict[str, Dict[str, str]] = {}
+        for ep in self.endpoint_cache.list_endpoints():
+            eid = ep["endpoint_id"]
+            for wid, rep in (ep.get("replicas") or {}).items():
+                url, status = rep.get("url"), rep.get("status")
+                if not url or status not in (EndpointStatus.DEPLOYED,
+                                             EndpointStatus.OFFLINE):
+                    continue
+                alive = _probe_ready(url, self.probe_timeout_s)
+                new = EndpointStatus.DEPLOYED if alive else EndpointStatus.OFFLINE
+                if new != status:
+                    self.endpoint_cache.set_replica(
+                        eid, wid, url=url, status=new)
+                    flips.setdefault(eid, {})[wid] = new
+                    logger.warning("job_monitor: endpoint %s replica %s %s -> %s",
+                                   eid, wid, status, new)
+        return flips
+
+    def sweep_once(self) -> Dict:
+        result = {"runs_fixed": self.sweep_runs(),
+                  "endpoint_flips": self.sweep_endpoints()}
+        self.sweeps += 1
+        return result
+
+    # -- loop ----------------------------------------------------------
+    def start(self) -> "JobMonitor":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="fedml-job-monitor")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sweep_once()
+            except Exception:
+                logger.exception("job_monitor sweep failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

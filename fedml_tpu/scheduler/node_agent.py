@@ -59,7 +59,9 @@ class NodeAgent(BrokerJsonAgent):
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "NodeAgent":
-        from fedml_tpu.scheduler.env_collect import collect_resources
+        from fedml_tpu.scheduler.env_collect import (
+            collect_resources_probe as collect_resources,
+        )
 
         self.agent.start()
         self._publish({"type": "node_online", "node_id": self.node_id,

@@ -17,9 +17,29 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # interpreter start (sitecustomize), in which case the env var above is
 # already baked into jax's config — force it through the config API too,
 # which works post-import as long as no backend has been initialized yet.
+import tempfile  # noqa: E402
+
+# Persistent XLA compile cache: CPU-gate wall clock is dominated by XLA
+# compiles, and the cache cuts a warm `pytest -m "not slow"` by minutes.
+# Exported via env (not only the config API) so subprocess tests
+# (cross-device clients, node agents, spawned job ranks) inherit it.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "fedml_tpu_xla_cache"),
+)
+
+# Agents probe accelerator inventory in a subprocess (a fresh jax import);
+# pin the answer so tests never pay that — inherited by spawned agents too.
+os.environ.setdefault(
+    "FEDML_TPU_RESOURCES",
+    '{"platform": "cpu", "device_count": 8, "device_kind": "cpu"}',
+)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

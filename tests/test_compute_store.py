@@ -1,0 +1,178 @@
+"""Cross-run compute cache + JobMonitor sweeps.
+
+Parity: reference ``scheduler_core/compute_cache_manager.py`` /
+``compute_gpu_db.py`` (sqlite cross-run state) and
+``comm_utils/job_monitor.py`` (run/endpoint liveness sweeper).
+"""
+import json
+import os
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from fedml_tpu.core.mlops.status import RunStatus
+from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
+from fedml_tpu.scheduler.compute_store import ComputeStore
+from fedml_tpu.scheduler.job_monitor import JobMonitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    yield
+    JobMonitor.reset_instance()
+
+
+def test_inventory_roundtrip(tmp_path):
+    store = ComputeStore(str(tmp_path))
+    rec = store.record_inventory("n1")
+    assert rec["device_count"] >= 1  # 8 virtual CPU devices under conftest
+    store.record_inventory("n2", {"platform": "tpu", "device_kind": "TPU v4",
+                                  "device_count": 4, "mem_gb": 32})
+    inv = store.inventory()
+    assert [r["node_id"] for r in inv] == ["n1", "n2"]
+    tpu = inv[1]
+    assert tpu["platform"] == "tpu" and tpu["extra"]["mem_gb"] == 32
+    assert store.total_devices("tpu") == 4
+    # re-recording replaces, not duplicates
+    store.record_inventory("n2", {"platform": "tpu", "device_kind": "TPU v4",
+                                  "device_count": 8})
+    assert store.total_devices("tpu") == 8 and len(store.inventory()) == 2
+
+
+def test_run_history_and_metrics(tmp_path):
+    store = ComputeStore(str(tmp_path))
+    store.upsert_run("r1", job_name="train", node_id="n1",
+                     status=RunStatus.RUNNING, pid=123)
+    store.log_metric("r1", "test_acc", 0.5)
+    store.log_metric("r1", "test_acc", 0.9)
+    store.finish_run("r1", RunStatus.FINISHED, returncode=0)
+
+    # a different handle (≈ another process) sees everything
+    other = ComputeStore(str(tmp_path))
+    row = other.get_run("r1")
+    assert row["status"] == RunStatus.FINISHED and row["returncode"] == 0
+    assert row["finished_at"] is not None
+    assert other.latest_metric("r1", "test_acc") == 0.9
+    assert [m["value"] for m in other.metrics("r1", "test_acc")] == [0.5, 0.9]
+    with pytest.raises(ValueError):
+        store.upsert_run("r1", nonsense=1)
+
+
+def test_local_agent_feeds_the_cache(tmp_path):
+    from fedml_tpu.scheduler.agent import LocalAgent
+    from fedml_tpu.scheduler.job_yaml import JobSpec
+
+    agent = LocalAgent(workdir=str(tmp_path)).start()
+    try:
+        rid = agent.start_run(JobSpec(job_name="hello", job="echo hi",
+                                      workspace="."))
+        agent.wait(rid, timeout=30)
+    finally:
+        agent.shutdown(kill_running=False)
+
+    # fresh handle, as the CLI would open it
+    store = ComputeStore(str(tmp_path))
+    row = store.get_run(rid)
+    assert row is not None
+    assert row["status"] == RunStatus.FINISHED
+    assert row["returncode"] == 0 and row["node_id"] == "local"
+    assert row["finished_at"] is not None
+    # inventory lands asynchronously (out-of-process probe)
+    deadline = time.time() + 30
+    while time.time() < deadline and not store.inventory():
+        time.sleep(0.05)
+    inv = store.inventory()
+    assert inv and inv[0]["node_id"] == "local"
+    assert inv[0]["device_count"] == 8  # pinned by conftest FEDML_TPU_RESOURCES
+
+
+def test_job_monitor_sweeps_dead_run(tmp_path):
+    store = ComputeStore(str(tmp_path))
+    # a run whose pid is provably dead
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    store.upsert_run("dead", status=RunStatus.RUNNING, pid=proc.pid)
+    store.upsert_run("alive", status=RunStatus.RUNNING, pid=os.getpid())
+    mon = JobMonitor(compute_store=store)
+    fixed = mon.sweep_runs()
+    assert fixed == ["dead"]
+    assert store.get_run("dead")["status"] == RunStatus.FAILED
+    assert store.get_run("alive")["status"] == RunStatus.RUNNING
+
+
+class _Ready(BaseHTTPRequestHandler):
+    ok = True
+
+    def do_GET(self):
+        if self.path == "/ready" and _Ready.ok:
+            self.send_response(200)
+            self.end_headers()
+        else:
+            self.send_error(503)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_job_monitor_flips_endpoint_replicas(tmp_path):
+    cache = EndpointCache(str(tmp_path / "cache.json"))
+    cache.upsert_endpoint("ep1", endpoint_name="ep", model_name="m",
+                          model_version="1", status=EndpointStatus.DEPLOYED,
+                          token=None)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Ready)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    live = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        cache.set_replica("ep1", "w_live", url=live,
+                          status=EndpointStatus.DEPLOYED)
+        cache.set_replica("ep1", "w_dead", url="http://127.0.0.1:9",
+                          status=EndpointStatus.DEPLOYED)
+        mon = JobMonitor(endpoint_cache=cache, probe_timeout_s=1.0)
+        flips = mon.sweep_endpoints()
+        assert flips == {"ep1": {"w_dead": EndpointStatus.OFFLINE}}
+        assert [r["worker_id"] for r in cache.healthy_replicas("ep1")] == ["w_live"]
+
+        # the dead replica comes back → self-heals to DEPLOYED
+        cache.set_replica("ep1", "w_dead", url=live,
+                          status=EndpointStatus.OFFLINE)
+        flips = mon.sweep_endpoints()
+        assert flips == {"ep1": {"w_dead": EndpointStatus.DEPLOYED}}
+        assert len(cache.healthy_replicas("ep1")) == 2
+    finally:
+        srv.shutdown()
+
+
+def test_job_monitor_singleton_loop(tmp_path):
+    store = ComputeStore(str(tmp_path))
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    store.upsert_run("dead", status=RunStatus.RUNNING, pid=proc.pid)
+    mon = JobMonitor.get_instance(compute_store=store, interval_s=0.1)
+    assert JobMonitor.get_instance() is mon
+    mon.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and mon.sweeps == 0:
+        time.sleep(0.05)
+    mon.stop()
+    assert mon.sweeps >= 1
+    assert store.get_run("dead")["status"] == RunStatus.FAILED
+
+
+def test_cli_jobs_history(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    store = ComputeStore(str(tmp_path))
+    store.record_inventory("local")
+    store.upsert_run("r1", job_name="train", status=RunStatus.FINISHED)
+    out = CliRunner().invoke(cli, ["jobs", "--workdir", str(tmp_path),
+                                   "--history"])
+    assert out.exit_code == 0, out.output
+    lines = [json.loads(line) for line in out.output.splitlines()]
+    assert any("device" in line for line in lines)
+    assert any(line.get("run_id") == "r1" for line in lines)

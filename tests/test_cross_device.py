@@ -94,6 +94,7 @@ def _run_server_against_subprocess_clients(tmp_path, *, rounds, secure):
         broker.stop()
 
 
+@pytest.mark.slow
 def test_two_device_subprocesses_three_rounds(tmp_path):
     result = _run_server_against_subprocess_clients(
         tmp_path, rounds=3, secure=False)

@@ -22,7 +22,7 @@ def test_fedseg_miou_improves():
         "model_args": {"model": "segnet"},
         "train_args": {"federated_optimizer": "FedSeg",
                        "client_num_in_total": 3, "client_num_per_round": 3,
-                       "comm_round": 3, "epochs": 25, "batch_size": 16,
+                       "comm_round": 2, "epochs": 20, "batch_size": 16,
                        "learning_rate": 0.01, "seg_classes": 3,
                        "seg_width": 8},
     }))

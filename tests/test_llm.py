@@ -99,6 +99,7 @@ def test_llm_trainer_converges_full_ft():
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+@pytest.mark.slow
 def test_llm_trainer_lora_freezes_base():
     from fedml_tpu.train.llm.trainer import LLMTrainer, extract_lora
 

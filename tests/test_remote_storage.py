@@ -12,6 +12,7 @@ import datetime
 import hashlib
 import hmac
 import json
+import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -279,6 +280,35 @@ def test_local_cas_dedups_and_unpins(tmp_path):
     store.delete_object(c1)
     with pytest.raises(KeyError):
         store.get_object(c1)
+
+
+def test_broker_sender_reclaims_stale_cas_generations(tmp_path):
+    """The sender unpins CIDs that age out of its keep-last window, so a
+    long federation doesn't accrete every round's payload forever."""
+    from fedml_tpu.core.distributed.communication.broker_comm import BrokerCommManager
+    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+    import numpy as np
+
+    broker = PubSubBroker(port=0).start()
+    store = LocalCASObjectStore(str(tmp_path))
+    tx = BrokerCommManager("rgc", 0, *broker.address, store, offload_bytes=16)
+    tx._cas_keep_last = 2
+    try:
+        from fedml_tpu.core.distributed.message import Message
+
+        cids = []
+        for i in range(5):  # 5 distinct payloads, window of 2
+            msg = Message("sync", 0, 1)
+            msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS,
+                           {"w": np.full(32, i, np.float32)})
+            tx.send_message(msg)
+            cids = tx._cas_sent
+        assert len(cids) == 2  # only the newest generations stay pinned
+        stored = set(os.listdir(str(tmp_path)))
+        assert stored == set(cids)
+    finally:
+        tx.client.close()
+        broker.stop()
 
 
 def test_seal_unseal_tamper_detected():

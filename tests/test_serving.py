@@ -141,6 +141,7 @@ def test_inference_runner_http_roundtrip():
         runner.stop()
 
 
+@pytest.mark.slow
 def test_llm_endpoint_two_concurrent_generations(tiny_model):
     """BASELINE config #5 shape: boot the endpoint, stream two generations
     concurrently through HTTP, both complete and match greedy reference."""
