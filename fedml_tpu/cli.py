@@ -354,14 +354,25 @@ def deploy() -> None:
 @deploy.command("broker")
 @click.option("--host", default="127.0.0.1", show_default=True)
 @click.option("--port", default=18923, show_default=True)
-def deploy_broker(host: str, port: int) -> None:
+@click.option("--native", is_flag=True,
+              help="run the C++ epoll broker (native/broker.cpp) instead "
+                   "of the in-process Python twin")
+def deploy_broker(host: str, port: int, native: bool) -> None:
     """Run the deploy-plane pub/sub broker (blocking)."""
-    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+    from fedml_tpu.core.distributed.communication.broker import (
+        NativePubSubBroker,
+        PubSubBroker,
+    )
 
-    broker = PubSubBroker(host, port).start()
-    click.echo(f"broker on {broker.address[0]}:{broker.address[1]}")
-    while True:
-        time.sleep(3600)
+    cls = NativePubSubBroker if native else PubSubBroker
+    broker = cls(host, port).start()
+    click.echo(f"broker on {broker.address[0]}:{broker.address[1]}"
+               + (" (native)" if native else ""))
+    try:
+        while True:
+            time.sleep(3600)
+    finally:
+        broker.stop()
 
 
 @deploy.command("worker")

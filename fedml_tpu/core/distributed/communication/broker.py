@@ -17,6 +17,7 @@ deployment shape as a small MQTT broker, without the dependency.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import struct
 import threading
@@ -142,6 +143,59 @@ class PubSubBroker:
             self._srv.close()
         except OSError:
             pass
+
+
+class NativePubSubBroker:
+    """The C++ epoll broker (``native/broker.cpp``) behind the same surface.
+
+    Same wire protocol and semantics as :class:`PubSubBroker`; parity is
+    enforced by running the client test suite against both. This is the
+    deployment-grade control plane (single-threaded epoll, buffered
+    non-blocking writes) — the runtime-native component the reference
+    delegates to a hosted MQTT broker.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import subprocess
+
+        binary = self._ensure_built()
+        self._proc = subprocess.Popen(
+            [binary, str(port), host], stdout=subprocess.PIPE, text=True
+        )
+        line = (self._proc.stdout.readline() or "").strip()
+        if not line.startswith("LISTENING "):
+            self._proc.kill()
+            raise RuntimeError(f"native broker failed to start: {line!r}")
+        self._addr = (host, int(line.split()[1]))
+
+    @staticmethod
+    def _ensure_built() -> str:
+        import subprocess
+
+        native_dir = os.path.join(
+            os.path.dirname(__file__), "..", "..", "..", "..", "native"
+        )
+        native_dir = os.path.abspath(native_dir)
+        binary = os.path.join(native_dir, "broker")
+        if not os.path.exists(binary):
+            subprocess.run(["make", "-C", native_dir, "broker"],
+                           check=True, capture_output=True)
+        return binary
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._addr
+
+    def start(self) -> "NativePubSubBroker":
+        return self  # the process is already serving
+
+    def stop(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except Exception:
+                self._proc.kill()
 
 
 class BrokerClient:

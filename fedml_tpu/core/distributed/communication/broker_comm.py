@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 import queue
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from fedml_tpu.core.distributed.communication.base_com_manager import (
     BaseCommunicationManager,
@@ -58,9 +58,13 @@ class BrokerCommManager(BaseCommunicationManager):
         self.offload_bytes = int(offload_bytes)
         # CAS reclamation: receivers can't delete (a dedup'd CID may still
         # be awaited by sibling receivers), so the sender unpins its own
-        # stale generations once they age out of this window.
-        self._cas_keep_last = 8
-        self._cas_sent: List[str] = []
+        # stale generations. The window is PER RECEIVER (a round of
+        # distinct per-client payloads must not evict in-flight ones) and
+        # an entry is only unpinned once it both ages out of every
+        # receiver's window AND exceeds the minimum age.
+        self._cas_keep_last = 4
+        self._cas_min_age_s = 300.0
+        self._cas_sent: Dict[int, List[Tuple[str, float]]] = {}
         self._observers: List[Observer] = []
         self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._running = False
@@ -78,13 +82,26 @@ class BrokerCommManager(BaseCommunicationManager):
     def _topic(self, rank: int) -> str:
         return f"fedml/{self.run_id}/{rank}"
 
-    def _reclaim_cas(self, cid: str) -> None:
-        """Sender-side unpin of CIDs that aged out of the keep window."""
-        if cid in self._cas_sent:  # re-sent content stays pinned
-            self._cas_sent.remove(cid)
-        self._cas_sent.append(cid)
-        while len(self._cas_sent) > self._cas_keep_last:
-            stale = self._cas_sent.pop(0)
+    def _reclaim_cas(self, cid: str, receiver: int) -> None:
+        """Sender-side unpin of CIDs that aged out of every keep window."""
+        import time as _time
+
+        now = _time.time()
+        window = self._cas_sent.setdefault(receiver, [])
+        self._cas_sent[receiver] = window = [
+            (c, t) for (c, t) in window if c != cid  # re-sent content stays
+        ]
+        window.append((cid, now))
+        while len(window) > self._cas_keep_last:
+            stale, sent_at = window[0]
+            if now - sent_at < self._cas_min_age_s:
+                break  # still possibly in flight; try again next send
+            window.pop(0)
+            # a broadcast dedups to one CID across receivers: keep it while
+            # any other receiver's window still references it
+            if any(stale == c for w in self._cas_sent.values()
+                   for (c, _) in w):
+                continue
             try:
                 self.store.delete_object(stale)
             except Exception:
@@ -111,7 +128,7 @@ class BrokerCommManager(BaseCommunicationManager):
             # (web3/theta CAS) return a CID, not the advisory key.
             store_key = self.store.put_object(store_key, safe_dumps(payload))
             if self.store.content_addressed:
-                self._reclaim_cas(store_key)
+                self._reclaim_cas(store_key, msg.get_receiver_id())
             del params[key]
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_KEY] = store_key
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = f"store://{store_key}"

@@ -40,8 +40,10 @@ def _load_native() -> Optional[ctypes.CDLL]:
     _lib_tried = True
     if not os.path.exists(_LIB_PATH):
         try:  # build on demand; fine to fail (numpy fallback)
+            # target only the LCC library: a broker.cpp build failure on a
+            # non-epoll platform must not take down the ctypes path
             subprocess.run(
-                ["make", "-C", _NATIVE_DIR], check=True,
+                ["make", "-C", _NATIVE_DIR, "liblcc.so"], check=True,
                 capture_output=True, timeout=120,
             )
         except Exception as e:  # pragma: no cover

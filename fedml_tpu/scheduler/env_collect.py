@@ -112,8 +112,10 @@ def collect_resources_probe(timeout_s: float = 60.0) -> Dict:
         except OSError:
             pass
     except Exception as e:
-        _probe_cache = {"platform": "unknown", "device_count": 0,
-                        "device_kind": "", "error": str(e)}
+        # do NOT memoize a transient failure: a long-lived agent must not
+        # report zero accelerators forever because one probe timed out
+        return {"platform": "unknown", "device_count": 0,
+                "device_kind": "", "error": str(e)}
     return dict(_probe_cache)
 
 

@@ -40,6 +40,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Tests measure correctness, not runtime speed: skip the expensive XLA
+# optimization passes (~25% less compile wall-clock on a cold cache).
+jax.config.update("jax_disable_most_optimizations", True)
+os.environ.setdefault("JAX_DISABLE_MOST_OPTIMIZATIONS", "1")  # subprocesses
 
 import pytest  # noqa: E402
 

@@ -108,6 +108,7 @@ def test_mesh_kill_and_resume_bit_exact(tmp_path):
     np.testing.assert_array_equal(straight, resumed)
 
 
+@pytest.mark.slow
 def test_cross_silo_server_resume(tmp_path):
     from fedml_tpu.cross_silo.run_inproc import run_cross_silo_inproc
 

@@ -200,6 +200,7 @@ def test_mesh_host_fallback_for_model_attack():
     assert mesh._host_agg
 
 
+@pytest.mark.slow
 def test_mesh_host_fallback_for_exotic_defense():
     """Defenses without a traced form still work via the host path."""
     sp, mesh = _sp_vs_mesh({
@@ -228,6 +229,7 @@ def test_mesh_matches_sp_with_data_poisoning():
     assert not mesh._host_agg  # data poisoning alone stays in-program
 
 
+@pytest.mark.slow
 def test_mesh_matches_sp_trimmed_mean_f32_edge():
     """beta*n landing just below an integer in f32 (0.35*20) must agree."""
     _sp_vs_mesh({

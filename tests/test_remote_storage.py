@@ -293,19 +293,30 @@ def test_broker_sender_reclaims_stale_cas_generations(tmp_path):
     store = LocalCASObjectStore(str(tmp_path))
     tx = BrokerCommManager("rgc", 0, *broker.address, store, offload_bytes=16)
     tx._cas_keep_last = 2
+    tx._cas_min_age_s = 0.0  # let the test reclaim immediately
     try:
         from fedml_tpu.core.distributed.message import Message
 
-        cids = []
-        for i in range(5):  # 5 distinct payloads, window of 2
-            msg = Message("sync", 0, 1)
+        def send(receiver, i):
+            msg = Message("sync", 0, receiver)
             msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS,
                            {"w": np.full(32, i, np.float32)})
             tx.send_message(msg)
-            cids = tx._cas_sent
-        assert len(cids) == 2  # only the newest generations stay pinned
-        stored = set(os.listdir(str(tmp_path)))
-        assert stored == set(cids)
+
+        for i in range(5):  # 5 distinct generations to rank 1, window of 2
+            send(1, i)
+        kept = [c for (c, _) in tx._cas_sent[1]]
+        assert len(kept) == 2  # only the newest generations stay pinned
+        assert set(os.listdir(str(tmp_path))) == set(kept)
+
+        # a CID still inside ANOTHER receiver's window survives rank 1's
+        # aging-out (broadcast dedup safety)
+        send(2, 99)
+        shared = tx._cas_sent[2][0][0]
+        for i in (99, 100, 101):  # rank 1: shared, then 2 more generations
+            send(1, i)
+        assert all(shared != c for (c, _) in tx._cas_sent[1])  # aged out
+        assert shared in os.listdir(str(tmp_path))  # but rank 2 pins it
     finally:
         tx.client.close()
         broker.stop()
