@@ -33,12 +33,15 @@ def _args(model="lr", dataset="synthetic", **extra):
     "darts",
 ])
 def test_cv_models_forward_and_grad(name):
-    # one cell at 16×16 keeps the conv/GroupNorm/pool/MixedOp coverage
-    # while halving the XLA graph the CPU gate has to compile
+    # darts: one cell at 16×16 keeps the conv/GroupNorm/pool/MixedOp
+    # coverage while halving the XLA graph the CPU gate has to compile.
+    # The deep stacks keep 32×32 — vgg11's five pools collapse anything
+    # smaller to zero spatial extent.
     extra = {"darts_cells": 1, "darts_channels": 8} if name == "darts" else {}
+    size = 16 if name == "darts" else 32
     args = _args(model=name, **extra)
     model = models_mod.create(args, output_dim=4)
-    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 16, 3)),
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, size, size, 3)),
                     jnp.float32)
     params = model.init(jax.random.key(0), x)
     logits = model.apply(params, x)
