@@ -1,0 +1,262 @@
+"""Measured baseline: the ACTUAL reference (torch FedML @ /root/reference)
+vs fedml_tpu on identical data, config, and seeds — BASELINE.md config #1
+shape (FedAvg + logistic regression, 10 clients, sp simulation).
+
+The reference is imported read-only from /root/reference/python with its
+cloud/edge dependencies (MQTT, S3, docker, wandb, triton, ...) auto-stubbed
+— only the training path runs, which needs none of them. No reference code
+is copied; it is *executed* to produce the baseline numbers BASELINE.md
+calls for ("baselines must be measured, not copied").
+
+Usage:
+    python tools/reference_baseline.py [--rounds 10] [--out BASELINE_MEASURED.md]
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.abc
+import importlib.machinery
+import json
+import sys
+import time
+import types
+from types import SimpleNamespace
+
+import numpy as np
+
+N_CLIENTS, PER_ROUND, EPOCHS, BATCH, LR = 10, 10, 2, 32, 0.1
+N_TRAIN, N_TEST, DIM, CLASSES = 2000, 400, 60, 10
+
+
+# --------------------------------------------------------------------------
+# shared synthetic data — one generator feeds both frameworks
+# --------------------------------------------------------------------------
+
+def make_data(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(DIM, CLASSES))
+    x = rng.normal(size=(N_TRAIN + N_TEST, DIM)).astype(np.float32)
+    y = np.argmax(x @ w + 0.5 * rng.normal(size=(N_TRAIN + N_TEST, CLASSES)),
+                  axis=1).astype(np.int64)
+    xs, ys = x[:N_TRAIN], y[:N_TRAIN]
+    xt, yt = x[N_TRAIN:], y[N_TRAIN:]
+    # uniform client split (reference config #1 uses homogeneous partition)
+    idx = np.array_split(np.arange(N_TRAIN), N_CLIENTS)
+    tidx = np.array_split(np.arange(N_TEST), N_CLIENTS)
+    return xs, ys, xt, yt, idx, tidx
+
+
+# --------------------------------------------------------------------------
+# reference side
+# --------------------------------------------------------------------------
+
+STUB_ROOTS = {
+    "GPUtil", "paho", "boto3", "botocore", "wandb", "MNN", "httpx", "redis",
+    "chardet", "fastapi", "uvicorn", "prettytable", "click_spinner",
+    "torchvision", "matplotlib", "sqlalchemy", "docker", "pkg_resources",
+    "tritonclient", "multiprocess", "setproctitle", "networkx", "gevent",
+    "geventhttpclient", "wget", "h5py", "spacy", "gensim", "sklearn",
+    "pandas", "PIL", "cv2", "pympler",
+}
+
+
+class _Dummy:
+    def __init__(self, *a, **k):
+        pass
+
+    def __call__(self, *a, **k):
+        return _Dummy()
+
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        return _Dummy()
+
+    def __mro_entries__(self, bases):
+        return (object,)
+
+    def __iter__(self):
+        return iter(())
+
+
+class _StubModule(types.ModuleType):
+    __path__: list = []
+
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        if name == "parse_version":
+            return lambda v: tuple(str(v).split("."))
+        if name == "declarative_base":
+            return lambda **k: type("Base", (), {})
+        if name in ("APIError", "NotFound", "DockerException"):
+            return type(name, (Exception,), {})
+        return _Dummy()
+
+
+class _StubFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname.split(".", 1)[0] in STUB_ROOTS:
+            return importlib.machinery.ModuleSpec(fullname, self,
+                                                  is_package=True)
+        return None
+
+    def create_module(self, spec):
+        return _StubModule(spec.name)
+
+    def exec_module(self, module):
+        pass
+
+
+def run_reference(rounds: int):
+    import requests  # noqa: F401 — bind real chardet handling before stubs
+
+    sys.meta_path.insert(0, _StubFinder())
+    sys.path.insert(0, "/root/reference/python")
+
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    import fedml
+    from fedml.model.linear.lr import LogisticRegression
+    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+    # the harness never calls fedml.init() (needs yaml/CLI); silence the
+    # mlops control-plane hooks the train loop fires
+    for name in dir(fedml.mlops):
+        if name.startswith(("log", "event")):
+            setattr(fedml.mlops, name, lambda *a, **k: None)
+
+    xs, ys, xt, yt, idx, tidx = make_data()
+
+    def loader(x, y):
+        return DataLoader(
+            TensorDataset(torch.from_numpy(x), torch.from_numpy(y)),
+            batch_size=BATCH, shuffle=False,
+        )
+
+    train_local = {i: loader(xs[idx[i]], ys[idx[i]]) for i in range(N_CLIENTS)}
+    test_local = {i: loader(xt[tidx[i]], yt[tidx[i]]) for i in range(N_CLIENTS)}
+    nums = {i: len(idx[i]) for i in range(N_CLIENTS)}
+    dataset = [N_TRAIN, N_TEST, loader(xs, ys), loader(xt, yt),
+               nums, train_local, test_local, CLASSES]
+
+    args = SimpleNamespace(
+        batch_size=BATCH, client_num_in_total=N_CLIENTS,
+        client_num_per_round=PER_ROUND, comm_round=rounds,
+        dataset="synthetic", enable_wandb=False, frequency_of_the_test=1000,
+        client_optimizer="sgd", epochs=EPOCHS, learning_rate=LR,
+        weight_decay=0.0, federated_optimizer="FedAvg", model="lr",
+        run_id=0, using_mlops=False,
+    )
+    torch.manual_seed(0)
+    model = LogisticRegression(DIM, CLASSES)
+    api = FedAvgAPI(args, torch.device("cpu"), dataset, model)
+
+    t0 = time.perf_counter()
+    api.train()
+    wall = time.perf_counter() - t0
+
+    with torch.no_grad():
+        logits = api.model_trainer.model(torch.from_numpy(xt))
+        acc = float((logits.argmax(1).numpy() == yt).mean())
+    return {"framework": "reference (torch, CPU)", "rounds": rounds,
+            "wall_sec": round(wall, 2),
+            "sec_per_round": round(wall / rounds, 3),
+            "final_test_acc": round(acc, 4)}
+
+
+# --------------------------------------------------------------------------
+# fedml_tpu side
+# --------------------------------------------------------------------------
+
+def run_ours(rounds: int, platform: str = ""):
+    sys.path.insert(0, "/root/repo")
+    import jax
+
+    if platform:
+        # sitecustomize may pin the hardware plugin; the config API wins
+        jax.config.update("jax_platforms", platform)
+
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data.dataset import FederatedDataset
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+    import fedml_tpu
+
+    xs, ys, xt, yt, idx, tidx = make_data()
+    ds = FederatedDataset(
+        train_data_num=N_TRAIN, test_data_num=N_TEST,
+        train_data_global=(xs, ys), test_data_global=(xt, yt),
+        train_data_local_num_dict={i: len(idx[i]) for i in range(N_CLIENTS)},
+        train_data_local_dict={i: (xs[idx[i]], ys[idx[i]])
+                               for i in range(N_CLIENTS)},
+        test_data_local_dict={i: (xt[tidx[i]], yt[tidx[i]])
+                              for i in range(N_CLIENTS)},
+        class_num=CLASSES, feature_dim=DIM,
+    )
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic"},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": N_CLIENTS,
+                       "client_num_per_round": PER_ROUND,
+                       "comm_round": rounds, "epochs": EPOCHS,
+                       "batch_size": BATCH, "learning_rate": LR,
+                       # same eval work as the reference side: test only at
+                       # the end, not every round
+                       "frequency_of_the_test": 1000},
+    }))
+    from fedml_tpu import models as models_mod
+
+    model = models_mod.create(args, output_dim=CLASSES)
+    api = FedAvgAPI(args, None, ds, model)
+    t0 = time.perf_counter()
+    res = api.train()
+    wall = time.perf_counter() - t0
+    return {"framework": f"fedml_tpu (jax, {jax.default_backend()})",
+            "rounds": rounds, "wall_sec": round(wall, 2),
+            "sec_per_round": round(wall / rounds, 3),
+            "first_compile_included": True,
+            "final_test_acc": round(float(res["test_acc"]), 4)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--side", choices=["reference", "ours", "both"],
+                    default="both")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for the fedml_tpu side (cpu|tpu); "
+                         "cpu by default so the CPU-vs-CPU table reproduces")
+    args = ap.parse_args()
+    results = []
+    if args.side in ("reference", "both"):
+        results.append(run_reference(args.rounds))
+        print(json.dumps(results[-1]))
+    if args.side in ("ours", "both"):
+        # run ours in a subprocess when both: the stub finder must not leak
+        if args.side == "both":
+            import subprocess
+
+            out = subprocess.run(
+                [sys.executable, __file__, "--side", "ours",
+                 "--rounds", str(args.rounds),
+                 "--platform", args.platform],
+                capture_output=True, text=True,
+            )
+            lines = out.stdout.strip().splitlines()
+            if out.returncode != 0 or not lines:
+                sys.stderr.write(out.stderr)
+                raise SystemExit(
+                    f"ours-side subprocess failed (rc={out.returncode})")
+            results.append(json.loads(lines[-1]))
+            print(lines[-1])
+        else:
+            results.append(run_ours(args.rounds, args.platform))
+            print(json.dumps(results[-1]))
+    return results
+
+
+if __name__ == "__main__":
+    main()
