@@ -133,6 +133,21 @@ def build_local_fn(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+    def _scan(f, carry, batches, params):
+        # XLA:CPU runs convolutions inside while-loops ~18x slower than
+        # unrolled (the loop blocks the fast conv layout path — measured in
+        # PERF_NOTES.md round-3 addendum); fully unroll small scans there.
+        # Only conv models (rank-4 kernels) pay the loop tax — dense/LSTM
+        # models keep the rolled scan and its fast compile. TPU always
+        # keeps the rolled scan: loops compile fast and run at speed.
+        n = jax.tree.leaves(batches)[0].shape[0]
+        has_conv = any(getattr(leaf, "ndim", 0) == 4
+                       for leaf in jax.tree.leaves(params))
+        unroll = n if (
+            jax.default_backend() == "cpu" and has_conv and n <= 32
+        ) else 1
+        return jax.lax.scan(f, carry, batches, unroll=unroll)
+
     def run_local(params, state: LocalState, xs, ys, mask):
         opt_state = tx.init(params)
 
@@ -149,8 +164,8 @@ def build_local_fn(
                 gsum = jax.tree.map(lambda a, b: a + b * w, gsum, g)
                 return (gsum, wsum + w), None
 
-            (gsum, wsum), _ = jax.lax.scan(
-                accum, (tree_zeros_like(params), 0.0), (xs, ys, mask)
+            (gsum, wsum), _ = _scan(
+                accum, (tree_zeros_like(params), 0.0), (xs, ys, mask), params
             )
             mime_full_grad = jax.tree.map(
                 lambda g: g / jnp.maximum(wsum, 1.0), gsum
@@ -190,8 +205,8 @@ def build_local_fn(
             params = optax.apply_updates(params, updates)
             return (params, opt_state), (loss, correct, denom, valid)
 
-        (new_params, _), (losses, corrects, denoms, valids) = jax.lax.scan(
-            step, (params, opt_state), (xs, ys, mask)
+        (new_params, _), (losses, corrects, denoms, valids) = _scan(
+            step, (params, opt_state), (xs, ys, mask), params
         )
         n_steps = xs.shape[0]
         tau = jnp.sum(valids)  # actual (non-padded) local optimizer steps

@@ -108,131 +108,204 @@ class _StubFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
         pass
 
 
-def run_reference(rounds: int):
+def _setup_reference():
+    """Install the stub finder, import the reference, silence its mlops."""
     import requests  # noqa: F401 — bind real chardet handling before stubs
 
     sys.meta_path.insert(0, _StubFinder())
     sys.path.insert(0, "/root/reference/python")
-
-    import torch
-    from torch.utils.data import DataLoader, TensorDataset
-
     import fedml
-    from fedml.model.linear.lr import LogisticRegression
-    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI
 
     # the harness never calls fedml.init() (needs yaml/CLI); silence the
     # mlops control-plane hooks the train loop fires
     for name in dir(fedml.mlops):
         if name.startswith(("log", "event")):
             setattr(fedml.mlops, name, lambda *a, **k: None)
+    return fedml
 
-    xs, ys, xt, yt, idx, tidx = make_data()
+
+def _reference_args(rounds, *, n_clients, per_round, epochs, batch, lr,
+                    model):
+    return SimpleNamespace(
+        batch_size=batch, client_num_in_total=n_clients,
+        client_num_per_round=per_round, comm_round=rounds,
+        dataset="synthetic", enable_wandb=False, frequency_of_the_test=1000,
+        client_optimizer="sgd", epochs=epochs, learning_rate=lr,
+        weight_decay=0.0, federated_optimizer="FedAvg", model=model,
+        run_id=0, using_mlops=False,
+    )
+
+
+def _run_reference_fedavg(args, model_fn, data, batch, label, to_input=None):
+    """Shared reference-side scaffold: loaders → FedAvgAPI → timing → acc."""
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+    xs, ys, xt, yt, idx, tidx = data
+    to_input = to_input or (lambda a: a)
+    n_clients = args.client_num_in_total
 
     def loader(x, y):
         return DataLoader(
-            TensorDataset(torch.from_numpy(x), torch.from_numpy(y)),
-            batch_size=BATCH, shuffle=False,
+            TensorDataset(torch.from_numpy(to_input(x)), torch.from_numpy(y)),
+            batch_size=batch, shuffle=False,
         )
 
-    train_local = {i: loader(xs[idx[i]], ys[idx[i]]) for i in range(N_CLIENTS)}
-    test_local = {i: loader(xt[tidx[i]], yt[tidx[i]]) for i in range(N_CLIENTS)}
-    nums = {i: len(idx[i]) for i in range(N_CLIENTS)}
-    dataset = [N_TRAIN, N_TEST, loader(xs, ys), loader(xt, yt),
+    train_local = {i: loader(xs[idx[i]], ys[idx[i]]) for i in range(n_clients)}
+    test_local = {i: loader(xt[tidx[i]], yt[tidx[i]]) for i in range(n_clients)}
+    nums = {i: len(idx[i]) for i in range(n_clients)}
+    dataset = [len(xs), len(xt), loader(xs, ys), loader(xt, yt),
                nums, train_local, test_local, CLASSES]
 
-    args = SimpleNamespace(
-        batch_size=BATCH, client_num_in_total=N_CLIENTS,
-        client_num_per_round=PER_ROUND, comm_round=rounds,
-        dataset="synthetic", enable_wandb=False, frequency_of_the_test=1000,
-        client_optimizer="sgd", epochs=EPOCHS, learning_rate=LR,
-        weight_decay=0.0, federated_optimizer="FedAvg", model="lr",
-        run_id=0, using_mlops=False,
-    )
-    torch.manual_seed(0)
-    model = LogisticRegression(DIM, CLASSES)
-    api = FedAvgAPI(args, torch.device("cpu"), dataset, model)
-
+    torch.manual_seed(0)  # seed BEFORE construction so init is seeded
+    api = FedAvgAPI(args, torch.device("cpu"), dataset, model_fn())
     t0 = time.perf_counter()
     api.train()
     wall = time.perf_counter() - t0
 
+    api.model_trainer.model.eval()
     with torch.no_grad():
-        logits = api.model_trainer.model(torch.from_numpy(xt))
+        logits = api.model_trainer.model(torch.from_numpy(to_input(xt)))
         acc = float((logits.argmax(1).numpy() == yt).mean())
-    return {"framework": "reference (torch, CPU)", "rounds": rounds,
+    return {"framework": label, "rounds": args.comm_round,
             "wall_sec": round(wall, 2),
-            "sec_per_round": round(wall / rounds, 3),
+            "sec_per_round": round(wall / args.comm_round, 3),
             "final_test_acc": round(acc, 4)}
+
+
+def run_reference(rounds: int):
+    _setup_reference()
+    from fedml.model.linear.lr import LogisticRegression
+
+    args = _reference_args(rounds, n_clients=N_CLIENTS, per_round=PER_ROUND,
+                           epochs=EPOCHS, batch=BATCH, lr=LR, model="lr")
+    return _run_reference_fedavg(
+        args, lambda: LogisticRegression(DIM, CLASSES), make_data(), BATCH,
+        "reference (torch, CPU)")
 
 
 # --------------------------------------------------------------------------
 # fedml_tpu side
 # --------------------------------------------------------------------------
 
-def run_ours(rounds: int, platform: str = ""):
+def _run_ours_fedavg(rounds, platform, data, data_args, model_name, label,
+                     *, n_clients, per_round, epochs, batch, lr):
+    """Shared fedml_tpu-side scaffold: dataset -> FedAvgAPI -> timing -> acc."""
     sys.path.insert(0, "/root/repo")
     import jax
 
     if platform:
         # sitecustomize may pin the hardware plugin; the config API wins
         jax.config.update("jax_platforms", platform)
-
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
     from fedml_tpu.arguments import load_arguments_from_dict
     from fedml_tpu.data.dataset import FederatedDataset
     from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
-    import fedml_tpu
 
-    xs, ys, xt, yt, idx, tidx = make_data()
+    xs, ys, xt, yt, idx, tidx = data
     ds = FederatedDataset(
-        train_data_num=N_TRAIN, test_data_num=N_TEST,
+        train_data_num=len(xs), test_data_num=len(xt),
         train_data_global=(xs, ys), test_data_global=(xt, yt),
-        train_data_local_num_dict={i: len(idx[i]) for i in range(N_CLIENTS)},
+        train_data_local_num_dict={i: len(idx[i]) for i in range(n_clients)},
         train_data_local_dict={i: (xs[idx[i]], ys[idx[i]])
-                               for i in range(N_CLIENTS)},
+                               for i in range(n_clients)},
         test_data_local_dict={i: (xt[tidx[i]], yt[tidx[i]])
-                              for i in range(N_CLIENTS)},
-        class_num=CLASSES, feature_dim=DIM,
+                              for i in range(n_clients)},
+        class_num=CLASSES,
     )
     args = fedml_tpu.init(load_arguments_from_dict({
         "common_args": {"training_type": "simulation", "random_seed": 0},
-        "data_args": {"dataset": "synthetic"},
-        "model_args": {"model": "lr"},
+        "data_args": data_args,
+        "model_args": {"model": model_name},
         "train_args": {"federated_optimizer": "FedAvg",
-                       "client_num_in_total": N_CLIENTS,
-                       "client_num_per_round": PER_ROUND,
-                       "comm_round": rounds, "epochs": EPOCHS,
-                       "batch_size": BATCH, "learning_rate": LR,
+                       "client_num_in_total": n_clients,
+                       "client_num_per_round": per_round,
+                       "comm_round": rounds, "epochs": epochs,
+                       "batch_size": batch, "learning_rate": lr,
                        # same eval work as the reference side: test only at
                        # the end, not every round
                        "frequency_of_the_test": 1000},
     }))
-    from fedml_tpu import models as models_mod
-
     model = models_mod.create(args, output_dim=CLASSES)
     api = FedAvgAPI(args, None, ds, model)
     t0 = time.perf_counter()
     res = api.train()
     wall = time.perf_counter() - t0
-    return {"framework": f"fedml_tpu (jax, {jax.default_backend()})",
+    return {"framework": f"{label} (jax, {jax.default_backend()})",
             "rounds": rounds, "wall_sec": round(wall, 2),
             "sec_per_round": round(wall / rounds, 3),
             "first_compile_included": True,
             "final_test_acc": round(float(res["test_acc"]), 4)}
 
 
+def run_ours(rounds: int, platform: str = ""):
+    return _run_ours_fedavg(
+        rounds, platform, make_data(), {"dataset": "synthetic"}, "lr",
+        "fedml_tpu", n_clients=N_CLIENTS, per_round=PER_ROUND,
+        epochs=EPOCHS, batch=BATCH, lr=LR)
+
+
+# --------------------------------------------------------------------------
+# config #2 flavor: CNN (resnet20) image classification — both frameworks'
+# own CIFAR-style resnet20 on identical synthetic 32×32×3 data
+# --------------------------------------------------------------------------
+
+CNN_TRAIN, CNN_TEST, CNN_CLIENTS, CNN_BATCH, CNN_LR, CNN_EPOCHS = (
+    640, 160, 4, 32, 0.05, 1)
+
+
+def make_image_data(seed: int = 1):
+    rng = np.random.default_rng(seed)
+    n = CNN_TRAIN + CNN_TEST
+    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    # class = sign pattern of 10 fixed random filters → learnable, not trivial
+    w = rng.normal(size=(32 * 32 * 3, 10))
+    y = np.argmax(x.reshape(n, -1) @ w, axis=1).astype(np.int64)
+    xs, ys, xt, yt = x[:CNN_TRAIN], y[:CNN_TRAIN], x[CNN_TRAIN:], y[CNN_TRAIN:]
+    idx = np.array_split(np.arange(CNN_TRAIN), CNN_CLIENTS)
+    tidx = np.array_split(np.arange(CNN_TEST), CNN_CLIENTS)
+    return xs, ys, xt, yt, idx, tidx
+
+
+def run_reference_cnn(rounds: int):
+    _setup_reference()
+    from fedml.model.cv.resnet import resnet20
+
+    args = _reference_args(rounds, n_clients=CNN_CLIENTS,
+                           per_round=CNN_CLIENTS, epochs=CNN_EPOCHS,
+                           batch=CNN_BATCH, lr=CNN_LR, model="resnet20")
+    return _run_reference_fedavg(
+        args, lambda: resnet20(10), make_image_data(), CNN_BATCH,
+        "reference resnet20 (torch, CPU)",
+        to_input=lambda a: np.transpose(a, (0, 3, 1, 2)).copy())
+
+
+def run_ours_cnn(rounds: int, platform: str = ""):
+    return _run_ours_fedavg(
+        rounds, platform, make_image_data(),
+        {"dataset": "synthetic_image", "image_size": 32}, "resnet20",
+        "fedml_tpu resnet20", n_clients=CNN_CLIENTS, per_round=CNN_CLIENTS,
+        epochs=CNN_EPOCHS, batch=CNN_BATCH, lr=CNN_LR)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--config", choices=["lr", "cnn"], default="lr")
     ap.add_argument("--side", choices=["reference", "ours", "both"],
                     default="both")
     ap.add_argument("--platform", default="cpu",
                     help="jax platform for the fedml_tpu side (cpu|tpu); "
                          "cpu by default so the CPU-vs-CPU table reproduces")
     args = ap.parse_args()
+    ref_fn = run_reference if args.config == "lr" else run_reference_cnn
+    ours_fn = run_ours if args.config == "lr" else run_ours_cnn
     results = []
     if args.side in ("reference", "both"):
-        results.append(run_reference(args.rounds))
+        results.append(ref_fn(args.rounds))
         print(json.dumps(results[-1]))
     if args.side in ("ours", "both"):
         # run ours in a subprocess when both: the stub finder must not leak
@@ -241,7 +314,7 @@ def main():
 
             out = subprocess.run(
                 [sys.executable, __file__, "--side", "ours",
-                 "--rounds", str(args.rounds),
+                 "--rounds", str(args.rounds), "--config", args.config,
                  "--platform", args.platform],
                 capture_output=True, text=True,
             )
@@ -253,7 +326,7 @@ def main():
             results.append(json.loads(lines[-1]))
             print(lines[-1])
         else:
-            results.append(run_ours(args.rounds, args.platform))
+            results.append(ours_fn(args.rounds, args.platform))
             print(json.dumps(results[-1]))
     return results
 
