@@ -1,0 +1,43 @@
+import time, numpy as np, jax, jax.numpy as jnp
+import sys; sys.path.insert(0, "/root/repo")
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                  num_hidden_layers=22, num_attention_heads=32,
+                  num_key_value_heads=8, max_position_embeddings=2048,
+                  remat=False, remat_policy="none", dtype=jnp.bfloat16,
+                  param_dtype=jnp.bfloat16, use_flash=False)
+model = LlamaForCausalLM(cfg)
+rng = np.random.default_rng(0)
+toks = jnp.asarray(rng.integers(0, 32000, size=(1, 8)))
+params = jax.jit(model.init)(jax.random.key(0), toks)
+n_params = sum(x.size for x in jax.tree.leaves(params))
+B, CTX = 8, 1024
+eng = ContinuousBatchingEngine(model, params, batch_slots=B, max_len=CTX)
+caches = model.init_kv_caches(B, CTX)
+caches = [(jnp.asarray(k), jnp.asarray(v)) for k, v, _ in caches]
+last = jnp.asarray(rng.integers(0, 32000, size=(B,)))
+lengths = jnp.full((B,), 512, jnp.int32)
+
+def chain(n):
+    global caches
+    t0 = time.perf_counter()
+    c = caches
+    logits = None
+    for _ in range(n):
+        c, logits = eng._decode(params, c, last, lengths)
+    caches = c
+    float(jnp.sum(logits.astype(jnp.float32)))
+    return time.perf_counter() - t0
+
+chain(2)
+best = 1e9
+for _ in range(3):
+    ts = chain(2); tl = chain(34)
+    best = min(best, (tl - ts) / 32)
+tok_s = B / best
+print(f"params={n_params/1e9:.2f}B  decode step {best*1e3:.2f} ms @B{B} ctx512 "
+      f"-> {tok_s:.0f} tok/s device-side")
+# memory-bound roofline: reading 2.25GB bf16 weights per step
+print(f"weight-read roofline: {2.25e9/best/1e9:.0f} GB/s effective")

@@ -136,7 +136,7 @@ def _reference_args(rounds, *, n_clients, per_round, epochs, batch, lr,
     )
 
 
-def _run_reference_fedavg(args, model_fn, data, batch, label, to_input=None):
+def _run_reference_fedavg(args, model_fn, data, label, to_input=None):
     """Shared reference-side scaffold: loaders → FedAvgAPI → timing → acc."""
     import torch
     from torch.utils.data import DataLoader, TensorDataset
@@ -150,7 +150,7 @@ def _run_reference_fedavg(args, model_fn, data, batch, label, to_input=None):
     def loader(x, y):
         return DataLoader(
             TensorDataset(torch.from_numpy(to_input(x)), torch.from_numpy(y)),
-            batch_size=batch, shuffle=False,
+            batch_size=args.batch_size, shuffle=False,
         )
 
     train_local = {i: loader(xs[idx[i]], ys[idx[i]]) for i in range(n_clients)}
@@ -182,7 +182,7 @@ def run_reference(rounds: int):
     args = _reference_args(rounds, n_clients=N_CLIENTS, per_round=PER_ROUND,
                            epochs=EPOCHS, batch=BATCH, lr=LR, model="lr")
     return _run_reference_fedavg(
-        args, lambda: LogisticRegression(DIM, CLASSES), make_data(), BATCH,
+        args, lambda: LogisticRegression(DIM, CLASSES), make_data(),
         "reference (torch, CPU)")
 
 
@@ -278,7 +278,7 @@ def run_reference_cnn(rounds: int):
                            per_round=CNN_CLIENTS, epochs=CNN_EPOCHS,
                            batch=CNN_BATCH, lr=CNN_LR, model="resnet20")
     return _run_reference_fedavg(
-        args, lambda: resnet20(10), make_image_data(), CNN_BATCH,
+        args, lambda: resnet20(10), make_image_data(),
         "reference resnet20 (torch, CPU)",
         to_input=lambda a: np.transpose(a, (0, 3, 1, 2)).copy())
 
