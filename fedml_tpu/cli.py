@@ -417,8 +417,10 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
 @click.option("--batch-slots", default=4, show_default=True)
 @click.option("--max-len", default=512, show_default=True)
 @click.option("--lora-rank", default=0, show_default=True)
+@click.option("--quantize", default=None, type=click.Choice(["int8"]),
+              help="weight-only quantization (halves HBM residency)")
 def serve(model_size: str, host: str, port: int, batch_slots: int,
-          max_len: int, lora_rank: int) -> None:
+          max_len: int, lora_rank: int, quantize) -> None:
     """Boot a continuous-batching LLM inference endpoint (blocking)."""
     import jax
     import jax.numpy as jnp
@@ -440,7 +442,8 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
     model = LlamaForCausalLM(cfg)
     params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
     engine = ContinuousBatchingEngine(
-        model, params, batch_slots=batch_slots, max_len=max_len
+        model, params, batch_slots=batch_slots, max_len=max_len,
+        quantize=quantize,
     )
     from fedml_tpu.serving.openai_protocol import OpenAIServing
 

@@ -43,6 +43,7 @@ def build_predictor(package_dir: str):
             model, weights,
             batch_slots=int(params.get("batch_slots", 4)),
             max_len=int(params.get("max_len", 512)),
+            quantize=params.get("quantize"),
         )
         return LlamaPredictor(engine)
     if builtin is not None:

@@ -202,7 +202,9 @@ class LoRADense(nn.Module):
             (x.shape[-1], self.features),
             self.param_dtype,
         )
-        y = x @ kernel.astype(self.dtype)
+        from fedml_tpu.ops.quant import matmul_maybe_quantized
+
+        y = matmul_maybe_quantized(x, kernel, self.dtype)
         if self.rank > 0:
             a = self.param(
                 "lora_a",
@@ -384,7 +386,9 @@ class LlamaForCausalLM(nn.Module):
                 (cfg.hidden_size, cfg.vocab_size),
                 cfg.param_dtype,
             )
-            logits = x @ head.astype(cfg.dtype)
+            from fedml_tpu.ops.quant import matmul_maybe_quantized
+
+            logits = matmul_maybe_quantized(x, head, cfg.dtype)
         logits = logits.astype(jnp.float32)
         if kv_caches is not None:
             return logits, new_caches

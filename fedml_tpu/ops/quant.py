@@ -1,0 +1,112 @@
+"""Int8 weight-only quantization for TPU serving.
+
+Quantizes 2-D kernels to per-output-channel int8 and swaps them into the
+params pytree as :class:`QuantizedTensor` leaves; ``LoRADense`` / the lm
+head consume them as ``(x @ q.astype(bf16)) * scale`` — mathematically
+identical to dequantize-then-matmul with the scale folded into outputs.
+
+What it buys (measured, PERF_NOTES addendum 4): **HBM residency halves**
+(2.25 GB → 1.13 GB for the 1.1B bench model), fitting ~2× the model per
+serving chip. What it does NOT buy on current XLA: decode speed — the
+int8→bf16 convert is materialized rather than staying fused into the
+dot's operand load, so the decode step measured *slower* (7.1 vs 4.5 ms
+at B8); use it for capacity, not latency. The latency path is full
+int8×int8 (activation quant, MXU-native) — future work.
+
+No reference counterpart: the reference delegates quantized serving to
+vLLM/Triton containers.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Per-output-channel symmetric int8 weight: ``w ≈ data * scale``."""
+
+    def __init__(self, data, scale):
+        self.data = data    # int8  [in, out]
+        self.scale = scale  # f32   [out]
+
+    # -- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- array-ish surface ----------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.data.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    def dequantize(self, dtype=jnp.float32):
+        return self.data.astype(dtype) * self.scale.astype(dtype)[None, :]
+
+    def matmul(self, x, dtype):
+        """``x @ W`` with the scale folded into the OUTPUT channels —
+        exact w.r.t. dequantize-then-matmul, but the int8→bf16 convert
+        fuses into the dot so the weights are read from HBM as int8."""
+        return (x @ self.data.astype(dtype)) * self.scale.astype(dtype)
+
+
+def quantize_int8(w: Any) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 quantization of a [in, out] kernel."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0)          # [out]
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale)
+
+
+def quantize_params_int8(params: Any, min_size: int = 65536) -> Any:
+    """Swap every large 2-D non-LoRA kernel leaf for a QuantizedTensor.
+
+    LoRA adapters stay fp32 (they are tiny and trained); embeddings stay
+    full precision (gather, not matmul); norms/bias are 1-D and skipped.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    out = []
+    for path, leaf in flat:
+        # partitioning metadata boxes end the path with GetAttrKey('value');
+        # the param NAME is the last dict key
+        dict_keys = [str(p.key) for p in path if hasattr(p, "key")]
+        name = "/".join(dict_keys)
+        is_kernel = dict_keys and dict_keys[-1] in ("kernel", "lm_head")
+        if (is_kernel and getattr(leaf, "ndim", 0) == 2
+                and leaf.size >= min_size
+                and "lora" not in name
+                and "embed" not in name):
+            out.append(quantize_int8(leaf))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def matmul_maybe_quantized(x, w, dtype):
+    """``x @ w`` that accepts either a plain kernel or a QuantizedTensor —
+    the single dispatch point model code uses, so new quantized formats
+    only need to be handled here."""
+    if isinstance(w, QuantizedTensor):
+        return w.matmul(x, dtype)
+    return x @ w.astype(dtype)
+
+
+def tree_bytes(params: Any) -> int:
+    """Actual bytes a (possibly quantized) params tree occupies."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        n = int(np.prod(getattr(leaf, "shape", (0,)) or (0,)))
+        total += n * jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize
+    return total

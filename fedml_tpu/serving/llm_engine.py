@@ -56,8 +56,17 @@ class ContinuousBatchingEngine:
         max_len: int = 256,
         min_prompt_bucket: int = 16,
         eos_id: Optional[int] = None,
+        quantize: Optional[str] = None,
     ):
         self.model = model
+        if quantize == "int8":
+            # weight-only int8: halves HBM residency (~2x models per chip);
+            # NOT a latency win on current XLA — see ops/quant.py docstring
+            from fedml_tpu.ops.quant import quantize_params_int8
+
+            params = quantize_params_int8(params)
+        elif quantize is not None:
+            raise ValueError(f"unknown quantize mode: {quantize!r}")
         self.params = params
         self.n_slots = int(batch_slots)
         self.max_len = int(max_len)

@@ -1,0 +1,96 @@
+"""Int8 weight-only quantized serving (no reference counterpart — the
+reference delegates quantized inference to vLLM/Triton containers)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+from fedml_tpu.ops.quant import (
+    QuantizedTensor,
+    quantize_int8,
+    quantize_params_int8,
+)
+
+
+def test_quantize_int8_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    q = quantize_int8(w)
+    assert q.data.dtype == jnp.int8 and q.scale.shape == (32,)
+    wq = np.asarray(q.dequantize())
+    # per-channel symmetric int8: error ≤ scale/2 per element
+    bound = np.asarray(q.scale)[None, :] * 0.5 + 1e-7
+    assert np.all(np.abs(wq - w) <= bound)
+
+
+def test_matmul_scale_folding_is_exact():
+    """(x @ q) * s must equal x @ (q * s) — the fold is not approximate."""
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    q = quantize_int8(w)
+    np.testing.assert_allclose(
+        np.asarray(q.matmul(x, jnp.float32)),
+        np.asarray(x @ q.dequantize(jnp.float32)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_params_targets_only_large_base_kernels():
+    cfg = LlamaConfig.tiny(lora_rank=4, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), toks)
+    qparams = quantize_params_int8(params, min_size=1024)
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor))[0]
+
+    def name_of(path):
+        return "/".join(str(p.key) for p in path if hasattr(p, "key"))
+
+    quantized = [name_of(path) for path, leaf in flat
+                 if isinstance(leaf, QuantizedTensor)]
+    assert quantized, "no kernels were quantized"
+    for name in quantized:
+        assert "lora" not in name and "embed" not in name, name
+    # lora adapters and the embedding survive at full precision
+    fp_names = [name_of(path) for path, leaf in flat
+                if not isinstance(leaf, QuantizedTensor)]
+    assert any("lora_a" in n for n in fp_names)
+    assert any("embed" in n for n in fp_names)
+
+
+def test_quantized_decode_agrees_with_fp(tmp_path):
+    """Greedy decode with int8 weights matches full-precision top-1 on a
+    majority of steps, and the engine runs end-to-end quantized."""
+    from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.tiny(use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(1, 12)))
+    params = model.init(jax.random.key(0), toks)
+
+    logits_fp = model.apply(params, toks)
+    qparams = quantize_params_int8(params, min_size=1024)
+    logits_q = model.apply(qparams, toks)
+    top_fp = np.asarray(jnp.argmax(logits_fp, -1))[0]
+    top_q = np.asarray(jnp.argmax(logits_q, -1))[0]
+    agree = float((top_fp == top_q).mean())
+    assert agree >= 0.75, f"top-1 agreement {agree}"
+    # relative logit error stays small
+    rel = float(jnp.max(jnp.abs(logits_q - logits_fp))
+                / (jnp.max(jnp.abs(logits_fp)) + 1e-9))
+    assert rel < 0.2, rel
+
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   quantize="int8").start()
+    try:
+        out = eng.generate(list(np.asarray(toks[0][:6])), max_new_tokens=4)
+        assert len(out) == 4
+    finally:
+        eng.stop()
+
+    with pytest.raises(ValueError):
+        ContinuousBatchingEngine(model, params, quantize="int4")

@@ -2,6 +2,9 @@ import time, numpy as np, jax, jax.numpy as jnp
 import sys; sys.path.insert(0, "/root/repo")
 from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
 from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+import argparse
+ap = argparse.ArgumentParser(); ap.add_argument("--quantize", default=None)
+cli = ap.parse_args()
 
 cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                   num_hidden_layers=22, num_attention_heads=32,
@@ -14,7 +17,9 @@ toks = jnp.asarray(rng.integers(0, 32000, size=(1, 8)))
 params = jax.jit(model.init)(jax.random.key(0), toks)
 n_params = sum(x.size for x in jax.tree.leaves(params))
 B, CTX = 8, 1024
-eng = ContinuousBatchingEngine(model, params, batch_slots=B, max_len=CTX)
+eng = ContinuousBatchingEngine(model, params, batch_slots=B, max_len=CTX,
+                               quantize=cli.quantize)
+params = eng.params  # quantized if requested
 caches = model.init_kv_caches(B, CTX)
 caches = [(jnp.asarray(k), jnp.asarray(v)) for k, v, _ in caches]
 last = jnp.asarray(rng.integers(0, 32000, size=(B,)))
@@ -37,7 +42,10 @@ for _ in range(3):
     ts = chain(2); tl = chain(34)
     best = min(best, (tl - ts) / 32)
 tok_s = B / best
-print(f"params={n_params/1e9:.2f}B  decode step {best*1e3:.2f} ms @B{B} ctx512 "
-      f"-> {tok_s:.0f} tok/s device-side")
-# memory-bound roofline: reading 2.25GB bf16 weights per step
-print(f"weight-read roofline: {2.25e9/best/1e9:.0f} GB/s effective")
+print(f"params={n_params/1e9:.2f}B quantize={cli.quantize} decode step "
+      f"{best*1e3:.2f} ms @B{B} ctx512 -> {tok_s:.0f} tok/s device-side")
+# memory-bound roofline from the ACTUAL (possibly quantized) weight bytes
+from fedml_tpu.ops.quant import tree_bytes
+wbytes = tree_bytes(params)
+print(f"weight bytes {wbytes/1e9:.2f} GB -> "
+      f"weight-read roofline: {wbytes/best/1e9:.0f} GB/s effective")
