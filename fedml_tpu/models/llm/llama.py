@@ -42,6 +42,13 @@ class LlamaConfig:
     # LoRA (0 = disabled)
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Mixture-of-experts FFN (0 = dense MLP). Experts shard over the
+    # mesh's "ep" axis (expert parallelism).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_group_size: int = 1024  # routing group: dispatch memory is O(S·g·K)
+    moe_aux_weight: float = 0.01  # load-balance pressure in the train loss
     # training knobs
     dtype: Any = jnp.bfloat16
     # storage dtype of the FROZEN base weights. fp32 default (full-FT
@@ -109,7 +116,8 @@ class LlamaConfig:
         ).lower().replace("-", "_")
         kw = {}
         for field in ("lora_rank", "lora_alpha", "max_position_embeddings",
-                      "num_hidden_layers", "hidden_size"):
+                      "num_hidden_layers", "hidden_size", "num_experts",
+                      "num_experts_per_tok", "moe_capacity_factor"):
             if getattr(args, field, None) is not None:
                 kw[field] = type(LlamaConfig.__dataclass_fields__[field].default)(
                     getattr(args, field)
@@ -315,6 +323,103 @@ class LlamaMLP(nn.Module):
         )
 
 
+class LlamaMoE(nn.Module):
+    """Mixture-of-experts FFN (Mixtral/Switch shape) with expert parallelism.
+
+    Expert weights are stacked with a leading ``expert`` logical dim,
+    mapped to the mesh's ``ep`` axis (``train/llm/sharding.py``): the
+    dispatch/combine einsums below contract token-major tensors against
+    expert-major ones, and XLA inserts the all-to-alls that a hand-written
+    NCCL MoE would issue. Top-k routing with capacity dropping; aux
+    load-balance loss is sown as an intermediate. No reference
+    counterpart — the reference has no MoE anywhere (SURVEY §2.10).
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E, K = cfg.num_experts, cfg.num_experts_per_tok
+        B, T, H = x.shape
+        S = B * T
+        # Route within fixed-size token groups (Switch/Mesh-TF grouping):
+        # dispatch/combine are [G, g, E, cap] with cap ∝ g·K/E, so memory
+        # is O(S·g·K) — linear in S — instead of O(S²·K) ungrouped.
+        g = min(int(cfg.moe_group_size), S)
+        S_pad = ((S + g - 1) // g) * g
+        xs = x.reshape(S, H)
+        if S_pad != S:
+            # padding tokens route like zeros and are sliced off after the
+            # combine; they only waste capacity in the tail group
+            xs = jnp.concatenate(
+                [xs, jnp.zeros((S_pad - S, H), xs.dtype)], axis=0
+            )
+        G = S_pad // g
+        xg = xs.reshape(G, g, H)
+        # router in f32 for numerically-stable softmax/top-k
+        router_w = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", None)
+            ),
+            (H, E), jnp.float32,
+        )
+        logits = xg.astype(jnp.float32) @ router_w              # [G, g, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_vals, top_idx = jax.lax.top_k(probs, K)             # [G, g, K]
+        top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+
+        cap = max(4, int(cfg.moe_capacity_factor * g * K / E))
+        counts = jnp.zeros((G, E), jnp.int32)
+        dispatch = jnp.zeros((G, g, E, cap), cfg.dtype)
+        combine = jnp.zeros((G, g, E, cap), jnp.float32)
+        for j in range(K):  # K is tiny and static — unrolled at trace time
+            oh = jax.nn.one_hot(top_idx[..., j], E, dtype=jnp.int32)  # [G,g,E]
+            pos = counts[:, None, :] + jnp.cumsum(oh, 1) - oh         # [G,g,E]
+            counts = counts + jnp.sum(oh, 1)
+            keep = (pos < cap) & (oh > 0)                 # capacity dropping
+            slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [G,g,E,cap]
+            sel = slot * keep[..., None].astype(jnp.float32)
+            dispatch = dispatch + sel.astype(cfg.dtype)
+            combine = combine + sel * top_vals[..., j, None, None]
+
+        def experts(feats, name, in_axis, out_axis):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("expert", in_axis, out_axis)
+                ),
+                (E, *feats), cfg.param_dtype,
+            )
+
+        M = cfg.intermediate_size
+        w_gate = experts((H, M), "gate_proj", "embed", "mlp")
+        w_up = experts((H, M), "up_proj", "embed", "mlp")
+        w_down = experts((M, H), "down_proj", "mlp", "embed")
+
+        ein = xs.dtype
+        expert_in = jnp.einsum("gsec,gsh->egch", dispatch, xg)   # all-to-all
+        gate = jnp.einsum("egch,ehm->egcm", expert_in, w_gate.astype(ein))
+        up = jnp.einsum("egch,ehm->egcm", expert_in, w_up.astype(ein))
+        out = jnp.einsum("egcm,emh->egch",
+                         nn.silu(gate) * up, w_down.astype(ein))
+        ys = jnp.einsum("gsec,egch->gsh", combine.astype(ein), out)
+        ys = ys.reshape(S_pad, H)[:S]                            # drop padding
+
+        # Switch aux loss: E * Σ_e (fraction routed to e) * (mean prob of e),
+        # over REAL tokens only — pad rows have uniform router probs whose
+        # top-1 tie-breaks to expert 0 and would skew the statistics
+        valid = (jnp.arange(S_pad) < S).astype(jnp.float32).reshape(G, g)
+        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+        top1 = jax.nn.one_hot(top_idx[..., 0], E, dtype=jnp.float32)
+        frac = jnp.sum(top1 * valid[..., None], (0, 1)) / n_valid
+        mean_prob = jnp.sum(probs * valid[..., None], (0, 1)) / n_valid
+        aux = E * jnp.sum(frac * mean_prob)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return ys.reshape(B, T, H)
+
+
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
@@ -330,7 +435,9 @@ class LlamaBlock(nn.Module):
         )
         x = x + attn_out
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
-        x = x + LlamaMLP(cfg, name="mlp")(
+        ffn = (LlamaMoE(cfg, name="moe") if cfg.num_experts > 0
+               else LlamaMLP(cfg, name="mlp"))
+        x = x + ffn(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attn_norm")(x)
         )
         return x, new_cache
@@ -413,13 +520,15 @@ def causal_lm_loss(apply_fn):
     import optax
 
     def loss_fn(params, x, y, mask):
-        logits = apply_fn(params, x)  # y: next tokens [B, T]
+        out = apply_fn(params, x)  # y: next tokens [B, T]
+        # MoE apply_fns return (logits, aux_loss); dense ones return logits
+        logits, aux = out if isinstance(out, tuple) else (out, 0.0)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         valid = (y >= 0).astype(jnp.float32) * mask[:, None]
         total = jnp.sum(ce * valid)
         denom = jnp.maximum(jnp.sum(valid), 1.0)
         pred = jnp.argmax(logits, axis=-1)
         correct = jnp.sum((pred == y).astype(jnp.float32) * valid)
-        return total / denom, (correct, denom)
+        return total / denom + aux, (correct, denom)
 
     return loss_fn

@@ -8,6 +8,8 @@ mesh with axes
 
     dp    — pure data parallelism (params replicated)
     fsdp  — ZeRO-3-style parameter/optimizer sharding (params split, batch split)
+    ep    — expert parallelism (MoE expert dim split; XLA inserts the
+            dispatch/combine all-to-alls)
     tp    — megatron-style tensor parallelism (heads/mlp/vocab split)
     sp    — sequence/context parallelism (ring attention, fedml_tpu/parallel)
 
@@ -37,6 +39,7 @@ LOGICAL_RULES: Sequence[Tuple[str, Any]] = (
     ("heads", "tp"),
     ("mlp", "tp"),
     ("vocab", "tp"),
+    ("expert", "ep"),
 )
 
 
@@ -45,21 +48,26 @@ def make_mesh(
     fsdp: int = -1,
     tp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (dp, fsdp, tp, sp) mesh; ``fsdp=-1`` absorbs the remainder.
+    """Build a (dp, fsdp, ep, tp, sp) mesh; ``fsdp=-1`` absorbs the
+    remainder.
 
-    Axis order puts tp/sp innermost so they land on the fastest ICI hops.
+    Axis order puts tp/sp innermost so they land on the fastest ICI hops;
+    ep sits between fsdp and tp so expert all-to-alls stay within a slice.
+    The ep axis always exists (size 1 when unused) so downstream sharding
+    code never branches on mesh rank.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if fsdp == -1:
-        fsdp = n // max(dp * tp * sp, 1)
-    assert dp * fsdp * tp * sp == n, (
-        f"mesh {dp}x{fsdp}x{tp}x{sp} != {n} devices"
+        fsdp = n // max(dp * tp * sp * ep, 1)
+    assert dp * fsdp * ep * tp * sp == n, (
+        f"mesh {dp}x{fsdp}x{ep}x{tp}x{sp} != {n} devices"
     )
-    arr = np.asarray(devices).reshape(dp, fsdp, tp, sp)
-    return Mesh(arr, axis_names=("dp", "fsdp", "tp", "sp"))
+    arr = np.asarray(devices).reshape(dp, fsdp, ep, tp, sp)
+    return Mesh(arr, axis_names=("dp", "fsdp", "ep", "tp", "sp"))
 
 
 def mesh_from_args(args: Any, devices=None) -> Mesh:
@@ -68,6 +76,7 @@ def mesh_from_args(args: Any, devices=None) -> Mesh:
         fsdp=int(getattr(args, "mesh_fsdp", -1)),
         tp=int(getattr(args, "mesh_tp", 1)),
         sp=int(getattr(args, "mesh_sp", 1)),
+        ep=int(getattr(args, "mesh_ep", 1)),
         devices=devices,
     )
 

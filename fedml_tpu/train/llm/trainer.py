@@ -98,9 +98,17 @@ class LLMTrainer:
         )
         if self.lora_only:
             # frozen base weights get set_to_zero (optax.masked would pass
-            # their raw gradients through as updates)
+            # their raw gradients through as updates). The MoE router stays
+            # trainable in LoRA mode: it is tiny, has no LoRA twin, and the
+            # load-balance loss must be able to act on it.
+            def _trainable(path) -> bool:
+                return is_lora_path(path) or any(
+                    str(getattr(p, "key", p)) == "router" for p in path
+                )
+
             labels = lambda params: jax.tree_util.tree_map_with_path(
-                lambda path, _: "train" if is_lora_path(path) else "freeze", params
+                lambda path, _: "train" if _trainable(path) else "freeze",
+                params,
             )
             self.tx = optax.multi_transform(
                 {"train": base_tx, "freeze": optax.set_to_zero()}, labels
@@ -121,13 +129,34 @@ class LLMTrainer:
 
             attention_fn = make_ring_attention_fn(self.mesh, "sp", causal=True)
 
+        moe_aux_w = float(getattr(self.cfg, "moe_aux_weight", 0.01))
+        is_moe = int(getattr(self.cfg, "num_experts", 0)) > 0
+
         def apply_fn(p, x):
             # activation constraints inside the model resolve against these
             # logical→mesh rules (otherwise they are silent no-ops)
             with nn.logical_axis_rules(LOGICAL_RULES):
-                return self.model.apply(p, x, attention_fn=attention_fn)
+                if not is_moe:
+                    return self.model.apply(p, x, attention_fn=attention_fn)
+                # collect each layer's sown load-balance term: without the
+                # aux pressure in the objective the router collapses
+                logits, state = self.model.apply(
+                    p, x, attention_fn=attention_fn,
+                    mutable=["intermediates"],
+                )
+                auxes = jax.tree.leaves(state["intermediates"])
+                aux = moe_aux_w * sum(auxes) / max(len(auxes), 1)
+                return logits, aux
 
         self._loss_fn = causal_lm_loss(apply_fn)
+
+        def eval_apply_fn(p, x):
+            # evaluation reports PURE cross-entropy: no aux regularizer, so
+            # perplexity and dense-baseline comparisons stay meaningful
+            with nn.logical_axis_rules(LOGICAL_RULES):
+                return self.model.apply(p, x, attention_fn=attention_fn)
+
+        self._eval_loss_fn = causal_lm_loss(eval_apply_fn)
         self._train_step = None  # compiled lazily once shardings exist
         self.params = None
         self.opt_state = None
@@ -179,8 +208,10 @@ class LLMTrainer:
             donate_argnums=(0, 1),
         )
 
+        eval_loss_fn = self._eval_loss_fn
+
         def eval_step(params, x, y, m):
-            loss, (correct, denom) = loss_fn(params, x, y, m)
+            loss, (correct, denom) = eval_loss_fn(params, x, y, m)
             return loss, correct, denom
 
         eval_spec = batch_sharding(self.mesh)
