@@ -1,0 +1,125 @@
+"""Pipeline parallelism (GPipe) over a ``pp`` mesh axis.
+
+The reference has no pipeline parallelism anywhere (SURVEY §2.10) — this
+is a beyond-parity capability, built the TPU way: the *forward* schedule
+is written once with ``shard_map`` + ``ppermute`` (activations hop one
+ICI neighbor per tick), and the backward schedule is NOT hand-written —
+``jax.grad`` transposes the ppermute ring automatically, yielding the
+reverse pipeline for free. That is the structural win over the
+hand-scheduled NCCL send/recv pairs a torch pipeline needs.
+
+Semantics: classic GPipe. ``n_stages`` devices each hold one stage's
+params (stacked leaves ``[S, ...]`` sharded on ``pp``); the input batch
+is split into microbatches that flow through the ring; the bubble is the
+usual ``(S-1)/(S-1+M)`` fraction. Stages must share one structure
+(homogeneous transformer blocks).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def make_pipeline_mesh(n_stages: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    assert len(devices) >= n_stages, (len(devices), n_stages)
+    return Mesh(np.asarray(devices[:n_stages]), axis_names=("pp",))
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    n_microbatches: int,
+    axis: str = "pp",
+):
+    """Build a pipelined forward: ``fn(stage_params, x) -> y``.
+
+    ``stage_params``: pytree with stacked leading stage dim ``[S, ...]``
+    (sharded on ``axis``); ``stage_fn(params_i, mb) -> mb`` must preserve
+    the microbatch shape (residual-block shaped, like transformer layers).
+    ``x``: ``[n_microbatches * mb, ...]``; returns same shape, equal to
+    sequentially applying all stages.
+    """
+    shard_map = jax.shard_map
+
+    n_stages = mesh.shape[axis]
+
+    def _pipelined(stage_params, x):
+        mb_total = x.shape[0]
+        assert mb_total % n_microbatches == 0, (mb_total, n_microbatches)
+        mb = mb_total // n_microbatches
+        micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+        def shard_body(params_blk, micro_all):
+            # params_blk leaves: [1, ...] (this device's stage); squeeze
+            params_i = jax.tree.map(lambda a: a[0], params_blk)
+            idx = jax.lax.axis_index(axis)
+            steps = n_microbatches + n_stages - 1
+            # the ring buffer is device-varying from the first ppermute on;
+            # mark the zero init as varying so the scan carry types agree
+            buf0 = jax.lax.pcast(
+                jnp.zeros_like(micro_all[0]), (axis,), to="varying"
+            )
+
+            def tick(buf, t):
+                # stage 0 ingests microbatch t while it exists; other
+                # stages consume what the ring delivered last tick
+                ingest = micro_all[jnp.clip(t, 0, n_microbatches - 1)]
+                inp = jnp.where(idx == 0, ingest, buf)
+                y = stage_fn(params_i, inp)
+                sent = jax.lax.ppermute(
+                    y, axis,
+                    [(i, (i + 1) % n_stages) for i in range(n_stages)],
+                )
+                return sent, y
+
+            _, ys = jax.lax.scan(tick, buf0, jnp.arange(steps))
+            # ys: [steps, mb, ...] — only the LAST stage's ticks
+            # n_stages-1 .. steps-1 are real pipeline outputs
+            return ys[None]  # [1, steps, mb, ...] (stage-sharded out)
+
+        ys_all = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(axis),
+        )(stage_params, micro)
+        # take the final stage's output ticks
+        outs = ys_all[n_stages - 1, n_stages - 1:]
+        return outs.reshape(mb_total, *x.shape[1:])
+
+    return _pipelined
+
+
+def stack_stage_params(params_list: Sequence[Any]) -> Any:
+    """Stack per-stage pytrees into stacked leaves ``[S, ...]``."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def stage_sharding(mesh: Mesh, stacked: Any, axis: str = "pp") -> Any:
+    """NamedShardings placing each stage's slice on its device."""
+    return jax.tree.map(
+        lambda a: NamedSharding(mesh, P(axis, *([None] * (a.ndim - 1)))),
+        stacked,
+    )
+
+
+def sequential_reference(stage_fn, params_list, x):
+    """The ground truth the pipeline must match: stages applied in order."""
+    for p in params_list:
+        x = stage_fn(p, x)
+    return x
+
+
+__all__ = [
+    "gpipe",
+    "make_pipeline_mesh",
+    "stack_stage_params",
+    "stage_sharding",
+    "sequential_reference",
+]
