@@ -111,6 +111,7 @@ def test_moe_capacity_drops_tokens_without_nan():
     assert float(jnp.mean(jnp.abs(out))) < 1.0
 
 
+@pytest.mark.slow
 def test_moe_trainer_aux_loss_balances_router():
     """LLMTrainer on an MoE config: the sown load-balance loss reaches the
     objective (loss with aux pressure ≠ pure CE) and training improves."""
