@@ -9,6 +9,7 @@ from __future__ import annotations
 import json
 import os
 import platform
+import stat
 import subprocess
 import sys
 import tempfile
@@ -64,6 +65,28 @@ def collect_resources() -> Dict:
 _probe_cache: Optional[Dict] = None
 
 
+def _probe_cache_path() -> Optional[str]:
+    """Path for the probe disk cache inside a per-uid 0700 dir, or None
+    (in-memory only) when the dir can't be trusted — e.g. pre-created by
+    another user, a symlink, or group/world-accessible."""
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    d = os.path.join(tempfile.gettempdir(), f"fedml_tpu_probe_{uid}")
+    try:
+        os.mkdir(d, 0o700)
+    except FileExistsError:
+        pass
+    except OSError:
+        return None
+    try:
+        st = os.lstat(d)
+        if (not stat.S_ISDIR(st.st_mode) or st.st_uid != uid
+                or (st.st_mode & 0o077)):
+            return None
+    except OSError:
+        return None
+    return os.path.join(d, "resource_probe.json")
+
+
 def collect_resources_probe(timeout_s: float = 60.0) -> Dict:
     """``collect_resources()`` in a short-lived subprocess, memoized.
 
@@ -84,11 +107,13 @@ def collect_resources_probe(timeout_s: float = 60.0) -> Dict:
         except ValueError:
             pass
     # cross-process disk cache: one probe per machine per TTL, not one
-    # per agent construction
-    cache_path = os.path.join(tempfile.gettempdir(),
-                              "fedml_tpu_resource_probe.json")
+    # per agent construction. The cache lives in a per-uid 0700 directory:
+    # the shared tempdir is world-writable, so a flat fixed name could be
+    # pre-created (poisoning or silently breaking os.replace under the
+    # sticky bit) or planted as a symlink by another user.
+    cache_path = _probe_cache_path()
     try:
-        if time.time() - os.path.getmtime(cache_path) < 600:
+        if cache_path and time.time() - os.path.getmtime(cache_path) < 600:
             with open(cache_path) as f:
                 _probe_cache = json.load(f)
             return dict(_probe_cache)
@@ -104,13 +129,14 @@ def collect_resources_probe(timeout_s: float = 60.0) -> Dict:
             timeout=timeout_s, check=True,
         )
         _probe_cache = json.loads(out.stdout.strip().splitlines()[-1])
-        try:
-            fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir())
-            with os.fdopen(fd, "w") as f:
-                json.dump(_probe_cache, f)
-            os.replace(tmp, cache_path)
-        except OSError:
-            pass
+        if cache_path:
+            try:
+                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(cache_path))
+                with os.fdopen(fd, "w") as f:
+                    json.dump(_probe_cache, f)
+                os.replace(tmp, cache_path)
+            except OSError:
+                pass
     except Exception as e:
         # do NOT memoize a transient failure: a long-lived agent must not
         # report zero accelerators forever because one probe timed out

@@ -19,7 +19,9 @@ This build sweeps two planes with one loop:
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
@@ -33,6 +35,32 @@ logger = logging.getLogger(__name__)
 
 _singleton_lock = threading.Lock()
 _singleton: Optional["JobMonitor"] = None
+
+
+def _pid_reused(pid: int, run_started_at) -> bool:
+    """True when the live pid demonstrably belongs to a *newer* process
+    than the run row — i.e. the run died and the kernel recycled its pid.
+    /proc-only (Linux); anywhere it can't be read, assume not reused."""
+    if not run_started_at:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        # field 22 (1-based) = starttime in ticks since boot; fields can
+        # contain spaces only inside the comm "(...)" — split after it
+        starttime_ticks = int(stat.rsplit(")", 1)[1].split()[19])
+        with open("/proc/uptime") as f:
+            uptime_s = float(f.read().split()[0])
+        hz = os.sysconf("SC_CLK_TCK")
+        proc_started_at = time.time() - uptime_s + starttime_ticks / hz
+        # Generous 120s slack: proc_started_at is derived from the current
+        # wall clock, so an NTP step/VM-pause between the run being stamped
+        # and this sweep shifts the comparison — a tight slack would FAIL
+        # live runs on a clock jump. Real pid recycling is visible at any
+        # slack once the row outlives it (rows live minutes-to-hours).
+        return proc_started_at > float(run_started_at) + 120.0
+    except (OSError, ValueError, IndexError):
+        return False
 
 
 def _probe_ready(url: str, timeout: float) -> bool:
@@ -49,11 +77,17 @@ class JobMonitor:
 
     def __init__(self, compute_store: Optional[ComputeStore] = None,
                  endpoint_cache: Optional[EndpointCache] = None,
-                 interval_s: float = 5.0, probe_timeout_s: float = 2.0):
+                 interval_s: float = 5.0, probe_timeout_s: float = 2.0,
+                 node_id: Optional[str] = None):
         self.compute_store = compute_store
         self.endpoint_cache = endpoint_cache
         self.interval_s = interval_s
         self.probe_timeout_s = probe_timeout_s
+        # Pid liveness is only meaningful on the node that spawned the
+        # run. With a shared store (NFS workdir, multi-node sqlite) a
+        # monitor must never judge another node's rows: host A would mark
+        # host B's live runs FAILED. None = single-node store, sweep all.
+        self.node_id = node_id
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.sweeps = 0
@@ -82,8 +116,12 @@ class JobMonitor:
             return []
         fixed = []
         for row in self.compute_store.runs(status=RunStatus.RUNNING):
+            if self.node_id is not None and row.get("node_id") not in (
+                    "", None, self.node_id):
+                continue
             pid = row.get("pid")
-            if pid and not _pid_alive(int(pid)):
+            if pid and (not _pid_alive(int(pid))
+                        or _pid_reused(int(pid), row.get("started_at"))):
                 self.compute_store.finish_run(
                     row["run_id"], RunStatus.FAILED, returncode=None)
                 fixed.append(row["run_id"])

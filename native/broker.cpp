@@ -99,7 +99,8 @@ void send_bytes(Conn& c, const char* data, size_t n) {
     data += w;
     n -= (size_t)w;
   }
-  if (c.wbuf.size() + n > kMaxWriteBacklog) { doom(c.fd); return; }
+  // backlog = bytes actually pending, not the already-flushed prefix
+  if (c.wbuf.size() - c.woff + n > kMaxWriteBacklog) { doom(c.fd); return; }
   c.wbuf.append(data, n);
   watch(c.fd, true);
 }
@@ -110,15 +111,24 @@ void flush(Conn& c) {
     ssize_t w = ::send(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff,
                        MSG_NOSIGNAL);
     if (w < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       doom(c.fd);
       return;
     }
     c.woff += (size_t)w;
   }
-  c.wbuf.clear();
-  c.woff = 0;
-  watch(c.fd, false);
+  if (c.woff == c.wbuf.size()) {
+    c.wbuf.clear();
+    c.woff = 0;
+    watch(c.fd, false);
+    return;
+  }
+  // partial drain: compact once the dead prefix dominates, so a slow
+  // subscriber doesn't pin flushed bytes in memory indefinitely
+  if (c.woff >= (64u << 10) && c.woff > c.wbuf.size() / 2) {
+    c.wbuf.erase(0, c.woff);
+    c.woff = 0;
+  }
 }
 
 void route(const std::string& topic, const char* frame, size_t frame_len) {

@@ -42,8 +42,14 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # Tests measure correctness, not runtime speed: skip the expensive XLA
 # optimization passes (~25% less compile wall-clock on a cold cache).
-jax.config.update("jax_disable_most_optimizations", True)
-os.environ.setdefault("JAX_DISABLE_MOST_OPTIMIZATIONS", "1")  # subprocesses
+# FEDML_TPU_FULL_OPT=1 (nightly CI) keeps default optimizations so the
+# configuration production runs is compiled at least once a day —
+# numerics demonstrably shift with opt level.
+if os.environ.get("FEDML_TPU_FULL_OPT") != "1":
+    jax.config.update("jax_disable_most_optimizations", True)
+    os.environ.setdefault("JAX_DISABLE_MOST_OPTIMIZATIONS", "1")  # subprocesses
+else:
+    os.environ.pop("JAX_DISABLE_MOST_OPTIMIZATIONS", None)
 
 import pytest  # noqa: E402
 

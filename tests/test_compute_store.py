@@ -103,6 +103,37 @@ def test_job_monitor_sweeps_dead_run(tmp_path):
     assert store.get_run("alive")["status"] == RunStatus.RUNNING
 
 
+def test_job_monitor_skips_other_nodes_rows(tmp_path):
+    """With a shared store, node A must never judge node B's pids: B's run
+    may be alive on B even though the pid means nothing (or worse, matches
+    a live unrelated process) on A."""
+    store = ComputeStore(str(tmp_path))
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    store.upsert_run("mine-dead", status=RunStatus.RUNNING, pid=proc.pid,
+                     node_id="node-a")
+    store.upsert_run("theirs", status=RunStatus.RUNNING, pid=proc.pid,
+                     node_id="node-b")
+    mon = JobMonitor(compute_store=store, node_id="node-a")
+    assert mon.sweep_runs() == ["mine-dead"]
+    assert store.get_run("theirs")["status"] == RunStatus.RUNNING
+
+
+def test_job_monitor_detects_pid_reuse(tmp_path):
+    """A RUNNING row whose (live) pid belongs to a process started after
+    the run row was stamped is a recycled pid — the run is dead."""
+    store = ComputeStore(str(tmp_path))
+    # our own (old) process against a fresh started_at → NOT flagged
+    store.upsert_run("fresh", status=RunStatus.RUNNING, pid=os.getpid(),
+                     started_at=time.time())
+    # our own process against an ancient started_at → pid was "reused"
+    store.upsert_run("stale", status=RunStatus.RUNNING, pid=os.getpid(),
+                     started_at=time.time() - 86400 * 365)
+    mon = JobMonitor(compute_store=store)
+    assert mon.sweep_runs() == ["stale"]
+    assert store.get_run("fresh")["status"] == RunStatus.RUNNING
+
+
 class _Ready(BaseHTTPRequestHandler):
     ok = True
 
