@@ -1,0 +1,127 @@
+"""Model deploy plane: cards → master/workers → gateway → failover.
+
+Parity target: the reference's model scheduler
+(``model_scheduler/device_model_cards.py`` ``serve_model_on_premise``,
+deploy master/worker runners, FastAPI gateway) — minus docker/redis: the
+TPU build deploys model-card workspaces onto worker agents as replica
+subprocesses, and the gateway routes ``/inference/{endpoint_id}`` with
+health-based failover.
+
+Flow: create a model card, deploy 2 replicas onto 2 workers, query
+through the gateway, kill one replica, verify the endpoint keeps
+answering on the survivor.
+
+Run:  python examples/deploy/model_cards_failover/run.py
+"""
+import json
+import os
+import sys
+import tempfile
+import textwrap
+import time
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, "..", "..", ".."))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from fedml_tpu.core.distributed.communication.broker import PubSubBroker  # noqa: E402
+from fedml_tpu.core.distributed.communication.object_store import (  # noqa: E402
+    LocalDirObjectStore,
+)
+from fedml_tpu.deploy import (  # noqa: E402
+    DeployMaster,
+    DeployWorkerAgent,
+    EndpointCache,
+    EndpointStatus,
+    FedMLModelCards,
+    InferenceGateway,
+)
+
+PREDICTOR = textwrap.dedent("""
+    from fedml_tpu.serving.predictor import FedMLPredictor
+
+    class SentimentPredictor(FedMLPredictor):
+        def __init__(self, positive=("good", "great")):
+            self.positive = tuple(positive)
+
+        def predict(self, request):
+            text = str(request.get("text", ""))
+            score = sum(w in text for w in self.positive)
+            return {"sentiment": "pos" if score else "neg", "score": score}
+""")
+
+
+def _post(url, obj, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="fedml_deploy_example_")
+    ws = os.path.join(tmp, "card_ws")
+    os.makedirs(ws)
+    with open(os.path.join(ws, "my_predictor.py"), "w") as f:
+        f.write(PREDICTOR)
+    with open(os.path.join(ws, "model_config.yaml"), "w") as f:
+        f.write("entry_module: my_predictor\n"
+                "entry_class: SentimentPredictor\n")
+
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    store = LocalDirObjectStore(os.path.join(tmp, "store"))
+    cache = EndpointCache(os.path.join(tmp, "endpoints.json"))
+    cards = FedMLModelCards(os.path.join(tmp, "registry"))
+    workers = [DeployWorkerAgent(f"w{i}", host, port, store,
+                                 workdir=os.path.join(tmp, "deploy"),
+                                 heartbeat_s=0.3).start()
+               for i in (1, 2)]
+    master = DeployMaster(host, port, store, cache, cards=cards,
+                          worker_timeout_s=5.0,
+                          health_interval_s=0.5).start()
+    gateway = InferenceGateway(cache).start()
+    try:
+        cards.create_model("sentiment", ws)
+        master.wait_for_workers(2, timeout=30)
+        ep = master.deploy("sentiment", n_replicas=2, timeout=120)
+        assert ep["status"] == EndpointStatus.DEPLOYED, ep
+        eid = ep["endpoint_id"]
+        base = f"http://127.0.0.1:{gateway.port}"
+
+        code, resp = _post(f"{base}/inference/{eid}", {"text": "great day"})
+        assert code == 200 and resp["sentiment"] == "pos", resp
+        print("routed:", json.dumps(resp))
+
+        # kill one replica → gateway fails over to the survivor
+        victim_worker = list(ep["replicas"])[0]
+        [w for w in workers if w.worker_id == victim_worker][0].shutdown()
+        deadline = time.time() + 60
+        ok = None
+        while time.time() < deadline:
+            try:
+                code, resp = _post(f"{base}/inference/{eid}",
+                                   {"text": "bad day"}, timeout=5)
+                if code == 200:
+                    ok = resp
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert ok is not None and ok["sentiment"] == "neg", ok
+        print("failover answer:", json.dumps(ok))
+    finally:
+        gateway.stop()
+        master.shutdown()
+        for w in workers:
+            w.shutdown()
+        broker.stop()
+    print("EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Cross-silo client rank — one federated organization.
+Parity: the reference's ``torch_client.py`` example entrypoint."""
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_cross_silo_client()
+    print("CLIENT DONE", flush=True)

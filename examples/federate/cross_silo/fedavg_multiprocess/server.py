@@ -1,0 +1,9 @@
+"""Cross-silo server rank — what each organization's ops team runs.
+Parity: the reference's ``torch_server.py`` example entrypoint."""
+import json
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    result = fedml_tpu.run_cross_silo_server()
+    print("RESULT", json.dumps(result, default=str), flush=True)

@@ -1,0 +1,60 @@
+"""Federated analytics: find heavy hitters without pooling raw data.
+
+Parity target: ``python/fedml/fa/`` — the reference's federated
+analytics engine (tasks in ``fa/constants.py``: heavy hitter via TrieHH,
+frequency estimation, union/intersection, percentiles, histogram...).
+Same engine shape here: analyzer/aggregator ABCs over the cross-silo
+FSM (``fedml_tpu/fa/``).
+
+Three "hospitals" hold private symptom logs; TrieHH reveals only the
+strings frequent across the federation (threshold theta), and frequency
+estimation returns their global rates.
+
+Run:  python examples/federated_analytics/heavy_hitter/run.py
+"""
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, "..", "..", ".."))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import fedml_tpu  # noqa: E402
+from fedml_tpu.arguments import load_arguments_from_dict  # noqa: E402
+from fedml_tpu.fa import run_fa_inproc  # noqa: E402
+
+
+def make_args(task, run_id, **extra):
+    return fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "federated_analytics",
+                        "random_seed": 0, "run_id": run_id},
+        "fa_args": {"fa_task": task, **extra},
+    }))
+
+
+def main() -> None:
+    data = {
+        1: ["fever"] * 6 + ["cough"] * 5 + ["rash"],
+        2: ["fever"] * 4 + ["cough"] * 6 + ["fatigue"],
+        3: ["fever"] * 5 + ["cough"] * 4 + ["nausea"] * 2,
+    }
+
+    res = run_fa_inproc(make_args("heavy_hitter_triehh", "fa_example_hh",
+                                  fa_theta=4), data)
+    print("heavy hitters:", json.dumps(sorted(res["heavy_hitters"])))
+    assert set(res["heavy_hitters"]) == {"fever", "cough"}, res
+
+    res = run_fa_inproc(make_args("frequency_estimation", "fa_example_freq"),
+                        data)
+    total = sum(len(v) for v in data.values())
+    fever = sum(v.count("fever") for v in data.values()) / total
+    print("frequencies:", json.dumps(res["frequencies"]))
+    assert abs(res["frequencies"]["fever"] - fever) < 1e-9, res
+    print("EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

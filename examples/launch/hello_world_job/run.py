@@ -1,0 +1,56 @@
+"""Launch a job through the scheduler: `fedml launch job.yaml` parity.
+
+Parity target: ``python/examples/launch/hello_world`` +
+``fedml.api.launch_job`` (``api/__init__.py:42``) — package a workspace,
+match resources, run under an agent, stream status and logs. Here the
+job is scheduled on the in-process LocalAgent (no hosted control plane):
+the same ``launch_job`` the CLI (`python -m fedml_tpu.cli launch`) uses.
+
+Run:  python examples/launch/hello_world_job/run.py
+"""
+import os
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, "..", "..", ".."))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from fedml_tpu.core.mlops.status import RunStatus  # noqa: E402
+from fedml_tpu.scheduler.launch import get_agent, launch_job  # noqa: E402
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="fedml_launch_example_")
+    ws = os.path.join(tmp, "workspace")
+    os.makedirs(ws)
+    with open(os.path.join(ws, "hello.py"), "w") as f:
+        f.write("print('Hello from a fedml_tpu job!')\n")
+    job_yaml = os.path.join(tmp, "job.yaml")
+    with open(job_yaml, "w") as f:
+        f.write(
+            "job_name: hello-world\n"
+            f"workspace: {ws}\n"
+            f"job: |\n  {sys.executable} hello.py\n"
+            "env:\n"
+            f"  PYTHONPATH: '{ROOT}{os.pathsep}"
+            f"{os.environ.get('PYTHONPATH', '')}'\n"
+        )
+
+    workdir = os.path.join(tmp, "runs")
+    run_id = launch_job(job_yaml, workdir=workdir)
+    print("run_id:", run_id)
+    agent = get_agent(workdir)
+    status = agent.wait(run_id, timeout=120)
+    logs = agent.logs(run_id)
+    print("status:", status)
+    print("logs:", logs.strip())
+    assert status == RunStatus.FINISHED, logs
+    assert "Hello from a fedml_tpu job!" in logs
+    print("EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Example-driven smoke tests — the reference's entire CI philosophy.
+
+Parity target: ``.github/workflows/smoke_test_*.yml``, which literally
+run the scripts under ``python/examples/``. Same here: every example's
+``run.py`` asserts its own expected output and prints ``EXAMPLE OK``;
+this module runs each one as a real subprocess (fresh interpreter, no
+test fixtures leaking in). The quick ones stay in the fast gate; the
+multi-process federations are @slow.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO_ROOT, "examples")
+
+
+def _run_example(rel_path: str, timeout: int = 600) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO_ROOT, env.get("PYTHONPATH")) if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, rel_path, "run.py")],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"{rel_path} failed:\n{out[-4000:]}"
+    assert "EXAMPLE OK" in out, out[-4000:]
+    return out
+
+
+def test_every_example_is_listed_in_readme():
+    """Adding an example without documenting it (or a smoke test) is the
+    reference's failure mode — hold the line here."""
+    with open(os.path.join(EXAMPLES, "README.md")) as f:
+        readme = f.read()
+    found = sorted(
+        os.path.relpath(dirpath, EXAMPLES)
+        for dirpath, _dirs, files in os.walk(EXAMPLES)
+        if "run.py" in files
+    )
+    assert found, "no examples found"
+    for rel in found:
+        assert rel.replace(os.sep, "/") in readme, (
+            f"examples/{rel} missing from examples/README.md")
+    smoked = {rel for rel in found
+              if rel.replace(os.sep, "/") in _ALL_SMOKED}
+    assert smoked == set(found), (
+        f"examples without a smoke test: {sorted(set(found) - smoked)}")
+
+
+# -- fast gate ------------------------------------------------------------
+
+def test_example_mesh_fedavg_parallel():
+    _run_example("federate/simulation/mesh_fedavg_parallel")
+
+
+def test_example_heavy_hitter():
+    _run_example("federated_analytics/heavy_hitter")
+
+
+def test_example_hello_world_job():
+    _run_example("launch/hello_world_job")
+
+
+# -- slow gate (multi-process / compile-heavy) ----------------------------
+
+@pytest.mark.slow
+def test_example_sp_fedavg_mnist_lr():
+    _run_example("federate/simulation/sp_fedavg_mnist_lr")
+
+
+@pytest.mark.slow
+def test_example_cross_silo_fedavg_multiprocess():
+    _run_example("federate/cross_silo/fedavg_multiprocess")
+
+
+@pytest.mark.slow
+def test_example_cross_silo_secagg_multiprocess():
+    _run_example("federate/cross_silo/secagg_multiprocess")
+
+
+@pytest.mark.slow
+def test_example_cross_device_beehive():
+    _run_example("federate/cross_device/beehive")
+
+
+@pytest.mark.slow
+def test_example_llm_lora_finetune():
+    _run_example("train/llm_lora_finetune")
+
+
+@pytest.mark.slow
+def test_example_serve_openai():
+    _run_example("deploy/serve_openai")
+
+
+@pytest.mark.slow
+def test_example_model_cards_failover():
+    _run_example("deploy/model_cards_failover")
+
+
+_ALL_SMOKED = {
+    "federate/simulation/sp_fedavg_mnist_lr",
+    "federate/simulation/mesh_fedavg_parallel",
+    "federate/cross_silo/fedavg_multiprocess",
+    "federate/cross_silo/secagg_multiprocess",
+    "federate/cross_device/beehive",
+    "train/llm_lora_finetune",
+    "deploy/serve_openai",
+    "deploy/model_cards_failover",
+    "launch/hello_world_job",
+    "federated_analytics/heavy_hitter",
+}

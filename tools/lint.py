@@ -114,7 +114,7 @@ def check_file(path):
 
 
 def main():
-    roots = sys.argv[1:] or ["fedml_tpu", "tools", "bench.py",
+    roots = sys.argv[1:] or ["fedml_tpu", "tools", "examples", "bench.py",
                              "__graft_entry__.py"]
     total = 0
     for root in roots:
