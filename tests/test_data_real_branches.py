@@ -128,6 +128,89 @@ def test_shakespeare_txt_branch(tmp_path, caplog):
     assert ds.train_data_global[0].shape[1] == 20
 
 
+def _write_jpeg(path, rgb, size=16):
+    from PIL import Image
+
+    arr = np.zeros((size, size, 3), np.uint8)
+    arr[..., :] = rgb
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    Image.fromarray(arr).save(path, "JPEG")
+
+
+def test_imagenet_imagefolder_branch(tmp_path, caplog):
+    """The reference's on-disk layout (`<root>/{train,val}/<class>/*.JPEG`,
+    ref data/ImageNet/datasets.py:83-174) round-trips through load(args)
+    into a federated split — a real user's ImageNet tree must load."""
+    root = tmp_path / "ImageNet"
+    # 2 classes x 4 train images, 2 val each; color encodes the class
+    for split, n in (("train", 4), ("val", 2)):
+        for ci, cls in enumerate(["n01440764", "n01443537"]):
+            for i in range(n):
+                _write_jpeg(str(root / split / cls / f"img_{i}.JPEG"),
+                            (250, 5, 5) if ci == 0 else (5, 5, 250))
+    ds = _load_no_fallback(_args("imagenet", tmp_path, image_size=8), caplog)
+    assert ds.class_num == 2
+    assert ds.train_data_num == 8 and ds.test_data_num == 4
+    xtr, ytr = ds.train_data_global
+    assert xtr.shape == (8, 8, 8, 3) and xtr.dtype == np.float32
+    assert 0.0 <= xtr.min() and xtr.max() <= 1.0
+    # class indexing = sorted dir names (ref find_classes): red class 0
+    red = xtr[ytr == 0]
+    blue = xtr[ytr == 1]
+    assert red[..., 0].mean() > 0.8 and red[..., 2].mean() < 0.2
+    assert blue[..., 2].mean() > 0.8 and blue[..., 0].mean() < 0.2
+    # federated: the 8 images land across the (default 3) clients
+    assert set(ds.train_data_local_dict) == {0, 1, 2}
+    assert sum(ds.train_data_local_num_dict.values()) == 8
+
+
+def test_imagenet_train_only_tree_holds_out_val(tmp_path, caplog):
+    root = tmp_path / "imagenet"
+    for ci, cls in enumerate(["a", "b"]):
+        for i in range(5):
+            _write_jpeg(str(root / "train" / cls / f"{i}.jpg"),
+                        (200, ci * 100, 0))
+    ds = _load_no_fallback(_args("imagenet", tmp_path, image_size=8), caplog)
+    assert ds.train_data_num == 10 and ds.test_data_num == 1
+    assert ds.class_num == 2
+
+
+def test_landmarks_csv_branch_natural_user_partition(tmp_path, caplog):
+    """The reference's Landmarks layout: mapping csvs with
+    user_id,image_id,class + <image_id>.jpg files (ref
+    data/Landmarks/data_loader.py:123-156). Clients = csv users."""
+    root = tmp_path / "Landmarks"
+    os.makedirs(root / "images")
+    rows = []
+    for u, (cls, rgb) in enumerate(
+            [("eiffel", (250, 0, 0)), ("eiffel", (250, 0, 0)),
+             ("louvre", (0, 0, 250))]):
+        for i in range(3):
+            iid = f"u{u}_img{i}"
+            _write_jpeg(str(root / "images" / f"{iid}.jpg"), rgb)
+            rows.append((u, iid, cls))
+    with open(root / "mini_gld_train_split.csv", "w") as f:
+        f.write("user_id,image_id,class\n")
+        for u, iid, cls in rows:
+            f.write(f"{u},{iid},{cls}\n")
+    with open(root / "mini_gld_test.csv", "w") as f:
+        f.write("user_id,image_id,class\n")
+        _write_jpeg(str(root / "images" / "t0.jpg"), (250, 0, 0))
+        f.write("0,t0,eiffel\n")
+
+    ds = _load_no_fallback(_args("gld23k", tmp_path, image_size=8,
+                                 client_num_in_total=3), caplog)
+    assert ds.class_num == 2
+    assert ds.train_data_num == 9 and ds.test_data_num == 1
+    # natural partition: 3 csv users -> 3 clients, 3 images each
+    assert ds.train_data_local_num_dict == {0: 3, 1: 3, 2: 3}
+    # per-user class purity survives the packing (user 2 holds louvre=1)
+    for cid in range(3):
+        _x, y = ds.train_data_local_dict[cid]
+        assert len(set(y.tolist())) == 1
+    assert ds.stats == {"leaf_users": 3}
+
+
 def test_missing_files_fall_back_loudly(tmp_path, caplog):
     import logging
 
