@@ -558,8 +558,12 @@ def load_imagenet(args: Any) -> FederatedDataset:
         val_dir = os.path.join(root, "val")
         if os.path.isdir(val_dir):
             xte, yte = _read_image_folder(val_dir, size, class_to_idx)
-        else:  # train-only trees: hold out every 10th image
-            xte, yte = xtr[::10], ytr[::10]
+        else:  # train-only trees: hold OUT every 10th image (not a copy —
+            # evaluating on trained-on images would inflate accuracy)
+            hold = np.zeros(len(ytr), bool)
+            hold[::10] = True
+            xte, yte = xtr[hold], ytr[hold]
+            xtr, ytr = xtr[~hold], ytr[~hold]
         return _partition_and_pack(args, xtr, ytr, xte, yte, len(classes))
     classes = int(getattr(args, "class_num", 100) or 100)
     xtr, ytr, xte, yte = _load_image_or_synthetic(
@@ -624,6 +628,17 @@ def load_landmarks(args: Any) -> FederatedDataset:
         test_rows = _read_landmarks_csv(te_csv)
         classes = sorted({r["class"] for r in train_rows})
         cls_idx = {c: i for i, c in enumerate(classes)}
+        unseen = [r for r in test_rows if r["class"] not in cls_idx]
+        if unseen:
+            # mapping them to an arbitrary index would silently corrupt
+            # evaluation labels; drop with a warning instead
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "landmarks: dropping %d test rows whose class never "
+                "appears in the train split (e.g. %r)",
+                len(unseen), unseen[0]["class"])
+            test_rows = [r for r in test_rows if r["class"] in cls_idx]
 
         def img(row):
             return _decode_image(

@@ -18,13 +18,12 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, Optional, Tuple
 
-import jax
 import numpy as np
 
 from fedml_tpu.core.alg_frame.client_trainer import ClientTrainer
 from fedml_tpu.core.alg_frame.server_aggregator import ServerAggregator
 from fedml_tpu.models.llm.llama import LlamaConfig
-from fedml_tpu.train.llm.trainer import LLMTrainer, extract_lora, merge_lora
+from fedml_tpu.train.llm.trainer import LLMTrainer
 
 logger = logging.getLogger(__name__)
 
@@ -55,24 +54,12 @@ class LLMClientTrainer(ClientTrainer):
         self._round_seed = int(round_idx)
 
     def get_exchange_params(self) -> Pytree:
-        # deep-copy: the train step donates its param buffers, so exchanged
-        # state must not alias the engine's live (soon-to-be-donated) arrays
-        import jax.numpy as jnp
-
-        src = extract_lora(self.engine.params) if self.lora_only else self.engine.params
-        return jax.tree.map(jnp.copy, src)
+        # fresh buffers (the train step donates params); host numpy when
+        # the silo mesh spans processes — see LLMTrainer.exchange_state
+        return self.engine.exchange_state()
 
     def set_exchange_params(self, exchanged: Pytree) -> None:
-        import jax.numpy as jnp
-
-        # copy incoming state: merged leaves land in engine.params, which the
-        # next train step DONATES — without the copy, the caller's dict would
-        # silently point at deleted buffers afterwards
-        exchanged = jax.tree.map(jnp.copy, exchanged)
-        if self.lora_only:
-            self.engine.params = merge_lora(self.engine.params, exchanged)
-        else:
-            self.engine.params = exchanged
+        self.engine.load_exchange_state(exchanged)
 
     def train(self, params: Pytree, train_data, device, args) -> Tuple[Pytree, Dict]:
         """ClientTrainer contract: (new_exchange_params, metrics)."""
@@ -129,19 +116,10 @@ class LLMAggregator(ServerAggregator):
         self.lora_only = self.engine.lora_only
 
     def get_init_params(self) -> Pytree:
-        import jax.numpy as jnp
-
-        src = extract_lora(self.engine.params) if self.lora_only else self.engine.params
-        return jax.tree.map(jnp.copy, src)
+        return self.engine.exchange_state()
 
     def set_global_params(self, exchanged: Pytree) -> None:
-        import jax.numpy as jnp
-
-        exchanged = jax.tree.map(jnp.copy, exchanged)
-        if self.lora_only:
-            self.engine.params = merge_lora(self.engine.params, exchanged)
-        else:
-            self.engine.params = exchanged
+        self.engine.load_exchange_state(exchanged)
 
     def test(self, params: Pytree, test_data, device, args) -> Dict:
         self.set_global_params(params)

@@ -201,6 +201,7 @@ class LLMTrainer:
 
         # inputs are [accum, B, ...]: the *batch* dim rides (dp, fsdp)
         micro_spec = NamedSharding(self.mesh, P(None, ("dp", "fsdp")))
+        self._micro_spec = micro_spec
         self._train_step = jax.jit(
             train_step,
             in_shardings=(self.shardings, None, micro_spec, micro_spec, micro_spec),
@@ -215,33 +216,99 @@ class LLMTrainer:
             return loss, correct, denom
 
         eval_spec = batch_sharding(self.mesh)
+        self._eval_spec = eval_spec
         self._eval_step = jax.jit(
             eval_step,
             in_shardings=(self.shardings, eval_spec, eval_spec, eval_spec),
         )
+        # built once: a fresh lambda per exchange_state() call would miss
+        # the jit cache and recompile the all-gather every round
+        self._gather = jax.jit(lambda t: t,
+                               out_shardings=replicated(self.mesh))
 
     # -- stepping ---------------------------------------------------------
+    def _put(self, x, spec, dtype=None):
+        """Host batch → globally sharded device array.
+
+        ``device_put`` (not ``jnp.asarray``) so the path also works when
+        the mesh spans multiple *processes* (multi-host silo over DCN):
+        every process passes the identical host array and receives only
+        its addressable shards — numpy straight into a jit with
+        non-trivial shardings is rejected by JAX in that regime.
+        """
+        return jax.device_put(np.asarray(x, dtype), spec)
+
     def step(self, xs, ys, mask) -> float:
         """One optimizer step over [accum, B, T] token microbatches."""
+        xs, ys, mask = np.asarray(xs), np.asarray(ys), np.asarray(mask)
         if xs.ndim == 2:  # single microbatch convenience
             xs, ys = xs[None], ys[None]
             mask = mask[None]
         self.params, self.opt_state, loss = self._train_step(
-            self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
-            jnp.asarray(mask, jnp.float32),
+            self.params, self.opt_state,
+            self._put(xs, self._micro_spec),
+            self._put(ys, self._micro_spec),
+            self._put(mask, self._micro_spec, np.float32),
         )
         self._step += 1
         return float(loss)
 
     def evaluate(self, x, y) -> dict:
-        m = jnp.ones((x.shape[0],), jnp.float32)
+        m = self._put(np.ones((np.shape(x)[0],)), self._eval_spec, np.float32)
         loss, correct, denom = self._eval_step(
-            self.params, jnp.asarray(x), jnp.asarray(y), m
+            self.params, self._put(x, self._eval_spec),
+            self._put(y, self._eval_spec), m
         )
         return {
             "eval_loss": float(loss),
             "eval_acc": float(correct) / max(float(denom), 1.0),
         }
+
+    # -- federation exchange (multi-host safe) ----------------------------
+    def exchange_state(self):
+        """The federated-exchange payload (LoRA dict, or full params) as
+        fresh buffers safe to ship.
+
+        Single-process: on-device copies (the sp fast path — no host
+        round-trip). Multi-process silo (mesh over DCN): leaves are
+        sharded across processes and NOT fully addressable, so a compiled
+        all-gather replicates them first and host numpy is returned —
+        every process then holds the identical payload, and only the
+        silo's rank-0 hands it to the federation transport.
+        """
+        payload = extract_lora(self.params) if self.lora_only else self.params
+        if jax.process_count() == 1:
+            return jax.tree.map(jnp.copy, payload)
+        full = self._gather(payload)
+        return jax.tree.map(lambda a: np.asarray(a.addressable_data(0)), full)
+
+    def load_exchange_state(self, exchanged) -> None:
+        """Merge an exchange payload back into the live (sharded) params.
+
+        Every leaf is re-laid-out onto its NamedSharding via
+        ``device_put`` — required in the multi-process regime (host
+        leaves can't enter a jit with non-trivial shardings) and a fresh
+        buffer either way (the train step DONATES params, so merged
+        state must never alias the caller's arrays).
+        """
+        if self.lora_only:
+            merged = merge_lora(self.params, dict(exchanged))
+        else:
+            merged = exchanged
+
+        def _relay(v, live, s):
+            if v is live:
+                # untouched live leaf (the frozen base in LoRA mode):
+                # keep it — copying would transiently double HBM for the
+                # whole frozen model every round
+                return v
+            if isinstance(v, jax.Array) and v.sharding.is_equivalent_to(
+                    s, v.ndim):
+                return jnp.copy(v)  # keeps sharding; no host round-trip
+            return jax.device_put(np.asarray(v), s)
+
+        self.params = jax.tree.map(_relay, merged, self.params,
+                                   self.shardings)
 
     # -- checkpointing (orbax) -------------------------------------------
     def save_checkpoint(self, ckpt_dir: str, round_idx: int):

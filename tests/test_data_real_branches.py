@@ -171,7 +171,8 @@ def test_imagenet_train_only_tree_holds_out_val(tmp_path, caplog):
             _write_jpeg(str(root / "train" / cls / f"{i}.jpg"),
                         (200, ci * 100, 0))
     ds = _load_no_fallback(_args("imagenet", tmp_path, image_size=8), caplog)
-    assert ds.train_data_num == 10 and ds.test_data_num == 1
+    # held-out images leave the train set: no train/test leakage
+    assert ds.train_data_num == 9 and ds.test_data_num == 1
     assert ds.class_num == 2
 
 
