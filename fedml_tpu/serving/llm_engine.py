@@ -59,12 +59,21 @@ class ContinuousBatchingEngine:
         quantize: Optional[str] = None,
     ):
         self.model = model
-        if quantize == "int8":
-            # weight-only int8: halves HBM residency (~2x models per chip);
-            # NOT a latency win on current XLA — see ops/quant.py docstring
+        if quantize in ("int8", "int8_w8a8", "w8a8", "int8_pallas", "pallas",
+                        "int8_dequant"):
+            # int8 (default = fused pallas kernel): halves HBM residency
+            # AND the decode weight-bandwidth; int8_w8a8 adds activation
+            # quant (int8xint8 MXU dot); int8_dequant is the plain-XLA
+            # lowering. Measured tradeoffs: ops/quant.py docstring.
             from fedml_tpu.ops.quant import quantize_params_int8
 
-            params = quantize_params_int8(params)
+            if quantize.endswith("w8a8"):
+                mode = "w8a8"
+            elif quantize.endswith("dequant"):
+                mode = "dequant"
+            else:
+                mode = "pallas"
+            params = quantize_params_int8(params, mode=mode)
         elif quantize is not None:
             raise ValueError(f"unknown quantize mode: {quantize!r}")
         self.params = params

@@ -94,3 +94,46 @@ def test_quantized_decode_agrees_with_fp(tmp_path):
 
     with pytest.raises(ValueError):
         ContinuousBatchingEngine(model, params, quantize="int4")
+
+
+def test_pallas_dequant_matmul_matches_xla_dequant():
+    """The fused kernel is the same math as dequantize-then-matmul — only
+    the memory movement differs. Runs under interpret mode off-TPU."""
+    from fedml_tpu.ops.quant import pallas_dequant_matmul
+
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(256, 512)).astype(np.float32)
+    x = jnp.asarray(rng.normal(size=(8, 256)), jnp.bfloat16)
+    q = quantize_int8(w)
+    got = pallas_dequant_matmul(x, q.data, q.scale, jnp.float32)
+    want = (x @ q.data.astype(jnp.bfloat16)).astype(jnp.float32) * np.asarray(q.scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pallas_mode_handles_3d_and_odd_shapes():
+    from fedml_tpu.ops.quant import quantize_int8
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(256, 384)).astype(np.float32)  # 384 = 3*128
+    q = quantize_int8(w, mode="pallas")
+    x = jnp.asarray(rng.normal(size=(2, 4, 256)), jnp.bfloat16)  # prefill
+    out = q.matmul(x, jnp.bfloat16)
+    assert out.shape == (2, 4, 384)
+    # shapes the tiler can't split (F not a multiple of 128) fall back
+    w_odd = rng.normal(size=(256, 100)).astype(np.float32)
+    q_odd = quantize_int8(w_odd, mode="pallas")
+    assert q_odd.matmul(x, jnp.bfloat16).shape == (2, 4, 100)
+
+
+def test_w8a8_mode_accuracy():
+    """Activation quant adds bounded error (rounding only)."""
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(128, 64)).astype(np.float32)
+    x = jnp.asarray(rng.normal(size=(4, 128)), jnp.float32)
+    q = quantize_int8(w, mode="w8a8")
+    got = np.asarray(q.matmul(x, jnp.float32))
+    want = np.asarray(x) @ (np.asarray(q.data, np.float32)
+                            * np.asarray(q.scale)[None, :])
+    rms = np.sqrt(np.mean((got - want) ** 2)) / np.sqrt(np.mean(want ** 2))
+    assert rms < 0.02, rms
