@@ -2,9 +2,10 @@
 """Benchmark driver entry — prints ONE JSON line.
 
 Flagship metric (BASELINE.json): **FedAvg rounds/sec/chip on the LLM path
-(Llama LoRA fine-tune, 8 clients)** — the federated round is 8 clients'
-compiled local steps + LoRA-dict FedAvg on the real chip, with the model
-sized to single-chip HBM.
+(Llama-2-7B LoRA fine-tune, 8 clients)** — the federated round is 8
+clients' compiled local steps + LoRA-dict FedAvg on the real chip, at the
+TRUE 7B config (6.76B params, bf16 frozen base in 13.5 of 15.75 GB HBM).
+FEDML_BENCH_MODEL=1b reruns the round-2/3 1.1B comparison shape.
 
 vs_baseline: the reference (FedML, torch eager) cannot run on TPU at all —
 its achievable throughput on this host is a torch-CPU step of the *same*
@@ -67,11 +68,27 @@ def llm_shape(hbm_bytes: float):
     """Pick a Llama shape sized to the chip's HBM (fp32 masters + grads)."""
     from fedml_tpu.models.llm.llama import LlamaConfig
 
-    if hbm_bytes >= 12e9:
-        # ~1.1B params (TinyLlama-class): fp32 masters 4.5GB; LoRA keeps
-        # optimizer state tiny. remat OFF: B8xT1024 activations fit v5e
-        # HBM, and the round-3 sweep (PERF_NOTES.md) measured full-remat
-        # at 545ms/step vs 421ms without — recompute was pure overhead.
+    which = os.environ.get("FEDML_BENCH_MODEL", "auto")
+    if hbm_bytes >= 12e9 and which in ("auto", "7b"):
+        # The NORTH-STAR model (BASELINE.json: Llama-2-7B LoRA): true
+        # 7B config — hidden 4096, inter 11008, 32 layers, 32 MHA heads,
+        # 6.76B params. bf16 frozen base = 13.5 GB of the v5e's 15.75 GB
+        # HBM; fits with LoRA-only fp32 masters at B=1/T=512, remat OFF
+        # (measured round 4: 97.9 ms/step, MFU 0.72; B1/T1024 remat-off
+        # OOMs by 435 MB — tools/probe_7b.py reproduces both).
+        import jax.numpy as jnp
+
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=32, max_position_embeddings=4096,
+            lora_rank=16, remat=False, remat_policy="none",
+            param_dtype=jnp.bfloat16,
+        )
+        return cfg, 1, 512  # batch, seq
+    if hbm_bytes >= 12e9 and which == "1b":
+        # ~1.1B (TinyLlama-class) comparison shape — the round-2/3
+        # flagship, kept for cross-round regression tracking
         import jax.numpy as jnp
 
         cfg = LlamaConfig(
@@ -79,11 +96,9 @@ def llm_shape(hbm_bytes: float):
             num_hidden_layers=22, num_attention_heads=32,
             num_key_value_heads=8, max_position_embeddings=2048,
             lora_rank=16, remat=False, remat_policy="none",
-            # frozen base needs no fp32 master: bf16 storage halves cast
-            # traffic (PERF_NOTES.md; LoRA adapters keep fp32 masters)
             param_dtype=jnp.bfloat16,
         )
-        return cfg, 8, 1024  # batch, seq
+        return cfg, 8, 1024
     # CPU / tiny-dev fallback so the bench always completes
     cfg = LlamaConfig.tiny(lora_rank=8)
     return cfg, 4, 128
@@ -170,17 +185,22 @@ def bench_reference_torch(cfg):
         return None
     try:
         torch.set_num_threads(os.cpu_count() or 8)
+        # at 7B scale a full-depth fp32 torch step takes many minutes on
+        # this host's CPU: measure a reduced-depth model with the SAME
+        # per-layer shape and scale by depth (linear in layers — embed/lm
+        # head overhead is ignored, which flatters the reference)
+        layers = min(cfg.num_hidden_layers, 4)
         hf = HFConfig(
             vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
             intermediate_size=cfg.intermediate_size,
-            num_hidden_layers=cfg.num_hidden_layers,
+            num_hidden_layers=layers,
             num_attention_heads=cfg.num_attention_heads,
             num_key_value_heads=cfg.num_key_value_heads,
             max_position_embeddings=cfg.max_position_embeddings,
             use_cache=False,
         )
         model = HFModel(hf)
-        b, t = 1, 256
+        b, t = 1, 128 if cfg.hidden_size >= 4096 else 256
         x = torch.randint(0, cfg.vocab_size, (b, t))
         out = model(input_ids=x, labels=x)  # warm once (allocations)
         out.loss.backward()
@@ -189,7 +209,7 @@ def bench_reference_torch(cfg):
         out = model(input_ids=x, labels=x)
         out.loss.backward()
         dt = time.perf_counter() - t0
-        return (b * t) / dt
+        return (b * t) / dt * (layers / cfg.num_hidden_layers)
     except Exception:
         return None
 
@@ -210,6 +230,11 @@ def main() -> None:
     from fedml_tpu.train.llm.trainer import LLMTrainer, extract_lora, merge_lora
 
     cfg, batch, seq = llm_shape(hbm)
+
+    # flash kernel micro-bench FIRST: its XLA reference path materializes
+    # multi-GB T×T score tensors, which cannot coexist with the 7B
+    # trainer's 13.5 GB of live params later in this process
+    flash = bench_flash() if dev.platform == "tpu" else None
 
     class Args:
         max_seq_length = seq
@@ -295,8 +320,6 @@ def main() -> None:
     else:
         vs_baseline = 0.0
         baseline_kind = "reference engine unavailable"
-
-    flash = bench_flash() if dev.platform == "tpu" else None
 
     extra = {
         "device": dev.device_kind,

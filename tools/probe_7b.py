@@ -1,0 +1,109 @@
+"""Single-chip Llama-2-7B LoRA step probe (VERDICT r3 task 1).
+
+Attempts the real thing on the v5e: bf16 frozen base (~13.5 GB of HBM),
+LoRA-only fp32 masters, B=1/T=512. Prints step time + memory stats, or
+the OOM evidence. Run variants:
+
+  python tools/probe_7b.py            # remat off
+  python tools/probe_7b.py --remat    # full remat
+  python tools/probe_7b.py --t 1024   # longer sequence
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--remat", action="store_true")
+ap.add_argument("--t", type=int, default=512)
+ap.add_argument("--b", type=int, default=1)
+ap.add_argument("--layers", type=int, default=32)
+ap.add_argument("--steps", type=int, default=8)
+cli = ap.parse_args()
+
+from fedml_tpu.models.llm.llama import LlamaConfig
+from fedml_tpu.train.llm.trainer import LLMTrainer
+
+cfg = LlamaConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+    num_hidden_layers=cli.layers, num_attention_heads=32,
+    num_key_value_heads=32, max_position_embeddings=4096,
+    lora_rank=16, remat=cli.remat,
+    remat_policy="full" if cli.remat else "none",
+    param_dtype=jnp.bfloat16,
+)
+
+
+class Args:
+    max_seq_length = cli.t
+    per_device_batch_size = cli.b
+    gradient_accumulation_steps = 1
+    learning_rate = 1e-4
+    mesh_dp = 1
+    mesh_fsdp = -1
+    mesh_tp = 1
+    mesh_sp = 1
+
+
+dev = jax.devices()[0]
+print(f"device: {dev.device_kind}, platform {dev.platform}", flush=True)
+
+t0 = time.perf_counter()
+tr = LLMTrainer(cfg, Args())
+tr.init(seed=0)
+n_params = sum(x.size for x in jax.tree.leaves(tr.params))
+print(f"init ok: {n_params/1e9:.2f}B params, {time.perf_counter()-t0:.1f}s",
+      flush=True)
+
+rng = np.random.default_rng(0)
+x = rng.integers(0, 32000, size=(cli.b, cli.t), dtype=np.int32)
+y = (x + 1) % 32000
+m = np.ones((cli.b,), np.float32)
+
+t0 = time.perf_counter()
+loss = tr.step(x, y, m)
+print(f"first step (compile): {time.perf_counter()-t0:.1f}s loss={loss:.3f}",
+      flush=True)
+
+# chained timing: steps donate params/opt_state -> data-dependent
+def chain(n):
+    t0 = time.perf_counter()
+    p, o = tr.params, tr.opt_state
+    loss = None
+    for _ in range(n):
+        p, o, loss = tr._train_step(
+            p, o, tr._put(x[None], tr._micro_spec),
+            tr._put(y[None], tr._micro_spec),
+            tr._put(m[None], tr._micro_spec, np.float32))
+    tr.params, tr.opt_state = p, o
+    float(loss)
+    return time.perf_counter() - t0
+
+chain(2)
+best = 1e9
+for _ in range(3):
+    ts, tl = chain(2), chain(2 + cli.steps)
+    best = min(best, (tl - ts) / cli.steps)
+toks = cli.b * cli.t
+flops = 4.0 * n_params * toks + 6.0 * cfg.num_hidden_layers * \
+    cfg.hidden_size * cli.t * toks * 0.5
+stats = {}
+try:
+    ms = dev.memory_stats()
+    stats = {k: round(ms[k] / 1e9, 2) for k in
+             ("bytes_in_use", "peak_bytes_in_use", "bytes_limit") if k in ms}
+except Exception:
+    pass
+print(json.dumps({
+    "sec_per_step": round(best, 4),
+    "tokens_per_sec": round(toks / best, 1),
+    "mfu": round(flops / best / 197e12, 4),
+    "B": cli.b, "T": cli.t, "layers": cli.layers, "remat": cli.remat,
+    "memory_gb": stats,
+}), flush=True)
