@@ -68,7 +68,11 @@ def llm_shape(hbm_bytes: float):
     """Pick a Llama shape sized to the chip's HBM (fp32 masters + grads)."""
     from fedml_tpu.models.llm.llama import LlamaConfig
 
-    which = os.environ.get("FEDML_BENCH_MODEL", "auto")
+    which = os.environ.get("FEDML_BENCH_MODEL", "auto").lower()
+    if which not in ("auto", "7b", "1b"):
+        raise SystemExit(
+            f"FEDML_BENCH_MODEL={which!r}: expected auto|7b|1b — refusing "
+            "to silently bench the tiny-dev model as the flagship")
     if hbm_bytes >= 12e9 and which in ("auto", "7b"):
         # The NORTH-STAR model (BASELINE.json: Llama-2-7B LoRA): true
         # 7B config — hidden 4096, inter 11008, 32 layers, 32 MHA heads,
@@ -78,10 +82,7 @@ def llm_shape(hbm_bytes: float):
         # OOMs by 435 MB — tools/probe_7b.py reproduces both).
         import jax.numpy as jnp
 
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
-            num_hidden_layers=32, num_attention_heads=32,
-            num_key_value_heads=32, max_position_embeddings=4096,
+        cfg = LlamaConfig.llama2_7b(
             lora_rank=16, remat=False, remat_policy="none",
             param_dtype=jnp.bfloat16,
         )

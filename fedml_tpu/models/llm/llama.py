@@ -68,30 +68,36 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
 
-    # -- presets ---------------------------------------------------------
+    # -- presets (kw overrides win — e.g. a reduced-depth 7B) ------------
     @staticmethod
     def llama2_7b(**kw) -> "LlamaConfig":
-        return LlamaConfig(
+        for k, v in dict(
             vocab_size=32000, hidden_size=4096, intermediate_size=11008,
             num_hidden_layers=32, num_attention_heads=32,
-            num_key_value_heads=32, **kw,
-        )
+            num_key_value_heads=32,
+        ).items():
+            kw.setdefault(k, v)
+        return LlamaConfig(**kw)
 
     @staticmethod
     def llama2_13b(**kw) -> "LlamaConfig":
-        return LlamaConfig(
+        for k, v in dict(
             vocab_size=32000, hidden_size=5120, intermediate_size=13824,
             num_hidden_layers=40, num_attention_heads=40,
-            num_key_value_heads=40, **kw,
-        )
+            num_key_value_heads=40,
+        ).items():
+            kw.setdefault(k, v)
+        return LlamaConfig(**kw)
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
-        return LlamaConfig(
+        for k, v in dict(
             vocab_size=128256, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32,
-            num_key_value_heads=8, rope_theta=500000.0, **kw,
-        )
+            num_key_value_heads=8, rope_theta=500000.0,
+        ).items():
+            kw.setdefault(k, v)
+        return LlamaConfig(**kw)
 
     @staticmethod
     def tiny(**kw) -> "LlamaConfig":

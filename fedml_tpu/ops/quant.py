@@ -100,11 +100,16 @@ def quantize_int8(w: Any, mode: str = "dequant") -> QuantizedTensor:
 
 
 def quantize_params_int8(params: Any, min_size: int = 65536,
-                         mode: str = "dequant") -> Any:
+                         mode: str = "dequant", donate: bool = False) -> Any:
     """Swap every large 2-D non-LoRA kernel leaf for a QuantizedTensor.
 
     LoRA adapters stay fp32 (they are tiny and trained); embeddings stay
     full precision (gather, not matmul); norms/bias are 1-D and skipped.
+
+    ``donate=True`` frees each source kernel's device buffer as soon as
+    its int8 twin exists — without it, quantizing a 7B model needs
+    bf16 + int8 resident simultaneously (13.5 + 6.8 GB), which does not
+    fit a 16 GB chip. The caller's ``params`` tree is INVALID afterwards.
     """
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree_util.tree_structure(params)
@@ -119,7 +124,11 @@ def quantize_params_int8(params: Any, min_size: int = 65536,
                 and leaf.size >= min_size
                 and "lora" not in name
                 and "embed" not in name):
-            out.append(quantize_int8(leaf, mode=mode))
+            q = quantize_int8(leaf, mode=mode)
+            if donate and isinstance(leaf, jax.Array):
+                jax.block_until_ready(q.data)  # q computed before source dies
+                leaf.delete()
+            out.append(q)
         else:
             out.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -134,17 +143,49 @@ def quantize_params_int8(params: Any, min_size: int = 65536,
 # int8 (half the bytes — decode is weight-bandwidth-bound), convert
 # in-VMEM on the VPU, and feed the MXU in bf16. Scales fold into outputs.
 
-def _pick_block(dim: int) -> int:
-    for cand in (1024, 512, 256, 128):
-        if dim % cand == 0:
-            return cand
-    return 0
+try:  # pltpu only imports on TPU-enabled builds; interpret mode needs no TPU
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# VMEM budget for the weight tile: scoped vmem is 16 MB, and the tile
+# shares it with x, the accumulator, and the output block
+_TILE_BYTES = 6 * 1024 * 1024
 
 
-def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
+def _pick_tiles(h: int, f: int):
+    """(bh, bf) tile of the int8 weight: lane dims multiples of 128 that
+    divide the axis, biggest f-block first, tile ≤ _TILE_BYTES."""
+    def divisors(dim, cap):
+        # 128-lane-aligned blocks only — Mosaic tiling needs them; a dim
+        # with no 128-multiple divisor returns [] → caller falls back
+        start = min(dim, cap) // 128 * 128
+        return [b for b in range(start, 0, -128) if dim % b == 0]
+
+    # narrow f-blocks (≤512) give the DMA/compute pipeline more grid
+    # steps to overlap — measured faster than maximal tiles at B=8
+    for bf in divisors(f, 512):
+        for bh in divisors(h, 8192):
+            if bh * bf <= _TILE_BYTES:
+                return bh, bf
+    return 0, 0
+
+
+def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref):
+    ih = pl.program_id(1)  # reduction step (innermost grid dim)
+
+    @pl.when(ih == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
     w = w_ref[...].astype(jnp.bfloat16)          # int8 → bf16 in VMEM
-    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
-    o_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(ih == pl.num_programs(1) - 1)
+    def _done():
+        o_ref[...] = (acc_ref[...] * s_ref[...].astype(jnp.float32)
+                      ).astype(o_ref.dtype)
 
 
 def pallas_dequant_matmul(x, q, scale, dtype):
@@ -152,21 +193,27 @@ def pallas_dequant_matmul(x, q, scale, dtype):
     load. x: [B, H] (or [..., H], flattened), q: int8 [H, F], scale [F]."""
     lead = x.shape[:-1]
     h, f = q.shape
-    bf = _pick_block(f)
-    if bf == 0 or h % 128 != 0:
-        # shapes the tiler can't split cleanly: fall back to XLA dequant
+    bh, bf = _pick_tiles(h, f)
+    rows = int(np.prod(lead)) if lead else 1
+    # The kernel exists for the weight-bandwidth-bound DECODE regime
+    # (few rows). Prefill (rows ≫ 128) is MXU-bound — the weights
+    # amortize over the rows, the x block would blow the VMEM budget
+    # (rows × bh bf16), and XLA's dequant costs proportionally little.
+    if bh == 0 or rows > 128 or pltpu is None:
         return (x.reshape(*lead, h) @ q.astype(dtype)) * scale.astype(dtype)
     x2 = x.reshape(-1, h).astype(jnp.bfloat16)
+    b = x2.shape[0]
     out = pl.pallas_call(
         _dequant_matmul_kernel,
-        grid=(f // bf,),
+        grid=(f // bf, h // bh),
         in_specs=[
-            pl.BlockSpec((x2.shape[0], h), lambda j: (0, 0)),
-            pl.BlockSpec((h, bf), lambda j: (0, j)),
-            pl.BlockSpec((1, bf), lambda j: (0, j)),
+            pl.BlockSpec((b, bh), lambda j, i: (0, i)),
+            pl.BlockSpec((bh, bf), lambda j, i: (i, j)),
+            pl.BlockSpec((1, bf), lambda j, i: (0, j)),
         ],
-        out_specs=pl.BlockSpec((x2.shape[0], bf), lambda j: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((x2.shape[0], f), dtype),
+        out_specs=pl.BlockSpec((b, bf), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((b, f), dtype),
+        scratch_shapes=[pltpu.VMEM((b, bf), jnp.float32)],
         interpret=jax.devices()[0].platform != "tpu",  # CPU tests
     )(x2, q, scale.reshape(1, f))
     return out.reshape(*lead, f)

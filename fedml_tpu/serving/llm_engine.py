@@ -46,7 +46,14 @@ class _Slot:
 
 
 class ContinuousBatchingEngine:
-    """Schedules generation requests onto a fixed slot pool."""
+    """Schedules generation requests onto a fixed slot pool.
+
+    With ``quantize`` set (and the default ``quantize_donate=True``),
+    the passed ``params`` tree is CONSUMED — its device buffers are
+    freed as the int8 twins are built, so a 7B quantizes within a 16 GB
+    chip. Do not use it after constructing the engine; read
+    ``engine.params`` instead, or pass ``quantize_donate=False``.
+    """
 
     def __init__(
         self,
@@ -57,6 +64,7 @@ class ContinuousBatchingEngine:
         min_prompt_bucket: int = 16,
         eos_id: Optional[int] = None,
         quantize: Optional[str] = None,
+        quantize_donate: bool = True,
     ):
         self.model = model
         if quantize in ("int8", "int8_w8a8", "w8a8", "int8_pallas", "pallas",
@@ -73,7 +81,12 @@ class ContinuousBatchingEngine:
                 mode = "dequant"
             else:
                 mode = "pallas"
-            params = quantize_params_int8(params, mode=mode)
+            # donate (default): at 7B the bf16 source (13.5 GB) and the
+            # int8 twin cannot be resident together — the caller's params
+            # tree is consumed (class docstring); pass
+            # quantize_donate=False to keep the source alive (A/B runs)
+            params = quantize_params_int8(params, mode=mode,
+                                          donate=quantize_donate)
         elif quantize is not None:
             raise ValueError(f"unknown quantize mode: {quantize!r}")
         self.params = params

@@ -3,27 +3,40 @@ import sys; sys.path.insert(0, "/root/repo")
 from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
 from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
 import argparse
-ap = argparse.ArgumentParser(); ap.add_argument("--quantize", default=None)
+ap = argparse.ArgumentParser()
+ap.add_argument("--quantize", default=None)
+ap.add_argument("--model", default="1b", choices=["1b", "7b"])
+ap.add_argument("--batch", type=int, default=8)
+ap.add_argument("--ctx", type=int, default=1024)
 cli = ap.parse_args()
 
-cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-                  num_hidden_layers=22, num_attention_heads=32,
-                  num_key_value_heads=8, max_position_embeddings=2048,
-                  remat=False, remat_policy="none", dtype=jnp.bfloat16,
-                  param_dtype=jnp.bfloat16, use_flash=False)
+if cli.model == "7b":
+    # BASELINE config #5: Llama-2-7B inference endpoint on TPU. bf16
+    # weights alone are 13.5 GB — int8 (6.8 GB) is what makes a B=8
+    # single-v5e 7B endpoint fit at all (KV cache ~0.5 GB/slot @1024).
+    cfg = LlamaConfig.llama2_7b(remat=False, remat_policy="none",
+                                dtype=jnp.bfloat16,
+                                param_dtype=jnp.bfloat16, use_flash=False)
+else:
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=22,
+                      num_attention_heads=32, num_key_value_heads=8,
+                      max_position_embeddings=2048, remat=False,
+                      remat_policy="none", dtype=jnp.bfloat16,
+                      param_dtype=jnp.bfloat16, use_flash=False)
 model = LlamaForCausalLM(cfg)
 rng = np.random.default_rng(0)
 toks = jnp.asarray(rng.integers(0, 32000, size=(1, 8)))
 params = jax.jit(model.init)(jax.random.key(0), toks)
 n_params = sum(x.size for x in jax.tree.leaves(params))
-B, CTX = 8, 1024
+B, CTX = cli.batch, cli.ctx
 eng = ContinuousBatchingEngine(model, params, batch_slots=B, max_len=CTX,
                                quantize=cli.quantize)
 params = eng.params  # quantized if requested
 caches = model.init_kv_caches(B, CTX)
 caches = [(jnp.asarray(k), jnp.asarray(v)) for k, v, _ in caches]
 last = jnp.asarray(rng.integers(0, 32000, size=(B,)))
-lengths = jnp.full((B,), 512, jnp.int32)
+lengths = jnp.full((B,), CTX // 2, jnp.int32)
 
 def chain(n):
     global caches
@@ -42,8 +55,8 @@ for _ in range(3):
     ts = chain(2); tl = chain(34)
     best = min(best, (tl - ts) / 32)
 tok_s = B / best
-print(f"params={n_params/1e9:.2f}B quantize={cli.quantize} decode step "
-      f"{best*1e3:.2f} ms @B{B} ctx512 -> {tok_s:.0f} tok/s device-side")
+print(f"model={cli.model} params={n_params/1e9:.2f}B quantize={cli.quantize} decode step "
+      f"{best*1e3:.2f} ms @B{B} ctx{CTX//2} -> {tok_s:.0f} tok/s device-side")
 # memory-bound roofline from the ACTUAL (possibly quantized) weight bytes
 from fedml_tpu.ops.quant import tree_bytes
 wbytes = tree_bytes(params)

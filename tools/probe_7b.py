@@ -21,21 +21,22 @@ import numpy as np
 
 ap = argparse.ArgumentParser()
 ap.add_argument("--remat", action="store_true")
+ap.add_argument("--policy", default=None, choices=["none", "dots", "full"])
 ap.add_argument("--t", type=int, default=512)
 ap.add_argument("--b", type=int, default=1)
 ap.add_argument("--layers", type=int, default=32)
 ap.add_argument("--steps", type=int, default=8)
 cli = ap.parse_args()
+if cli.policy:
+    cli.remat = cli.policy != "none"
 
 from fedml_tpu.models.llm.llama import LlamaConfig
 from fedml_tpu.train.llm.trainer import LLMTrainer
 
-cfg = LlamaConfig(
-    vocab_size=32000, hidden_size=4096, intermediate_size=11008,
-    num_hidden_layers=cli.layers, num_attention_heads=32,
-    num_key_value_heads=32, max_position_embeddings=4096,
+cfg = LlamaConfig.llama2_7b(
+    num_hidden_layers=cli.layers,
     lora_rank=16, remat=cli.remat,
-    remat_policy="full" if cli.remat else "none",
+    remat_policy=cli.policy or ("full" if cli.remat else "none"),
     param_dtype=jnp.bfloat16,
 )
 
@@ -104,6 +105,6 @@ print(json.dumps({
     "sec_per_step": round(best, 4),
     "tokens_per_sec": round(toks / best, 1),
     "mfu": round(flops / best / 197e12, 4),
-    "B": cli.b, "T": cli.t, "layers": cli.layers, "remat": cli.remat,
+    "B": cli.b, "T": cli.t, "layers": cli.layers, "remat": cli.policy or cli.remat,
     "memory_gb": stats,
 }), flush=True)
