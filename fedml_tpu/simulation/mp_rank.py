@@ -1,0 +1,9 @@
+"""Entry module for one simulated client rank of the mp backend.
+
+Parity: a reference MPI rank (``simulation/mpi/fedavg/FedAvgClientManager``)
+— here each rank is simply a cross-silo client over the broker.
+"""
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_cross_silo_client()

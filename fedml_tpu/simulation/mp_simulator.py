@@ -1,0 +1,126 @@
+"""Multi-process message-passing simulation — the reference's MPI mode.
+
+Parity target: ``python/fedml/simulation/mpi/`` (one OS process per
+simulated client, message-passing FedAvg through ``mpi4py``) and the
+``SimulatorMPI`` facade (``simulation/simulator.py:70``).
+
+TPU-native design: the message-passing substrate is the same broker
+transport + cross-silo FSM real federations use — "MPI simulation" is
+exactly a loopback cross-silo run, so protocol behavior in simulation
+IS production behavior (the reference maintains a second 9k-LoC engine
+for this; here it is ~150 lines of orchestration). The *parallel
+compute* role of the reference's MPI/NCCL modes (N clients' SGD at
+once) is served by ``backend: "mesh"``, which vmaps clients over the
+device mesh inside one XLA program; ``backend: "mp"`` exists for true
+process isolation — per-client OS resources, crash isolation, and
+message-passing semantics identical to the wire.
+
+Each client process rebuilds its dataset from ``args`` (registry
+datasets are deterministic given the config + seed), mirroring the
+reference where every MPI rank loads data itself.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Any
+
+import yaml
+
+from fedml_tpu.data.dataset import FederatedDataset
+
+logger = logging.getLogger(__name__)
+
+_YAMLABLE = (str, int, float, bool, list, dict, tuple, type(None))
+# runtime-only attrs that must not leak into the spawned ranks' config
+_SKIP_KEYS = {"role", "rank", "backend", "training_type", "run_id",
+              "comm_backend", "broker_host", "broker_port",
+              "object_store_dir", "client_id_list", "device"}
+
+
+class MPSimulator:
+    """Server in-process + one subprocess per simulated client."""
+
+    def __init__(self, args: Any, device: Any, dataset: FederatedDataset,
+                 model: Any, client_trainer=None, server_aggregator=None):
+        self.args = args
+        self.device = device
+        self.dataset = dataset
+        self.model = model
+        self.server_aggregator = server_aggregator
+
+    def _client_config(self, broker_addr, store_dir: str, run_id: str) -> dict:
+        flat = {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in self.args.to_dict().items()
+            if isinstance(v, _YAMLABLE) and k not in _SKIP_KEYS
+        }
+        flat.update(
+            training_type="cross_silo",
+            run_id=run_id,
+            comm_backend="BROKER",
+            broker_host=broker_addr[0],
+            broker_port=broker_addr[1],
+            object_store_dir=store_dir,
+        )
+        return {"common_args": flat}
+
+    def run(self):
+        from fedml_tpu.core.distributed.communication.broker import (
+            PubSubBroker,
+        )
+        from fedml_tpu.runner import FedMLRunner
+
+        n_clients = int(getattr(self.args, "client_num_in_total", 2))
+        broker = PubSubBroker().start()
+        tmp = tempfile.mkdtemp(prefix="fedml_mp_sim_")
+        run_id = f"mp_sim_{os.getpid()}"
+        cfg_path = os.path.join(tmp, "fedml_config.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(self._client_config(
+                broker.address, os.path.join(tmp, "store"), run_id), f)
+
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "fedml_tpu.simulation.mp_rank",
+                 "--cf", cfg_path, "--rank", str(r), "--role", "client"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env)
+            for r in range(1, n_clients + 1)
+        ]
+        try:
+            # the server runs in THIS process on the already-loaded
+            # dataset/model; clients are real ranks over the broker
+            server_args = copy.copy(self.args)
+            server_args.training_type = "cross_silo"
+            server_args.role = "server"
+            server_args.rank = 0
+            server_args.run_id = run_id
+            server_args.comm_backend = "BROKER"
+            server_args.broker_host = broker.address[0]
+            server_args.broker_port = broker.address[1]
+            server_args.object_store_dir = os.path.join(tmp, "store")
+            result = FedMLRunner(
+                server_args, self.device, self.dataset, self.model,
+                server_aggregator=self.server_aggregator,
+            ).run()
+            for p in procs:
+                out, _ = p.communicate(timeout=120)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"mp client rank failed:\n{out[-2000:]}")
+            return result
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            broker.stop()

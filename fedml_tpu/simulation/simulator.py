@@ -107,4 +107,13 @@ def create_simulator(args: Any, device, dataset, model,
         return SimulatorMesh(
             args, device, dataset, model, client_trainer, server_aggregator
         )
+    if backend.lower() in ("mp", "multiprocess", "message_passing"):
+        # the reference's MPI mode proper: one OS process per client,
+        # message-passing over the broker (crash isolation + wire-true
+        # protocol); "mesh" remains the parallel-compute answer
+        from fedml_tpu.simulation.mp_simulator import MPSimulator
+
+        return MPSimulator(
+            args, device, dataset, model, client_trainer, server_aggregator
+        )
     raise ValueError(f"unknown simulation backend {backend!r}")
