@@ -15,10 +15,18 @@ implements the actual RLWE/CKKS algebra from scratch:
   multiplication/rescaling is deliberately out of scope: aggregation is
   additive.)
 
-Parameters default to demo scale (N=1024, one 31-bit prime q, Δ=2^19):
-correct CKKS algebra with a real noise term, sized so exact arithmetic
-fits int64. Production deployments would use RNS-CKKS with N ≥ 8192 and
-a chain of primes; the API is parameter-compatible.
+Two parameter regimes:
+
+- **demo** (``CKKSContext``, N=1024, one 31-bit prime, Δ=2^19): correct
+  CKKS algebra with a real noise term, sized so exact arithmetic fits
+  int64 via the O(N²) limb-split matmul — fast to construct, NOT a
+  production security level.
+- **secure** (``RNSCKKSContext``, N=8192, two ~30-bit NTT primes —
+  logQ ≈ 60 ≪ the ≤218 the HE standard allows at N=8192/128-bit —
+  Δ=2^40): RNS residue arithmetic with negacyclic NTT polynomial
+  multiplication, uniform ternary secret. This is the RNS-CKKS-at-N≥8192
+  profile; select it with ``fhe_profile: "secure"`` (or
+  ``fhe_poly_degree >= 4096``).
 
 Correctness bound: coefficient noise |e| ≈ a few hundred spreads over
 slots by ≈ √N at decode, so slot error ≈ √N·e/Δ ≈ 6e-3 at the defaults,
@@ -179,3 +187,258 @@ class CKKSContext:
         if len(a) != len(b):
             raise ValueError("ciphertext vectors have different chunk counts")
         return [self.add(x, y) for x, y in zip(a, b)]
+
+
+# ---------------------------------------------------------------------------
+# RNS-CKKS at production scale: NTT polynomial arithmetic over a chain of
+# primes, N >= 4096.
+# ---------------------------------------------------------------------------
+
+def _is_prime(n: int) -> bool:
+    """Deterministic Miller-Rabin for n < 3.3e24."""
+    if n < 2:
+        return False
+    for p in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for a in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def find_ntt_primes(two_n: int, bits: int, count: int) -> List[int]:
+    """``count`` primes q ≡ 1 (mod 2N) just below 2^bits (NTT-friendly)."""
+    primes: List[int] = []
+    q = ((1 << bits) - 1) // two_n * two_n + 1
+    while len(primes) < count and q > (1 << (bits - 1)):
+        if _is_prime(q):
+            primes.append(q)
+        q -= two_n
+    if len(primes) < count:
+        raise ValueError(f"not enough {bits}-bit NTT primes for 2N={two_n}")
+    return primes
+
+
+def _primitive_2n_root(q: int, two_n: int) -> int:
+    """ψ of order 2N in Z_q* (exists since 2N | q-1)."""
+    # factor q-1 (= 2N · m, m small for our prime sizes) by trial division
+    m = q - 1
+    factors = set()
+    d = 2
+    while d * d <= m:
+        while m % d == 0:
+            factors.add(d)
+            m //= d
+        d += 1
+    if m > 1:
+        factors.add(m)
+    for g in range(2, 1000):
+        if all(pow(g, (q - 1) // p, q) != 1 for p in factors):
+            psi = pow(g, (q - 1) // two_n, q)
+            return psi
+    raise ValueError(f"no generator found for q={q}")
+
+
+class _NTTPlan:
+    """Precomputed tables for negacyclic (X^N+1) NTT mod one prime."""
+
+    def __init__(self, q: int, n: int):
+        self.q, self.n = q, n
+        psi = _primitive_2n_root(q, 2 * n)
+        k = np.arange(n)
+        self.psi_pow = np.array(
+            [pow(psi, int(i), q) for i in k], np.int64)
+        psi_inv = pow(psi, q - 2, q)
+        self.psi_inv_pow = np.array(
+            [pow(psi_inv, int(i), q) for i in k], np.int64)
+        self.n_inv = pow(n, q - 2, q)
+        w = pow(psi, 2, q)  # n-th root for the cyclic core
+        self.w_pows = {}
+        self.w_inv_pows = {}
+        length = 2
+        while length <= n:
+            base = pow(w, n // length, q)
+            base_inv = pow(base, q - 2, q)
+            self.w_pows[length] = np.array(
+                [pow(base, int(i), q) for i in range(length // 2)], np.int64)
+            self.w_inv_pows[length] = np.array(
+                [pow(base_inv, int(i), q) for i in range(length // 2)],
+                np.int64)
+            length *= 2
+        bits = n.bit_length() - 1
+        rev = np.zeros(n, np.int64)
+        for i in range(n):
+            rev[i] = int(format(i, f"0{bits}b")[::-1], 2)
+        self.bitrev = rev
+
+    def _core(self, a: np.ndarray, inverse: bool) -> np.ndarray:
+        q, n = self.q, self.n
+        a = a[..., self.bitrev]
+        length = 2
+        while length <= n:
+            half = length // 2
+            w = self.w_inv_pows[length] if inverse else self.w_pows[length]
+            shape = a.shape[:-1] + (n // length, length)
+            a = a.reshape(shape)
+            lo, hi = a[..., :half], a[..., half:]
+            t = hi * w % q  # < 2^30 · 2^30 → fits int64
+            a = np.concatenate([(lo + t) % q, (lo - t) % q], axis=-1)
+            a = a.reshape(a.shape[:-2] + (n,))
+            length *= 2
+        return a
+
+    def mul(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """a·b mod (X^N+1, q) via ψ-twisted NTT."""
+        q = self.q
+        fa = self._core(a % q * self.psi_pow % q, False)
+        fb = self._core(b % q * self.psi_pow % q, False)
+        fc = fa * fb % q
+        c = self._core(fc, True)
+        return c * self.n_inv % q * self.psi_inv_pow % q
+
+
+class RNSCKKSContext:
+    """CKKS additive subset over an RNS basis with NTT arithmetic.
+
+    Same public surface as :class:`CKKSContext` (keygen / encode /
+    decode / encrypt_poly / decrypt_poly / add / add_plain / vector
+    API); ciphertext polys are residue matrices ``[k_primes, N]``.
+    """
+
+    def __init__(self, n: int = 8192, prime_bits: int = 30,
+                 n_primes: int = 2, delta: int = 1 << 40,
+                 seed: Optional[int] = None):
+        if n & (n - 1):
+            raise ValueError("ring degree n must be a power of two")
+        if n_primes != 2:
+            raise ValueError("int64 CRT path supports exactly 2 primes")
+        self.n = int(n)
+        self.delta = int(delta)
+        self.primes = find_ntt_primes(2 * n, prime_bits, n_primes)
+        self.q = self.primes[0] * self.primes[1]  # composite modulus Q
+        self.plans = [_NTTPlan(q, n) for q in self.primes]
+        self.slots = n // 2
+        self._rng = np.random.default_rng(seed)
+        k = np.arange(self.n)
+        self._zeta_pow = np.exp(1j * np.pi * k / self.n)
+        self.sk: Optional[np.ndarray] = None          # [N] small ints
+        self.pk: Optional[Tuple[np.ndarray, np.ndarray]] = None  # [k,N] each
+
+    # -- residue helpers --------------------------------------------------
+    def _to_rns(self, small: np.ndarray) -> np.ndarray:
+        """Small signed ints [N] → residues [k, N]."""
+        return np.stack([np.mod(small, q) for q in self.primes])
+
+    def _from_rns_centered(self, r: np.ndarray) -> np.ndarray:
+        """Residues [k, N] → centered representative of Z_Q, float64.
+
+        CRT: x = r1 + q1·((r2-r1)·q1⁻¹ mod q2); every intermediate
+        product stays below 2^61 so int64 is exact.
+        """
+        q1, q2 = self.primes
+        inv_q1 = pow(q1 % q2, q2 - 2, q2)
+        t = (r[1] - r[0]) % q2 * inv_q1 % q2
+        x = r[0].astype(np.float64) + float(q1) * t.astype(np.float64)
+        half = self.q / 2.0
+        return np.where(x > half, x - float(self.q), x)
+
+    def _polymul_rns(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        return np.stack([p.mul(a[i], b[i])
+                         for i, p in enumerate(self.plans)])
+
+    # -- keys -------------------------------------------------------------
+    def _ternary(self) -> np.ndarray:
+        # uniform ternary secret — the standard-compliant choice at this N
+        return self._rng.integers(-1, 2, self.n).astype(np.int64)
+
+    def _noise(self) -> np.ndarray:
+        return np.rint(
+            self._rng.normal(0.0, _NOISE_SIGMA, self.n)).astype(np.int64)
+
+    def keygen(self) -> "RNSCKKSContext":
+        self.sk = self._ternary()
+        s = self._to_rns(self.sk)
+        a = np.stack([self._rng.integers(0, q, self.n, dtype=np.int64)
+                      for q in self.primes])
+        e = self._to_rns(self._noise())
+        b = np.stack([
+            np.mod(-(self.plans[i].mul(a[i], s[i])) + e[i], self.primes[i])
+            for i in range(len(self.primes))
+        ])
+        self.pk = (b, a)
+        return self
+
+    # -- encode / decode --------------------------------------------------
+    def encode(self, values: np.ndarray) -> np.ndarray:
+        """Real slot values (≤ N/2) → integer plaintext poly [N]."""
+        values = np.asarray(values, np.float64)
+        limit = self.q / (2.0 * self.delta)
+        if values.size and np.abs(values).max() >= limit:
+            raise ValueError(
+                f"slot value {np.abs(values).max():.1f} exceeds the CKKS "
+                f"range |x| < {limit:.0f} at delta={self.delta}")
+        z = np.zeros(self.slots, np.complex128)
+        z[: len(values)] = values
+        zfull = np.concatenate([z, np.conj(z[::-1])])
+        coeffs = np.fft.fft(zfull) * np.conj(self._zeta_pow) / self.n
+        return np.rint(np.real(coeffs) * self.delta).astype(np.int64)
+
+    def decode(self, poly: np.ndarray,
+               length: Optional[int] = None) -> np.ndarray:
+        vals = np.fft.ifft(np.asarray(poly, np.float64)
+                           * self._zeta_pow) * self.n
+        z = np.real(vals[: self.slots]) / self.delta
+        return z[:length] if length is not None else z
+
+    # -- encrypt / decrypt ------------------------------------------------
+    def encrypt_poly(self, m: np.ndarray) -> CKKSCiphertext:
+        if self.pk is None:
+            raise RuntimeError("keygen() first")
+        b, a = self.pk
+        u = self._to_rns(self._ternary())
+        # ONE noise draw reduced into every residue ring — independent
+        # draws per prime would not represent a single ring element.
+        # (m's coeffs, up to Δ·|x| ≈ 2^50, exceed one prime: same rule.)
+        noise0 = self._noise() + m
+        e0 = np.stack([np.mod(noise0, q) for q in self.primes])
+        c0 = np.mod(self._polymul_rns(b, u) + e0,
+                    np.asarray(self.primes)[:, None])
+        c1 = np.mod(self._polymul_rns(a, u)
+                    + self._to_rns(self._noise()),
+                    np.asarray(self.primes)[:, None])
+        return CKKSCiphertext(c0, c1)
+
+    def decrypt_poly(self, ct: CKKSCiphertext) -> np.ndarray:
+        if self.sk is None:
+            raise RuntimeError("no secret key in this context")
+        s = self._to_rns(self.sk)
+        m = np.mod(ct.c0 + self._polymul_rns(ct.c1, s),
+                   np.asarray(self.primes)[:, None])
+        return self._from_rns_centered(m)
+
+    # -- homomorphic ops --------------------------------------------------
+    def add(self, x: CKKSCiphertext, y: CKKSCiphertext) -> CKKSCiphertext:
+        qcol = np.asarray(self.primes)[:, None]
+        return CKKSCiphertext(np.mod(x.c0 + y.c0, qcol),
+                              np.mod(x.c1 + y.c1, qcol))
+
+    def add_plain(self, x: CKKSCiphertext, m: np.ndarray) -> CKKSCiphertext:
+        qcol = np.asarray(self.primes)[:, None]
+        return CKKSCiphertext(np.mod(x.c0 + self._to_rns(m), qcol), x.c1)
+
+    # -- vector API (same shape as CKKSContext) ---------------------------
+    encrypt_vector = CKKSContext.encrypt_vector
+    decrypt_vector = CKKSContext.decrypt_vector
+    add_vectors = CKKSContext.add_vectors

@@ -49,17 +49,35 @@ class FedMLFHE:
         self.is_enabled = bool(getattr(args, "enable_fhe", False))
         if not self.is_enabled:
             return
-        from fedml_tpu.core.fhe.ckks import CKKSContext
+        from fedml_tpu.core.fhe.ckks import CKKSContext, RNSCKKSContext
 
         seed = int(getattr(args, "fhe_key_seed",
                            getattr(args, "random_seed", 0))) + 40487
-        self.ctx = CKKSContext(
-            n=int(getattr(args, "fhe_poly_degree", 1024)),
-            delta=int(getattr(args, "fhe_scale", 1 << 19)),
-            seed=seed,
-        ).keygen()
-        logging.info("FHE enabled: CKKS n=%d slots=%d", self.ctx.n,
-                     self.ctx.slots)
+        profile = str(getattr(args, "fhe_profile", "demo")).lower()
+        degree = int(getattr(args, "fhe_poly_degree", 0) or 0)
+        if profile == "secure" or degree >= 4096:
+            # RNS-CKKS at N≥8192: NTT arithmetic, two ~30-bit primes —
+            # inside the HE-standard security envelope for this N
+            self.ctx = RNSCKKSContext(
+                n=degree or 8192,
+                delta=int(getattr(args, "fhe_scale", 1 << 40)),
+                seed=seed,
+            ).keygen()
+            logging.info("FHE enabled: RNS-CKKS n=%d primes=%s logQ=%d",
+                         self.ctx.n, self.ctx.primes,
+                         self.ctx.q.bit_length())
+        else:
+            # demo-scale params (N=1024, one 31-bit prime): real CKKS
+            # algebra, NOT a production security level — fast for tests
+            self.ctx = CKKSContext(
+                n=degree or 1024,
+                delta=int(getattr(args, "fhe_scale", 1 << 19)),
+                seed=seed,
+            ).keygen()
+            logging.info(
+                "FHE enabled: CKKS n=%d slots=%d (DEMO-SCALE parameters; "
+                "set fhe_profile: secure for RNS-CKKS at N=8192)",
+                self.ctx.n, self.ctx.slots)
 
     def is_fhe_enabled(self) -> bool:
         return self.is_enabled
