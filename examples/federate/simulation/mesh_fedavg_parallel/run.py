@@ -8,8 +8,8 @@ mesh_simulator.py``).
 
 Needs >= 2 devices. Without real chips this example forces 8 virtual CPU
 devices (the same trick the test suite and the driver's multichip dryrun
-use); on a TPU slice, unset FEDML_EXAMPLES_FORCE_CPU_MESH and it runs on
-the real mesh.
+use); on a TPU slice, set FEDML_EXAMPLES_FORCE_CPU_MESH=0 (and leave
+JAX_PLATFORMS unset) to run on the real mesh.
 
 Run:  python examples/federate/simulation/mesh_fedavg_parallel/run.py
 """
@@ -22,7 +22,8 @@ ROOT = os.path.abspath(os.path.join(HERE, "..", "..", "..", ".."))
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
 
-if os.environ.get("FEDML_EXAMPLES_FORCE_CPU_MESH", "1") == "1":
+_force_cpu = os.environ.get("FEDML_EXAMPLES_FORCE_CPU_MESH", "1") == "1"
+if _force_cpu:
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -30,7 +31,11 @@ if os.environ.get("FEDML_EXAMPLES_FORCE_CPU_MESH", "1") == "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+# pin the platform only when we (or the caller) chose one — with
+# FEDML_EXAMPLES_FORCE_CPU_MESH=0 and no JAX_PLATFORMS, jax autoselects
+# the real accelerator
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import fedml_tpu  # noqa: E402
 

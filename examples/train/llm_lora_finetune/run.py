@@ -24,7 +24,8 @@ if ROOT not in sys.path:
 
 # The demo mesh is fsdp=4 × tp=2 = 8 devices. Without 8 real chips,
 # force 8 virtual CPU devices (the test suite / driver-dryrun trick);
-# on a real slice set FEDML_EXAMPLES_FORCE_CPU_MESH=0.
+# on an 8-chip slice set FEDML_EXAMPLES_FORCE_CPU_MESH=0 (and leave
+# JAX_PLATFORMS unset) to run on the real mesh.
 if os.environ.get("FEDML_EXAMPLES_FORCE_CPU_MESH", "1") == "1":
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -33,7 +34,8 @@ if os.environ.get("FEDML_EXAMPLES_FORCE_CPU_MESH", "1") == "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import fedml_tpu  # noqa: E402
 from fedml_tpu.arguments import load_arguments_from_dict  # noqa: E402

@@ -344,15 +344,17 @@ class RNSCKKSContext:
     def _from_rns_centered(self, r: np.ndarray) -> np.ndarray:
         """Residues [k, N] → centered representative of Z_Q, float64.
 
-        CRT: x = r1 + q1·((r2-r1)·q1⁻¹ mod q2); every intermediate
-        product stays below 2^61 so int64 is exact.
+        CRT: x = r1 + q1·((r2-r1)·q1⁻¹ mod q2). Every step INCLUDING the
+        reconstruction q1·t (< 2^61) and the centering subtraction is
+        done in exact int64 — converting to float64 before centering
+        would cost up to 2^7 of rounding per coefficient.
         """
         q1, q2 = self.primes
         inv_q1 = pow(q1 % q2, q2 - 2, q2)
         t = (r[1] - r[0]) % q2 * inv_q1 % q2
-        x = r[0].astype(np.float64) + float(q1) * t.astype(np.float64)
-        half = self.q / 2.0
-        return np.where(x > half, x - float(self.q), x)
+        x = r[0] + np.int64(q1) * t                 # exact, < 2^61
+        x = np.where(x > self.q // 2, x - np.int64(self.q), x)
+        return x.astype(np.float64)
 
     def _polymul_rns(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         return np.stack([p.mul(a[i], b[i])

@@ -498,24 +498,42 @@ def _decode_image(path: str, size: int) -> np.ndarray:
         return np.asarray(im, np.float32) / 255.0
 
 
-def _read_image_folder(split_dir: str, size: int, class_to_idx):
+def _read_image_folder(split_dir: str, size: int, class_to_idx,
+                       max_images: int = 0, seed: int = 0):
     """Read one split of the torchvision-style ImageFolder layout the
     reference's loader walks (``data/ImageNet/datasets.py:83-174``):
-    ``split_dir/<class_name>/<image>.<ext>``. Returns (x, y)."""
-    xs, ys = [], []
+    ``split_dir/<class_name>/<image>.<ext>``. Returns (x, y).
+
+    The file list is enumerated FIRST and (when ``max_images`` caps it)
+    subsampled before any decode: real ImageNet is 1.28M images — eager
+    full-tree decoding would need ~60 GB and hours, so large trees must
+    be capped via args.train_size/test_size (a loud log says when).
+    """
+    entries = []
     for cls in sorted(os.listdir(split_dir)):
         cdir = os.path.join(split_dir, cls)
         if not os.path.isdir(cdir) or cls not in class_to_idx:
             continue
         for fname in sorted(os.listdir(cdir)):
-            if not fname.lower().endswith(_IMG_EXTENSIONS):
-                continue
-            xs.append(_decode_image(os.path.join(cdir, fname), size))
-            ys.append(class_to_idx[cls])
-    if not xs:
+            if fname.lower().endswith(_IMG_EXTENSIONS):
+                entries.append((os.path.join(cdir, fname),
+                                class_to_idx[cls]))
+    if max_images and len(entries) > max_images:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "image folder %s: subsampling %d of %d images "
+            "(args.train_size/test_size cap)",
+            split_dir, max_images, len(entries))
+        rng = np.random.default_rng(seed)
+        keep = rng.choice(len(entries), size=max_images, replace=False)
+        entries = [entries[i] for i in sorted(keep)]
+    if not entries:
         return (np.zeros((0, size, size, 3), np.float32),
                 np.zeros(0, np.int32))
-    return np.stack(xs), np.asarray(ys, np.int32)
+    xs = np.stack([_decode_image(p, size) for p, _ in entries])
+    ys = np.asarray([label for _, label in entries], np.int32)
+    return xs, ys
 
 
 def _find_image_folder_root(cache: str, names) -> Optional[str]:
@@ -554,10 +572,15 @@ def load_imagenet(args: Any) -> FederatedDataset:
             d for d in os.listdir(train_dir)
             if os.path.isdir(os.path.join(train_dir, d)))
         class_to_idx = {c: i for i, c in enumerate(classes)}
-        xtr, ytr = _read_image_folder(train_dir, size, class_to_idx)
+        seed = int(getattr(args, "random_seed", 0))
+        cap_tr = int(getattr(args, "train_size", 0) or 0)
+        cap_te = int(getattr(args, "test_size", 0) or 0)
+        xtr, ytr = _read_image_folder(train_dir, size, class_to_idx,
+                                      max_images=cap_tr, seed=seed)
         val_dir = os.path.join(root, "val")
         if os.path.isdir(val_dir):
-            xte, yte = _read_image_folder(val_dir, size, class_to_idx)
+            xte, yte = _read_image_folder(val_dir, size, class_to_idx,
+                                          max_images=cap_te, seed=seed + 1)
         else:  # train-only trees: hold OUT every 10th image (not a copy —
             # evaluating on trained-on images would inflate accuracy)
             hold = np.zeros(len(ytr), bool)

@@ -47,6 +47,15 @@ class MPSimulator:
 
     def __init__(self, args: Any, device: Any, dataset: FederatedDataset,
                  model: Any, client_trainer=None, server_aggregator=None):
+        if client_trainer is not None:
+            # client ranks are fresh processes that rebuild their trainer
+            # from args (the reference's MPI ranks do the same) — a live
+            # trainer object cannot be shipped; refuse loudly rather
+            # than silently training with the default
+            raise ValueError(
+                "backend 'mp' cannot forward an in-process client_trainer "
+                "object to spawned ranks; configure the trainer via args "
+                "(registry name) or use backend 'sp'/'mesh'")
         self.args = args
         self.device = device
         self.dataset = dataset
@@ -89,13 +98,16 @@ class MPSimulator:
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (repo_root, env.get("PYTHONPATH")) if p)
+        # rank output goes to FILES, not pipes: an undrained pipe blocks a
+        # chatty rank at ~64KB mid-federation and deadlocks the round
+        logs = [open(os.path.join(tmp, f"rank{r}.log"), "w+")
+                for r in range(1, n_clients + 1)]
         procs = [
             subprocess.Popen(
                 [sys.executable, "-m", "fedml_tpu.simulation.mp_rank",
                  "--cf", cfg_path, "--rank", str(r), "--role", "client"],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, env=env)
-            for r in range(1, n_clients + 1)
+                stdout=log, stderr=subprocess.STDOUT, text=True, env=env)
+            for r, log in zip(range(1, n_clients + 1), logs)
         ]
         try:
             # the server runs in THIS process on the already-loaded
@@ -113,14 +125,18 @@ class MPSimulator:
                 server_args, self.device, self.dataset, self.model,
                 server_aggregator=self.server_aggregator,
             ).run()
-            for p in procs:
-                out, _ = p.communicate(timeout=120)
+            for p, log in zip(procs, logs):
+                p.wait(timeout=120)
                 if p.returncode != 0:
+                    log.flush()
+                    log.seek(0)
                     raise RuntimeError(
-                        f"mp client rank failed:\n{out[-2000:]}")
+                        f"mp client rank failed:\n{log.read()[-2000:]}")
             return result
         finally:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+            for log in logs:
+                log.close()
             broker.stop()

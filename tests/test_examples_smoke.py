@@ -73,6 +73,11 @@ def test_example_sp_fedavg_mnist_lr():
 
 
 @pytest.mark.slow
+def test_example_mp_fedavg_processes():
+    _run_example("federate/simulation/mp_fedavg_processes")
+
+
+@pytest.mark.slow
 def test_example_cross_silo_fedavg_multiprocess():
     _run_example("federate/cross_silo/fedavg_multiprocess")
 
@@ -105,6 +110,7 @@ def test_example_model_cards_failover():
 _ALL_SMOKED = {
     "federate/simulation/sp_fedavg_mnist_lr",
     "federate/simulation/mesh_fedavg_parallel",
+    "federate/simulation/mp_fedavg_processes",
     "federate/cross_silo/fedavg_multiprocess",
     "federate/cross_silo/secagg_multiprocess",
     "federate/cross_device/beehive",
