@@ -421,8 +421,11 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
               type=click.Choice(["int8", "int8_w8a8", "int8_dequant"]),
               help="int8 weights via the Pallas fused dequant-matmul: "
                    "halves HBM residency and speeds up decode 1.7x")
+@click.option("--hf-checkpoint", default=None,
+              help="HF Llama checkpoint dir/id to serve real weights "
+                   "(converted via models/llm/hf_convert.py)")
 def serve(model_size: str, host: str, port: int, batch_slots: int,
-          max_len: int, lora_rank: int, quantize) -> None:
+          max_len: int, lora_rank: int, quantize, hf_checkpoint) -> None:
     """Boot a continuous-batching LLM inference endpoint (blocking)."""
     import jax
     import jax.numpy as jnp
@@ -443,6 +446,17 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
     cfg = LlamaConfig.from_args(a)
     model = LlamaForCausalLM(cfg)
     params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    if hf_checkpoint:
+        from transformers import AutoModelForCausalLM
+
+        from fedml_tpu.models.llm.hf_convert import (
+            convert_hf_llama_state_dict,
+        )
+
+        click.echo(f"loading HF checkpoint {hf_checkpoint} ...")
+        hf = AutoModelForCausalLM.from_pretrained(hf_checkpoint)
+        params = convert_hf_llama_state_dict(hf.state_dict(), params)
+        del hf
     engine = ContinuousBatchingEngine(
         model, params, batch_slots=batch_slots, max_len=max_len,
         quantize=quantize,

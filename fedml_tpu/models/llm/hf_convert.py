@@ -1,0 +1,113 @@
+"""HuggingFace Llama checkpoint → fedml_tpu flax params.
+
+Parity target: the reference consumes Llama weights through HF
+`transformers` directly (``train/llm/configurations.py`` model loading;
+``spotlight_prj/fedllm`` targets ``meta-llama/Llama-2-7b-hf``). The TPU
+build has its own flax implementation, so real checkpoints enter through
+this converter: HF parameter names/layouts → the fedml_tpu tree, with
+every tensor's shape checked and every unconsumed HF key reported.
+
+Layout notes (verified by the logit-parity test):
+- HF ``nn.Linear`` stores [out, in]; flax Dense kernels are [in, out]
+  → transpose every projection;
+- both sides use the half-split RoPE ("rotate_half") with the same
+  frequency schedule, so q/k need NO permutation;
+- LoRA adapters are fedml_tpu-local (zero-initialized ``lora_b`` makes
+  them a no-op at load) and are left untouched.
+
+Usage:
+    params = model.init(key, tokens)                  # template tree
+    params = convert_hf_llama_state_dict(sd, params)  # sd: HF state_dict
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["convert_hf_llama_state_dict", "hf_key_map"]
+
+# HF buffers that are derived, not weights
+_IGNORABLE_SUFFIXES = (".rotary_emb.inv_freq",)
+
+
+def hf_key_map(num_layers: int) -> Dict[str, Tuple[str, bool]]:
+    """{fedml_flat_name: (hf_key, transpose)} for a Llama of given depth."""
+    m: Dict[str, Tuple[str, bool]] = {
+        "params/embed_tokens": ("model.embed_tokens.weight", False),
+        "params/final_norm/scale": ("model.norm.weight", False),
+        "params/lm_head": ("lm_head.weight", True),
+    }
+    for i in range(num_layers):
+        ours = f"params/layer_{i}"
+        hf = f"model.layers.{i}"
+        m[f"{ours}/input_norm/scale"] = (f"{hf}.input_layernorm.weight",
+                                         False)
+        m[f"{ours}/post_attn_norm/scale"] = (
+            f"{hf}.post_attention_layernorm.weight", False)
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            m[f"{ours}/attn/{proj}/kernel"] = (
+                f"{hf}.self_attn.{proj}.weight", True)
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            m[f"{ours}/mlp/{proj}/kernel"] = (
+                f"{hf}.mlp.{proj}.weight", True)
+    return m
+
+
+def _flat_name(path) -> str:
+    keys = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+    name = "/".join(keys)
+    # strip flax Partitioned metadata suffix (GetAttrKey('value'))
+    return name.removesuffix("/.value").removesuffix("/value")
+
+
+def convert_hf_llama_state_dict(state_dict: Dict[str, Any],
+                                params: Any) -> Any:
+    """Fill ``params`` (an initialized fedml_tpu Llama tree) from an HF
+    Llama ``state_dict``. Raises on shape mismatches, missing tensors,
+    and unconsumed HF keys (so a truncated/renamed checkpoint cannot
+    load silently)."""
+    sd = {k: np.asarray(v.detach().cpu().numpy()
+                        if hasattr(v, "detach") else v)
+          for k, v in state_dict.items()}
+    tied = "lm_head.weight" not in sd and "model.embed_tokens.weight" in sd
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    n_layers = sum(1 for p, _ in flat
+                   if _flat_name(p).endswith("input_norm/scale"))
+    keymap = hf_key_map(n_layers)
+
+    used = set()
+    out = []
+    for path, leaf in flat:
+        name = _flat_name(path)
+        if name not in keymap or "lora" in name:
+            out.append(leaf)
+            continue
+        hf_key, transpose = keymap[name]
+        if hf_key == "lm_head.weight" and tied:
+            hf_key = "model.embed_tokens.weight"  # tied embeddings
+        if hf_key not in sd:
+            raise KeyError(f"HF checkpoint is missing {hf_key!r} "
+                           f"(needed for {name})")
+        w = sd[hf_key]
+        if transpose:
+            w = w.T
+        if tuple(w.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"{name}: HF tensor {hf_key!r} has shape {w.shape}, "
+                f"model expects {tuple(leaf.shape)}")
+        used.add(hf_key)
+        out.append(np.asarray(w, dtype=np.asarray(leaf).dtype))
+    leftovers = [k for k in sd
+                 if k not in used and not k.endswith(_IGNORABLE_SUFFIXES)
+                 and not (tied and k == "model.embed_tokens.weight")]
+    # embeddings are legitimately read twice under tying
+    leftovers = [k for k in leftovers if k != "model.embed_tokens.weight"
+                 or "model.embed_tokens.weight" not in used]
+    if leftovers:
+        raise ValueError(
+            f"{len(leftovers)} HF tensors were not consumed "
+            f"(first few: {leftovers[:5]}) — config/depth mismatch?")
+    return jax.tree_util.tree_unflatten(treedef, out)
