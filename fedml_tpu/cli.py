@@ -424,8 +424,12 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
 @click.option("--hf-checkpoint", default=None,
               help="HF Llama checkpoint dir/id to serve real weights "
                    "(converted via models/llm/hf_convert.py)")
+@click.option("--checkpoint", default=None,
+              help="orbax round checkpoint (LLMTrainer.save_checkpoint) "
+                   "to serve — LoRA payloads merge onto the base")
 def serve(model_size: str, host: str, port: int, batch_slots: int,
-          max_len: int, lora_rank: int, quantize, hf_checkpoint) -> None:
+          max_len: int, lora_rank: int, quantize, hf_checkpoint,
+          checkpoint) -> None:
     """Boot a continuous-batching LLM inference endpoint (blocking)."""
     import jax
     import jax.numpy as jnp
@@ -457,6 +461,13 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
         hf = AutoModelForCausalLM.from_pretrained(hf_checkpoint)
         params = convert_hf_llama_state_dict(hf.state_dict(), params)
         del hf
+    if checkpoint:
+        from fedml_tpu.train.llm.sharding import unbox
+        from fedml_tpu.train.llm.trainer import restore_checkpoint_into
+
+        click.echo(f"loading round checkpoint {checkpoint} ...")
+        params = restore_checkpoint_into(unbox(params), checkpoint,
+                                         lora_only=bool(lora_rank))
     engine = ContinuousBatchingEngine(
         model, params, batch_slots=batch_slots, max_len=max_len,
         quantize=quantize,

@@ -323,14 +323,22 @@ class LLMTrainer:
         return path
 
     def load_checkpoint(self, path: str):
-        import orbax.checkpoint as ocp
-
-        ckptr = ocp.StandardCheckpointer()
-        if self.lora_only:
-            template = jax.tree.map(np.asarray, extract_lora(self.params))
-            restored = ckptr.restore(os.path.abspath(path), template)
-            self.params = merge_lora(self.params, restored)
-        else:
-            template = jax.tree.map(np.asarray, self.params)
-            self.params = ckptr.restore(os.path.abspath(path), template)
+        self.params = restore_checkpoint_into(
+            self.params, path, lora_only=self.lora_only)
         return self.params
+
+
+def restore_checkpoint_into(params: Pytree, path: str,
+                            lora_only: bool) -> Pytree:
+    """Restore a round checkpoint (``save_checkpoint`` format) into a
+    params tree — LoRA-only payloads merge into the given base; full
+    payloads replace it. Also the serving path (`serve --checkpoint`)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if lora_only:
+        template = jax.tree.map(np.asarray, extract_lora(params))
+        restored = ckptr.restore(os.path.abspath(path), template)
+        return merge_lora(params, restored)
+    template = jax.tree.map(np.asarray, params)
+    return ckptr.restore(os.path.abspath(path), template)

@@ -136,6 +136,22 @@ def test_llm_checkpoint_roundtrip(tmp_path):
     for k, v in now.items():
         assert np.allclose(saved[k], np.asarray(v))
 
+    # fine-tune -> serve loop: a FRESH serving-style params tree (the
+    # `serve --checkpoint` path) picks up the trained adapters
+    import jax
+
+    from fedml_tpu.models.llm.llama import LlamaForCausalLM
+    from fedml_tpu.train.llm.sharding import unbox
+    from fedml_tpu.train.llm.trainer import restore_checkpoint_into
+
+    import jax.numpy as jnp
+
+    fresh = unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(7), jnp.zeros((1, 8), jnp.int32)))
+    served = restore_checkpoint_into(fresh, path, lora_only=True)
+    for k, v in extract_lora(served).items():
+        assert np.allclose(saved[k], np.asarray(v))
+
 
 @pytest.mark.slow
 def test_fedllm_rounds_improve():
