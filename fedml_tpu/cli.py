@@ -458,7 +458,11 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
         )
 
         click.echo(f"loading HF checkpoint {hf_checkpoint} ...")
-        hf = AutoModelForCausalLM.from_pretrained(hf_checkpoint)
+        # torch_dtype="auto" keeps the checkpoint's own dtype: loading a
+        # 7B in default fp32 would double host RAM for nothing (the
+        # converter casts to the model's param_dtype anyway)
+        hf = AutoModelForCausalLM.from_pretrained(hf_checkpoint,
+                                                  torch_dtype="auto")
         params = convert_hf_llama_state_dict(hf.state_dict(), params)
         del hf
     if checkpoint:

@@ -68,9 +68,17 @@ def convert_hf_llama_state_dict(state_dict: Dict[str, Any],
     Llama ``state_dict``. Raises on shape mismatches, missing tensors,
     and unconsumed HF keys (so a truncated/renamed checkpoint cannot
     load silently)."""
-    sd = {k: np.asarray(v.detach().cpu().numpy()
-                        if hasattr(v, "detach") else v)
-          for k, v in state_dict.items()}
+    def _to_np(v):
+        if hasattr(v, "detach"):
+            v = v.detach().cpu()
+            # torch bf16 tensors reject .numpy(); fp32 round-trip is
+            # exact for them (bf16 ⊂ fp32)
+            if str(v.dtype) == "torch.bfloat16":
+                v = v.float()
+            return v.numpy()
+        return np.asarray(v)
+
+    sd = {k: _to_np(v) for k, v in state_dict.items()}
     tied = "lm_head.weight" not in sd and "model.embed_tokens.weight" in sd
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
@@ -103,9 +111,6 @@ def convert_hf_llama_state_dict(state_dict: Dict[str, Any],
     leftovers = [k for k in sd
                  if k not in used and not k.endswith(_IGNORABLE_SUFFIXES)
                  and not (tied and k == "model.embed_tokens.weight")]
-    # embeddings are legitimately read twice under tying
-    leftovers = [k for k in leftovers if k != "model.embed_tokens.weight"
-                 or "model.embed_tokens.weight" not in used]
     if leftovers:
         raise ValueError(
             f"{len(leftovers)} HF tensors were not consumed "

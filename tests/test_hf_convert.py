@@ -92,3 +92,15 @@ def test_hf_convert_handles_tied_embeddings():
         want = hf(input_ids=torch.tensor(x)).logits.numpy()
     got = np.asarray(model.apply(params, jnp.asarray(x)))
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
+def test_hf_convert_accepts_bf16_checkpoint():
+    """bf16 torch tensors reject .numpy() — the converter must route
+    them through fp32 (exact, bf16 is a subset)."""
+    hf = _hf_model().to(torch.bfloat16)
+    model, params = _ours()
+    params = convert_hf_llama_state_dict(hf.state_dict(), params)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, VOCAB, (1, 8))
+    got = np.asarray(model.apply(params, jnp.asarray(x)))
+    assert np.isfinite(got).all()
