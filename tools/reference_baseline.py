@@ -136,7 +136,8 @@ def _reference_args(rounds, *, n_clients, per_round, epochs, batch, lr,
     )
 
 
-def _run_reference_fedavg(args, model_fn, data, label, to_input=None):
+def _run_reference_fedavg(args, model_fn, data, label, to_input=None,
+                          classes=CLASSES):
     """Shared reference-side scaffold: loaders → FedAvgAPI → timing → acc."""
     import torch
     from torch.utils.data import DataLoader, TensorDataset
@@ -157,7 +158,7 @@ def _run_reference_fedavg(args, model_fn, data, label, to_input=None):
     test_local = {i: loader(xt[tidx[i]], yt[tidx[i]]) for i in range(n_clients)}
     nums = {i: len(idx[i]) for i in range(n_clients)}
     dataset = [len(xs), len(xt), loader(xs, ys), loader(xt, yt),
-               nums, train_local, test_local, CLASSES]
+               nums, train_local, test_local, classes]
 
     torch.manual_seed(0)  # seed BEFORE construction so init is seeded
     api = FedAvgAPI(args, torch.device("cpu"), dataset, model_fn())
@@ -191,7 +192,8 @@ def run_reference(rounds: int):
 # --------------------------------------------------------------------------
 
 def _run_ours_fedavg(rounds, platform, data, data_args, model_name, label,
-                     *, n_clients, per_round, epochs, batch, lr):
+                     *, n_clients, per_round, epochs, batch, lr,
+                     classes=CLASSES):
     """Shared fedml_tpu-side scaffold: dataset -> FedAvgAPI -> timing -> acc."""
     sys.path.insert(0, "/root/repo")
     import jax
@@ -214,7 +216,7 @@ def _run_ours_fedavg(rounds, platform, data, data_args, model_name, label,
                                for i in range(n_clients)},
         test_data_local_dict={i: (xt[tidx[i]], yt[tidx[i]])
                               for i in range(n_clients)},
-        class_num=CLASSES,
+        class_num=classes,
     )
     args = fedml_tpu.init(load_arguments_from_dict({
         "common_args": {"training_type": "simulation", "random_seed": 0},
@@ -229,7 +231,8 @@ def _run_ours_fedavg(rounds, platform, data, data_args, model_name, label,
                        # the end, not every round
                        "frequency_of_the_test": 1000},
     }))
-    model = models_mod.create(args, output_dim=CLASSES)
+    model = (model_name(args) if callable(model_name)
+             else models_mod.create(args, output_dim=classes))
     api = FedAvgAPI(args, None, ds, model)
     t0 = time.perf_counter()
     res = api.train()
@@ -291,18 +294,94 @@ def run_ours_cnn(rounds: int, platform: str = ""):
         epochs=CNN_EPOCHS, batch=CNN_BATCH, lr=CNN_LR)
 
 
+# --------------------------------------------------------------------------
+# config #3 flavor: Shakespeare-style LSTM next-character prediction —
+# both frameworks' own McMahan-RNN (Embed(8) → LSTM(256)×2 → Dense(vocab),
+# final-position classification head) on identical synthetic char streams
+# --------------------------------------------------------------------------
+
+RNN_TRAIN, RNN_TEST, RNN_CLIENTS, RNN_BATCH, RNN_LR, RNN_EPOCHS = (
+    600, 150, 4, 32, 0.5, 1)
+RNN_SEQ, RNN_VOCAB = 20, 90
+
+
+def make_char_data(seed: int = 2):
+    """Markov-chain character streams: next-char is genuinely learnable
+    (each char has 3 likely successors), not memorizable noise."""
+    rng = np.random.default_rng(seed)
+    succ = rng.integers(0, RNN_VOCAB, size=(RNN_VOCAB, 3))
+    n = RNN_TRAIN + RNN_TEST
+    x = np.zeros((n, RNN_SEQ), np.int64)
+    y = np.zeros((n,), np.int64)
+    state = rng.integers(0, RNN_VOCAB, size=n)
+    for t in range(RNN_SEQ + 1):
+        choice = succ[state, rng.integers(0, 3, size=n)]
+        # 10% uniform noise keeps the chain ergodic
+        noise = rng.integers(0, RNN_VOCAB, size=n)
+        nxt = np.where(rng.random(n) < 0.1, noise, choice)
+        if t < RNN_SEQ:
+            x[:, t] = state
+        else:
+            y = state
+        state = nxt
+    xs, ys, xt, yt = x[:RNN_TRAIN], y[:RNN_TRAIN], x[RNN_TRAIN:], y[RNN_TRAIN:]
+    idx = np.array_split(np.arange(RNN_TRAIN), RNN_CLIENTS)
+    tidx = np.array_split(np.arange(RNN_TEST), RNN_CLIENTS)
+    return xs, ys, xt, yt, idx, tidx
+
+
+def run_reference_rnn(rounds: int):
+    _setup_reference()
+    from fedml.model.nlp.rnn import RNN_OriginalFedAvg
+
+    args = _reference_args(rounds, n_clients=RNN_CLIENTS,
+                           per_round=RNN_CLIENTS, epochs=RNN_EPOCHS,
+                           batch=RNN_BATCH, lr=RNN_LR, model="rnn")
+    return _run_reference_fedavg(
+        args, lambda: RNN_OriginalFedAvg(vocab_size=RNN_VOCAB),
+        make_char_data(), "reference shakespeare-LSTM (torch, CPU)",
+        classes=RNN_VOCAB)
+
+
+def run_ours_rnn(rounds: int, platform: str = ""):
+    def final_char_rnn(args):
+        # our zoo RNN emits per-position LM logits (the fed_shakespeare
+        # objective); the reference model here classifies the FINAL
+        # position only — wrap for identical work
+        import flax.linen as nn
+
+        from fedml_tpu.models.nlp.rnn import RNNOriginalFedAvg
+
+        class FinalCharRNN(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                logits = RNNOriginalFedAvg(vocab_size=RNN_VOCAB)(x)
+                return logits[:, -1] if logits.ndim == 3 else logits
+
+        return FinalCharRNN()
+
+    return _run_ours_fedavg(
+        rounds, platform, make_char_data(),
+        {"dataset": "shakespeare", "seq_len": RNN_SEQ}, final_char_rnn,
+        "fedml_tpu shakespeare-LSTM", n_clients=RNN_CLIENTS,
+        per_round=RNN_CLIENTS, epochs=RNN_EPOCHS, batch=RNN_BATCH,
+        lr=RNN_LR, classes=RNN_VOCAB)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
-    ap.add_argument("--config", choices=["lr", "cnn"], default="lr")
+    ap.add_argument("--config", choices=["lr", "cnn", "rnn"], default="lr")
     ap.add_argument("--side", choices=["reference", "ours", "both"],
                     default="both")
     ap.add_argument("--platform", default="cpu",
                     help="jax platform for the fedml_tpu side (cpu|tpu); "
                          "cpu by default so the CPU-vs-CPU table reproduces")
     args = ap.parse_args()
-    ref_fn = run_reference if args.config == "lr" else run_reference_cnn
-    ours_fn = run_ours if args.config == "lr" else run_ours_cnn
+    ref_fn = {"lr": run_reference, "cnn": run_reference_cnn,
+              "rnn": run_reference_rnn}[args.config]
+    ours_fn = {"lr": run_ours, "cnn": run_ours_cnn,
+               "rnn": run_ours_rnn}[args.config]
     results = []
     if args.side in ("reference", "both"):
         results.append(ref_fn(args.rounds))
