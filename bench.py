@@ -308,7 +308,11 @@ def main() -> None:
         float(sum(jnp.sum(v.astype(jnp.float32)) for v in jax.tree.leaves(global_lora)))
         return time.perf_counter() - t0
 
-    round_sec = chain_time(round_chain, 1, 3)
+    # the round chain interleaves 16 device steps with host-side tree
+    # work (LoRA merge/extract per client) — on this 1-core host the
+    # python share is variance-prone, so average over more rounds and
+    # keep the best of 3 trials
+    round_sec = chain_time(round_chain, 1, 5, trials=3)
     rounds_per_sec_per_chip = 1.0 / round_sec / n_chips
     round_tokens = n_clients * local_steps * batch * seq
 
