@@ -124,47 +124,73 @@ def model_deploy(name: str, broker: str, n_replicas: int = 1,
         master.shutdown()
 
 
-# -- storage (object store) --------------------------------------------------
+# -- storage (artifact catalog over the object-store seam) -------------------
+# Reference surface: api/__init__.py:181-204 upload/download/
+# list_storage_obects/get_storage_metadata/delete over the hosted R2
+# service; here the backend is selectable (local CAS default, s3/web3/
+# theta) via fedml_tpu.storage.StorageManager.
+
+def _storage_manager(service: str, store_dir: Optional[str], backend_kw):
+    from fedml_tpu.storage import StorageManager
+
+    kw = dict(backend_kw)
+    index_dir = kw.pop("index_dir", None)
+    if store_dir is not None:  # one-dir convenience: bytes + index together
+        if service == "local":
+            kw.setdefault("root", os.path.join(store_dir, "cas"))
+        index_dir = index_dir or os.path.join(store_dir, "index")
+    return StorageManager(service, index_dir=index_dir, **kw)
+
 
 def upload(data_path: str, name: Optional[str] = None,
-           store_dir: Optional[str] = None) -> str:
-    """Store a local file; returns its key (reference ``api.upload``)."""
-    from fedml_tpu.core.distributed.communication.object_store import (
-        LocalDirObjectStore,
-    )
-
-    store = LocalDirObjectStore(store_dir)
-    key = f"storage/{name or os.path.basename(data_path)}"
-    with open(data_path, "rb") as f:
-        store.put_object(key, f.read())
-    return key
+           description: str = "", metadata: Optional[Dict] = None,
+           service: str = "local", store_dir: Optional[str] = None,
+           **backend_kw):
+    """Store a file or directory as a named artifact; returns its
+    :class:`~fedml_tpu.storage.StorageMetadata`."""
+    return _storage_manager(service, store_dir, backend_kw).upload(
+        data_path, name=name, description=description, metadata=metadata)
 
 
-def download(key: str, dest_path: str,
-             store_dir: Optional[str] = None) -> str:
-    from fedml_tpu.core.distributed.communication.object_store import (
-        LocalDirObjectStore,
-    )
-
-    store = LocalDirObjectStore(store_dir)
-    with open(dest_path, "wb") as f:
-        f.write(store.get_object(key))
-    return dest_path
+def download(name: str, dest_path: Optional[str] = None,
+             service: str = "local", store_dir: Optional[str] = None,
+             **backend_kw) -> str:
+    """Fetch artifact ``name``; returns the written path."""
+    return _storage_manager(service, store_dir, backend_kw).download(
+        name, dest=dest_path)
 
 
-def delete(key: str, store_dir: Optional[str] = None) -> None:
-    from fedml_tpu.core.distributed.communication.object_store import (
-        LocalDirObjectStore,
-    )
+def delete(name: str, service: str = "local",
+           store_dir: Optional[str] = None, **backend_kw) -> bool:
+    return _storage_manager(service, store_dir, backend_kw).delete(name)
 
-    LocalDirObjectStore(store_dir).delete_object(key)
+
+def list_storage_objects(service: str = "local",
+                         store_dir: Optional[str] = None, **backend_kw):
+    return _storage_manager(service, store_dir, backend_kw).list()
+
+
+def get_storage_metadata(name: str, service: str = "local",
+                         store_dir: Optional[str] = None, **backend_kw):
+    return _storage_manager(service, store_dir, backend_kw).get_metadata(name)
+
+
+def get_storage_user_defined_metadata(
+        name: str, service: str = "local",
+        store_dir: Optional[str] = None, **backend_kw) -> Optional[Dict]:
+    return get_storage_metadata(
+        name, service=service, store_dir=store_dir,
+        **backend_kw).user_metadata
 
 
 __all__ = [
     "delete",
     "download",
+    "get_storage_metadata",
+    "get_storage_user_defined_metadata",
     "launch_job",
     "launch_job_on_cluster",
+    "list_storage_objects",
     "model_create",
     "model_delete",
     "model_deploy",

@@ -14,6 +14,7 @@ here; the local equivalents are:
   fedml_tpu env                  # environment / accelerator report
   fedml_tpu version
   fedml_tpu serve --model tiny   # boot an LLM inference endpoint
+  fedml_tpu storage upload/download/list/metadata/delete  # artifacts
 
 Invoke as `python -m fedml_tpu.cli ...` (console-script packaging comes
 with the wheel build).
@@ -474,7 +475,9 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
                                          lora_only=bool(lora_rank))
     engine = ContinuousBatchingEngine(
         model, params, batch_slots=batch_slots, max_len=max_len,
-        quantize=quantize,
+        # donate: the bf16 source is dead after quantization, and a 7B
+        # cannot hold both copies in HBM while the int8 twin is built
+        quantize=quantize, quantize_donate=True,
     )
     from fedml_tpu.serving.openai_protocol import OpenAIServing
 
@@ -485,6 +488,86 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
     click.echo(f"serving {model_size} on http://{host}:{runner.port} "
                f"(/predict + /v1/completions + /v1/chat/completions)")
     runner.run()
+
+
+@cli.group()
+def storage() -> None:
+    """Manage stored artifacts (reference: `fedml storage`,
+    ``cli/modules/storage.py`` — upload/download/list/delete over R2;
+    here over the local CAS / s3 / web3 / theta object stores)."""
+
+
+_SERVICE_OPT = click.option(
+    "--service", "-s", default="local", show_default=True,
+    type=click.Choice(["local", "s3", "web3", "theta"]),
+    help="object-store backend (non-local ones read FEDML_* env config)")
+
+
+@storage.command("upload")
+@click.argument("data_path")
+@click.option("--name", "-n", default=None,
+              help="artifact name (default: file/dir basename)")
+@click.option("--description", "-d", default="", help="free-text description")
+@click.option("--user-metadata", "-um", default=None,
+              help="JSON object of user metadata")
+@_SERVICE_OPT
+def storage_upload(data_path: str, name, description: str,
+                   user_metadata, service: str) -> None:
+    from fedml_tpu import api
+
+    meta = json.loads(user_metadata) if user_metadata else None
+    m = api.upload(data_path, name=name, description=description,
+                   metadata=meta, service=service)
+    click.echo(f"uploaded {m.name!r}: {m.size_bytes} bytes -> "
+               f"{service}:{m.handle}")
+
+
+@storage.command("download")
+@click.argument("name")
+@click.option("--dest", "-o", default=None,
+              help="output path (default: ./<name>)")
+@_SERVICE_OPT
+def storage_download(name: str, dest, service: str) -> None:
+    from fedml_tpu import api
+
+    click.echo(api.download(name, dest_path=dest, service=service))
+
+
+@storage.command("list")
+@_SERVICE_OPT
+def storage_list(service: str) -> None:
+    from fedml_tpu import api
+
+    rows = api.list_storage_objects(service=service)
+    if not rows:
+        click.echo("no stored artifacts")
+        return
+    for m in rows:
+        click.echo(f"{m.name}\t{m.size_bytes}B\t{'dir' if m.is_dir else 'file'}"
+                   f"\tcreated {m.created_at}\tupdated {m.updated_at}"
+                   f"\t{m.description}")
+
+
+@storage.command("metadata")
+@click.argument("name")
+@_SERVICE_OPT
+def storage_metadata(name: str, service: str) -> None:
+    from fedml_tpu import api
+
+    click.echo(json.dumps(
+        api.get_storage_metadata(name, service=service).to_dict(), indent=1))
+
+
+@storage.command("delete")
+@click.argument("name")
+@_SERVICE_OPT
+def storage_delete(name: str, service: str) -> None:
+    from fedml_tpu import api
+
+    ok = api.delete(name, service=service)
+    click.echo(f"deleted {name!r}" if ok else f"no artifact named {name!r}")
+    if not ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

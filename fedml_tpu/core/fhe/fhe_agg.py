@@ -51,17 +51,24 @@ class FedMLFHE:
             return
         from fedml_tpu.core.fhe.ckks import CKKSContext, RNSCKKSContext
 
-        seed = int(getattr(args, "fhe_key_seed",
-                           getattr(args, "random_seed", 0))) + 40487
+        explicit_seed = getattr(args, "fhe_key_seed", None)
+        seed = (int(explicit_seed) if explicit_seed is not None
+                else int(getattr(args, "random_seed", 0))) + 40487
         profile = str(getattr(args, "fhe_profile", "demo")).lower()
         degree = int(getattr(args, "fhe_poly_degree", 0) or 0)
         if profile == "secure" or degree >= 4096:
             # RNS-CKKS at N≥8192: NTT arithmetic, two ~30-bit primes —
-            # inside the HE-standard security envelope for this N
+            # inside the HE-standard security envelope for this N.
+            # Keys come from OS entropy UNLESS fhe_key_seed is explicitly
+            # set: deriving sk from the shared run config would let the
+            # aggregator regenerate it and decrypt client updates, voiding
+            # the lattice security (ADVICE r4). Multi-process deployments
+            # that need every party to hold the same context must
+            # distribute a key seed out of band (docs/trust_stack.md).
             self.ctx = RNSCKKSContext(
                 n=degree or 8192,
                 delta=int(getattr(args, "fhe_scale", 1 << 40)),
-                seed=seed,
+                seed=seed if explicit_seed is not None else None,
             ).keygen()
             logging.info("FHE enabled: RNS-CKKS n=%d primes=%s logQ=%d",
                          self.ctx.n, self.ctx.primes,

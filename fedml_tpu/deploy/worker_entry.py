@@ -44,6 +44,7 @@ def build_predictor(package_dir: str):
             batch_slots=int(params.get("batch_slots", 4)),
             max_len=int(params.get("max_len", 512)),
             quantize=params.get("quantize"),
+            quantize_donate=True,  # freshly-initialized weights, no other user
         )
         return LlamaPredictor(engine)
     if builtin is not None:

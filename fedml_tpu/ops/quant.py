@@ -39,8 +39,9 @@ class QuantizedTensor:
       * ``"w8a8"``    — dynamic per-row activation quant + int8×int8 dot
         accumulated in int32 (``preferred_element_type``), MXU-native;
       * ``"pallas"``  — fused dequant-matmul kernel: weight tiles DMA'd
-        from HBM as int8 and converted in-VMEM (exact math, half the
-        weight bandwidth — the decode-latency path).
+        from HBM as int8 and converted in-VMEM (half the weight
+        bandwidth — the decode-latency path). bf16-activation-only:
+        exact for bf16 compute; fp32 requests fall back to "dequant".
     """
 
     def __init__(self, data, scale, mode: str = "dequant"):
@@ -199,7 +200,11 @@ def pallas_dequant_matmul(x, q, scale, dtype):
     # (few rows). Prefill (rows ≫ 128) is MXU-bound — the weights
     # amortize over the rows, the x block would blow the VMEM budget
     # (rows × bh bf16), and XLA's dequant costs proportionally little.
-    if bh == 0 or rows > 128 or pltpu is None:
+    # The kernel's MXU dot runs on bf16 operands, so it is exact only for
+    # bf16 compute — fp32 requests take the XLA dequant lowering instead
+    # of silently truncating activations (ADVICE r4).
+    if (bh == 0 or rows > 128 or pltpu is None
+            or jnp.dtype(dtype) != jnp.dtype(jnp.bfloat16)):
         return (x.reshape(*lead, h) @ q.astype(dtype)) * scale.astype(dtype)
     x2 = x.reshape(-1, h).astype(jnp.bfloat16)
     b = x2.shape[0]

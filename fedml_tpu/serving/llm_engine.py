@@ -48,11 +48,11 @@ class _Slot:
 class ContinuousBatchingEngine:
     """Schedules generation requests onto a fixed slot pool.
 
-    With ``quantize`` set (and the default ``quantize_donate=True``),
-    the passed ``params`` tree is CONSUMED — its device buffers are
-    freed as the int8 twins are built, so a 7B quantizes within a 16 GB
-    chip. Do not use it after constructing the engine; read
-    ``engine.params`` instead, or pass ``quantize_donate=False``.
+    With ``quantize`` set, pass ``quantize_donate=True`` to CONSUME the
+    given ``params`` tree — its device buffers are freed as the int8
+    twins are built, which is the only way a 7B quantizes within a 16 GB
+    chip (the serve CLI and deploy worker do this). Donation is opt-in
+    (ADVICE r4): by default the caller's tree stays valid.
     """
 
     def __init__(
@@ -64,7 +64,7 @@ class ContinuousBatchingEngine:
         min_prompt_bucket: int = 16,
         eos_id: Optional[int] = None,
         quantize: Optional[str] = None,
-        quantize_donate: bool = True,
+        quantize_donate: bool = False,
     ):
         self.model = model
         if quantize in ("int8", "int8_w8a8", "w8a8", "int8_pallas", "pallas",
@@ -81,10 +81,9 @@ class ContinuousBatchingEngine:
                 mode = "dequant"
             else:
                 mode = "pallas"
-            # donate (default): at 7B the bf16 source (13.5 GB) and the
-            # int8 twin cannot be resident together — the caller's params
-            # tree is consumed (class docstring); pass
-            # quantize_donate=False to keep the source alive (A/B runs)
+            # donate: at 7B the bf16 source (13.5 GB) and the int8 twin
+            # cannot be resident together — opt in to consume the
+            # caller's params tree (class docstring)
             params = quantize_params_int8(params, mode=mode,
                                           donate=quantize_donate)
         elif quantize is not None:

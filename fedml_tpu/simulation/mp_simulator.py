@@ -56,6 +56,23 @@ class MPSimulator:
                 "backend 'mp' cannot forward an in-process client_trainer "
                 "object to spawned ranks; configure the trainer via args "
                 "(registry name) or use backend 'sp'/'mesh'")
+        if dataset is not None:
+            # spawned client ranks REBUILD their data from args.dataset via
+            # the registry — an in-memory dataset object only the in-process
+            # server sees (the reference_baseline pattern) would train
+            # clients on different data than the server evaluates. Mirror
+            # the client_trainer refusal with a loud warning (ADVICE r4).
+            from fedml_tpu.data.data_loader import _LOADERS
+
+            name = str(getattr(args, "dataset", "")).lower()
+            if name not in _LOADERS:
+                logger.warning(
+                    "backend 'mp': the passed in-memory dataset is NOT "
+                    "reproducible from args (dataset=%r is not a registered "
+                    "name) — spawned client ranks will fall back to "
+                    "synthetic data while the server evaluates on the "
+                    "passed dataset; configure a registry dataset name or "
+                    "use backend 'sp'/'mesh'", name or None)
         self.args = args
         self.device = device
         self.dataset = dataset

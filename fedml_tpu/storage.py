@@ -1,0 +1,251 @@
+"""Artifact storage — the ``fedml storage`` surface, TPU-repo edition.
+
+Parity target: ``python/fedml/cli/modules/storage.py`` +
+``python/fedml/api/__init__.py:181-204`` — upload / download / list /
+delete / get-metadata of named data artifacts. The reference routes
+these through the hosted Nexus backend (R2 object storage + a cloud
+metadata DB); here the same verbs run over the in-tree object-store
+seam with no hosted service:
+
+- ``local`` (default) — content-addressed store on disk
+  (:class:`LocalCASObjectStore`), root at ``$FEDML_TPU_STORAGE_DIR`` or
+  ``~/.fedml_tpu/storage``;
+- ``s3`` — real S3 REST + SigV4 (:class:`S3ObjectStore`), endpoint and
+  credentials from env/kwargs;
+- ``web3`` / ``theta`` — decentralized pinning services
+  (:class:`Web3ObjectStore` / :class:`ThetaObjectStore`).
+
+The name→handle index the reference keeps in its cloud DB lives in a
+local JSON file per service (``<root>/index/<service>.json``): object
+*bytes* go to the selected backend, the *catalog* stays with the user.
+Directories are uploaded as tar.gz archives and unpacked on download.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import tarfile
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["StorageMetadata", "StorageManager"]
+
+
+def _default_root() -> str:
+    return os.environ.get(
+        "FEDML_TPU_STORAGE_DIR",
+        os.path.join(os.path.expanduser("~"), ".fedml_tpu", "storage"),
+    )
+
+
+@dataclasses.dataclass
+class StorageMetadata:
+    """One stored artifact (reference: ``StorageMetadata`` rows shown by
+    ``fedml storage list``: dataName/description/createdAt/updatedAt)."""
+
+    name: str
+    handle: str                 # backend handle: CID (CAS) or object key
+    service: str
+    size_bytes: int
+    sha256: str
+    is_dir: bool
+    created_at: str
+    updated_at: str
+    description: str = ""
+    user_metadata: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StorageMetadata":
+        return cls(**{f.name: d.get(f.name) for f in dataclasses.fields(cls)})
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime())
+
+
+def _make_store(service: str, **kw):
+    service = (service or "local").lower()
+    if service == "local":
+        from fedml_tpu.core.distributed.communication.decentralized_storage import (
+            LocalCASObjectStore,
+        )
+
+        return LocalCASObjectStore(
+            root=kw.get("root") or os.path.join(_default_root(), "cas"),
+            secret_key=kw.get("secret_key"),
+        )
+    if service == "s3":
+        from fedml_tpu.core.distributed.communication.s3_store import S3ObjectStore
+
+        missing = [k for k in ("endpoint", "bucket")
+                   if not (kw.get(k) or os.environ.get(f"FEDML_S3_{k.upper()}"))]
+        if missing:
+            raise ValueError(
+                f"s3 storage needs {missing} (kwargs or FEDML_S3_* env); "
+                "credentials come from AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY")
+        return S3ObjectStore(
+            endpoint=kw.get("endpoint") or os.environ["FEDML_S3_ENDPOINT"],
+            bucket=kw.get("bucket") or os.environ["FEDML_S3_BUCKET"],
+            region=kw.get("region") or os.environ.get("FEDML_S3_REGION",
+                                                      "us-east-1"),
+            access_key=kw.get("access_key"),
+            # for s3, secret_key is the AWS secret (this backend does no
+            # payload sealing); falls back to AWS_SECRET_ACCESS_KEY env
+            secret_key=kw.get("secret_key"),
+        )
+    if service == "web3":
+        from fedml_tpu.core.distributed.communication.decentralized_storage import (
+            Web3ObjectStore,
+        )
+
+        return Web3ObjectStore(
+            upload_uri=kw.get("upload_uri") or os.environ["FEDML_WEB3_UPLOAD_URI"],
+            download_uri=kw.get("download_uri")
+            or os.environ["FEDML_WEB3_DOWNLOAD_URI"],
+            api_token=kw.get("api_token") or os.environ.get("FEDML_WEB3_TOKEN"),
+            secret_key=kw.get("secret_key"),
+        )
+    if service == "theta":
+        from fedml_tpu.core.distributed.communication.decentralized_storage import (
+            ThetaObjectStore,
+        )
+
+        return ThetaObjectStore(
+            rpc_uri=kw.get("rpc_uri") or os.environ["FEDML_THETA_RPC_URI"],
+            secret_key=kw.get("secret_key"),
+        )
+    raise ValueError(f"unknown storage service {service!r} "
+                     "(expected local|s3|web3|theta)")
+
+
+class StorageManager:
+    """Named-artifact catalog over a pluggable object store."""
+
+    def __init__(self, service: str = "local",
+                 index_dir: Optional[str] = None, **backend_kw):
+        self.service = (service or "local").lower()
+        if self.service not in ("local", "s3", "web3", "theta"):
+            raise ValueError(f"unknown storage service {self.service!r} "
+                             "(expected local|s3|web3|theta)")
+        self._backend_kw = backend_kw
+        self._store = None
+        self._index_path = os.path.join(
+            index_dir or os.path.join(_default_root(), "index"),
+            f"{self.service}.json",
+        )
+
+    @property
+    def store(self):
+        """Backend built lazily: list/metadata only read the local index
+        and must work without s3/web3/theta env config."""
+        if self._store is None:
+            self._store = _make_store(self.service, **self._backend_kw)
+        return self._store
+
+    # -- index persistence -------------------------------------------------
+    def _load_index(self) -> Dict[str, Dict]:
+        try:
+            with open(self._index_path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _save_index(self, idx: Dict[str, Dict]) -> None:
+        os.makedirs(os.path.dirname(self._index_path), exist_ok=True)
+        tmp = self._index_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(idx, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._index_path)
+
+    # -- verbs -------------------------------------------------------------
+    def upload(self, data_path: str, name: Optional[str] = None,
+               description: str = "",
+               metadata: Optional[Dict[str, Any]] = None) -> StorageMetadata:
+        """Store a file or directory under ``name`` (defaults to its
+        basename). Directories ship as in-memory tar.gz archives."""
+        data_path = os.path.expanduser(data_path)
+        if not os.path.exists(data_path):
+            raise FileNotFoundError(data_path)
+        name = name or os.path.basename(os.path.normpath(data_path))
+        is_dir = os.path.isdir(data_path)
+        if is_dir:
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                tar.add(data_path, arcname=".")
+            data = buf.getvalue()
+        else:
+            with open(data_path, "rb") as f:
+                data = f.read()
+        handle = self.store.put_object(f"storage/{name}", data)
+        idx = self._load_index()
+        prev = idx.get(name)
+        meta = StorageMetadata(
+            name=name, handle=handle, service=self.service,
+            size_bytes=len(data), sha256=hashlib.sha256(data).hexdigest(),
+            is_dir=is_dir,
+            created_at=prev["created_at"] if prev else _now(),
+            updated_at=_now(), description=description,
+            user_metadata=metadata,
+        )
+        idx[name] = meta.to_dict()
+        self._save_index(idx)
+        if prev and prev["handle"] != handle:
+            # don't leak the superseded blob (content-addressed stores give
+            # new content a new handle) — unless another entry shares it
+            self._unpin_if_unreferenced(idx, prev["handle"])
+        return meta
+
+    def _unpin_if_unreferenced(self, idx: Dict[str, Dict],
+                               handle: str) -> None:
+        if any(e["handle"] == handle for e in idx.values()):
+            return  # CAS dedup: identical content shares one blob
+        try:
+            self.store.delete_object(handle)
+        except Exception:  # unpin is best-effort on pinning services
+            pass
+
+    def get_metadata(self, name: str) -> StorageMetadata:
+        idx = self._load_index()
+        if name not in idx:
+            raise KeyError(f"no stored artifact named {name!r}")
+        return StorageMetadata.from_dict(idx[name])
+
+    def list(self) -> List[StorageMetadata]:
+        return [StorageMetadata.from_dict(d)
+                for _, d in sorted(self._load_index().items())]
+
+    def download(self, name: str, dest: Optional[str] = None) -> str:
+        """Fetch an artifact to ``dest`` (default: ./<name>); returns the
+        written path. Integrity-checked against the recorded sha256."""
+        meta = self.get_metadata(name)
+        data = self.store.get_object(meta.handle)
+        if hashlib.sha256(data).hexdigest() != meta.sha256:
+            raise IOError(
+                f"artifact {name!r}: downloaded bytes fail the recorded "
+                f"sha256 — store corrupted or tampered")
+        dest = os.path.expanduser(dest or os.path.join(".", name))
+        if meta.is_dir:
+            os.makedirs(dest, exist_ok=True)
+            with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+                tar.extractall(dest, filter="data")
+        else:
+            parent = os.path.dirname(os.path.abspath(dest))
+            os.makedirs(parent, exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(data)
+        return dest
+
+    def delete(self, name: str) -> bool:
+        idx = self._load_index()
+        entry = idx.pop(name, None)
+        if entry is None:
+            return False
+        self._save_index(idx)
+        self._unpin_if_unreferenced(idx, entry["handle"])
+        return True

@@ -15,6 +15,7 @@ import logging
 import time
 from typing import Any, Dict, List
 
+import numpy as np
 
 from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
 from fedml_tpu.data.dataset import FederatedDataset
@@ -42,8 +43,85 @@ class FedLLMAPI:
         self.global_exchange = self.aggregator.get_init_params()
         self.event = MLOpsProfilerEvent(args)
         self.test_history: List[dict] = []
+        # on_device_round: true fuses the ENTIRE round (client-switch,
+        # local steps, LoRA FedAvg) into one donated-buffer XLA program —
+        # see LLMTrainer.compile_federated_round. The trust-stack hooks
+        # intercept per-client payloads on the host, which that program
+        # bypasses, so the two are mutually exclusive by construction.
+        self.on_device = bool(getattr(args, "on_device_round", False))
+        self._fed_round = None
+        self._fed_round_key = None
+        if self.on_device:
+            self._check_no_host_hooks()
+
+    def _check_no_host_hooks(self) -> None:
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+        from fedml_tpu.core.security.attacker import FedMLAttacker
+        from fedml_tpu.core.security.defender import FedMLDefender
+
+        active = [
+            name
+            for name, on in (
+                ("attack", FedMLAttacker.get_instance().is_attack_enabled()),
+                ("defense", FedMLDefender.get_instance().is_defense_enabled()),
+                ("dp", FedMLDifferentialPrivacy.get_instance().is_dp_enabled()),
+                ("fhe", FedMLFHE.get_instance().is_fhe_enabled()),
+            )
+            if on
+        ]
+        if active:
+            raise ValueError(
+                f"on_device_round: true is incompatible with host-side "
+                f"trust-stack hooks (active: {', '.join(active)}) — the "
+                f"fused round never surfaces per-client payloads to the "
+                f"host; disable the hooks or drop on_device_round")
+
+    def _train_one_round_on_device(self, round_idx: int) -> Dict:
+        """The fused-round fast path: one XLA program per round."""
+        engine = self.client.engine
+        client_ids = sample_clients(self.args, round_idx)
+        batch = engine.batch_size
+        steps = int(getattr(self.args, "local_steps_per_round", 0) or 0)
+        if steps <= 0:
+            # default: one optimizer step per local epoch, each on a fresh
+            # random batch (the fixed-shape SPMD analogue of an epoch sweep)
+            steps = int(getattr(self.args, "epochs", 1))
+        key = (len(client_ids), steps)
+        if self._fed_round_key != key:
+            self._fed_round = engine.compile_federated_round(*key)
+            self._fed_round_key = key
+
+        xs = np.zeros((len(client_ids), steps, batch, engine.seq_len), np.int32)
+        ys = np.zeros_like(xs)
+        ms = np.ones((len(client_ids), steps, batch), np.float32)
+        weights = np.zeros((len(client_ids),), np.float32)
+        rng = np.random.default_rng(
+            int(getattr(self.args, "random_seed", 0)) * 9973 + round_idx)
+        for i, cid in enumerate(client_ids):
+            x, y = self.dataset.train_data_local_dict[cid]
+            x, y = np.asarray(x), np.asarray(y)
+            idx = rng.integers(0, x.shape[0], size=(steps, batch))
+            xs[i], ys[i] = x[idx], y[idx]
+            weights[i] = float(self.dataset.train_data_local_num_dict[cid])
+
+        self.event.log_event_started("round", round_idx)
+        t0 = time.time()
+        engine.params, engine.opt_state, self.global_exchange, loss = (
+            self._fed_round(engine.params, engine.opt_state,
+                            self.global_exchange, xs, ys, ms, weights))
+        loss = float(loss)  # jit returns futures: block BEFORE stopping t
+        dt = time.time() - t0
+        self.event.log_event_ended("round", round_idx)
+        report = {"round": round_idx, "round_sec": dt, "train_loss": loss}
+        self._maybe_test_and_checkpoint(round_idx, report)
+        return report
 
     def train_one_round(self, round_idx: int) -> Dict:
+        if self.on_device:
+            return self._train_one_round_on_device(round_idx)
         client_ids = sample_clients(self.args, round_idx)
         payloads = []
         self.event.log_event_started("round", round_idx)
@@ -69,6 +147,10 @@ class FedLLMAPI:
         self.event.log_event_ended("round", round_idx)
 
         report = {"round": round_idx, "round_sec": dt}
+        self._maybe_test_and_checkpoint(round_idx, report)
+        return report
+
+    def _maybe_test_and_checkpoint(self, round_idx: int, report: Dict) -> None:
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
         if round_idx % max(freq, 1) == 0 or round_idx == int(
             getattr(self.args, "comm_round", 1)
@@ -83,7 +165,6 @@ class FedLLMAPI:
         every = int(getattr(self.args, "save_every_rounds", 0) or 0)
         if ckpt_dir and every and round_idx % every == 0:
             self.aggregator.save_round(str(ckpt_dir), round_idx)
-        return report
 
     def train(self) -> Dict:
         t0 = time.time()

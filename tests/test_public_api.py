@@ -52,11 +52,18 @@ def test_api_storage_roundtrip(tmp_path):
     src = tmp_path / "blob.bin"
     src.write_bytes(b"\x00\x01payload")
     store = str(tmp_path / "store")
-    key = api.upload(str(src), store_dir=store)
+    meta = api.upload(str(src), store_dir=store, description="a blob",
+                      metadata={"kind": "test"})
+    assert meta.name == "blob.bin" and meta.size_bytes == 9
+    names = [m.name for m in api.list_storage_objects(store_dir=store)]
+    assert names == ["blob.bin"]
+    assert api.get_storage_user_defined_metadata(
+        "blob.bin", store_dir=store) == {"kind": "test"}
     dst = str(tmp_path / "out.bin")
-    api.download(key, dst, store_dir=store)
+    api.download("blob.bin", dst, store_dir=store)
     assert open(dst, "rb").read() == b"\x00\x01payload"
-    api.delete(key, store_dir=store)
+    assert api.delete("blob.bin", store_dir=store)
+    assert api.list_storage_objects(store_dir=store) == []
 
 
 def test_build_package_and_lenet(tmp_path):

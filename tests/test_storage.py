@@ -1,0 +1,156 @@
+"""Storage surface (VERDICT r4 task 7): StorageManager over the object-
+store seam + the `fedml_tpu storage` CLI + api functions.
+
+Parity target: ``python/fedml/cli/modules/storage.py`` (upload/download/
+list/delete/metadata)."""
+import json
+import os
+
+import pytest
+
+from fedml_tpu.storage import StorageManager
+
+
+@pytest.fixture()
+def mgr(tmp_path, monkeypatch):
+    monkeypatch.setenv("FEDML_TPU_STORAGE_DIR", str(tmp_path / "root"))
+    return StorageManager("local")
+
+
+def test_file_roundtrip_and_catalog(mgr, tmp_path):
+    src = tmp_path / "weights.bin"
+    src.write_bytes(os.urandom(1024))
+    meta = mgr.upload(str(src), description="round-3 adapters",
+                      metadata={"round": 3})
+    assert meta.name == "weights.bin" and not meta.is_dir
+    assert meta.size_bytes == 1024
+
+    got = mgr.get_metadata("weights.bin")
+    assert got.description == "round-3 adapters"
+    assert got.user_metadata == {"round": 3}
+    assert [m.name for m in mgr.list()] == ["weights.bin"]
+
+    out = mgr.download("weights.bin", dest=str(tmp_path / "out.bin"))
+    assert open(out, "rb").read() == src.read_bytes()
+
+    assert mgr.delete("weights.bin")
+    assert not mgr.delete("weights.bin")  # idempotent: already gone
+    assert mgr.list() == []
+    with pytest.raises(KeyError):
+        mgr.get_metadata("weights.bin")
+
+
+def test_directory_artifacts_tar_roundtrip(mgr, tmp_path):
+    d = tmp_path / "ckpt"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.txt").write_text("alpha")
+    (d / "sub" / "b.txt").write_text("beta")
+    meta = mgr.upload(str(d), name="ckpt-r1")
+    assert meta.is_dir
+
+    dest = tmp_path / "restored"
+    mgr.download("ckpt-r1", dest=str(dest))
+    assert (dest / "a.txt").read_text() == "alpha"
+    assert (dest / "sub" / "b.txt").read_text() == "beta"
+
+
+def test_reupload_keeps_created_at(mgr, tmp_path):
+    src = tmp_path / "f.txt"
+    src.write_text("v1")
+    m1 = mgr.upload(str(src))
+    src.write_text("v2 longer")
+    m2 = mgr.upload(str(src))
+    assert m2.created_at == m1.created_at
+    assert m2.size_bytes == 9
+    out = mgr.download("f.txt", dest=str(tmp_path / "o.txt"))
+    assert open(out).read() == "v2 longer"
+
+
+def test_download_integrity_check(mgr, tmp_path):
+    src = tmp_path / "f.bin"
+    src.write_bytes(b"payload")
+    meta = mgr.upload(str(src))
+    # corrupt the stored blob behind the manager's back
+    root = os.environ["FEDML_TPU_STORAGE_DIR"]
+    blob = None
+    for dirpath, _, files in os.walk(os.path.join(root, "cas")):
+        for f in files:
+            blob = os.path.join(dirpath, f)
+    assert blob is not None
+    with open(blob, "wb") as f:
+        f.write(b"tampered")
+    with pytest.raises(IOError, match="sha256"):
+        mgr.download(meta.name, dest=str(tmp_path / "o.bin"))
+
+
+def test_unknown_service_rejected(tmp_path, monkeypatch):
+    monkeypatch.setenv("FEDML_TPU_STORAGE_DIR", str(tmp_path))
+    with pytest.raises(ValueError, match="unknown storage service"):
+        StorageManager("r2")
+    # backend config is only needed once bytes move: list/metadata work
+    # without it (the backend builds lazily), upload raises helpfully
+    mgr = StorageManager("s3")
+    assert mgr.list() == []
+    src = tmp_path / "f.txt"
+    src.write_text("x")
+    with pytest.raises(ValueError, match="s3 storage needs"):
+        mgr.upload(str(src))
+
+
+def test_shared_content_survives_sibling_delete(mgr, tmp_path):
+    """CAS dedup: two names for identical bytes share one blob — deleting
+    one name must not destroy the other's data."""
+    src = tmp_path / "same.bin"
+    src.write_bytes(b"shared-bytes")
+    mgr.upload(str(src), name="a")
+    mgr.upload(str(src), name="b")
+    assert mgr.get_metadata("a").handle == mgr.get_metadata("b").handle
+    assert mgr.delete("a")
+    out = mgr.download("b", dest=str(tmp_path / "b.out"))
+    assert open(out, "rb").read() == b"shared-bytes"
+
+
+def test_reupload_unpins_superseded_blob(mgr, tmp_path):
+    src = tmp_path / "ckpt.bin"
+    src.write_bytes(b"round-1")
+    m1 = mgr.upload(str(src), name="ckpt-latest")
+    src.write_bytes(b"round-2!")
+    m2 = mgr.upload(str(src), name="ckpt-latest")
+    assert m1.handle != m2.handle
+    # the superseded blob is gone from the CAS (no unbounded leak)
+    with pytest.raises(KeyError):
+        mgr.store.get_object(m1.handle)
+    out = mgr.download("ckpt-latest", dest=str(tmp_path / "o.bin"))
+    assert open(out, "rb").read() == b"round-2!"
+
+
+def test_storage_cli(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    monkeypatch.setenv("FEDML_TPU_STORAGE_DIR", str(tmp_path / "root"))
+    src = tmp_path / "data.json"
+    src.write_text('{"x": 1}')
+    r = CliRunner()
+
+    res = r.invoke(cli, ["storage", "upload", str(src), "-d", "test data",
+                         "-um", '{"owner": "ci"}'])
+    assert res.exit_code == 0, res.output
+    assert "uploaded 'data.json'" in res.output
+
+    res = r.invoke(cli, ["storage", "list"])
+    assert res.exit_code == 0 and "data.json" in res.output
+
+    res = r.invoke(cli, ["storage", "metadata", "data.json"])
+    assert res.exit_code == 0
+    assert json.loads(res.output)["user_metadata"] == {"owner": "ci"}
+
+    dest = tmp_path / "fetched.json"
+    res = r.invoke(cli, ["storage", "download", "data.json", "-o", str(dest)])
+    assert res.exit_code == 0 and dest.read_text() == '{"x": 1}'
+
+    res = r.invoke(cli, ["storage", "delete", "data.json"])
+    assert res.exit_code == 0
+    res = r.invoke(cli, ["storage", "delete", "data.json"])
+    assert res.exit_code == 1  # gone → non-zero, like rm

@@ -100,6 +100,36 @@ def test_fhe_secure_profile_fedavg():
     FedMLFHE.reset()
 
 
+def test_fhe_secure_profile_keys_not_derivable_from_config():
+    """ADVICE r4 (medium): under the secure profile the secret key must
+    NOT be derivable from the shared run config — OS entropy unless
+    fhe_key_seed is explicitly set (then deterministic, for multi-party
+    runs that distribute the seed out of band)."""
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+
+    class A:
+        enable_fhe = True
+        fhe_profile = "secure"
+        random_seed = 0
+
+    def secret(args):
+        FedMLFHE.reset()
+        fhe = FedMLFHE.get_instance()
+        fhe.init(args)
+        s = np.asarray(fhe.ctx.sk, np.int64).copy()
+        FedMLFHE.reset()
+        return s
+
+    # same config twice → different keys (config alone can't regenerate sk)
+    assert not np.array_equal(secret(A()), secret(A()))
+
+    class B(A):
+        fhe_key_seed = 7
+
+    # explicit key seed → reproducible (the out-of-band distribution path)
+    np.testing.assert_array_equal(secret(B()), secret(B()))
+
+
 def test_fhe_fedavg_matches_plain_weighted_average():
     from fedml_tpu.core.fhe.fhe_agg import FedMLFHE, _is_cipher
 

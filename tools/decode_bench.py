@@ -31,7 +31,7 @@ params = jax.jit(model.init)(jax.random.key(0), toks)
 n_params = sum(x.size for x in jax.tree.leaves(params))
 B, CTX = cli.batch, cli.ctx
 eng = ContinuousBatchingEngine(model, params, batch_slots=B, max_len=CTX,
-                               quantize=cli.quantize)
+                               quantize=cli.quantize, quantize_donate=True)
 params = eng.params  # quantized if requested
 caches = model.init_kv_caches(B, CTX)
 caches = [(jnp.asarray(k), jnp.asarray(v)) for k, v, _ in caches]
