@@ -1,0 +1,68 @@
+"""Shared harness for the trust-stack examples.
+
+Mirrors the reference's security smoke matrix
+(``.github/workflows/smoke_test_cross_silo_fedavg_{attack,defense,cdp,
+ldp}_linux.yml`` + ``smoke_test_security.yml``): each example runs a
+real federated simulation with the trust hook under test enabled and
+asserts the *observable effect* (attacker filtered, noise applied,
+ciphertext on the wire) — not just that the run finished.
+"""
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, "..", "..", ".."))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def run_sp_federation(security_args=None, train_extra=None, fhe_args=None):
+    """One single-process FedAvg federation (synthetic data, MLP) with the
+    given trust-stack config; returns the final report dict.
+
+    Trust singletons are process-global — reset them so back-to-back
+    A/B runs inside one example stay independent.
+    """
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.core.alg_frame.params import Context
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy,
+    )
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+    from fedml_tpu.core.security.attacker import FedMLAttacker
+    from fedml_tpu.core.security.defender import FedMLDefender
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    for singleton in (FedMLAttacker, FedMLDefender,
+                      FedMLDifferentialPrivacy, FedMLFHE, Context):
+        singleton.reset()
+
+    cfg = {
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic", "train_size": 1200,
+                      "test_size": 300, "class_num": 6, "feature_dim": 24},
+        "model_args": {"model": "mlp", "hidden_dim": 32},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 6, "client_num_per_round": 6,
+                       "comm_round": 6, "epochs": 1, "batch_size": 25,
+                       "learning_rate": 0.2, **(train_extra or {})},
+    }
+    if security_args:
+        cfg["security_args"] = security_args
+    if fhe_args:
+        cfg["fhe_args"] = fhe_args
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    api = FedAvgAPI(args, None, ds, model)
+    report = api.train()
+    report["global_model"] = api.global_params  # for drift assertions
+    return report

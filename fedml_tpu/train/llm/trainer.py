@@ -163,10 +163,12 @@ class LLMTrainer:
         self._step = 0
 
     # -- init -------------------------------------------------------------
-    def init(self, seed: int = 0):
+    def init(self, seed: int = 0, zeros: bool = False):
+        """``zeros=True``: sharded zero params (dryrun fast path — see
+        ``init_sharded_params``)."""
         sample = jnp.zeros((self.batch_size, self.seq_len), jnp.int32)
         self.params, self.shardings = init_sharded_params(
-            self.model, sample, self.mesh, seed=seed
+            self.model, sample, self.mesh, seed=seed, zeros=zeros
         )
         self.opt_state = jax.jit(self.tx.init)(self.params)
         self._compile()

@@ -65,6 +65,10 @@ def test_example_hello_world_job():
     _run_example("launch/hello_world_job")
 
 
+def test_example_trust_fhe_round():
+    _run_example("federate/trust/fhe_round")
+
+
 # -- slow gate (multi-process / compile-heavy) ----------------------------
 
 @pytest.mark.slow
@@ -107,7 +111,31 @@ def test_example_model_cards_failover():
     _run_example("deploy/model_cards_failover")
 
 
+# trust-stack examples: each runs ≥2 full federations (A/B against an
+# unprotected twin), so they live in the slow gate — except the single-run
+# FHE one above. Ref CI: smoke_test_cross_silo_fedavg_{attack,defense,
+# cdp,ldp}_linux.yml + smoke_test_security.yml.
+
+@pytest.mark.slow
+def test_example_trust_attack_byzantine_krum():
+    _run_example("federate/trust/attack_byzantine_krum")
+
+
+@pytest.mark.slow
+def test_example_trust_defense_sweep():
+    _run_example("federate/trust/defense_sweep")
+
+
+@pytest.mark.slow
+def test_example_trust_dp_cdp_ldp():
+    _run_example("federate/trust/dp_cdp_ldp")
+
+
 _ALL_SMOKED = {
+    "federate/trust/attack_byzantine_krum",
+    "federate/trust/defense_sweep",
+    "federate/trust/dp_cdp_ldp",
+    "federate/trust/fhe_round",
     "federate/simulation/sp_fedavg_mnist_lr",
     "federate/simulation/mesh_fedavg_parallel",
     "federate/simulation/mp_fedavg_processes",
