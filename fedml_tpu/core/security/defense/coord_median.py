@@ -29,8 +29,15 @@ class CoordinateWiseMedianDefense(BaseDefense):
         base_aggregation_func: Callable = None,
         extra_auxiliary_info: Any = None,
     ) -> Pytree:
-        stacked = tree_stack([p for _, p in raw_client_grad_list])
-        return _median_tree(stacked)
+        from fedml_tpu.core.security.defense.blockwise import (
+            coordinate_median_blockwise,
+            should_go_blockwise,
+        )
+
+        trees = [p for _, p in raw_client_grad_list]
+        if should_go_blockwise(raw_client_grad_list, self.args):
+            return coordinate_median_blockwise(trees)
+        return _median_tree(tree_stack(trees))
 
     def defend_stacked(self, vecs, counts, valid, global_vec):
         """Traced masked median for the in-mesh compiled round.

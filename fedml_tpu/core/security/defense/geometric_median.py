@@ -47,6 +47,17 @@ class GeometricMedianDefense(BaseDefense):
         base_aggregation_func: Callable = None,
         extra_auxiliary_info: Any = None,
     ) -> Pytree:
+        from fedml_tpu.core.security.defense.blockwise import (
+            geometric_median_blockwise,
+            should_go_blockwise,
+        )
+
+        if should_go_blockwise(raw_client_grad_list, self.args):
+            return geometric_median_blockwise(
+                [p for _, p in raw_client_grad_list],
+                [n for n, _ in raw_client_grad_list],
+                iters=self.iters,
+            )
         vecs, counts, template = stack_updates(raw_client_grad_list)
         gm = geometric_median(vecs, counts, self.iters)
         return tree_unflatten_vector(gm, template)

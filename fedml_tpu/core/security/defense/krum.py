@@ -1,8 +1,11 @@
 """Krum / Multi-Krum (Blanchard et al., NeurIPS'17).
 
 Parity: ``core/security/defense/krum_defense.py``. The reference computes
-pairwise distances with nested numpy loops; here it is one N×D gram matmul
-(``pairwise_sq_dists``) so it scales to large models on the MXU.
+pairwise distances with nested numpy loops; here it is a gram matmul on
+the MXU — one N×D program when the stacked updates fit the device budget,
+and the blockwise-streamed accumulation from ``blockwise.py`` when they
+don't (full-parameter LLM payloads: N×D fp32 at 7B is >200 GB, far over
+HBM — see SURVEY hard part (e)).
 """
 from __future__ import annotations
 
@@ -18,6 +21,19 @@ from fedml_tpu.core.security.defense.base import (
 )
 
 Pytree = Any
+
+
+def select_krum(d: jnp.ndarray, f: int, k: int) -> List[int]:
+    """Krum selection from an N×N squared-distance matrix: keep the ``k``
+    clients whose summed n-f-2 nearest distances are smallest. Shared by
+    the dense path, the blockwise >HBM path, and the benches."""
+    n = d.shape[0]
+    m = max(1, n - f - 2)
+    d = jnp.asarray(d).at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    sorted_d = jnp.sort(d, axis=1)
+    scores = jnp.sum(sorted_d[:, :m], axis=1)
+    keep = jnp.argsort(scores)[:k]
+    return sorted(int(i) for i in keep)
 
 
 @register("krum")
@@ -37,15 +53,21 @@ class KrumDefense(BaseDefense):
     ) -> List[Tuple[int, Pytree]]:
         n = len(raw_client_grad_list)
         f = min(self.byzantine_client_num, max(0, (n - 3) // 2))
-        vecs, _, _ = stack_updates(raw_client_grad_list)
-        d = pairwise_sq_dists(vecs)
-        # score_i = sum of the n-f-2 smallest distances to other clients
-        m = max(1, n - f - 2)
-        d = d.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
-        sorted_d = jnp.sort(d, axis=1)
-        scores = jnp.sum(sorted_d[:, :m], axis=1)
-        keep = jnp.argsort(scores)[: self.krum_param_k]
-        keep_idx = sorted(int(i) for i in keep)
+        from fedml_tpu.core.security.defense.blockwise import (
+            flatten_clients,
+            iter_blocks,
+            pairwise_sq_dists_blockwise,
+            should_go_blockwise,
+        )
+
+        if should_go_blockwise(raw_client_grad_list, self.args):
+            d = jnp.asarray(pairwise_sq_dists_blockwise(
+                iter_blocks(flatten_clients(
+                    [p for _, p in raw_client_grad_list])), n))
+        else:
+            vecs, _, _ = stack_updates(raw_client_grad_list)
+            d = pairwise_sq_dists(vecs)
+        keep_idx = select_krum(d, f, self.krum_param_k)
         return [raw_client_grad_list[i] for i in keep_idx]
 
     def defend_stacked(self, vecs, counts, valid, global_vec):

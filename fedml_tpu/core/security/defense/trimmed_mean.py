@@ -43,8 +43,15 @@ class TrimmedMeanDefense(BaseDefense):
     ) -> Pytree:
         n = len(raw_client_grad_list)
         k = min(int(self.beta * n), (n - 1) // 2)
-        stacked = tree_stack([p for _, p in raw_client_grad_list])
-        return _trimmed_mean_tree(stacked, k)
+        from fedml_tpu.core.security.defense.blockwise import (
+            should_go_blockwise,
+            trimmed_mean_blockwise,
+        )
+
+        trees = [p for _, p in raw_client_grad_list]
+        if should_go_blockwise(raw_client_grad_list, self.args):
+            return trimmed_mean_blockwise(trees, k)
+        return _trimmed_mean_tree(tree_stack(trees), k)
 
     def defend_stacked(self, vecs, counts, valid, global_vec):
         """Traced masked trimmed mean for the in-mesh compiled round."""

@@ -1,0 +1,158 @@
+"""Blockwise defenses for payloads bigger than HBM (VERDICT r4 task 3).
+
+The blockwise paths must agree with the dense N×D implementations, block
+boundaries must not leak (tiny block widths force many partial blocks),
+and the auto-switch must engage on payload size.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.security.defense.blockwise import (
+    coordinate_median_blockwise,
+    flatten_clients,
+    geometric_median_blockwise,
+    iter_blocks,
+    pairwise_sq_dists_blockwise,
+    should_go_blockwise,
+    stacked_bytes,
+    trimmed_mean_blockwise,
+)
+
+
+def _cohort(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"a": rng.normal(size=(7, 5)).astype(np.float32),
+         "b": rng.normal(size=(13,)).astype(np.float32),
+         "c": rng.normal(size=(3, 2, 4)).astype(np.float32)}
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize("block", [8, 17, 64, 1000])
+def test_blockwise_pairwise_dists_match_dense(block):
+    trees = _cohort()
+    from fedml_tpu.core.security.defense.base import (
+        pairwise_sq_dists,
+        stack_updates,
+    )
+
+    vecs, _, _ = stack_updates([(1, t) for t in trees])
+    dense = np.asarray(pairwise_sq_dists(vecs))
+    blocked = pairwise_sq_dists_blockwise(
+        iter_blocks(flatten_clients(trees), block), len(trees))
+    np.testing.assert_allclose(blocked, dense, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("block", [8, 17, 1000])
+def test_blockwise_median_and_trimmed_mean_match_dense(block):
+    trees = _cohort(n=7, seed=1)
+    want_med = {k: np.median(np.stack([t[k] for t in trees]), axis=0)
+                for k in trees[0]}
+    got_med = coordinate_median_blockwise(trees, block_elems=block)
+    for k in want_med:
+        np.testing.assert_allclose(got_med[k], want_med[k], rtol=1e-6,
+                                   atol=1e-6)
+
+    k_trim = 2
+    got_tm = trimmed_mean_blockwise(trees, k_trim, block_elems=block)
+    for k in trees[0]:
+        arr = np.sort(np.stack([t[k] for t in trees]), axis=0)[k_trim:-k_trim]
+        np.testing.assert_allclose(got_tm[k], arr.mean(axis=0), rtol=1e-5,
+                                   atol=1e-5)
+
+
+@pytest.mark.parametrize("block", [16, 1000])
+def test_blockwise_geometric_median_matches_dense(block):
+    trees = _cohort(n=5, seed=2)
+    weights = [1.0, 2.0, 3.0, 1.0, 5.0]
+    from fedml_tpu.core.security.defense.base import stack_updates
+    from fedml_tpu.core.security.defense.geometric_median import (
+        geometric_median,
+    )
+    from fedml_tpu.utils.tree import tree_unflatten_vector
+
+    vecs, _, template = stack_updates(
+        [(w, t) for w, t in zip(weights, trees)])
+    dense = tree_unflatten_vector(
+        geometric_median(vecs, jnp.asarray(weights), 10), template)
+    blocked = geometric_median_blockwise(trees, weights, iters=10,
+                                         block_elems=block)
+    for k in trees[0]:
+        np.testing.assert_allclose(np.asarray(blocked[k]),
+                                   np.asarray(dense[k]), rtol=2e-4, atol=2e-4)
+
+
+def test_auto_switch_thresholds():
+    class A:
+        defense_stack_budget_bytes = 0  # default 4 GB
+
+    trees = _cohort()
+    cohort = [(1, t) for t in trees]
+    assert stacked_bytes(cohort) == 4 * 6 * (35 + 13 + 24)
+    assert not should_go_blockwise(cohort, A())
+
+    class Tiny:
+        defense_stack_budget_bytes = 128
+
+    assert should_go_blockwise(cohort, Tiny())
+
+
+def test_krum_blockwise_drops_planted_byzantine():
+    """End-to-end: krum forced down the blockwise path (tiny budget) still
+    filters the planted attacker exactly like the dense path."""
+    from fedml_tpu.core.security.defense import create_defender
+
+    rng = np.random.default_rng(3)
+    base = rng.normal(size=(40,)).astype(np.float32)
+    benign = [{"w": base + rng.normal(scale=0.01, size=40).astype(np.float32)}
+              for _ in range(5)]
+    evil = {"w": rng.normal(scale=50.0, size=40).astype(np.float32)}
+    cohort = [(100, evil)] + [(100, b) for b in benign]
+
+    class A:
+        byzantine_client_num = 1
+        krum_param_k = 2
+        multi = True
+        defense_stack_budget_bytes = 64  # force blockwise
+
+    survivors = create_defender("krum", A()).defend_before_aggregation(cohort)
+    assert len(survivors) == 2
+    for _, s in survivors:
+        assert min(np.abs(s["w"] - b["w"]).max() for b in benign) < 1e-6
+
+    class ADense(A):
+        defense_stack_budget_bytes = 1 << 40
+
+    dense = create_defender("krum", ADense()).defend_before_aggregation(cohort)
+    got = [np.asarray(s["w"]) for _, s in survivors]
+    want = [np.asarray(s["w"]) for _, s in dense]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+@pytest.mark.parametrize("defense,extra", [
+    ("coordinate_wise_median", {}),
+    ("trimmed_mean", {"beta": 0.2}),
+    ("rfa", {}),
+])
+def test_aggregating_defenses_blockwise_vs_dense(defense, extra):
+    from fedml_tpu.core.security.defense import create_defender
+
+    trees = _cohort(n=6, seed=4)
+    cohort = [(10 * (i + 1), t) for i, t in enumerate(trees)]
+
+    def mk(budget):
+        class A:
+            defense_stack_budget_bytes = budget
+
+        for k, v in extra.items():
+            setattr(A, k, v)
+        return create_defender(defense, A())
+
+    blocked = mk(64).defend_on_aggregation(cohort)
+    dense = mk(1 << 40).defend_on_aggregation(cohort)
+    for k in trees[0]:
+        np.testing.assert_allclose(np.asarray(blocked[k]),
+                                   np.asarray(dense[k]), rtol=2e-4, atol=2e-4)
