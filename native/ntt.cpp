@@ -1,0 +1,180 @@
+// Negacyclic NTT kernels for the RNS-CKKS secure profile.
+//
+// TPU-era equivalent of the reference's native trust-stack components
+// (the reference delegates CKKS entirely to TenSEAL's C++; here the
+// scheme is in-tree — fedml_tpu/core/fhe/ckks.py — and this kernel
+// replaces its numpy NTT butterfly on the hot path: encrypt/decrypt of
+// LoRA-sized payloads is thousands of N=8192 polynomial products).
+// Parity with the numpy twin is exact (modular arithmetic), enforced by
+// tests/test_trust_round3.py.
+//
+// Build:  make -C native        (produces native/libntt.so)
+// Bind:   ctypes (fedml_tpu/core/fhe/ckks.py), no pybind11 needed.
+//
+// Moduli are NTT-friendly primes q < 2^31 (q ≡ 1 mod 2N), so products
+// fit __int128-free in 64 bits only via (a*b)%q with a,b < 2^31 — we use
+// __int128 where available anyway for clarity and safety.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <utility>
+#include <vector>
+
+namespace {
+
+inline uint64_t mulmod(uint64_t a, uint64_t b, uint64_t q) {
+#ifdef __SIZEOF_INT128__
+    return (uint64_t)(((__uint128_t)a * b) % q);
+#else
+    return (a * b) % q;  // safe for q < 2^32 operands
+#endif
+}
+
+inline uint64_t powmod(uint64_t a, uint64_t e, uint64_t q) {
+    uint64_t r = 1 % q;
+    a %= q;
+    while (e) {
+        if (e & 1) r = mulmod(r, a, q);
+        a = mulmod(a, a, q);
+        e >>= 1;
+    }
+    return r;
+}
+
+// Precomputed tables for one (q, psi, N): bit-reversal permutation,
+// stage twiddles for the cyclic core (w = psi^2), and the psi twists.
+struct Plan {
+    uint64_t q, n, n_inv;
+    std::vector<uint32_t> bitrev;
+    std::vector<uint64_t> w_fwd, w_inv;      // stage-major twiddles
+    std::vector<uint64_t> psi_pow, psi_inv_pow;
+};
+
+std::map<std::pair<uint64_t, uint64_t>, Plan> g_plans;
+std::mutex g_mu;
+
+const Plan& get_plan(uint64_t q, uint64_t psi, uint64_t n) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto key = std::make_pair(q, psi);
+    auto it = g_plans.find(key);
+    if (it != g_plans.end()) return it->second;
+    Plan p;
+    p.q = q;
+    p.n = n;
+    p.n_inv = powmod(n, q - 2, q);
+    p.bitrev.resize(n);
+    uint32_t bits = 0;
+    while ((1ull << bits) < n) ++bits;
+    for (uint64_t i = 0; i < n; ++i) {
+        uint64_t r = 0;
+        for (uint32_t b = 0; b < bits; ++b)
+            if (i & (1ull << b)) r |= 1ull << (bits - 1 - b);
+        p.bitrev[i] = (uint32_t)r;
+    }
+    uint64_t w = mulmod(psi, psi, q);            // primitive n-th root
+    uint64_t psi_inv = powmod(psi, q - 2, q);
+    p.psi_pow.resize(n);
+    p.psi_inv_pow.resize(n);
+    uint64_t acc = 1, acc_i = 1;
+    for (uint64_t i = 0; i < n; ++i) {
+        p.psi_pow[i] = acc;
+        p.psi_inv_pow[i] = acc_i;
+        acc = mulmod(acc, psi, q);
+        acc_i = mulmod(acc_i, psi_inv, q);
+    }
+    // stage-major twiddles: for len = 2,4,...,n store len/2 powers of
+    // base = w^(n/len) — total n-1 values per direction
+    p.w_fwd.reserve(n);
+    p.w_inv.reserve(n);
+    for (uint64_t len = 2; len <= n; len <<= 1) {
+        uint64_t base = powmod(w, n / len, q);
+        uint64_t base_inv = powmod(base, q - 2, q);
+        uint64_t t = 1, ti = 1;
+        for (uint64_t j = 0; j < len / 2; ++j) {
+            p.w_fwd.push_back(t);
+            p.w_inv.push_back(ti);
+            t = mulmod(t, base, q);
+            ti = mulmod(ti, base_inv, q);
+        }
+    }
+    return g_plans.emplace(key, std::move(p)).first->second;
+}
+
+// In-place cyclic NTT core on one row (already bit-rev permuted input?
+// no — permutes internally), matching the numpy twin's math exactly.
+void core(uint64_t* a, const Plan& p, bool inverse) {
+    const uint64_t q = p.q, n = p.n;
+    // bit-reversal permutation
+    for (uint64_t i = 0; i < n; ++i) {
+        uint32_t j = p.bitrev[i];
+        if (i < j) std::swap(a[i], a[j]);
+    }
+    const std::vector<uint64_t>& tw = inverse ? p.w_inv : p.w_fwd;
+    size_t toff = 0;
+    for (uint64_t len = 2; len <= n; len <<= 1) {
+        uint64_t half = len >> 1;
+        for (uint64_t blk = 0; blk < n; blk += len) {
+            for (uint64_t j = 0; j < half; ++j) {
+                uint64_t u = a[blk + j];
+                uint64_t t = mulmod(a[blk + j + half], tw[toff + j], q);
+                a[blk + j] = u + t < q ? u + t : u + t - q;
+                a[blk + j + half] = u >= t ? u - t : u + q - t;
+            }
+        }
+        toff += half;
+    }
+}
+
+void polymul_rows(const uint64_t* fa,   // NTT(pretwist(a)) [N], shared
+                  const int64_t* u,     // [B, N] second operands
+                  int64_t* out,         // [B, N]
+                  int64_t n_rows, const Plan& p) {
+    const uint64_t q = p.q, n = p.n;
+    std::vector<uint64_t> buf(n);
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const int64_t* row = u + r * n;
+        for (uint64_t i = 0; i < n; ++i) {
+            uint64_t v = (uint64_t)(row[i] % (int64_t)q + (int64_t)q) % q;
+            buf[i] = mulmod(v, p.psi_pow[i], q);
+        }
+        core(buf.data(), p, false);
+        for (uint64_t i = 0; i < n; ++i) buf[i] = mulmod(buf[i], fa[i], q);
+        core(buf.data(), p, true);
+        int64_t* orow = out + r * n;
+        for (uint64_t i = 0; i < n; ++i)
+            orow[i] = (int64_t)mulmod(mulmod(buf[i], p.n_inv, q),
+                                      p.psi_inv_pow[i], q);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[B,N] = a[N] (*) u[B,N] mod (X^N+1, q) — one fixed operand
+// (public key / secret key poly) against a batch. psi is a primitive
+// 2N-th root of unity mod q (the caller's _NTTPlan already found one).
+void ntt_polymul_bcast(const int64_t* a, const int64_t* u, int64_t* out,
+                       int64_t n_rows, int64_t n, int64_t q, int64_t psi) {
+    const Plan& p = get_plan((uint64_t)q, (uint64_t)psi, (uint64_t)n);
+    std::vector<uint64_t> fa(n);
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t v = (uint64_t)(a[i] % q + q) % q;
+        fa[i] = mulmod(v, p.psi_pow[i], (uint64_t)q);
+    }
+    core(fa.data(), p, false);
+    polymul_rows(fa.data(), u, out, n_rows, p);
+}
+
+// Pairwise batch variant: out[r] = a[r] (*) u[r]. Used where both
+// operands vary (none on the current hot path, provided for parity).
+void ntt_polymul_batch(const int64_t* a, const int64_t* u, int64_t* out,
+                       int64_t n_rows, int64_t n, int64_t q, int64_t psi) {
+    for (int64_t r = 0; r < n_rows; ++r)
+        ntt_polymul_bcast(a + r * n, u + r * n, out + r * n, 1, n, q, psi);
+}
+
+}  // extern "C"

@@ -100,6 +100,36 @@ def test_fhe_secure_profile_fedavg():
     FedMLFHE.reset()
 
 
+def test_native_ntt_matches_numpy_butterfly_bit_exact():
+    """native/ntt.cpp must produce the SAME residues as the numpy twin
+    (exact modular arithmetic — no tolerance)."""
+    from fedml_tpu.core.fhe import ckks
+
+    lib = ckks._load_ntt_native()
+    if lib is None:
+        pytest.skip("no C++ toolchain for libntt.so")
+    ctx = ckks.RNSCKKSContext(seed=5)
+    rng = np.random.default_rng(6)
+    for plan in ctx.plans:
+        fixed = rng.integers(0, plan.q, ctx.n, dtype=np.int64)
+        batch = rng.integers(0, plan.q, (3, ctx.n), dtype=np.int64)
+        native = plan.mul_bcast(fixed, batch)
+        want = np.stack([plan.mul(fixed, row) for row in batch])
+        np.testing.assert_array_equal(native, want)
+
+
+def test_rns_batched_vector_roundtrip_partial_chunk():
+    """Batched encrypt/decrypt with a ragged final ciphertext chunk."""
+    from fedml_tpu.core.fhe.ckks import RNSCKKSContext
+
+    ctx = RNSCKKSContext(seed=7).keygen()
+    v = np.random.default_rng(8).normal(0, 1, ctx.slots * 2 + 123)
+    cts = ctx.encrypt_vector(v)
+    assert len(cts) == 3
+    out = ctx.decrypt_vector(cts, v.size)
+    np.testing.assert_allclose(out, v, atol=1e-4)
+
+
 def test_fhe_secure_profile_keys_not_derivable_from_config():
     """ADVICE r4 (medium): under the secure profile the secret key must
     NOT be derivable from the shared run config — OS entropy unless

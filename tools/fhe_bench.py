@@ -1,0 +1,88 @@
+"""Secure-profile FHE round cost on a realistic LoRA payload
+(VERDICT r4 task 5).
+
+Simulates one `fhe_profile: secure` federated round end to end:
+N_CLIENTS clients encrypt a D-param adapter payload (r=16 7B LoRA is
+~10M params ≈ 40 MB fp32), the server computes the weighted ciphertext
+aggregate WITHOUT decrypting (fhe_fedavg), one client decrypts the
+aggregate. RNS-CKKS N=8192 → D/4096 ciphertexts per payload.
+
+Run:  python tools/fhe_bench.py [--d 10000000] [--clients 8]
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+from fedml_tpu.core.fhe.ckks import RNSCKKSContext, _load_ntt_native
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--d", type=int, default=10_000_000)
+ap.add_argument("--clients", type=int, default=8)
+cli = ap.parse_args()
+
+ctx = RNSCKKSContext(seed=0).keygen()
+D, N = cli.d, cli.clients
+n_ct = -(-D // ctx.slots)
+mb = 4.0 * D / 1e6
+print(f"payload D={D/1e6:.1f}M params ({mb:.0f} MB fp32) -> {n_ct} cts "
+      f"(N={ctx.n}, {ctx.slots} slots); native ntt: "
+      f"{_load_ntt_native() is not None}", flush=True)
+
+rng = np.random.default_rng(1)
+vec = rng.normal(0, 0.02, D)
+
+t0 = time.perf_counter()
+cts = ctx.encrypt_vector(vec)
+t_enc = time.perf_counter() - t0
+print(f"encrypt (1 client): {t_enc:.1f}s  ({mb/t_enc:.1f} MB/s)", flush=True)
+
+# server: weighted ciphertext aggregation over N clients. Every client's
+# payload has identical shape/size, so aggregating N references to this
+# one is compute-identical to N distinct uploads (values don't change the
+# mod-arithmetic cost) without paying N× encrypt time in the harness.
+q = ctx.q
+weights = np.maximum(1, np.rint(
+    (np.arange(N) + 1.0) / (N * (N + 1) / 2) * 256)).astype(np.int64)
+t0 = time.perf_counter()
+acc0 = [np.mod(ct.c0 * int(weights[0]), q) for ct in cts]
+acc1 = [np.mod(ct.c1 * int(weights[0]), q) for ct in cts]
+for w in weights[1:]:
+    for j, ct in enumerate(cts):
+        acc0[j] = np.mod(acc0[j] + ct.c0 * int(w), q)
+        acc1[j] = np.mod(acc1[j] + ct.c1 * int(w), q)
+t_agg = time.perf_counter() - t0
+print(f"aggregate ({N} clients, ciphertext-only): {t_agg:.1f}s", flush=True)
+
+from fedml_tpu.core.fhe.ckks import CKKSCiphertext
+
+agg = [CKKSCiphertext(a0, a1) for a0, a1 in zip(acc0, acc1)]
+save = ctx.delta
+ctx.delta = save * float(weights.sum())
+t0 = time.perf_counter()
+out = ctx.decrypt_vector(agg, D)
+t_dec = time.perf_counter() - t0
+ctx.delta = save
+print(f"decrypt (aggregate): {t_dec:.1f}s  ({mb/t_dec:.1f} MB/s)", flush=True)
+
+# correctness: all clients sent the same vec, so the weighted mean is vec
+err = float(np.abs(out - vec).max())
+assert err < 5e-3, f"aggregate decrypt error {err}"
+
+round_sec = t_enc + t_agg + t_dec
+print(json.dumps({
+    "profile": "secure RNS-CKKS N=8192",
+    "payload_mb": round(mb, 1),
+    "n_ciphertexts": n_ct,
+    "clients": N,
+    "encrypt_s": round(t_enc, 1),
+    "aggregate_s": round(t_agg, 1),
+    "decrypt_s": round(t_dec, 1),
+    "round_s": round(round_sec, 1),
+    "max_err": err,
+    "native_ntt": _load_ntt_native() is not None,
+}), flush=True)
