@@ -239,8 +239,12 @@ def main() -> None:
 
     # flash kernel micro-bench FIRST: its XLA reference path materializes
     # multi-GB T×T score tensors, which cannot coexist with the 7B
-    # trainer's 13.5 GB of live params later in this process
-    flash = bench_flash() if dev.platform == "tpu" else None
+    # trainer's 13.5 GB of live params later in this process.
+    # FEDML_BENCH_SKIP_FLASH=1 skips it (A/B tool for memory-state
+    # effects on the trainer sections; see PERF_NOTES MFU-variance note)
+    skip_flash = os.environ.get("FEDML_BENCH_SKIP_FLASH") == "1"
+    flash = (bench_flash()
+             if dev.platform == "tpu" and not skip_flash else None)
 
     class Args:
         max_seq_length = seq

@@ -1,0 +1,44 @@
+"""Multi-framework engine adapter — bring torch/numpy worlds into the
+JAX compute path.
+
+Parity target: ``ml/engine/ml_engine_adapter.py`` in the reference
+(``:37 convert_numpy_to_jax_data_format``, ``:127`` jax device_count,
+``:176 get_jax_device``, ``:291 jax_model_ddp``). The reference shims
+four engines (torch/tf/jax/mxnet) behind one interface so trainers stay
+engine-agnostic; here JAX **is** the engine, and the adapter solves the
+practical half of that job: users arriving from the reference bring
+torch datasets, torch tensors, and torch ``state_dict`` checkpoints —
+this module converts each into the JAX-native form the framework runs.
+
+- data: :func:`to_jax` / :func:`to_numpy` accept torch tensors, numpy,
+  jax arrays, and nested containers; :func:`dataset_to_arrays` drains a
+  torch ``Dataset``/``DataLoader`` into the (x, y) numpy pair the
+  federated data registry uses;
+- models: :func:`import_torch_state_dict` maps a torch ``state_dict``
+  onto a structurally-matching flax params tree, transposing
+  Linear/Conv kernels (torch ``[out, in]`` / ``[out, in, kh, kw]`` →
+  flax ``[in, out]`` / ``[kh, kw, in, out]``). The LLM path has its own
+  exact mapper (``models/llm/hf_convert.py``); this is the generic
+  by-structure version for zoo-scale models;
+- devices: :func:`get_device` / :func:`device_count` parity helpers
+  (the reference's ``get_jax_device``); "DDP wrap" maps to sharding —
+  see ``train/llm/sharding.py`` / ``parallel/`` (the reference's jax
+  branch stubs it too, ``ml_engine_adapter.py:291``).
+"""
+from fedml_tpu.ml.engine.adapter import (
+    dataset_to_arrays,
+    device_count,
+    get_device,
+    import_torch_state_dict,
+    to_jax,
+    to_numpy,
+)
+
+__all__ = [
+    "dataset_to_arrays",
+    "device_count",
+    "get_device",
+    "import_torch_state_dict",
+    "to_jax",
+    "to_numpy",
+]

@@ -1,0 +1,187 @@
+"""Engine-adapter implementation (see package docstring for scope)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def _is_torch_tensor(x: Any) -> bool:
+    mod = type(x).__module__
+    return mod is not None and mod.split(".")[0] == "torch"
+
+
+def to_numpy(data: Any) -> Any:
+    """Torch tensors / jax arrays / numpy (nested in dict/list/tuple) →
+    numpy, structure preserved."""
+    if _is_torch_tensor(data):
+        return data.detach().cpu().numpy()
+    if isinstance(data, (jax.Array, np.ndarray, np.generic)):
+        return np.asarray(data)
+    if isinstance(data, dict):
+        return {k: to_numpy(v) for k, v in data.items()}
+    if isinstance(data, (list, tuple)):
+        return type(data)(to_numpy(v) for v in data)
+    return data
+
+
+def to_jax(data: Any, dtype=None) -> Any:
+    """Anything :func:`to_numpy` accepts → jax arrays (reference:
+    ``convert_numpy_to_jax_data_format``, ``ml_engine_adapter.py:37``)."""
+    out = to_numpy(data)
+    if isinstance(out, np.ndarray):
+        return jnp.asarray(out, dtype)
+    if isinstance(out, dict):
+        return {k: to_jax(v, dtype) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return type(out)(to_jax(v, dtype) for v in out)
+    return out
+
+
+def dataset_to_arrays(dataset: Any,
+                      limit: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Drain a torch ``Dataset``/``DataLoader`` (or any iterable of
+    (x, y) pairs / batches) into stacked numpy (x, y) — the form the
+    federated data registry partitions."""
+    xs, ys = [], []
+    for item in dataset:
+        if not (isinstance(item, (list, tuple)) and len(item) == 2):
+            raise ValueError(
+                "expected an iterable of (x, y) samples or batches; got "
+                f"{type(item).__name__}")
+        x, y = to_numpy(item[0]), to_numpy(item[1])
+        if np.ndim(x) == 0 or (hasattr(x, "shape") and x.shape == ()):
+            raise ValueError("scalar sample; expected array-like x")
+        xs.append(np.asarray(x))
+        ys.append(np.asarray(y))
+        if limit is not None and len(xs) >= limit:
+            break
+    x0 = xs[0]
+    if np.ndim(ys[0]) >= 1 and ys[0].shape[:1] == x0.shape[:1] and (
+            np.ndim(x0) > 1):
+        # already batched (DataLoader): concatenate along batch dim
+        return np.concatenate(xs, 0), np.concatenate(ys, 0)
+    return np.stack(xs, 0), np.stack(ys, 0)
+
+
+def get_device(args: Any = None):
+    """Parity with the reference's ``get_jax_device``
+    (``ml_engine_adapter.py:176``): pick a device by ``args.device`` /
+    ``args.gpu_id`` (index), defaulting to the first accelerator."""
+    devices = jax.devices()
+    idx = 0
+    if args is not None:
+        want = getattr(args, "device", None)
+        if isinstance(want, str) and ":" in want:
+            idx = int(want.split(":")[-1])
+        elif getattr(args, "gpu_id", None) is not None:
+            idx = int(args.gpu_id)
+    return devices[min(idx, len(devices) - 1)]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def _fits(src_shape, dst_shape):
+    """Return a transform name mapping a torch tensor shape onto a flax
+    kernel shape, or None."""
+    if tuple(src_shape) == tuple(dst_shape):
+        return "same"
+    if len(src_shape) == 2 and tuple(src_shape[::-1]) == tuple(dst_shape):
+        return "linear_t"  # torch Linear [out, in] → flax [in, out]
+    if len(src_shape) == 4 and (
+            src_shape[2], src_shape[3], src_shape[1], src_shape[0]
+    ) == tuple(dst_shape):
+        return "conv_t"  # torch Conv2d [O, I, H, W] → flax [H, W, I, O]
+    return None
+
+
+def _apply(x: np.ndarray, how: str) -> np.ndarray:
+    if how == "same":
+        return x
+    if how == "linear_t":
+        return x.T
+    return np.transpose(x, (2, 3, 1, 0))
+
+
+def import_torch_state_dict(flax_params: Pytree, state_dict: Dict[str, Any],
+                            strict: bool = True) -> Pytree:
+    """Map a torch ``state_dict`` onto a flax params tree by structural
+    position: both are walked in layer order and each torch tensor must
+    fit the corresponding flax leaf directly or via the standard
+    Linear/Conv transposes.
+
+    This is the generic zoo-scale importer (an exact named mapper for
+    Llama lives in ``models/llm/hf_convert.py``). It requires the torch
+    module to mirror the flax model's layer order — the natural case for
+    the reference's sequential LR/MLP/CNN models. Buffers that have no
+    flax twin (``num_batches_tracked``) are skipped. ``strict=False``
+    leaves unmatched flax leaves at their initialized values.
+    """
+    entries = [(k, to_numpy(v)) for k, v in state_dict.items()
+               if not k.endswith("num_batches_tracked")]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(flax_params)
+
+    # Modules pair positionally, but WITHIN a module the two worlds order
+    # differently (torch: weight, bias; flax sorts: bias, kernel) — so
+    # group both sides by module and shape-match inside each group.
+    def _groups(items, keyfn):
+        out, cur_key = [], object()
+        for it in items:
+            k = keyfn(it)
+            if k != cur_key:
+                out.append([])
+                cur_key = k
+            out[-1].append(it)
+        return out
+
+    fgroups = _groups(flat, lambda pl: tuple(
+        str(getattr(p, "key", p)) for p in pl[0][:-1]))
+    tgroups = _groups(
+        entries,
+        lambda kv: kv[0].rsplit(".", 1)[0] if "." in kv[0] else "")
+    if len(fgroups) != len(tgroups):
+        if strict:
+            raise ValueError(
+                f"module count mismatch: flax has {len(fgroups)} modules, "
+                f"torch state_dict has {len(tgroups)}")
+        tgroups = tgroups[: len(fgroups)]
+
+    filled: Dict[int, Any] = {}
+    leaf_pos = 0
+    for fg, tg in zip(fgroups, tgroups):
+        unused = list(range(len(tg)))
+        for path, leaf in fg:
+            shape = np.shape(leaf)
+            hit = None
+            for ui in unused:
+                how = _fits(np.shape(tg[ui][1]), shape)
+                if how is not None:
+                    hit = (ui, how)
+                    break
+            if hit is None:
+                if strict:
+                    name = "/".join(str(getattr(p, "key", p)) for p in path)
+                    raise ValueError(
+                        f"no torch tensor in module {tg[0][0].rsplit('.', 1)[0]!r} "
+                        f"fits flax leaf {name} {shape} "
+                        f"(candidates: {[np.shape(tg[u][1]) for u in unused]})")
+                filled[leaf_pos] = leaf
+            else:
+                ui, how = hit
+                unused.remove(ui)
+                filled[leaf_pos] = jnp.asarray(
+                    _apply(tg[ui][1], how), np.asarray(leaf).dtype)
+            leaf_pos += 1
+        if strict and unused:
+            raise ValueError(
+                f"torch tensors left over in module "
+                f"{tg[0][0].rsplit('.', 1)[0]!r}: {[tg[u][0] for u in unused]}")
+    leaves = [filled.get(i, flat[i][1]) for i in range(len(flat))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

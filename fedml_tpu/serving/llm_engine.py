@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -112,12 +113,21 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
         self._prefill_cache: Dict[int, Any] = {}
+        # prefills stall every active decode stream (the decode program
+        # can't run concurrently with a prefill on one chip): admit at
+        # most this many queued requests between decode steps so a burst
+        # of arrivals can't starve in-flight generations. Measured in
+        # tools/serving_load_bench.py; invariant-tested in
+        # tests/test_serving_schedule.py.
+        self.admit_per_step = 1
+        self.oplog: deque = deque(maxlen=4096)  # ("prefill"|"decode", ...)
 
         model_apply = model.apply
 
         def prefill_fn(params, caches, tokens, slot, true_len):
             """tokens [1, P] (padded): fill slot's cache rows, return the
-            next-token logits at the prompt's true end."""
+            next-token logits at the prompt's true end + its argmax (the
+            greedy path never pulls the [V] logits to host)."""
             sub = [
                 (
                     jax.lax.dynamic_slice_in_dim(k, slot, 1, axis=0),
@@ -137,10 +147,15 @@ class ContinuousBatchingEngine:
                 )
                 for (k, v), (nk, nv, _) in zip(caches, new_sub)
             ]
-            return caches, logits[0, true_len - 1]
+            last = logits[0, true_len - 1]
+            return caches, last, jnp.argmax(last).astype(jnp.int32)
 
         def decode_fn(params, caches, last_tokens, lengths):
-            """One token for every slot: [B] → [B, V] next-token logits."""
+            """One token for every slot: [B] → [B, V] next-token logits
+            plus the greedy argmax [B]. Greedy streams read back only the
+            [B] int32 tokens — pulling the [B, V] logits to host every
+            step costs ~1 MB/step of device→host traffic and dominated
+            per-token latency in the load bench (PERF_NOTES)."""
             sub = [(k, v, lengths) for k, v in caches]
             logits, new_sub = model_apply(
                 params,
@@ -149,7 +164,8 @@ class ContinuousBatchingEngine:
                 kv_caches=sub,
             )
             caches = [(k, v) for k, v, _ in new_sub]
-            return caches, logits[:, 0, :]
+            logits = logits[:, 0, :]
+            return caches, logits, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
@@ -231,9 +247,10 @@ class ContinuousBatchingEngine:
         rid, prompt, max_new, temp, seed, eos, out = req
         slot_idx = next(i for i, s in enumerate(self.slots) if not s.active)
         p = self._bucket(len(prompt))
+        self.oplog.append(("prefill", p, self.active_slots))
         padded = np.zeros((1, p), np.int32)
         padded[0, : len(prompt)] = prompt
-        self.caches, last_logits = self._prefill(
+        self.caches, last_logits, greedy = self._prefill(
             self.params, self.caches, jnp.asarray(padded),
             jnp.int32(slot_idx), jnp.int32(len(prompt)),
         )
@@ -248,12 +265,18 @@ class ContinuousBatchingEngine:
         slot.active = True
         slot.tokens = []
         self.lengths[slot_idx] = len(prompt)
-        self._emit(slot_idx, np.asarray(last_logits))
+        if slot.temperature > 0.0:
+            self._emit(slot_idx, logits=np.asarray(last_logits))
+        else:
+            self._emit(slot_idx, tok=int(greedy))
 
-    def _emit(self, slot_idx: int, logits: np.ndarray) -> None:
-        """Sample one token for a slot; stream it; retire on EOS/max."""
+    def _emit(self, slot_idx: int, logits: Optional[np.ndarray] = None,
+              tok: Optional[int] = None) -> None:
+        """Stream one token for a slot (sampled from ``logits`` or the
+        device-computed greedy ``tok``); retire on EOS/max."""
         slot = self.slots[slot_idx]
-        tok = self._sample(slot, logits)
+        if tok is None:
+            tok = self._sample(slot, logits)
         slot.last_token = tok
         slot.generated += 1
         slot.tokens.append(tok)
@@ -266,8 +289,16 @@ class ContinuousBatchingEngine:
 
     def _loop(self) -> None:
         while not self._stopping.is_set():
-            # admit as many waiting requests as there are free slots
+            # Admit waiting requests into free slots — but when decodes
+            # are in flight, at most admit_per_step per decode step: each
+            # prefill stalls every active stream for a full prompt-length
+            # forward pass, so draining a burst of arrivals here would
+            # starve in-flight generations (measured: ~1 bucketed-prefill
+            # stall per admitted request, tools/serving_load_bench.py).
+            admitted = 0
             while self.active_slots < self.n_slots:
+                if self.active_slots and admitted >= self.admit_per_step:
+                    break
                 try:
                     # never stall active decodes waiting for new arrivals
                     if self.active_slots:
@@ -277,18 +308,25 @@ class ContinuousBatchingEngine:
                 except queue.Empty:
                     break
                 self._admit(req)
+                admitted += 1
             if self.active_slots == 0:
                 continue
             self.step()
 
     def step(self) -> None:
         """One batched decode step for every active slot."""
+        self.oplog.append(("decode", self.active_slots, 0))
         last = np.asarray([s.last_token for s in self.slots], np.int32)
         lengths = jnp.asarray(self.lengths)
-        self.caches, logits = self._decode(
+        self.caches, logits_dev, greedy_dev = self._decode(
             self.params, self.caches, jnp.asarray(last), lengths
         )
-        logits = np.asarray(logits)
+        # pull the [B, V] logits only if some active slot samples; greedy
+        # streams need just the [B] int32 argmax
+        need_logits = any(s.active and s.temperature > 0.0
+                          for s in self.slots)
+        logits = np.asarray(logits_dev) if need_logits else None
+        greedy = np.asarray(greedy_dev)
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
@@ -298,4 +336,7 @@ class ContinuousBatchingEngine:
                 slot.out.put(None)
                 slot.active = False
                 continue
-            self._emit(i, logits[i])
+            if slot.temperature > 0.0:
+                self._emit(i, logits=logits[i])
+            else:
+                self._emit(i, tok=int(greedy[i]))

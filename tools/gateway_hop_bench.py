@@ -1,0 +1,77 @@
+"""Gateway-hop latency (VERDICT r4 task 4, "include the gateway hop").
+
+Measures the added latency of routing through the InferenceGateway vs
+hitting the predictor runner directly, with a trivial predictor so the
+numbers isolate the proxy (resolve + round-robin + forward + stream-back)
+rather than model time. Reference counterpart: the FastAPI gateway at
+``model_scheduler/device_model_inference.py:52-132``.
+
+Run:  python tools/gateway_hop_bench.py [--n 200]
+"""
+import argparse
+import json
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
+from fedml_tpu.deploy.gateway import InferenceGateway
+from fedml_tpu.serving.inference_runner import FedMLInferenceRunner
+from fedml_tpu.serving.predictor import FedMLPredictor
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--n", type=int, default=200)
+cli = ap.parse_args()
+
+
+class Echo(FedMLPredictor):
+    def predict(self, request):
+        return {"echo": request}
+
+
+runner = FedMLInferenceRunner(Echo(), host="127.0.0.1", port=0)
+runner.start()
+time.sleep(0.3)
+direct = f"http://127.0.0.1:{runner.port}"
+
+with tempfile.TemporaryDirectory() as td:
+    cache = EndpointCache(td + "/endpoints.json")
+    cache.upsert_endpoint("ep1", endpoint_name="echo", model_name="echo",
+                          model_version=1, status=EndpointStatus.DEPLOYED)
+    cache.set_replica("ep1", "w1", url=direct,
+                      status=EndpointStatus.DEPLOYED)
+    gw = InferenceGateway(cache).start()
+    via_gw = f"http://127.0.0.1:{gw.port}/inference/ep1"
+
+    def post(url):
+        req = urllib.request.Request(
+            url if url != direct else url + "/predict",
+            data=json.dumps({"x": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=10) as r:
+            r.read()
+        return time.perf_counter() - t0
+
+    for _ in range(20):  # warm sockets/handlers
+        post(direct)
+        post(via_gw)
+    td_ms = np.asarray([post(direct) for _ in range(cli.n)]) * 1e3
+    tg_ms = np.asarray([post(via_gw) for _ in range(cli.n)]) * 1e3
+    gw.stop()
+
+out = {
+    "direct_p50_ms": round(float(np.percentile(td_ms, 50)), 2),
+    "direct_p99_ms": round(float(np.percentile(td_ms, 99)), 2),
+    "gateway_p50_ms": round(float(np.percentile(tg_ms, 50)), 2),
+    "gateway_p99_ms": round(float(np.percentile(tg_ms, 99)), 2),
+    "hop_added_p50_ms": round(float(np.percentile(tg_ms, 50)
+                                    - np.percentile(td_ms, 50)), 2),
+    "n": cli.n,
+}
+print(json.dumps(out))
