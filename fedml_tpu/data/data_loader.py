@@ -179,12 +179,23 @@ def load_mnist(args: Any) -> FederatedDataset:
     """MNIST: real ``mnist.npz`` if cached locally, else synthetic 28×28."""
     cache = str(getattr(args, "data_cache_dir", "") or "")
     path = os.path.join(cache, "mnist.npz") if cache else ""
+    idx = os.path.join(cache, "train-images-idx3-ubyte") if cache else ""
     if path and os.path.exists(path):
         with np.load(path) as d:
             xtr = (d["x_train"].astype(np.float32) / 255.0).reshape(-1, 784)
             ytr = d["y_train"].astype(np.int32)
             xte = (d["x_test"].astype(np.float32) / 255.0).reshape(-1, 784)
             yte = d["y_test"].astype(np.int32)
+    elif idx and os.path.exists(idx):
+        # the raw download format (yann.lecun.com idx files) — parsed by
+        # the native reader (C++ kernel or bit-identical numpy twin)
+        from fedml_tpu.data.native_reader import read_mnist
+
+        xtr, ytr = read_mnist(idx, os.path.join(
+            cache, "train-labels-idx1-ubyte"))
+        xte, yte = read_mnist(
+            os.path.join(cache, "t10k-images-idx3-ubyte"),
+            os.path.join(cache, "t10k-labels-idx1-ubyte"))
     else:
         _synthetic_fallback("mnist", f"no mnist.npz under {cache!r}")
         xtr, ytr, xte, yte = _make_classification_arrays(
@@ -314,6 +325,24 @@ def _load_image_or_synthetic(args, shape, classes, name):
                 d["x_test"].astype(np.float32) / 255.0,
                 d["y_test"].astype(np.int32).ravel(),
             )
+    bin1 = os.path.join(cache, "data_batch_1.bin") if cache else ""
+    if name == "cifar10" and bin1 and os.path.exists(bin1):
+        # the raw cifar-10-binary download layout — native reader (C++
+        # kernel or bit-identical numpy twin), CHW records → HWC floats
+        from fedml_tpu.data.native_reader import read_cifar10_batches
+
+        train_bins = [os.path.join(cache, f"data_batch_{i}.bin")
+                      for i in range(1, 6)]
+        xtr, ytr = read_cifar10_batches(
+            [p for p in train_bins if os.path.exists(p)])
+        test_bin = os.path.join(cache, "test_batch.bin")
+        if os.path.exists(test_bin):
+            xte, yte = read_cifar10_batches([test_bin])
+        else:  # no test batch shipped: hold out the tail of train
+            k = max(1, len(ytr) // 10)
+            xte, yte = xtr[-k:], ytr[-k:]
+            xtr, ytr = xtr[:-k], ytr[:-k]
+        return xtr, ytr, xte, yte
     _synthetic_fallback(name, f"no {name}.npz under {cache!r}")
     return _make_classification_arrays(
         int(getattr(args, "train_size", 4000)),

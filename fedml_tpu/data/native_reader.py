@@ -1,0 +1,170 @@
+"""Raw-format dataset readers: MNIST idx + CIFAR-10 binary — C++ kernel
+with a numpy twin.
+
+Parity target: the reference's native MobileNN dataset readers
+(``android/fedmlsdk/MobileNN/src/MNN/{mnist,cifar10}.cpp``,
+``src/torch/{mnist,cifar10}.cpp``), which parse exactly these raw
+formats for the on-device trainer. Here they feed the data registry /
+cross-device runtime: ``native/dataset.cpp`` via ctypes when a
+toolchain is present, bit-identical numpy fallback otherwise
+(``tests/test_native_reader.py`` enforces parity).
+
+Formats:
+- idx3/idx1 (big-endian magic 0x803/0x801): images → float32 [0, 1]
+  flattened rows, labels → int32;
+- CIFAR-10 binary batches (3073-byte records, CHW uint8): images →
+  float32 [0, 1] **HWC** (TPU/XLA's native conv layout), labels int32.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdataset.so")
+_lib = None
+_lib_tried = False
+
+
+def _load_native():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR, "libdataset.so"],
+                           check=True, capture_output=True, timeout=120)
+        except Exception as e:  # pragma: no cover
+            logger.info("native dataset build unavailable (%s); numpy twin", e)
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        LL, F32P, I32P, LLP, CP = (ctypes.c_longlong,
+                                   ctypes.POINTER(ctypes.c_float),
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.POINTER(ctypes.c_longlong),
+                                   ctypes.c_char_p)
+        lib.mnist_read_images.restype = LL
+        lib.mnist_read_images.argtypes = [CP, F32P, LL, LLP, LLP]
+        lib.mnist_read_labels.restype = LL
+        lib.mnist_read_labels.argtypes = [CP, I32P, LL]
+        lib.cifar10_read_batch.restype = LL
+        lib.cifar10_read_batch.argtypes = [CP, F32P, I32P, LL]
+        _lib = lib
+    except OSError as e:  # pragma: no cover
+        logger.info("native dataset load failed (%s); numpy twin", e)
+        _lib = None
+    return _lib
+
+
+# -- numpy twins (bit-identical; also the no-toolchain path) ---------------
+
+def _mnist_images_np(path: str, max_n: Optional[int]) -> np.ndarray:
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) < 16 or int.from_bytes(raw[:4], "big") != 0x803:
+        raise ValueError(f"{path}: not an idx3 image file")
+    n, r, c = (int.from_bytes(raw[o: o + 4], "big") for o in (4, 8, 12))
+    if max_n is not None:
+        n = min(n, max_n)
+    body = np.frombuffer(raw, np.uint8, count=n * r * c, offset=16)
+    return (body.astype(np.float32) / 255.0).reshape(n, r * c)
+
+
+def _mnist_labels_np(path: str, max_n: Optional[int]) -> np.ndarray:
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) < 8 or int.from_bytes(raw[:4], "big") != 0x801:
+        raise ValueError(f"{path}: not an idx1 label file")
+    n = int.from_bytes(raw[4:8], "big")
+    if max_n is not None:
+        n = min(n, max_n)
+    return np.frombuffer(raw, np.uint8, count=n, offset=8).astype(np.int32)
+
+
+def _cifar10_np(path: str,
+                max_n: Optional[int]) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.fromfile(path, np.uint8)
+    rec = 1 + 3 * 32 * 32
+    n = raw.size // rec
+    if max_n is not None:
+        n = min(n, max_n)
+    rows = raw[: n * rec].reshape(n, rec)
+    labels = rows[:, 0].astype(np.int32)
+    chw = rows[:, 1:].reshape(n, 3, 32, 32)
+    hwc = np.transpose(chw, (0, 2, 3, 1)).astype(np.float32) / 255.0
+    return hwc, labels
+
+
+# -- public API ------------------------------------------------------------
+
+def read_mnist(images_path: str, labels_path: str,
+               max_n: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """(x [n, 784] float32 in [0,1], y [n] int32) from raw idx files."""
+    lib = _load_native()
+    if lib is None:
+        return (_mnist_images_np(images_path, max_n),
+                _mnist_labels_np(labels_path, max_n))
+    rows = ctypes.c_longlong()
+    cols = ctypes.c_longlong()
+    n = lib.mnist_read_images(images_path.encode(), None, 0,
+                              ctypes.byref(rows), ctypes.byref(cols))
+    if n < 0:
+        raise ValueError(f"{images_path}: not an idx3 image file")
+    if max_n is not None:
+        n = min(n, max_n)
+    x = np.empty((n, rows.value * cols.value), np.float32)
+    got = lib.mnist_read_images(
+        images_path.encode(),
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+        ctypes.byref(rows), ctypes.byref(cols))
+    y = np.empty((n,), np.int32)
+    gotl = lib.mnist_read_labels(
+        labels_path.encode(),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+    if gotl < 0:
+        raise ValueError(f"{labels_path}: not an idx1 label file")
+    m = min(int(got), int(gotl))
+    return x[:m], y[:m]
+
+
+def read_cifar10_batches(paths, max_n: Optional[int] = None
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """(x [n, 32, 32, 3] float32 HWC in [0,1], y [n] int32) from binary
+    batch files (concatenated in the given order)."""
+    lib = _load_native()
+    xs, ys = [], []
+    remaining = max_n
+    for path in paths:
+        if remaining is not None and remaining <= 0:
+            break
+        if lib is None:
+            x, y = _cifar10_np(path, remaining)
+        else:
+            rec_bytes = os.path.getsize(path)
+            cap = rec_bytes // (1 + 3 * 32 * 32)
+            if remaining is not None:
+                cap = min(cap, remaining)
+            x = np.empty((cap, 32, 32, 3), np.float32)
+            y = np.empty((cap,), np.int32)
+            n = lib.cifar10_read_batch(
+                path.encode(),
+                x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+            if n < 0:
+                raise ValueError(f"{path}: unreadable CIFAR-10 batch")
+            x, y = x[:n], y[:n]
+        xs.append(x)
+        ys.append(y)
+        if remaining is not None:
+            remaining -= len(y)
+    return np.concatenate(xs), np.concatenate(ys)

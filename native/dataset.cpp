@@ -1,0 +1,129 @@
+// Native dataset readers — MNIST idx and CIFAR-10 binary formats.
+//
+// TPU-era equivalent of the reference's MobileNN dataset readers
+// (android/fedmlsdk/MobileNN/src/MNN/{mnist,cifar10}.cpp and
+// src/torch/{mnist,cifar10}.cpp — C++ parsers feeding the on-device
+// trainer). Here they feed the cross-device client runtime / data
+// registry: same raw file formats (big-endian idx, 3073-byte CIFAR
+// records), parsed without Python-loop overhead. The numpy twin lives
+// in fedml_tpu/data/native_reader.py; parity is enforced by
+// tests/test_native_reader.py.
+//
+// Build:  make -C native        (produces native/libdataset.so)
+// Bind:   ctypes, no pybind11 needed.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+uint32_t be32(const unsigned char* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse an idx3 image file (magic 0x00000803). Returns the number of
+// images written to `out` (float32, scaled to [0,1], row-major
+// n*rows*cols), or -1 on format error. `max_n` caps the count
+// (max_n <= 0 means "probe": returns the file's image count and writes
+// only the header values).
+long long mnist_read_images(const char* path, float* out, long long max_n,
+                            long long* rows, long long* cols) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char hdr[16];
+    if (fread(hdr, 1, 16, f) != 16 || be32(hdr) != 0x803) {
+        fclose(f);
+        return -1;
+    }
+    long long n = be32(hdr + 4), r = be32(hdr + 8), c = be32(hdr + 12);
+    *rows = r;
+    *cols = c;
+    if (max_n <= 0) {
+        fclose(f);
+        return n;
+    }
+    if (n > max_n) n = max_n;
+    const long long px = r * c;
+    unsigned char* buf = new unsigned char[px];
+    for (long long i = 0; i < n; ++i) {
+        if ((long long)fread(buf, 1, px, f) != px) {
+            delete[] buf;
+            fclose(f);
+            return i;  // truncated file: return what parsed cleanly
+        }
+        float* o = out + i * px;
+        for (long long j = 0; j < px; ++j) o[j] = buf[j] / 255.0f;
+    }
+    delete[] buf;
+    fclose(f);
+    return n;
+}
+
+// Parse an idx1 label file (magic 0x00000801) into int32 labels.
+long long mnist_read_labels(const char* path, int32_t* out,
+                            long long max_n) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char hdr[8];
+    if (fread(hdr, 1, 8, f) != 8 || be32(hdr) != 0x801) {
+        fclose(f);
+        return -1;
+    }
+    long long n = be32(hdr + 4);
+    if (max_n <= 0) {
+        fclose(f);
+        return n;
+    }
+    if (n > max_n) n = max_n;
+    for (long long i = 0; i < n; ++i) {
+        int ch = fgetc(f);
+        if (ch == EOF) {
+            fclose(f);
+            return i;
+        }
+        out[i] = (int32_t)ch;
+    }
+    fclose(f);
+    return n;
+}
+
+// Parse a CIFAR-10 binary batch (3073-byte records: label + 3x32x32
+// CHW uint8). Writes images as float32 [0,1] in HWC order (the TPU/XLA
+// native conv layout) and int32 labels; returns record count or -1.
+long long cifar10_read_batch(const char* path, float* images,
+                             int32_t* labels, long long max_n) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    const long long rec = 1 + 3 * 32 * 32;
+    unsigned char buf[1 + 3 * 32 * 32];
+    long long i = 0;
+    while (max_n <= 0 || i < max_n) {
+        size_t got = fread(buf, 1, rec, f);
+        if (got == 0) break;
+        if ((long long)got != rec) {
+            fclose(f);
+            return max_n <= 0 ? i : i;  // truncated tail record dropped
+        }
+        if (max_n > 0) {
+            labels[i] = (int32_t)buf[0];
+            float* o = images + i * 3 * 32 * 32;
+            // CHW -> HWC
+            for (int h = 0; h < 32; ++h)
+                for (int w = 0; w < 32; ++w)
+                    for (int ch = 0; ch < 3; ++ch)
+                        o[(h * 32 + w) * 3 + ch] =
+                            buf[1 + ch * 1024 + h * 32 + w] / 255.0f;
+        }
+        ++i;
+    }
+    fclose(f);
+    return i;
+}
+
+}  // extern "C"

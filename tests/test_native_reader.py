@@ -1,0 +1,131 @@
+"""Native dataset readers (SURVEY §2.9 MobileNN datasets, TPU-mapped):
+C++ idx/CIFAR-binary parsers vs the numpy twin, on synthesized raw
+files, plus the data-registry wiring."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import native_reader as nr
+
+
+def _write_idx(tmp_path, n=40, r=28, c=28, seed=0):
+    rng = np.random.default_rng(seed)
+    imgs = rng.integers(0, 256, size=(n, r, c), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=n, dtype=np.uint8)
+    ip = tmp_path / "train-images-idx3-ubyte"
+    lp = tmp_path / "train-labels-idx1-ubyte"
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, n, r, c))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 0x801, n))
+        f.write(labels.tobytes())
+    return str(ip), str(lp), imgs, labels
+
+
+def _write_cifar(tmp_path, name, n=30, seed=1):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n, dtype=np.uint8)
+    chw = rng.integers(0, 256, size=(n, 3, 32, 32), dtype=np.uint8)
+    p = tmp_path / name
+    with open(p, "wb") as f:
+        for i in range(n):
+            f.write(bytes([labels[i]]) + chw[i].tobytes())
+    return str(p), chw, labels
+
+
+def test_mnist_native_matches_twin_and_truth(tmp_path):
+    ip, lp, imgs, labels = _write_idx(tmp_path)
+    x, y = nr.read_mnist(ip, lp)
+    assert x.shape == (40, 784) and y.shape == (40,)
+    np.testing.assert_allclose(
+        x, imgs.reshape(40, 784).astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(y, labels.astype(np.int32))
+    # twin parity (bit-identical)
+    tx = nr._mnist_images_np(ip, None)
+    ty = nr._mnist_labels_np(lp, None)
+    np.testing.assert_array_equal(x, tx)
+    np.testing.assert_array_equal(y, ty)
+
+
+def test_mnist_max_n_and_bad_magic(tmp_path):
+    ip, lp, *_ = _write_idx(tmp_path, n=20)
+    x, y = nr.read_mnist(ip, lp, max_n=7)
+    assert x.shape == (7, 784) and y.shape == (7,)
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00\x00\x00\x00" + b"\x00" * 32)
+    with pytest.raises(ValueError):
+        nr.read_mnist(str(bad), lp)
+
+
+def test_cifar_native_matches_twin_and_truth(tmp_path):
+    p1, chw1, l1 = _write_cifar(tmp_path, "data_batch_1.bin", n=12, seed=2)
+    p2, chw2, l2 = _write_cifar(tmp_path, "data_batch_2.bin", n=9, seed=3)
+    x, y = nr.read_cifar10_batches([p1, p2])
+    assert x.shape == (21, 32, 32, 3)
+    want = np.transpose(np.concatenate([chw1, chw2]),
+                        (0, 2, 3, 1)).astype(np.float32) / 255.0
+    np.testing.assert_allclose(x, want)
+    np.testing.assert_array_equal(y, np.concatenate([l1, l2]).astype(np.int32))
+    tx, ty = nr._cifar10_np(p1, None)
+    np.testing.assert_array_equal(x[:12], tx)
+    np.testing.assert_array_equal(y[:12], ty)
+
+
+def test_registry_mnist_idx_branch(tmp_path):
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+
+    _write_idx(tmp_path, n=60)
+    # test split files (t10k names)
+    rng = np.random.default_rng(9)
+    timgs = rng.integers(0, 256, size=(10, 28, 28), dtype=np.uint8)
+    tlabels = rng.integers(0, 10, size=10, dtype=np.uint8)
+    with open(tmp_path / "t10k-images-idx3-ubyte", "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, 10, 28, 28))
+        f.write(timgs.tobytes())
+    with open(tmp_path / "t10k-labels-idx1-ubyte", "wb") as f:
+        f.write(struct.pack(">II", 0x801, 10))
+        f.write(tlabels.tobytes())
+
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "mnist", "data_cache_dir": str(tmp_path),
+                      "partition_method": "homo"},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 3, "client_num_per_round": 3,
+                       "comm_round": 1, "epochs": 1, "batch_size": 8,
+                       "learning_rate": 0.1},
+    }))
+    ds = load_federated(args)
+    assert ds.train_data_num == 60
+    x, _y = ds.test_data_global
+    assert np.asarray(x).shape[1] == 784
+
+
+def test_registry_cifar_bin_branch(tmp_path):
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+
+    for i in range(1, 6):
+        _write_cifar(tmp_path, f"data_batch_{i}.bin", n=10, seed=10 + i)
+    _write_cifar(tmp_path, "test_batch.bin", n=8, seed=20)
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "cifar10", "data_cache_dir": str(tmp_path),
+                      "partition_method": "homo"},
+        "model_args": {"model": "cnn"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 1, "epochs": 1, "batch_size": 8,
+                       "learning_rate": 0.1},
+    }))
+    ds = load_federated(args)
+    assert ds.train_data_num == 50
+    x, _y = ds.test_data_global
+    assert tuple(np.asarray(x).shape[1:]) == (32, 32, 3)

@@ -26,7 +26,7 @@ sys.path.insert(0, "/root/repo")
 import numpy as np
 
 ap = argparse.ArgumentParser()
-ap.add_argument("--model", default="tiny", choices=["tiny", "1b"])
+ap.add_argument("--model", default="tiny", choices=["tiny", "1b", "7b"])
 ap.add_argument("--loads", default="0.5,1,2",
                 help="offered loads, requests/second, comma-separated")
 ap.add_argument("--requests", type=int, default=32)
@@ -47,7 +47,16 @@ import jax.numpy as jnp
 from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
 from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
 
-if cli.model == "1b":
+if cli.model == "7b":
+    # int8-only on one v5e: bf16 weights + KV cannot fit (PERF_NOTES r4)
+    cli.quantize = cli.quantize or "int8"
+    cfg = LlamaConfig.llama2_7b(
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        remat=False, remat_policy="none", use_flash=False,
+    )
+    max_len, prompt_hi = 768, 512
+    cli.slots = min(cli.slots, 4)  # KV is ~1.07 GB/slot at max_len 768
+elif cli.model == "1b":
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_hidden_layers=22, num_attention_heads=32,
