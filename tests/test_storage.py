@@ -124,6 +124,39 @@ def test_reupload_unpins_superseded_blob(mgr, tmp_path):
     assert open(out, "rb").read() == b"round-2!"
 
 
+def test_storage_manager_over_s3_twin(tmp_path, monkeypatch):
+    """The s3 service end to end against the in-process SigV4 twin from
+    tests/test_remote_storage.py — upload/list/download/delete with real
+    signed HTTP requests."""
+    from test_remote_storage import _S3Twin, s3_twin  # noqa: F401
+
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    _S3Twin.blobs, _S3Twin.auth_failures = {}, []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _S3Twin)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    endpoint = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        monkeypatch.setenv("FEDML_TPU_STORAGE_DIR", str(tmp_path / "root"))
+        mgr = StorageManager(
+            "s3", endpoint=endpoint, bucket="models",
+            access_key="AKIDEXAMPLE", secret_key="wJalrXUtnFEMI/K7MDENG")
+        src = tmp_path / "adapter.bin"
+        src.write_bytes(b"lora-adapter-bytes")
+        meta = mgr.upload(str(src), description="round 7")
+        assert not _S3Twin.auth_failures
+        assert _S3Twin.blobs  # bytes really landed behind signed PUTs
+        assert [m.name for m in mgr.list()] == ["adapter.bin"]
+        out = mgr.download("adapter.bin", dest=str(tmp_path / "o.bin"))
+        assert open(out, "rb").read() == b"lora-adapter-bytes"
+        assert mgr.delete("adapter.bin")
+        assert not _S3Twin.blobs  # delete propagated
+        assert meta.service == "s3"
+    finally:
+        srv.shutdown()
+
+
 def test_storage_cli(tmp_path, monkeypatch):
     from click.testing import CliRunner
 
