@@ -30,6 +30,7 @@ COMM_BACKEND_GRPC = "GRPC"
 COMM_BACKEND_XLA_ICI = "XLA_ICI"  # intra-pod ranks == mesh axes, XLA collectives
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"  # gated: requires paho-mqtt + boto3
 COMM_BACKEND_BROKER = "BROKER"    # in-tree pub/sub broker + object store
+COMM_BACKEND_TRPC = "TRPC"        # torch.distributed.rpc (TensorPipe)
                                   # (the MQTT+S3 deployment shape, no deps)
 
 # ---- federated optimizers ---------------------------------------------------

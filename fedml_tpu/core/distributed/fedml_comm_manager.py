@@ -73,6 +73,7 @@ class FedMLCommManager(Observer):
         if str(self.backend).upper() in (
             constants.COMM_BACKEND_BROKER,
             constants.COMM_BACKEND_GRPC,
+            constants.COMM_BACKEND_TRPC,
         ):
             self.receive_message(
                 self.MSG_TYPE_CONNECTION_IS_READY,
@@ -135,6 +136,19 @@ class FedMLCommManager(Observer):
                 client_id=self.rank,
                 client_num=self.size,
                 base_port=int(getattr(self.args, "grpc_base_port", 8890)),
+            )
+        elif backend == constants.COMM_BACKEND_TRPC:
+            from fedml_tpu.core.distributed.communication.trpc_comm import (
+                TRPCCommManager,
+            )
+
+            self.com_manager = TRPCCommManager(
+                client_id=self.rank,
+                client_num=self.size,
+                master_addr=str(getattr(self.args, "trpc_master_addr",
+                                        "127.0.0.1")),
+                master_port=int(getattr(self.args, "trpc_master_port",
+                                        29500)),
             )
         elif backend == constants.COMM_BACKEND_XLA_ICI:
             from fedml_tpu.core.distributed.communication.xla_ici_comm import (
