@@ -179,23 +179,25 @@ def load_mnist(args: Any) -> FederatedDataset:
     """MNIST: real ``mnist.npz`` if cached locally, else synthetic 28×28."""
     cache = str(getattr(args, "data_cache_dir", "") or "")
     path = os.path.join(cache, "mnist.npz") if cache else ""
-    idx = os.path.join(cache, "train-images-idx3-ubyte") if cache else ""
+    idx_files = [os.path.join(cache, f) for f in (
+        "train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+        "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")] if cache else []
     if path and os.path.exists(path):
         with np.load(path) as d:
             xtr = (d["x_train"].astype(np.float32) / 255.0).reshape(-1, 784)
             ytr = d["y_train"].astype(np.int32)
             xte = (d["x_test"].astype(np.float32) / 255.0).reshape(-1, 784)
             yte = d["y_test"].astype(np.int32)
-    elif idx and os.path.exists(idx):
+    elif idx_files and all(os.path.exists(f) for f in idx_files):
         # the raw download format (yann.lecun.com idx files) — parsed by
-        # the native reader (C++ kernel or bit-identical numpy twin)
+        # the native reader (C++ kernel or bit-identical numpy twin).
+        # ALL four files must be present: a partial cache (interrupted
+        # download) takes the documented synthetic fallback instead of
+        # crashing on the missing sibling.
         from fedml_tpu.data.native_reader import read_mnist
 
-        xtr, ytr = read_mnist(idx, os.path.join(
-            cache, "train-labels-idx1-ubyte"))
-        xte, yte = read_mnist(
-            os.path.join(cache, "t10k-images-idx3-ubyte"),
-            os.path.join(cache, "t10k-labels-idx1-ubyte"))
+        xtr, ytr = read_mnist(idx_files[0], idx_files[1])
+        xte, yte = read_mnist(idx_files[2], idx_files[3])
     else:
         _synthetic_fallback("mnist", f"no mnist.npz under {cache!r}")
         xtr, ytr, xte, yte = _make_classification_arrays(

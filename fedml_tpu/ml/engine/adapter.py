@@ -43,11 +43,20 @@ def to_jax(data: Any, dtype=None) -> Any:
 
 
 def dataset_to_arrays(dataset: Any,
-                      limit: Optional[int] = None
+                      limit: Optional[int] = None,
+                      batched: Optional[bool] = None
                       ) -> Tuple[np.ndarray, np.ndarray]:
     """Drain a torch ``Dataset``/``DataLoader`` (or any iterable of
     (x, y) pairs / batches) into stacked numpy (x, y) — the form the
-    federated data registry partitions."""
+    federated data registry partitions.
+
+    ``batched`` says whether each yielded item is a batch (concatenate)
+    or one sample (stack). Default: DataLoader-like objects (anything
+    exposing ``batch_size``) are batches, everything else is
+    per-sample — NOT a shape heuristic, which would silently corrupt
+    e.g. segmentation datasets whose (x, y) dims coincide."""
+    if batched is None:
+        batched = getattr(dataset, "batch_size", None) is not None
     xs, ys = [], []
     for item in dataset:
         if not (isinstance(item, (list, tuple)) and len(item) == 2):
@@ -61,10 +70,7 @@ def dataset_to_arrays(dataset: Any,
         ys.append(np.asarray(y))
         if limit is not None and len(xs) >= limit:
             break
-    x0 = xs[0]
-    if np.ndim(ys[0]) >= 1 and ys[0].shape[:1] == x0.shape[:1] and (
-            np.ndim(x0) > 1):
-        # already batched (DataLoader): concatenate along batch dim
+    if batched:
         return np.concatenate(xs, 0), np.concatenate(ys, 0)
     return np.stack(xs, 0), np.stack(ys, 0)
 

@@ -233,7 +233,13 @@ class StorageManager:
         if meta.is_dir:
             os.makedirs(dest, exist_ok=True)
             with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
-                tar.extractall(dest, filter="data")
+                try:
+                    tar.extractall(dest, filter="data")
+                except TypeError:
+                    # filter= appeared mid-3.10/3.11; the archive is one
+                    # we wrote ourselves and its bytes just passed the
+                    # sha256 check, so plain extraction is acceptable
+                    tar.extractall(dest)
         else:
             parent = os.path.dirname(os.path.abspath(dest))
             os.makedirs(parent, exist_ok=True)

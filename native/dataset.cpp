@@ -108,7 +108,7 @@ long long cifar10_read_batch(const char* path, float* images,
         if (got == 0) break;
         if ((long long)got != rec) {
             fclose(f);
-            return max_n <= 0 ? i : i;  // truncated tail record dropped
+            return i;  // truncated tail record dropped
         }
         if (max_n > 0) {
             labels[i] = (int32_t)buf[0];

@@ -107,6 +107,30 @@ def test_registry_mnist_idx_branch(tmp_path):
     assert np.asarray(x).shape[1] == 784
 
 
+def test_registry_mnist_partial_idx_cache_falls_back(tmp_path):
+    """Only the train-images file present (interrupted download): the
+    loader must take the synthetic fallback, not crash on siblings."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+
+    _write_idx(tmp_path, n=30)
+    os.remove(tmp_path / "train-labels-idx1-ubyte")
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "mnist", "data_cache_dir": str(tmp_path),
+                      "train_size": 50, "test_size": 10,
+                      "partition_method": "homo"},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 1, "epochs": 1, "batch_size": 8,
+                       "learning_rate": 0.1},
+    }))
+    ds = load_federated(args)  # synthetic stand-in, loudly logged
+    assert ds.train_data_num == 50
+
+
 def test_registry_cifar_bin_branch(tmp_path):
     import fedml_tpu
     from fedml_tpu.arguments import load_arguments_from_dict
