@@ -78,8 +78,10 @@ def llm_shape(hbm_bytes: float):
         # 7B config — hidden 4096, inter 11008, 32 layers, 32 MHA heads,
         # 6.76B params. bf16 frozen base = 13.5 GB of the v5e's 15.75 GB
         # HBM; fits with LoRA-only fp32 masters at B=1/T=512, remat OFF
-        # (measured round 4: 97.9 ms/step, MFU 0.72; B1/T1024 remat-off
-        # OOMs by 435 MB — tools/probe_7b.py reproduces both).
+        # (honest step 105-107 ms / MFU 0.66-0.67 — short probe chains
+        # read up to 8% fast, PERF_NOTES r5 addendum 5; B1/T1024
+        # remat-off OOMs by 435 MB; base_quantize int8 [QLoRA] fits
+        # B4/T512 at MFU 0.786 — tools/probe_7b.py reproduces all).
         import jax.numpy as jnp
 
         cfg = LlamaConfig.llama2_7b(
