@@ -1,0 +1,60 @@
+"""Guard bench.py's driver-facing surface: model-shape selection and the
+hardened chain-time estimator (the driver runs bench.py unattended at
+round end — a silent mis-selection would corrupt the recorded metric)."""
+import importlib
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import bench as mod
+
+    importlib.reload(mod)
+    return mod
+
+
+@pytest.mark.parametrize("which,want_batch,want_layers,want_quant", [
+    ("auto", 1, 32, ""),
+    ("7b", 1, 32, ""),
+    ("7b_qlora", 4, 32, "int8"),
+    ("1b", 8, 22, ""),
+])
+def test_llm_shape_selection(bench, monkeypatch, which, want_batch,
+                             want_layers, want_quant):
+    monkeypatch.setenv("FEDML_BENCH_MODEL", which)
+    cfg, batch, seq = bench.llm_shape(16e9)
+    assert batch == want_batch
+    assert cfg.num_hidden_layers == want_layers
+    # the qlora variant must flow into the trainer args via the env
+    import os
+
+    quant = ("int8" if os.environ.get("FEDML_BENCH_MODEL", "").lower()
+             == "7b_qlora" else "")
+    assert quant == want_quant
+
+
+def test_llm_shape_cpu_fallback(bench, monkeypatch):
+    monkeypatch.setenv("FEDML_BENCH_MODEL", "auto")
+    cfg, batch, seq = bench.llm_shape(0.0)
+    assert cfg.num_hidden_layers == 2  # tiny-dev model
+
+
+def test_llm_shape_rejects_unknown(bench, monkeypatch):
+    monkeypatch.setenv("FEDML_BENCH_MODEL", "gigantic")
+    with pytest.raises(SystemExit):
+        bench.llm_shape(16e9)
+
+
+def test_chain_time_discards_polluted_trials(bench):
+    seq = iter([1.0, 5.0, 2.0, 1.0, 2.6, 1.0, 2.62])  # trial 1 polluted
+    est = bench.chain_time(lambda n: next(seq), 1, 5, trials=3)
+    assert est == pytest.approx(0.4)
+
+
+def test_chain_time_upper_bound_when_all_polluted(bench):
+    seq = iter([1.0, 9.0, 2.0, 9.0, 2.0])  # every diff negative
+    est = bench.chain_time(lambda n: next(seq), 1, 5, trials=2)
+    assert est == pytest.approx(2.0 / 5)  # long chain mean, not -inf
