@@ -491,6 +491,44 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
 
 
 @cli.group()
+def telemetry() -> None:
+    """Inspect a run's telemetry sinks (spans, metrics, traces)."""
+
+
+@telemetry.command("report")
+@click.argument("run_dir")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the raw report dict as JSON")
+def telemetry_report(run_dir: str, as_json: bool) -> None:
+    """Per-round timeline + span percentiles + comm-bytes breakdown.
+
+    RUN_DIR is a run's sink directory (``.fedml_logs/run_<id>``) holding
+    the ``spans.jsonl`` / ``events.jsonl`` / ``telemetry.jsonl`` files the
+    telemetry layer writes during training/serving.
+    """
+    from fedml_tpu.telemetry.report import build_report, format_report
+
+    report = build_report(run_dir)
+    if not report["n_spans"]:
+        click.echo(f"no spans recorded under {run_dir}")
+        raise SystemExit(1)
+    if as_json:
+        stitched = report["stitched_spans"]
+        report = {**report, "stitched_spans": len(stitched)}
+        click.echo(json.dumps(report, indent=1))
+    else:
+        click.echo(format_report(report))
+
+
+@telemetry.command("prometheus")
+def telemetry_prometheus() -> None:
+    """Dump the current process's registry in Prometheus text format."""
+    from fedml_tpu.telemetry import get_registry
+
+    click.echo(get_registry().export_prometheus())
+
+
+@cli.group()
 def storage() -> None:
     """Manage stored artifacts (reference: `fedml storage`,
     ``cli/modules/storage.py`` — upload/download/list/delete over R2;

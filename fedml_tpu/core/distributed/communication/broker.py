@@ -21,7 +21,17 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from fedml_tpu.telemetry import (
+    activate_context,
+    current_context,
+    deactivate_context,
+    get_registry,
+    unwrap_frame_body,
+    wrap_frame_body,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +83,14 @@ class PubSubBroker:
         self._srv = socket.create_server((host, port))
         self._subs: Dict[str, List[socket.socket]] = {}
         self._lock = threading.Lock()
+        reg = get_registry()
+        self._m_bytes_in = reg.counter("broker/bytes_in")
+        self._m_bytes_out = reg.counter("broker/bytes_out")
+        self._m_publish = reg.counter("broker/publish_frames")
+        self._m_fanout = reg.counter("broker/fanout_deliveries")
+        self._m_dropped = reg.counter("broker/dropped_deliveries")
+        self._m_subscribers = reg.gauge("broker/subscriptions")
+        self._m_publish_ms = reg.histogram("broker/publish_ms")
         # one write lock per subscriber socket: concurrent publishers fan
         # out from their own _serve threads, and interleaved sendall calls
         # would corrupt the length-prefixed frame stream
@@ -106,11 +124,14 @@ class PubSubBroker:
                 payload = _recv_frame(conn)
                 if payload is None:
                     break
+                self._m_bytes_in.inc(len(payload) + 4)  # +4: length prefix
                 op, topic, body = _unpack(payload)
                 if op == _OP_SUB:
                     with self._lock:
                         self._subs.setdefault(topic, []).append(conn)
                         self._wlocks.setdefault(conn, threading.Lock())
+                        self._m_subscribers.set(
+                            sum(len(s) for s in self._subs.values()))
                 elif op == _OP_PUB:
                     self._route(topic, body)
         except (ConnectionError, ValueError, OSError):
@@ -121,6 +142,8 @@ class PubSubBroker:
                     if conn in subs:
                         subs.remove(conn)
                 self._wlocks.pop(conn, None)
+                self._m_subscribers.set(
+                    sum(len(s) for s in self._subs.values()))
             conn.close()
 
     def _route(self, topic: str, body: bytes) -> None:
@@ -130,12 +153,17 @@ class PubSubBroker:
                 for sock in self._subs.get(topic, [])
             ]
         frame = _pack(_OP_PUB, topic, body)
+        self._m_publish.inc()
+        t0 = time.time()
         for sock, wlock in targets:
             try:
                 with wlock:  # serialize frames per subscriber socket
                     _send_frame(sock, frame)
+                self._m_bytes_out.inc(len(frame) + 4)
+                self._m_fanout.inc()
             except OSError:
-                pass  # subscriber died; pruned on its reader exit
+                self._m_dropped.inc()  # subscriber died; pruned on exit
+        self._m_publish_ms.observe((time.time() - t0) * 1e3)
 
     def stop(self) -> None:
         self._stopping.set()
@@ -199,14 +227,26 @@ class NativePubSubBroker:
 
 
 class BrokerClient:
-    """Client connection: subscribe(topic, cb) + publish(topic, bytes)."""
+    """Client connection: subscribe(topic, cb) + publish(topic, bytes).
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    Trace propagation: when the publishing thread has an open telemetry
+    span, the span's context rides a header envelope prepended to the
+    body (opaque to both broker implementations); the subscriber strips
+    it and activates the context around the handler, so handler-side
+    spans stitch into the publisher's trace.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 propagate_trace: bool = True):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(None)
         self._handlers: Dict[str, Callable[[bytes], None]] = {}
         self._lock = threading.Lock()
         self._stopping = threading.Event()
+        self._propagate = bool(propagate_trace)
+        reg = get_registry()
+        self._m_pub_bytes = reg.counter("broker/client_bytes_out")
+        self._m_recv_bytes = reg.counter("broker/client_bytes_in")
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -216,6 +256,9 @@ class BrokerClient:
             _send_frame(self._sock, _pack(_OP_SUB, topic))
 
     def publish(self, topic: str, body: bytes) -> None:
+        if self._propagate and current_context() is not None:
+            body = wrap_frame_body(body)
+        self._m_pub_bytes.inc(len(body))
         with self._lock:
             _send_frame(self._sock, _pack(_OP_PUB, topic, body))
 
@@ -228,12 +271,17 @@ class BrokerClient:
             if payload is None:
                 return
             _, topic, body = _unpack(payload)
+            self._m_recv_bytes.inc(len(body))
+            ctx, body = unwrap_frame_body(body)
             handler = self._handlers.get(topic)
             if handler is not None:
+                token = activate_context(ctx)
                 try:
                     handler(body)
                 except Exception:
                     logger.exception("broker handler failed on %s", topic)
+                finally:
+                    deactivate_context(token)
 
     def close(self) -> None:
         self._stopping.set()

@@ -109,8 +109,10 @@ class BrokerCommManager(BaseCommunicationManager):
 
     # -- outbound ---------------------------------------------------------
     def send_message(self, msg: Message) -> None:
+        from fedml_tpu.telemetry import get_registry
         from fedml_tpu.utils.serialization import safe_dumps, tree_nbytes
 
+        reg = get_registry()
         params = dict(msg.get_params())
         for key in _OFFLOADABLE_KEYS:
             payload = params.get(key)
@@ -127,19 +129,22 @@ class BrokerCommManager(BaseCommunicationManager):
             # The returned key is authoritative: content-addressed backends
             # (web3/theta CAS) return a CID, not the advisory key.
             store_key = self.store.put_object(store_key, safe_dumps(payload))
+            reg.counter("comm/offload_bytes").inc(nbytes)
             if self.store.content_addressed:
                 self._reclaim_cas(store_key, msg.get_receiver_id())
             del params[key]
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_KEY] = store_key
             params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = f"store://{store_key}"
-        self.client.publish(
-            self._topic(msg.get_receiver_id()), safe_dumps(params)
-        )
+        wire = safe_dumps(params)
+        reg.counter("comm/wire_bytes_out").inc(len(wire))
+        self.client.publish(self._topic(msg.get_receiver_id()), wire)
 
     # -- inbound ----------------------------------------------------------
     def _on_frame(self, body: bytes) -> None:
+        from fedml_tpu.telemetry import get_registry
         from fedml_tpu.utils.serialization import safe_loads
 
+        get_registry().counter("comm/wire_bytes_in").inc(len(body))
         try:
             params = safe_loads(body)
             store_key = params.pop(Message.MSG_ARG_KEY_MODEL_PARAMS_KEY, None)

@@ -105,9 +105,13 @@ class GRPCCommManager(BaseCommunicationManager):
         )
 
     def send_message(self, msg: Message) -> None:
+        from fedml_tpu.telemetry import get_registry
         from fedml_tpu.utils.serialization import safe_dumps
 
         payload = safe_dumps(msg.get_params())
+        get_registry().counter(
+            "comm/wire_bytes_out", labels={"backend": "grpc"}
+        ).inc(len(payload))
         self._stub(msg.get_receiver_id())(payload, wait_for_ready=True, timeout=120)
 
     def add_observer(self, observer: Observer) -> None:

@@ -65,6 +65,11 @@ class LocalCommManager(BaseCommunicationManager):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
+        from fedml_tpu.telemetry import get_registry
+
+        get_registry().counter(
+            "comm/messages_delivered", labels={"backend": "local"}
+        ).inc()
         self.broker.post(msg.get_receiver_id(), msg)
 
     def add_observer(self, observer: Observer) -> None:

@@ -48,10 +48,16 @@ class PubSubClient:
 
 
 class TcpBrokerClient(PubSubClient):
-    """In-tree PubSubBroker client behind the contract."""
+    """In-tree PubSubBroker client behind the contract.
+
+    Frame-level trace propagation is off: comm messages already carry the
+    context as a ``telemetry_ctx`` param header (FedMLCommManager), and
+    stacking the frame envelope on top would propagate the same context
+    twice per hop.
+    """
 
     def __init__(self, host: str, port: int, **_):
-        self._client = BrokerClient(host, port)
+        self._client = BrokerClient(host, port, propagate_trace=False)
 
     def subscribe(self, topic, handler):
         self._client.subscribe(topic, handler)

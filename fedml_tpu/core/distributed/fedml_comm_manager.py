@@ -88,8 +88,20 @@ class FedMLCommManager(Observer):
         if handler is None:
             logger.warning("rank %d: no handler for %s", self.rank, msg_type)
             return
+        # re-activate the sender's trace context (injected by send_message)
+        # so this rank's handler spans stitch into the sender's timeline
+        from fedml_tpu import telemetry
+
+        ctx = telemetry.extract_context(msg_params.get_params())
+        token = telemetry.activate_context(ctx)
         try:
-            handler(msg_params)
+            if ctx is not None:
+                with telemetry.get_tracer().span(
+                    "comm/dispatch", msg_type=str(msg_type), rank=self.rank
+                ):
+                    handler(msg_params)
+            else:
+                handler(msg_params)
         except BaseException as e:
             # a raising handler must not silently kill the receive thread
             # and hang the federation — record, log, and stop this rank's
@@ -101,8 +113,20 @@ class FedMLCommManager(Observer):
                 msg_type,
             )
             self.com_manager.stop_receive_message()
+        finally:
+            from fedml_tpu import telemetry
+
+            telemetry.deactivate_context(token)
 
     def send_message(self, message: Message) -> None:
+        from fedml_tpu import telemetry
+
+        # carry the current trace context as a message header so the
+        # receiving rank's spans join this round's timeline
+        telemetry.inject_context(message.get_params())
+        reg = telemetry.get_registry()
+        reg.counter("comm/messages_sent",
+                    labels={"backend": str(self.backend).lower()}).inc()
         self.com_manager.send_message(message)
 
     def register_message_receive_handler(self, msg_type: str, handler: Callable) -> None:

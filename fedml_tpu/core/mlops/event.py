@@ -1,21 +1,29 @@
-"""Span-event profiler.
+"""Span-event profiler — thin facade over the telemetry tracer.
 
 Parity: ``core/mlops/mlops_profiler_event.py:9`` — ``log_event_started/
-log_event_ended`` timestamped spans. Transport here is a local JSONL sink
-(plus optional ``jax.profiler`` traces) instead of MQTT; the hosted control
-plane can attach later via the same interface.
+log_event_ended`` timestamped spans. The recording engine is
+:class:`fedml_tpu.telemetry.Tracer` (same span records, same
+``events.jsonl`` sink file as before); this class keeps the reference's
+started/ended-by-name API for existing call sites.
+
+Durability: spans auto-flush when the buffer passes ``flush_threshold``
+and again at interpreter exit, so a caller that never reaches ``flush()``
+(crash, SIGTERM path, forgotten call) loses at most the current buffer
+tail instead of the whole run.
 """
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from fedml_tpu.telemetry import Tracer
+
 
 class MLOpsProfilerEvent:
-    def __init__(self, args: Any = None, sink_path: Optional[str] = None):
+    def __init__(self, args: Any = None, sink_path: Optional[str] = None,
+                 flush_threshold: int = 512):
         self.enabled = bool(getattr(args, "sys_perf_profiling", True)) if args else True
         run_id = str(getattr(args, "run_id", "0")) if args else "0"
         base = sink_path or os.path.join(
@@ -23,45 +31,54 @@ class MLOpsProfilerEvent:
         )
         self._dir = base
         self._lock = threading.Lock()
-        self._open_spans: Dict[Tuple[str, Any], float] = {}
-        self._events = []
+        self._open_spans: Dict[Tuple[str, Any], Any] = {}
+        # threshold auto-flush + the tracer module's shared atexit hook
+        # (weak-ref'd, so profilers stay collectable) cover the
+        # never-calls-flush() case
+        self._tracer = Tracer(sink_dir=base, filename="events.jsonl",
+                              buffer_limit=max(int(flush_threshold), 1))
         self._jax_trace_dir = getattr(args, "jax_trace_dir", None) if args else None
 
     def log_event_started(self, event_name: str, event_edge_id: Any = 0) -> None:
         if not self.enabled:
             return
+        span = self._tracer.begin(f"event/{event_name}", edge_id=event_edge_id)
         with self._lock:
-            self._open_spans[(event_name, event_edge_id)] = time.time()
+            self._open_spans[(event_name, event_edge_id)] = span
 
     def log_event_ended(self, event_name: str, event_edge_id: Any = 0) -> None:
         if not self.enabled:
             return
         now = time.time()
         with self._lock:
-            t0 = self._open_spans.pop((event_name, event_edge_id), now)
-            self._events.append(
-                {
-                    "event": event_name,
-                    "edge_id": event_edge_id,
-                    "started": t0,
-                    "ended": now,
-                    "duration_ms": (now - t0) * 1000.0,
-                }
-            )
+            span = self._open_spans.pop((event_name, event_edge_id), None)
+        if span is None:
+            # unmatched end: record an explicit zero-duration marker, not a
+            # fabricated span pretending it started just now
+            span = self._tracer.begin(f"event/{event_name}",
+                                      edge_id=event_edge_id, unmatched=True)
+            span.started = now
+            self._tracer.end(span, ended=now)
+            return
+        self._tracer.end(span, ended=now)
 
     def spans(self):
-        return list(self._events)
+        """Buffered (not-yet-flushed) spans in the legacy record shape."""
+        out = []
+        for rec in self._tracer.records():
+            attrs = rec.get("attrs", {})
+            out.append({
+                "event": rec["name"].split("/", 1)[-1],
+                "edge_id": attrs.get("edge_id", 0),
+                "started": rec["started"],
+                "ended": rec["ended"],
+                "duration_ms": 0.0 if attrs.get("unmatched")
+                else rec["duration_ms"],
+            })
+        return out
 
     def flush(self) -> Optional[str]:
-        if not self._events:
-            return None
-        os.makedirs(self._dir, exist_ok=True)
-        path = os.path.join(self._dir, "events.jsonl")
-        with open(path, "a") as f:
-            for e in self._events:
-                f.write(json.dumps(e) + "\n")
-        self._events.clear()
-        return path
+        return self._tracer.flush()
 
     # jax profiler passthrough for deep TPU traces
     def start_trace(self):

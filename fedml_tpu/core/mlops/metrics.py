@@ -27,6 +27,8 @@ class MLOpsMetrics:
             str(getattr(args, "log_file_dir", "") or ".fedml_logs"), f"run_{run_id}"
         )
         self._lock = threading.Lock()
+        self._fh = None
+        self._fh_path: "str | None" = None
         self._wandb = None
         if args is not None and bool(getattr(args, "enable_wandb", False)):
             try:
@@ -36,14 +38,41 @@ class MLOpsMetrics:
             except ImportError:
                 logger.warning("wandb requested but not installed; using local sink")
 
+    def _handle(self):
+        """Cached append handle (caller holds the lock). Reopens when the
+        sink dir changed or the file was rotated/deleted underneath us —
+        one stat per write instead of makedirs+open+close per write."""
+        path = os.path.join(self._dir, "metrics.jsonl")
+        if (self._fh is None or self._fh_path != path
+                or not os.path.exists(path)):
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            os.makedirs(self._dir, exist_ok=True)
+            self._fh = open(path, "a")
+            self._fh_path = path
+        return self._fh
+
     def _write(self, kind: str, payload: Dict) -> None:
-        os.makedirs(self._dir, exist_ok=True)
         rec = {"ts": time.time(), "kind": kind, **payload}
         with self._lock:
-            with open(os.path.join(self._dir, "metrics.jsonl"), "a") as f:
-                f.write(json.dumps(rec, default=str) + "\n")
+            f = self._handle()
+            f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
         if self._wandb is not None and kind == "metric":
             self._wandb.log(payload)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+                self._fh_path = None
 
     def report_server_training_metric(self, metric: Dict) -> None:
         self._write("server_metric", metric)

@@ -105,7 +105,15 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(msg)
 
     def __train(self, global_params) -> None:
-        weights, local_sample_num = self.trainer_dist_adapter.train(
-            self.round_idx, global_params
-        )
+        from fedml_tpu import telemetry
+
+        # runs under the server's propagated trace context (activated by
+        # FedMLCommManager around handler dispatch), so this client-side
+        # span stitches into the server's round timeline
+        with telemetry.get_tracer().span(
+            f"round/{self.round_idx}/client/{self.rank}/train"
+        ):
+            weights, local_sample_num = self.trainer_dist_adapter.train(
+                self.round_idx, global_params
+            )
         self.send_model_to_server(0, weights, local_sample_num)

@@ -67,16 +67,24 @@ class FedMLServerManager(FedMLCommManager):
         super().run()
 
     def send_init_msg(self) -> None:
+        from fedml_tpu import telemetry
+
         global_params = self.aggregator.get_global_model_params()
-        for client_id in self.client_id_list_in_this_round:
-            silo_idx = self.data_silo_index_of_client[client_id]
-            msg = Message(
-                MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), client_id
-            )
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-            self.send_message(msg)
+        # the open span's context rides each init message, so every
+        # client's training span joins this round's server-side trace
+        with telemetry.get_tracer().span(
+            f"round/{self.args.round_idx}/sync",
+            n_clients=len(self.client_id_list_in_this_round),
+        ):
+            for client_id in self.client_id_list_in_this_round:
+                silo_idx = self.data_silo_index_of_client[client_id]
+                msg = Message(
+                    MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), client_id
+                )
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+                self.send_message(msg)
         mlops.log({"event": "server.init_sent", "round": 0})
 
     def register_message_receive_handlers(self) -> None:
@@ -153,8 +161,15 @@ class FedMLServerManager(FedMLCommManager):
         ):
             return
 
-        global_params = self.aggregator.aggregate()
-        metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        from fedml_tpu import telemetry
+
+        tracer = telemetry.get_tracer()
+        with tracer.span(f"round/{self.args.round_idx}/aggregate",
+                         n_clients=len(self.client_id_list_in_this_round)):
+            global_params = self.aggregator.aggregate()
+        with tracer.span(f"round/{self.args.round_idx}/eval"):
+            metrics = self.aggregator.test_on_server_for_all_clients(
+                self.args.round_idx)
         mlops.log({"round": self.args.round_idx, **{k: v for k, v in metrics.items()}})
 
         if self._ckpt is not None:
@@ -174,15 +189,17 @@ class FedMLServerManager(FedMLCommManager):
             return
 
         self._select_round_clients()
-        for client_id in self.client_id_list_in_this_round:
-            silo_idx = self.data_silo_index_of_client[client_id]
-            m = Message(
-                MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.get_sender_id(), client_id
-            )
-            m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
-            m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
-            m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-            self.send_message(m)
+        with tracer.span(f"round/{self.args.round_idx}/sync",
+                         n_clients=len(self.client_id_list_in_this_round)):
+            for client_id in self.client_id_list_in_this_round:
+                silo_idx = self.data_silo_index_of_client[client_id]
+                m = Message(
+                    MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.get_sender_id(), client_id
+                )
+                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+                m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+                m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+                self.send_message(m)
 
     def _send_finish(self) -> None:
         for client_id in range(1, self.client_num + 1):

@@ -76,6 +76,22 @@ class FedMLInferenceRunner:
                     return
                 t0 = time.time()
                 ok = True
+                # distributed callers (gateway hops, federated serving)
+                # propagate their trace via this header; the request span
+                # then stitches into the caller's timeline
+                from fedml_tpu import telemetry
+
+                ctx = None
+                raw_ctx = self.headers.get("X-Fedml-Trace")
+                if raw_ctx:
+                    try:
+                        ctx = telemetry.TraceContext.from_dict(
+                            json.loads(raw_ctx))
+                    except (ValueError, KeyError):
+                        ctx = None
+                token = telemetry.activate_context(ctx)
+                span = telemetry.get_tracer().begin(
+                    "serving/request", path=path)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(n) or b"{}")
@@ -139,6 +155,9 @@ class FedMLInferenceRunner:
                     except BrokenPipeError:
                         pass
                 finally:
+                    span.attrs["ok"] = ok
+                    telemetry.get_tracer().end(span)
+                    telemetry.deactivate_context(token)
                     runner.monitor.record_request(time.time() - t0, ok)
 
         self._server = ThreadingHTTPServer((host, port), Handler)

@@ -2,15 +2,20 @@
 
 Parity target: ``model_scheduler/device_model_monitor.py`` (the reference
 samples endpoint health and replica metrics into its MLOps plane). Here the
-monitor is an in-process stats aggregator the inference runner feeds; its
-snapshot lands in the JSONL metrics sink (``core/mlops``) so the scheduler
-plane can poll endpoint health without a hosted backend.
+monitor is an in-process stats aggregator the inference runner feeds;
+latency rides a telemetry :class:`~fedml_tpu.telemetry.Histogram` so the
+snapshot reports real p50/p95/p99 (the old sum/max pair could not answer
+"what does a slow request look like"), and the snapshot lands in the JSONL
+metrics sink (``core/mlops``) so the scheduler plane can poll endpoint
+health without a hosted backend.
 """
 from __future__ import annotations
 
 import threading
 import time
 from typing import Any, Dict
+
+from fedml_tpu.telemetry import get_registry
 
 
 class EndpointMonitor:
@@ -24,6 +29,11 @@ class EndpointMonitor:
         self._started = time.time()
         self._last_request = None
         self._metrics = None
+        reg = get_registry()
+        labels = {"endpoint": endpoint_id}
+        self._hist = reg.histogram("serving/request_ms", labels=labels)
+        self._m_requests = reg.counter("serving/requests", labels=labels)
+        self._m_errors = reg.counter("serving/errors", labels=labels)
         if args is not None:
             try:
                 from fedml_tpu.core.mlops.metrics import MLOpsMetrics
@@ -40,8 +50,13 @@ class EndpointMonitor:
             self._lat_sum += latency_s
             self._lat_max = max(self._lat_max, latency_s)
             self._last_request = time.time()
+        self._hist.observe(latency_s * 1e3)
+        self._m_requests.inc()
+        if not ok:
+            self._m_errors.inc()
 
     def snapshot(self) -> Dict:
+        hist = self._hist.snapshot()
         with self._lock:
             n = max(self._count, 1)
             snap = {
@@ -50,6 +65,9 @@ class EndpointMonitor:
                 "errors": self._errors,
                 "latency_avg_ms": round(1e3 * self._lat_sum / n, 3),
                 "latency_max_ms": round(1e3 * self._lat_max, 3),
+                "latency_p50_ms": round(hist["p50"], 3),
+                "latency_p95_ms": round(hist["p95"], 3),
+                "latency_p99_ms": round(hist["p99"], 3),
                 "uptime_s": round(time.time() - self._started, 1),
                 "last_request_ts": self._last_request,
             }

@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from fedml_tpu import telemetry
 from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
 from fedml_tpu.core.schedule.seq_train_scheduler import (
     RuntimeEstimator,
@@ -62,6 +63,8 @@ class MeshFedAvgAPI:
         self.server_opt = ServerOptimizer(args)
         self.estimator = RuntimeEstimator()
         self.event = MLOpsProfilerEvent(args)
+        self.tracer = telemetry.configure_from_args(args)
+        self._m_round_ms = telemetry.get_registry().histogram("mesh/round_ms")
 
         batch_size = int(getattr(args, "batch_size", 32))
         epochs = int(getattr(args, "epochs", 1))
@@ -328,16 +331,23 @@ class MeshFedAvgAPI:
         ctx.add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
         ctx.add(Context.KEY_CLIENT_NUM_IN_THIS_ROUND, len(client_ids))
         self.event.log_event_started("stage", round_idx)
-        xs, ys, ms, nk, ldp_kd, cdp_kd = self._stage_round(round_idx, client_ids)
+        with self.tracer.span(f"round/{round_idx}/stage"):
+            xs, ys, ms, nk, ldp_kd, cdp_kd = self._stage_round(round_idx, client_ids)
         self.event.log_event_ended("stage", round_idx)
 
         self.event.log_event_started("train+agg", round_idx)
         t0 = time.time()
-        out, loss, tau_eff = self._round_fn(
-            self.global_params, self._local_state, xs, ys, ms, nk, ldp_kd, cdp_kd
-        )
-        out = jax.block_until_ready(out)
+        # the whole round is ONE XLA program; round 0 pays the compile,
+        # which the jax.monitoring listener books into compile_ms so the
+        # report separates bridge cost from steady-state round time
+        with self.tracer.span(f"round/{round_idx}/train_agg",
+                              n_clients=len(client_ids)):
+            out, loss, tau_eff = self._round_fn(
+                self.global_params, self._local_state, xs, ys, ms, nk, ldp_kd, cdp_kd
+            )
+            out = jax.block_until_ready(out)
         dt = time.time() - t0
+        self._m_round_ms.observe(dt * 1e3)
         self.event.log_event_ended("train+agg", round_idx)
         self.estimator.observe(float(np.sum(jax.device_get(nk))), dt)
 
@@ -380,9 +390,10 @@ class MeshFedAvgAPI:
         report = {"round": round_idx, "train_loss": float(loss), "round_sec": dt}
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
         if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
-            metrics = self.aggregator.test(
-                self.global_params, self.dataset.test_data_global, None, self.args
-            )
+            with self.tracer.span(f"round/{round_idx}/eval"):
+                metrics = self.aggregator.test(
+                    self.global_params, self.dataset.test_data_global, None, self.args
+                )
             report.update(metrics)
             self.test_history.append(report)
             logger.info("mesh round %d acc=%.4f", round_idx, metrics.get("test_acc", -1))
@@ -393,6 +404,8 @@ class MeshFedAvgAPI:
         for round_idx in range(self._start_round, int(self.args.comm_round)):
             self.train_one_round(round_idx)
         wall = time.time() - t0
+        telemetry.flush_run()
+        self.event.flush()
         final = self.test_history[-1] if self.test_history else {}
         return {
             "wall_clock_sec": wall,

@@ -18,6 +18,7 @@ from typing import Any, List, Tuple
 import jax
 import numpy as np
 
+from fedml_tpu import telemetry
 from fedml_tpu.core.alg_frame.params import Context
 from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
 from fedml_tpu.data.dataset import FederatedDataset
@@ -62,6 +63,10 @@ class FedAvgAPI:
         self._mime_s = None  # Mime server momentum
         self._mime_beta = float(getattr(args, "mime_beta", 0.9))
         self.event = MLOpsProfilerEvent(args)
+        self.tracer = telemetry.configure_from_args(args)
+        self._m_client_ms = telemetry.get_registry().histogram(
+            "sp/client_train_ms")
+        self._m_rounds = telemetry.get_registry().counter("sp/rounds")
 
         from fedml_tpu.core.contribution import ContributionAssessorManager
 
@@ -131,7 +136,8 @@ class FedAvgAPI:
 
     # -- round ------------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:
-        client_ids = self._client_sampling(round_idx)
+        with self.tracer.span(f"round/{round_idx}/sample"):
+            client_ids = self._client_sampling(round_idx)
         ctx = Context()
         ctx.add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
         ctx.add(Context.KEY_CLIENT_NUM_IN_THIS_ROUND, len(client_ids))
@@ -146,24 +152,34 @@ class FedAvgAPI:
         if self._mime_s is not None:
             server_state["c_global"] = self._mime_s  # Mime rides the same slot
         self.event.log_event_started("train", round_idx)
-        for cid in client_ids:
-            self.trainer.set_id(cid)
-            self.trainer.set_round(round_idx)
-            self.trainer.set_server_state(server_state)
-            train_data = self.dataset.train_data_local_dict[cid]
-            n_k = self.dataset.train_data_local_num_dict[cid]
-            w, metrics = self.trainer.run_local_training(
-                self.global_params, train_data, self.device, self.args
-            )
-            if metrics.get("scaffold_c_delta") is not None:
-                c_deltas.append(metrics["scaffold_c_delta"])
-            if metrics.get("mime_full_grad") is not None:
-                mime_grads.append(metrics["mime_full_grad"])
-            taus.append(float(metrics.get("local_steps", 0.0)))
-            w_locals.append((n_k, w))
+        with self.tracer.span(f"round/{round_idx}/train"):
+            for cid in client_ids:
+                self.trainer.set_id(cid)
+                self.trainer.set_round(round_idx)
+                self.trainer.set_server_state(server_state)
+                train_data = self.dataset.train_data_local_dict[cid]
+                n_k = self.dataset.train_data_local_num_dict[cid]
+                # compile time lands in this span's compile_ms attr (the
+                # jax.monitoring listener attributes it to the open span),
+                # so the report can split compile from steady-state execute
+                with self.tracer.span(
+                    f"round/{round_idx}/client/{cid}/train", n_samples=n_k
+                ) as cspan:
+                    w, metrics = self.trainer.run_local_training(
+                        self.global_params, train_data, self.device, self.args
+                    )
+                self._m_client_ms.observe(
+                    (time.time() - cspan.started) * 1e3)
+                if metrics.get("scaffold_c_delta") is not None:
+                    c_deltas.append(metrics["scaffold_c_delta"])
+                if metrics.get("mime_full_grad") is not None:
+                    mime_grads.append(metrics["mime_full_grad"])
+                taus.append(float(metrics.get("local_steps", 0.0)))
+                w_locals.append((n_k, w))
         self.event.log_event_ended("train", round_idx)
 
         self.event.log_event_started("aggregate", round_idx)
+        agg_span = self.tracer.begin(f"round/{round_idx}/aggregate")
         ctx.add("global_model_for_defense", self.global_params)
         w_list, _ = self.aggregator.on_before_aggregation(w_locals)
         w_agg = self.aggregator.aggregate(w_list)
@@ -211,7 +227,9 @@ class FedAvgAPI:
             if self._c_global is None:
                 self._c_global = jax.tree.map(lambda x: 0 * x, avg_delta)
             self._c_global = tree_add(self._c_global, avg_delta)
+        self.tracer.end(agg_span)
         self.event.log_event_ended("aggregate", round_idx)
+        self._m_rounds.inc()
 
         if self._ckpt is not None:
             from fedml_tpu.core.checkpoint import should_save
@@ -223,9 +241,11 @@ class FedAvgAPI:
         report = {"round": round_idx, "clients": client_ids}
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
         if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
-            metrics = self.aggregator.test(
-                self.global_params, self.dataset.test_data_global, self.device, self.args
-            )
+            with self.tracer.span(f"round/{round_idx}/eval"):
+                metrics = self.aggregator.test(
+                    self.global_params, self.dataset.test_data_global,
+                    self.device, self.args
+                )
             report.update(metrics)
             self.test_history.append(report)
             logger.info(
@@ -241,6 +261,10 @@ class FedAvgAPI:
         for round_idx in range(self._start_round, int(self.args.comm_round)):
             self.train_one_round(round_idx)
         wall = time.time() - t0
+        # land every span + the registry snapshot in the run dir so
+        # `fedml_tpu telemetry report` works the moment training returns
+        telemetry.flush_run()
+        self.event.flush()
         final = self.test_history[-1] if self.test_history else {}
         return {
             "wall_clock_sec": wall,

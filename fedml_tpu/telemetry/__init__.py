@@ -1,0 +1,79 @@
+"""Unified telemetry: typed metrics registry + trace-propagating spans.
+
+Quick tour::
+
+    from fedml_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    reg.counter("broker/bytes_in").inc(1024)
+    reg.histogram("serving/request_ms").observe(12.5)
+
+    tracer = telemetry.configure(".fedml_logs/run_0")
+    with tracer.span("round/0/train"):
+        ...  # child spans + remote contexts stitch automatically
+
+    print(reg.export_prometheus())
+
+See ``docs/observability.md`` for the span taxonomy and sink layout.
+"""
+from fedml_tpu.telemetry.registry import (
+    BYTES_BUCKETS,
+    DEFAULT_BUCKETS_MS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+    set_registry,
+)
+from fedml_tpu.telemetry.spans import (
+    CTX_KEY,
+    TraceContext,
+    Tracer,
+    activate_context,
+    configure,
+    configure_from_args,
+    current_context,
+    deactivate_context,
+    extract_context,
+    flush_run,
+    get_tracer,
+    inject_context,
+    install_jax_compile_listener,
+    reset_tracer,
+    unwrap_frame_body,
+    wrap_frame_body,
+)
+from fedml_tpu.telemetry.report import build_report, format_report, load_spans
+
+__all__ = [
+    "BYTES_BUCKETS",
+    "DEFAULT_BUCKETS_MS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "reset_registry",
+    "set_registry",
+    "CTX_KEY",
+    "TraceContext",
+    "Tracer",
+    "activate_context",
+    "configure",
+    "configure_from_args",
+    "current_context",
+    "deactivate_context",
+    "extract_context",
+    "flush_run",
+    "get_tracer",
+    "inject_context",
+    "install_jax_compile_listener",
+    "reset_tracer",
+    "unwrap_frame_body",
+    "wrap_frame_body",
+    "build_report",
+    "format_report",
+    "load_spans",
+]

@@ -1,0 +1,209 @@
+"""Run-report builder — turns a run dir's JSONL sinks into a timeline.
+
+Consumes the files the telemetry layer writes under
+``.fedml_logs/run_<id>/``:
+
+- ``spans.jsonl``    — tracer spans (round/client phases, comm dispatch)
+- ``events.jsonl``   — legacy MLOpsProfilerEvent spans (facade output)
+- ``telemetry.jsonl``— metrics-registry snapshots (counters/gauges/hists)
+- ``metrics.jsonl``  — MLOpsMetrics records (accuracy/loss per round)
+
+and produces per-round wall time, per-phase p50/p95 (computed from the
+raw recorded spans, not bucket estimates), straggler attribution, the
+JAX compile-vs-execute split, and the broker comm-bytes breakdown.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List
+
+_ROUND_RE = re.compile(r"^round/(\d+)(?:/|$)")
+_CLIENT_RE = re.compile(r"^round/\d+/client/([^/]+)/")
+_NUM_SEG = re.compile(r"(?<=/)\d+(?=/|$)|^\d+(?=/|$)")
+
+
+def _load_jsonl(path: str) -> List[Dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line from a crashed writer
+    return out
+
+
+def normalize_name(name: str) -> str:
+    """Collapse numeric ids to taxonomy placeholders:
+    ``round/3/client/7/train`` → ``round/<n>/client/<id>/train``."""
+    name = re.sub(r"^round/\d+", "round/<n>", name)
+    name = re.sub(r"/client/[^/]+/", "/client/<id>/", name)
+    name = _NUM_SEG.sub("<n>", name)
+    return name
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = idx - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def load_spans(run_dir: str) -> List[Dict]:
+    spans = _load_jsonl(os.path.join(run_dir, "spans.jsonl"))
+    for e in _load_jsonl(os.path.join(run_dir, "events.jsonl")):
+        # legacy event records: {"event", "edge_id", started/ended/duration}
+        if "event" in e and "name" not in e:
+            e = dict(e)
+            e["name"] = f"event/{e.pop('event')}"
+        spans.append(e)
+    return [s for s in spans if "name" in s and "duration_ms" in s]
+
+
+def load_metrics(run_dir: str) -> List[Dict]:
+    return _load_jsonl(os.path.join(run_dir, "telemetry.jsonl"))
+
+
+def build_report(run_dir: str) -> Dict:
+    spans = load_spans(run_dir)
+    metrics = load_metrics(run_dir)
+
+    # -- per-round timeline (one pass; client spans collected for the
+    # straggler section as we go) ----------------------------------------
+    rounds: Dict[int, Dict] = {}
+    for s in spans:
+        m = _ROUND_RE.match(s["name"])
+        if not m:
+            continue
+        n = int(m.group(1))
+        r = rounds.setdefault(n, {"round": n, "started": s["started"],
+                                  "ended": s["ended"], "phases": {},
+                                  "client_spans": []})
+        r["started"] = min(r["started"], s["started"])
+        r["ended"] = max(r["ended"], s["ended"])
+        phase = normalize_name(s["name"])
+        r["phases"].setdefault(phase, []).append(s["duration_ms"])
+        if _CLIENT_RE.match(s["name"]):
+            r["client_spans"].append(s)
+    round_rows = []
+    for n in sorted(rounds):
+        r = rounds[n]
+        round_rows.append({
+            "round": n,
+            "wall_ms": (r["ended"] - r["started"]) * 1e3,
+            "phases": {p: sum(v) for p, v in sorted(r["phases"].items())},
+        })
+
+    # -- per-phase percentiles over the whole run -------------------------
+    by_phase: Dict[str, List[float]] = {}
+    for s in spans:
+        by_phase.setdefault(normalize_name(s["name"]), []).append(
+            s["duration_ms"])
+    phase_rows = []
+    for phase in sorted(by_phase):
+        vals = sorted(by_phase[phase])
+        phase_rows.append({
+            "phase": phase,
+            "count": len(vals),
+            "p50_ms": _pct(vals, 0.50),
+            "p95_ms": _pct(vals, 0.95),
+            "p99_ms": _pct(vals, 0.99),
+            "total_ms": sum(vals),
+        })
+
+    # -- straggler attribution -------------------------------------------
+    stragglers = []
+    for n in sorted(rounds):
+        client_spans = rounds[n]["client_spans"]
+        if not client_spans:
+            continue
+        worst = max(client_spans, key=lambda s: s["duration_ms"])
+        total = sum(s["duration_ms"] for s in client_spans)
+        stragglers.append({
+            "round": n,
+            "client": _CLIENT_RE.match(worst["name"]).group(1),
+            "duration_ms": worst["duration_ms"],
+            "share": worst["duration_ms"] / total if total else 0.0,
+        })
+
+    # -- compile vs execute ----------------------------------------------
+    compile_ms = sum(s.get("compile_ms", 0.0) for s in spans)
+    round_total = sum(r["wall_ms"] for r in round_rows)
+
+    # -- comm bytes (latest snapshot per metric name+labels) --------------
+    comm: Dict[str, float] = {}
+    for rec in metrics:
+        name = rec.get("name", "")
+        if rec.get("kind") == "counter" and (
+                name.startswith("broker/") or name.startswith("comm/")):
+            lbl = ",".join(f"{k}={v}"
+                           for k, v in sorted((rec.get("labels") or {}).items()))
+            comm[name + ("{" + lbl + "}" if lbl else "")] = rec["value"]
+
+    # -- stitched (cross-process) spans ----------------------------------
+    stitched = [s for s in spans if s.get("remote_parent")]
+
+    return {
+        "run_dir": run_dir,
+        "n_spans": len(spans),
+        "rounds": round_rows,
+        "phases": phase_rows,
+        "stragglers": stragglers,
+        "compile_ms": compile_ms,
+        "execute_ms": max(round_total - compile_ms, 0.0),
+        "comm_bytes": comm,
+        "stitched_spans": stitched,
+    }
+
+
+def format_report(report: Dict) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add(f"telemetry report: {report['run_dir']} "
+        f"({report['n_spans']} spans)")
+    add("")
+    add("per-round timeline:")
+    for r in report["rounds"]:
+        add(f"  round {r['round']}: wall {r['wall_ms']:.1f} ms")
+        for phase, total in r["phases"].items():
+            add(f"    {phase:<42s} {total:>10.1f} ms")
+    add("")
+    add("per-phase percentiles (all rounds):")
+    add(f"  {'phase':<44s}{'count':>6s}{'p50 ms':>10s}{'p95 ms':>10s}"
+        f"{'p99 ms':>10s}")
+    for p in report["phases"]:
+        add(f"  {p['phase']:<44s}{p['count']:>6d}{p['p50_ms']:>10.1f}"
+            f"{p['p95_ms']:>10.1f}{p['p99_ms']:>10.1f}")
+    if report["compile_ms"]:
+        add("")
+        add(f"jax compile-vs-execute: compile {report['compile_ms']:.1f} ms, "
+            f"execute {report['execute_ms']:.1f} ms")
+    if report["stragglers"]:
+        add("")
+        add("straggler attribution (slowest client per round):")
+        for s in report["stragglers"]:
+            add(f"  round {s['round']}: client {s['client']} "
+                f"{s['duration_ms']:.1f} ms ({100 * s['share']:.0f}% of "
+                "client time)")
+    if report["comm_bytes"]:
+        add("")
+        add("comm bytes breakdown:")
+        for name, v in sorted(report["comm_bytes"].items()):
+            add(f"  {name:<44s}{v:>14.0f}")
+    if report["stitched_spans"]:
+        add("")
+        add(f"cross-process stitched spans: {len(report['stitched_spans'])}")
+        for s in report["stitched_spans"][:10]:
+            add(f"  {s['name']} trace={s['trace_id'][:8]} "
+                f"parent={s['parent_id']} (publisher-side origin)")
+    return "\n".join(lines)

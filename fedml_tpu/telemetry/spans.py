@@ -1,0 +1,364 @@
+"""Hierarchical spans with cross-process trace propagation.
+
+Every span carries a ``(trace_id, span_id, parent_id)`` triple. Inside one
+process the current span rides a ``contextvars.ContextVar``; across
+processes the context travels as a header:
+
+- comm messages (LOCAL/GRPC/TRPC/BROKER backends): a JSON-safe
+  ``telemetry_ctx`` field injected into the message params by
+  ``FedMLCommManager.send_message`` and re-activated around handler
+  dispatch on the receiving rank;
+- raw ``PubSubBroker`` frames: a binary envelope (magic + JSON header)
+  prepended to the published body by ``BrokerClient`` and stripped on the
+  subscriber side, so server-side and client-side spans of the same round
+  stitch into one timeline.
+
+Span naming follows the taxonomy ``round/<n>[/client/<id>]/<phase>`` for
+round work and ``<subsystem>/<what>`` elsewhere; ``tools/
+check_span_names.py`` lints the instrumented literals.
+
+JAX compile-vs-execute split: a ``jax.monitoring`` duration listener
+attributes backend-compile seconds to whatever span is open when XLA
+compiles, so a span's ``compile_ms`` attr separates "first round pays the
+bridge" from steady-state execution.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import contextvars
+import json
+import os
+import struct
+import threading
+import time
+import uuid
+import weakref
+from typing import Any, Dict, Iterator, List, Optional
+
+from fedml_tpu.telemetry.registry import get_registry
+
+CTX_KEY = "telemetry_ctx"
+_FRAME_MAGIC = b"\xf5TCX"
+
+
+class TraceContext:
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, str]) -> "TraceContext":
+        return cls(str(d["trace_id"]), str(d["span_id"]))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"TraceContext({self.trace_id}/{self.span_id})"
+
+
+_current: "contextvars.ContextVar[Optional[_ActiveSpan]]" = contextvars.ContextVar(
+    "fedml_telemetry_span", default=None
+)
+
+
+class _ActiveSpan:
+    """Mutable in-flight span; becomes an immutable record at end()."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "started",
+                 "attrs", "remote_parent", "placeholder", "compile_ms")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 remote_parent: bool, attrs: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.started = time.time()
+        self.attrs = attrs
+        self.remote_parent = remote_parent
+        self.placeholder = False
+        self.compile_ms = 0.0
+
+    def context(self) -> TraceContext:
+        return TraceContext(self.trace_id, self.span_id)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def current_context() -> Optional[TraceContext]:
+    span = _current.get()
+    return span.context() if span is not None else None
+
+
+def activate_context(ctx: Optional[TraceContext]):
+    """Adopt a remote context as the current parent; returns a reset token.
+
+    The adopted context is represented as a zero-duration placeholder so
+    child spans stitch to the remote span id without recording anything.
+    """
+    if ctx is None:
+        return None
+    holder = _ActiveSpan("remote", ctx.trace_id, None, True, {})
+    holder.span_id = ctx.span_id
+    holder.placeholder = True
+    return _current.set(holder)
+
+
+def deactivate_context(token) -> None:
+    if token is not None:
+        _current.reset(token)
+
+
+# -- header propagation (comm-message params dict) ------------------------
+def inject_context(params: Dict[str, Any]) -> None:
+    ctx = current_context()
+    if ctx is not None:
+        params[CTX_KEY] = ctx.to_dict()
+
+
+def extract_context(params: Dict[str, Any]) -> Optional[TraceContext]:
+    raw = params.pop(CTX_KEY, None)
+    if not isinstance(raw, dict) or "trace_id" not in raw:
+        return None
+    try:
+        return TraceContext.from_dict(raw)
+    except (KeyError, TypeError):
+        return None
+
+
+# -- frame propagation (raw broker bodies) ---------------------------------
+def wrap_frame_body(body: bytes, ctx: Optional[TraceContext] = None) -> bytes:
+    """Prepend the trace header to a pub/sub body (no-op without context).
+
+    Layout: magic ‖ u16 header_len ‖ json(ctx) ‖ body. The broker routes
+    bodies opaquely (Python and native C++ alike), so the envelope is
+    invisible to it and to the wire protocol.
+    """
+    ctx = ctx or current_context()
+    if ctx is None:
+        return body
+    header = json.dumps(ctx.to_dict()).encode()
+    return _FRAME_MAGIC + struct.pack(">H", len(header)) + header + body
+
+
+def unwrap_frame_body(body: bytes):
+    """Split (ctx | None, original_body); bodies without the magic — or
+    that merely start with the magic bytes by accident — pass through
+    untouched, so un-instrumented publishers stay compatible."""
+    if not body.startswith(_FRAME_MAGIC) or len(body) < 6:
+        return None, body
+    (hlen,) = struct.unpack(">H", body[4:6])
+    if len(body) < 6 + hlen:
+        return None, body
+    try:
+        ctx = TraceContext.from_dict(json.loads(body[6 : 6 + hlen]))
+    except (ValueError, KeyError, UnicodeDecodeError):
+        return None, body
+    return ctx, body[6 + hlen :]
+
+
+# -- jax compile attribution ----------------------------------------------
+_jax_listener_installed = False
+_jax_listener_lock = threading.Lock()
+
+
+def install_jax_compile_listener() -> None:
+    """Attribute XLA backend-compile time to the currently open span.
+
+    Installed once per process, lazily on first Tracer construction; the
+    listener is a few ns when no compile happens and writes into both the
+    active span (``compile_ms`` attr) and the global ``jax/compile_ms``
+    histogram.
+    """
+    global _jax_listener_installed
+    with _jax_listener_lock:
+        if _jax_listener_installed:
+            return
+        try:
+            import jax.monitoring
+        except ImportError:  # pragma: no cover - jax is a hard dep in-tree
+            return
+
+        def _on_duration(event: str, duration_secs: float, **kw) -> None:
+            if "backend_compile" not in event:
+                return
+            ms = duration_secs * 1e3
+            get_registry().histogram("jax/compile_ms").observe(ms)
+            span = _current.get()
+            if span is not None:
+                span.compile_ms += ms
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _jax_listener_installed = True
+
+
+# one atexit hook over weak refs: tracers stay collectable, and the exit
+# flush covers however many instances are still alive
+_live_tracers: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
+
+
+def _flush_live_tracers() -> None:
+    for t in list(_live_tracers):
+        try:
+            t.flush()
+        except OSError:  # pragma: no cover - sink dir gone at exit
+            pass
+
+
+atexit.register(_flush_live_tracers)
+
+
+class Tracer:
+    """Span factory + buffered JSONL sink.
+
+    Completed spans buffer in memory and flush to ``<sink_dir>/<filename>``
+    when the buffer passes ``buffer_limit``, on ``flush()``, and at
+    interpreter exit — a crash loses at most one buffer, not the run.
+    """
+
+    def __init__(self, sink_dir: Optional[str] = None,
+                 filename: str = "spans.jsonl", buffer_limit: int = 256,
+                 service: str = ""):
+        self._dir = sink_dir
+        self._filename = filename
+        self._limit = max(int(buffer_limit), 1)
+        self.service = service
+        self._lock = threading.Lock()
+        self._records: List[Dict] = []
+        install_jax_compile_listener()
+        _live_tracers.add(self)
+
+    @property
+    def sink_dir(self) -> Optional[str]:
+        return self._dir
+
+    # -- span lifecycle ---------------------------------------------------
+    def begin(self, name: str, **attrs: Any) -> _ActiveSpan:
+        parent = _current.get()
+        if parent is not None:
+            # only the DIRECT child of an adopted remote context is marked
+            # stitched; its own descendants are ordinary local spans
+            span = _ActiveSpan(name, parent.trace_id, parent.span_id,
+                               parent.placeholder, attrs)
+        else:
+            span = _ActiveSpan(name, new_trace_id(), None, False, attrs)
+        return span
+
+    def end(self, span: _ActiveSpan, ended: Optional[float] = None) -> Dict:
+        ended = ended or time.time()
+        rec = {
+            "name": span.name,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            "started": span.started,
+            "ended": ended,
+            "duration_ms": (ended - span.started) * 1e3,
+        }
+        if span.compile_ms:
+            rec["compile_ms"] = span.compile_ms
+            rec["execute_ms"] = max(rec["duration_ms"] - span.compile_ms, 0.0)
+        if span.remote_parent:
+            rec["remote_parent"] = True
+        if self.service:
+            rec["service"] = self.service
+        if span.attrs:
+            rec["attrs"] = span.attrs
+        overflow = None
+        with self._lock:
+            self._records.append(rec)
+            if len(self._records) >= self._limit:
+                overflow = self._records
+                self._records = []
+        if overflow is not None:
+            self._write(overflow)
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[_ActiveSpan]:
+        s = self.begin(name, **attrs)
+        token = _current.set(s)
+        try:
+            yield s
+        finally:
+            _current.reset(token)
+            self.end(s)
+
+    # -- sink -------------------------------------------------------------
+    def records(self) -> List[Dict]:
+        with self._lock:
+            return list(self._records)
+
+    def _write(self, records: List[Dict]) -> Optional[str]:
+        if self._dir is None or not records:
+            return None
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, self._filename)
+        with open(path, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec, default=str) + "\n")
+        return path
+
+    def flush(self) -> Optional[str]:
+        with self._lock:
+            records, self._records = self._records, []
+        return self._write(records)
+
+
+_default_tracer: Optional[Tracer] = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (memory-only until configure() points it
+    at a run dir)."""
+    global _default_tracer
+    with _default_lock:
+        if _default_tracer is None:
+            _default_tracer = Tracer()
+        return _default_tracer
+
+
+def configure(run_dir: str, service: str = "") -> Tracer:
+    """Bind the global tracer to a run dir (idempotent per dir)."""
+    global _default_tracer
+    with _default_lock:
+        t = _default_tracer
+        if t is None or t._dir != run_dir:
+            t = Tracer(sink_dir=run_dir, service=service)
+            _default_tracer = t
+        return t
+
+
+def configure_from_args(args: Any) -> Tracer:
+    """Derive the sink dir from run args — same layout core/mlops uses:
+    ``<log_file_dir>/run_<run_id>/``."""
+    run_id = str(getattr(args, "run_id", "0") or "0")
+    base = str(getattr(args, "log_file_dir", "") or ".fedml_logs")
+    return configure(os.path.join(base, f"run_{run_id}"))
+
+
+def flush_run() -> Optional[str]:
+    """Land the global tracer's spans AND a registry snapshot in the run
+    dir (no-op for an unconfigured, memory-only tracer). The one call a
+    training loop needs at the end of ``train()``."""
+    from fedml_tpu.telemetry.registry import get_registry as _reg
+
+    tracer = get_tracer()
+    tracer.flush()
+    if tracer.sink_dir is None:
+        return None
+    return _reg().flush_jsonl(tracer.sink_dir)
+
+
+def reset_tracer() -> None:
+    """Drop the global tracer (test isolation)."""
+    global _default_tracer
+    with _default_lock:
+        _default_tracer = None

@@ -69,3 +69,9 @@ def _reset_singletons():
     FedMLDifferentialPrivacy.reset()
     FedMLFHE.reset()
     Context.reset()
+    # telemetry globals: fresh registry + tracer per test so counters and
+    # span sinks never leak across tests
+    from fedml_tpu import telemetry
+
+    telemetry.reset_registry()
+    telemetry.reset_tracer()

@@ -1,0 +1,445 @@
+"""Telemetry layer: typed registry, trace-propagating spans, run report.
+
+Covers the subsystem contract end to end: instrument semantics under
+concurrent writers, Prometheus exposition shape, span-context round-trip
+through an in-proc ``PubSubBroker`` publish/subscribe, the
+``telemetry report`` CLI on a real 2-round SP simulation run dir, the
+span-name lint, and the core/mlops facade fixes (auto-flush, unmatched
+ends, cached metrics handle).
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fedml_tpu import telemetry
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+# -- registry semantics ----------------------------------------------------
+def test_counter_concurrent_writers():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("test/hits")
+    threads = [
+        threading.Thread(target=lambda: [c.inc() for _ in range(1000)])
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_concurrent_percentiles():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("test/latency_ms")
+
+    def observe(base):
+        for i in range(500):
+            h.observe(base + (i % 100))
+
+    threads = [threading.Thread(target=observe, args=(b,)) for b in (0, 0, 0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = h.snapshot()
+    assert snap["count"] == 1500
+    # uniform 0..99 → p50 near 50, p95 near 95 (bucket interpolation)
+    assert 25 <= snap["p50"] <= 75, snap
+    assert snap["p95"] <= snap["p99"] <= snap["max"] == 99
+
+
+def test_registry_identity_and_type_conflicts():
+    reg = telemetry.MetricsRegistry()
+    assert reg.counter("a/b") is reg.counter("a/b")
+    assert reg.counter("a/b", labels={"x": "1"}) is not reg.counter("a/b")
+    g = reg.gauge("a/g")
+    g.set(4.5)
+    g.dec(0.5)
+    assert g.value == 4.0
+    with pytest.raises(TypeError):
+        reg.gauge("a/b")  # already a counter
+    with pytest.raises(ValueError):
+        reg.counter("Bad Name")  # taxonomy violation
+
+
+def test_prometheus_exposition_shape():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("broker/bytes_in").inc(10)
+    reg.gauge("broker/subscriptions", labels={"host": "a"}).set(3)
+    h = reg.histogram("serving/request_ms", buckets=(1, 10, 100))
+    h.observe(5)
+    h.observe(50)
+    text = reg.export_prometheus()
+    assert "# TYPE broker_bytes_in counter" in text
+    assert "broker_bytes_in 10.0" in text
+    assert 'broker_subscriptions{host="a"} 3.0' in text
+    assert "# TYPE serving_request_ms histogram" in text
+    # cumulative buckets: le=1 → 0, le=10 → 1, le=100 → 2, +inf → 2
+    assert 'serving_request_ms_bucket{le="1"} 0' in text
+    assert 'serving_request_ms_bucket{le="100"} 2' in text
+    assert 'serving_request_ms_bucket{le="+inf"} 2' in text
+    assert "serving_request_ms_count 2" in text
+
+
+def test_registry_jsonl_flush(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("test/n").inc(7)
+    path = reg.flush_jsonl(str(tmp_path))
+    (rec,) = _read_jsonl(path)
+    assert rec["name"] == "test/n" and rec["value"] == 7
+
+
+# -- spans + context propagation ------------------------------------------
+def test_span_nesting_and_sink(tmp_path):
+    tracer = telemetry.Tracer(sink_dir=str(tmp_path))
+    with tracer.span("round/0/train") as parent:
+        with tracer.span("round/0/client/2/train", n_samples=10) as child:
+            pass
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.span_id
+    tracer.flush()
+    recs = _read_jsonl(tmp_path / "spans.jsonl")
+    names = {r["name"] for r in recs}
+    assert names == {"round/0/train", "round/0/client/2/train"}
+    child_rec = [r for r in recs if "client" in r["name"]][0]
+    assert child_rec["attrs"]["n_samples"] == 10
+    assert not child_rec.get("remote_parent")
+
+
+def test_span_context_roundtrip_through_broker():
+    """Publisher-side span context rides the broker frame and stitches the
+    subscriber-side span into the same trace."""
+    from fedml_tpu.core.distributed.communication.broker import (
+        BrokerClient,
+        PubSubBroker,
+    )
+
+    tracer = telemetry.get_tracer()
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    sub = BrokerClient(host, port)
+    done = threading.Event()
+    seen = {}
+
+    def handler(body):
+        with tracer.span("round/0/client/1/train") as s:
+            seen["body"] = body
+            seen["span"] = s
+        done.set()
+
+    sub.subscribe("fedml/t", handler)
+    time.sleep(0.2)  # let the SUB frame reach the broker
+    pub = BrokerClient(host, port)
+    try:
+        with tracer.span("round/0/sync") as s:
+            pub_ctx = s.context()
+            pub.publish("fedml/t", b"payload-bytes")
+        assert done.wait(10), "subscriber never got the frame"
+        assert seen["body"] == b"payload-bytes"  # envelope fully stripped
+        assert seen["span"].trace_id == pub_ctx.trace_id
+        assert seen["span"].parent_id == pub_ctx.span_id
+        assert seen["span"].remote_parent
+        # broker-side byte accounting saw the publish
+        reg = telemetry.get_registry()
+        assert reg.counter("broker/bytes_in").value > 0
+        assert reg.counter("broker/bytes_out").value > 0
+    finally:
+        pub.close()
+        sub.close()
+        broker.stop()
+
+
+def test_plain_publish_unchanged_without_span():
+    """No active span → no envelope: raw subscribers see exact bytes."""
+    from fedml_tpu.core.distributed.communication.broker import (
+        BrokerClient,
+        PubSubBroker,
+    )
+
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    sub = BrokerClient(host, port)
+    got = []
+    done = threading.Event()
+    sub.subscribe("x", lambda b: (got.append(b), done.set()))
+    time.sleep(0.2)
+    pub = BrokerClient(host, port)
+    try:
+        pub.publish("x", b"\xf5" + b"raw")  # near-magic prefix passes through
+        assert done.wait(10)
+        assert got == [b"\xf5raw"]
+    finally:
+        pub.close()
+        sub.close()
+        broker.stop()
+
+
+def test_context_header_inject_extract():
+    tracer = telemetry.Tracer()
+    params = {}
+    with tracer.span("comm/send"):
+        telemetry.inject_context(params)
+        ctx = telemetry.current_context()
+    assert params[telemetry.CTX_KEY]["trace_id"] == ctx.trace_id
+    extracted = telemetry.extract_context(params)
+    assert telemetry.CTX_KEY not in params  # header consumed
+    assert extracted.span_id == ctx.span_id
+    token = telemetry.activate_context(extracted)
+    try:
+        with tracer.span("round/1/client/3/train") as s:
+            assert s.trace_id == ctx.trace_id
+            assert s.remote_parent
+    finally:
+        telemetry.deactivate_context(token)
+
+
+# -- report ----------------------------------------------------------------
+def test_report_smoke_on_synthetic_run_dir(tmp_path):
+    t0 = time.time()
+    spans = []
+    for rnd in range(2):
+        base = t0 + rnd
+        spans.append({"name": f"round/{rnd}/train", "trace_id": "t",
+                      "span_id": f"s{rnd}", "parent_id": None,
+                      "started": base, "ended": base + 0.5,
+                      "duration_ms": 500.0, "compile_ms": 100.0 * (rnd == 0)})
+        for cid, d in ((0, 400.0), (1, 90.0)):
+            spans.append({"name": f"round/{rnd}/client/{cid}/train",
+                          "trace_id": "t", "span_id": f"c{rnd}{cid}",
+                          "parent_id": f"s{rnd}", "started": base,
+                          "ended": base + d / 1e3, "duration_ms": d})
+    with open(tmp_path / "spans.jsonl", "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+    reg = telemetry.MetricsRegistry()
+    reg.counter("broker/bytes_in").inc(12345)
+    reg.flush_jsonl(str(tmp_path))
+
+    report = telemetry.build_report(str(tmp_path))
+    assert [r["round"] for r in report["rounds"]] == [0, 1]
+    assert report["rounds"][0]["wall_ms"] == pytest.approx(500.0)
+    phases = {p["phase"]: p for p in report["phases"]}
+    client = phases["round/<n>/client/<id>/train"]
+    assert client["count"] == 4
+    assert client["p95_ms"] >= client["p50_ms"]
+    assert report["stragglers"][0]["client"] == "0"
+    assert report["stragglers"][0]["share"] == pytest.approx(400 / 490)
+    assert report["compile_ms"] == pytest.approx(100.0)
+    assert report["comm_bytes"]["broker/bytes_in"] == 12345
+    text = telemetry.format_report(report)
+    assert "round 0: wall 500.0 ms" in text
+    assert "broker/bytes_in" in text
+
+
+def test_sp_run_report_acceptance(tmp_path):
+    """Acceptance: a 2-round SP simulation run dir reports per-round wall
+    time, per-phase p50/p95 from real recorded spans, broker bytes in/out,
+    and a span stitched across the broker publisher→subscriber boundary."""
+    import fedml_tpu
+    from fedml_tpu import device as device_mod
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    cfg = {
+        "common_args": {"training_type": "simulation", "random_seed": 0,
+                        "run_id": "telemetry_acc",
+                        "log_file_dir": str(tmp_path)},
+        "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                      "partition_alpha": 0.5, "train_size": 200,
+                      "test_size": 80, "class_num": 3, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 3, "client_num_per_round": 3,
+                       "comm_round": 2, "epochs": 1, "batch_size": 16,
+                       "learning_rate": 0.3},
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    dataset = load_federated(args)
+    model = models_mod.create(args, dataset.class_num)
+    api = FedAvgAPI(args, device_mod.get_device(args), dataset, model)
+    api.train()
+    run_dir = os.path.join(str(tmp_path), "run_telemetry_acc")
+
+    # broker leg: publish under a span, subscriber records the stitched
+    # side; its counters land in the same run dir's telemetry sink
+    from fedml_tpu.core.distributed.communication.broker import (
+        BrokerClient,
+        PubSubBroker,
+    )
+
+    tracer = telemetry.get_tracer()
+    assert tracer._dir == run_dir  # configured by FedAvgAPI
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    sub = BrokerClient(host, port)
+    done = threading.Event()
+
+    def handler(body):
+        with tracer.span("round/1/client/9/train"):
+            pass
+        done.set()
+
+    sub.subscribe("fedml/acc", handler)
+    time.sleep(0.2)
+    pub = BrokerClient(host, port)
+    try:
+        with tracer.span("round/1/sync"):
+            pub.publish("fedml/acc", b"model-update")
+        assert done.wait(10)
+    finally:
+        pub.close()
+        sub.close()
+        broker.stop()
+    tracer.flush()
+    telemetry.get_registry().flush_jsonl(run_dir)
+
+    report = telemetry.build_report(run_dir)
+    # per-round wall time for both rounds, from real spans
+    assert [r["round"] for r in report["rounds"]] == [0, 1]
+    assert all(r["wall_ms"] > 0 for r in report["rounds"])
+    # per-phase percentiles present for the instrumented phases
+    phases = {p["phase"]: p for p in report["phases"]}
+    for phase in ("round/<n>/train", "round/<n>/aggregate",
+                  "round/<n>/client/<id>/train"):
+        assert phases[phase]["count"] >= 2, phase
+        assert phases[phase]["p95_ms"] >= phases[phase]["p50_ms"] >= 0
+    # broker bytes in/out recorded
+    assert report["comm_bytes"]["broker/bytes_in"] > 0
+    assert report["comm_bytes"]["broker/bytes_out"] > 0
+    # a span whose trace context originated on the publisher side and was
+    # stitched on the subscriber side of the broker
+    stitched = [s for s in report["stitched_spans"]
+                if s["name"] == "round/1/client/9/train"]
+    assert stitched, report["stitched_spans"]
+    publisher = [s for s in telemetry.load_spans(run_dir)
+                 if s["name"] == "round/1/sync"][0]
+    assert stitched[0]["trace_id"] == publisher["trace_id"]
+    assert stitched[0]["parent_id"] == publisher["span_id"]
+
+    # the CLI renders all of it
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    res = CliRunner().invoke(cli, ["telemetry", "report", run_dir])
+    assert res.exit_code == 0, res.output
+    assert "round 0: wall" in res.output
+    assert "round 1: wall" in res.output
+    assert "p50 ms" in res.output and "p95 ms" in res.output
+    assert "broker/bytes_in" in res.output
+    assert "cross-process stitched spans" in res.output
+    assert "jax compile-vs-execute" in res.output
+
+
+def test_report_cli_empty_dir(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    res = CliRunner().invoke(cli, ["telemetry", "report", str(tmp_path)])
+    assert res.exit_code == 1
+    assert "no spans" in res.output
+
+
+# -- span-name lint --------------------------------------------------------
+def _load_lint():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "check_span_names.py")
+    spec = importlib.util.spec_from_file_location("check_span_names", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_span_name_lint_clean():
+    lint = _load_lint()
+    problems = lint.check(lint.collect())
+    assert problems == [], "\n".join(problems)
+
+
+def test_span_name_lint_catches_violations():
+    lint = _load_lint()
+    bad = [
+        ("x.py", 1, "span", lint.normalize("round/{r}/Train", True)),
+        ("x.py", 2, "span", lint.normalize("round/{r}/client/{c}", True)),
+        ("x.py", 3, "counter", "a/b"),
+        ("x.py", 4, "gauge", "a/b"),
+    ]
+    problems = lint.check(bad)
+    assert len(problems) == 3, problems  # bad case, bad shape, kind clash
+
+
+# -- core/mlops facades (satellite fixes) ---------------------------------
+def test_profiler_event_unmatched_end_is_explicit_zero(tmp_path):
+    from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
+
+    ev = MLOpsProfilerEvent(sink_path=str(tmp_path))
+    ev.log_event_ended("never_started", 7)
+    (span,) = ev.spans()
+    assert span["duration_ms"] == 0.0
+    assert span["event"] == "never_started" and span["edge_id"] == 7
+    path = ev.flush()
+    (rec,) = _read_jsonl(path)
+    assert rec["attrs"]["unmatched"] is True
+
+
+def test_profiler_event_autoflush_threshold(tmp_path):
+    from fedml_tpu.core.mlops.event import MLOpsProfilerEvent
+
+    ev = MLOpsProfilerEvent(sink_path=str(tmp_path), flush_threshold=5)
+    for i in range(6):
+        ev.log_event_started("step", i)
+        ev.log_event_ended("step", i)
+    # buffer crossed the threshold → spans hit disk without flush()
+    recs = _read_jsonl(tmp_path / "events.jsonl")
+    assert len(recs) >= 5
+    ev.flush()
+    assert len(_read_jsonl(tmp_path / "events.jsonl")) == 6
+
+
+def test_metrics_sink_caches_handle(tmp_path):
+    from fedml_tpu.core.mlops.metrics import MLOpsMetrics
+
+    m = MLOpsMetrics(sink_dir=str(tmp_path))
+    m.log({"a": 1})
+    fh = m._fh
+    m.log({"a": 2})
+    assert m._fh is fh, "append handle must be reused across writes"
+    path = tmp_path / "metrics.jsonl"
+    assert len(_read_jsonl(path)) == 2
+    # rotation: the file vanishes → next write reopens instead of feeding
+    # a dead inode
+    os.remove(path)
+    m.log({"a": 3})
+    assert m._fh is not fh
+    (rec,) = _read_jsonl(path)
+    assert rec["a"] == 3
+    m.close()
+
+
+def test_endpoint_monitor_percentiles():
+    from fedml_tpu.serving.monitor import EndpointMonitor
+
+    mon = EndpointMonitor("ep1")
+    for ms in (1, 2, 3, 4, 5, 6, 7, 8, 9, 200):
+        mon.record_request(ms / 1e3, ok=ms != 200)
+    snap = mon.snapshot()
+    assert snap["requests"] == 10 and snap["errors"] == 1
+    assert snap["latency_p50_ms"] <= snap["latency_p95_ms"]
+    assert snap["latency_p95_ms"] > 9  # the tail request is visible
+    assert snap["latency_p99_ms"] <= snap["latency_max_ms"] == 200.0

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Span/metric name lint — keeps the telemetry taxonomy from drifting.
+
+Scans ``fedml_tpu/`` for instrumented literals:
+
+  tracer.span("...") / tracer.begin("...")
+  registry.counter("...") / .gauge("...") / .histogram("...")
+
+and fails on
+
+- names violating the taxonomy: ``/``-separated lowercase ``[a-z0-9_]``
+  segments (f-string ``{expr}`` placeholders normalize to ``<v>``);
+- ``round/...`` span names that do not follow
+  ``round/<n>[/client/<id>]/<phase>``;
+- the same metric name registered with two different instrument kinds
+  (the registry raises at runtime; this catches it statically).
+
+Run from CI via ``tests/test_telemetry.py`` — no extra infrastructure.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROOTS = ("fedml_tpu",)
+
+_SPAN_CALL = re.compile(
+    r"\.(?:span|begin)\(\s*(?:\n\s*)?(f?)\"([^\"]+)\"")
+_METRIC_CALL = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*(?:\n\s*)?(f?)\"([^\"]+)\"")
+_SEGMENT = re.compile(r"^(?:[a-z0-9_]+|<[a-z_]+>)$")
+_ROUND_SHAPE = re.compile(
+    r"^round/<v>(?:/client/<v>)?/[a-z0-9_]+$")
+
+
+def normalize(literal: str, is_fstring: bool) -> str:
+    if is_fstring:
+        literal = re.sub(r"\{[^}]*\}", "<v>", literal)
+    # literal numeric ids (docstring examples, fixed round 0 spans) are the
+    # runtime shape of an interpolated id — same placeholder
+    return re.sub(r"(?<=/)\d+(?=/|$)", "<v>", literal)
+
+
+def iter_py():
+    for root in ROOTS:
+        for base, dirs, files in os.walk(os.path.join(REPO, root)):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+            for fn in files:
+                if fn.endswith(".py"):
+                    yield os.path.join(base, fn)
+
+
+def collect():
+    """[(path, lineno, kind, name)] for every instrumented literal."""
+    out = []
+    for path in sorted(iter_py()):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for m in _SPAN_CALL.finditer(src):
+            lineno = src[: m.start()].count("\n") + 1
+            out.append((path, lineno, "span",
+                        normalize(m.group(2), bool(m.group(1)))))
+        for m in _METRIC_CALL.finditer(src):
+            lineno = src[: m.start()].count("\n") + 1
+            out.append((path, lineno, m.group(1),
+                        normalize(m.group(3), bool(m.group(2)))))
+    return out
+
+
+def check(entries):
+    problems = []
+    metric_kinds = {}
+    for path, lineno, kind, name in entries:
+        rel = os.path.relpath(path, REPO)
+        where = f"{rel}:{lineno}"
+        segments = name.split("/")
+        if not all(_SEGMENT.match(s) for s in segments):
+            problems.append(
+                f"{where}: {kind} name {name!r} violates the taxonomy "
+                "(lowercase [a-z0-9_] segments joined by '/')")
+            continue
+        if kind == "span" and name.startswith("round/"):
+            if not _ROUND_SHAPE.match(name):
+                problems.append(
+                    f"{where}: span {name!r} must follow "
+                    "round/<n>[/client/<id>]/<phase>")
+        if kind != "span":
+            prev = metric_kinds.get(name)
+            if prev is not None and prev[0] != kind:
+                problems.append(
+                    f"{where}: metric {name!r} registered as {kind} but "
+                    f"already a {prev[0]} at {prev[1]}")
+            else:
+                metric_kinds.setdefault(name, (kind, where))
+    return problems
+
+
+def main() -> int:
+    entries = collect()
+    problems = check(entries)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\n{len(problems)} problem(s)")
+        return 1
+    print(f"span-name lint clean ({len(entries)} instrumented names)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
