@@ -247,6 +247,16 @@ def bench_reference_torch(cfg):
 
 
 def main() -> None:
+    if "--stage" in sys.argv:
+        # staging-path micro-bench (pipelined round engine): staged
+        # bytes/s, vectorized assembly ms, prefetch overlap ratio —
+        # same ONE-JSON-line contract, orthogonal to the LLM metric
+        from tools.stage_bench import run_stage_bench
+
+        print(json.dumps(run_stage_bench(
+            prefetch="--no-prefetch" not in sys.argv)))
+        return
+
     import jax
     import jax.numpy as jnp
     import numpy as np

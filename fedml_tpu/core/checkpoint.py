@@ -97,23 +97,32 @@ def pack_round_state(
     server_opt: Any = None,
     next_round: int = 0,
     extra: Optional[Dict[str, Any]] = None,
+    dp_counter: Optional[int] = None,
 ) -> Dict[str, Any]:
     """The ONE saved-state contract every engine shares: global params,
     server-optimizer state, DP RNG counter, next round — plus engine
-    extras (e.g. sp's SCAFFOLD/Mime server trees)."""
+    extras (e.g. sp's SCAFFOLD/Mime server trees).
+
+    ``dp_counter`` overrides the live singleton counter: an engine whose
+    prefetch worker has already drawn the NEXT round's keys must save the
+    counter as it stood when the round being checkpointed was staged,
+    otherwise resume replays the wrong key sequence.
+    """
     from fedml_tpu.core.dp.fedml_differential_privacy import (
         FedMLDifferentialPrivacy,
     )
 
+    if dp_counter is None:
+        dp_counter = FedMLDifferentialPrivacy.get_instance()._rng_counter
     state = {
         "global_params": global_params,
         "server_opt": (
             server_opt.get_state(global_params) if server_opt is not None else {}
         ),
-        "dp_counter": np.int32(
-            FedMLDifferentialPrivacy.get_instance()._rng_counter
-        ),
-        "next_round": np.int32(next_round),
+        # 0-d arrays, not numpy scalars: orbax's standard handler rejects
+        # np.generic leaves
+        "dp_counter": np.asarray(dp_counter, np.int32),
+        "next_round": np.asarray(next_round, np.int32),
     }
     if extra:
         state.update(extra)

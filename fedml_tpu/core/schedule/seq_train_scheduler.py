@@ -14,6 +14,28 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 
+class FrozenEstimate:
+    """An immutable ``(a, b)`` affine estimate — a point-in-time snapshot
+    of a :class:`RuntimeEstimator`.
+
+    The pipelined round engine schedules round ``r+1`` while round ``r``
+    is still in flight, so the estimator coefficients it plans with must
+    be pinned at a well-defined point in the round sequence: a snapshot
+    taken when round ``r`` is handed to the device gives prefetching and
+    inline staging the exact same schedule inputs, keeping the two modes
+    bit-identical.
+    """
+
+    __slots__ = ("a", "b")
+
+    def __init__(self, a: float = 1.0, b: float = 0.0):
+        self.a = float(a)
+        self.b = float(b)
+
+    def estimate(self, n_samples: float) -> float:
+        return self.a * float(n_samples) + self.b
+
+
 class RuntimeEstimator:
     """Fit t ≈ a * n_samples + b per client from observed round times.
 
@@ -24,6 +46,10 @@ class RuntimeEstimator:
         self._obs: List[Tuple[float, float]] = []  # (n_samples, seconds)
         self.a = 1.0
         self.b = 0.0
+
+    def snapshot(self) -> FrozenEstimate:
+        """Freeze the current fit for deferred (prefetch-time) scheduling."""
+        return FrozenEstimate(self.a, self.b)
 
     def observe(self, n_samples: float, seconds: float) -> None:
         self._obs.append((float(n_samples), float(seconds)))

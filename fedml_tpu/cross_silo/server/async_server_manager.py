@@ -52,6 +52,7 @@ class AsyncFedMLServerManager(FedMLCommManager):
             int(getattr(args, "comm_round", 1)) * client_num))
         self.version = 0  # server model version == #applied updates
         self.staleness_seen: list = []
+        self.senders_seen: list = []  # participation skew diagnostics
         self.client_online_status: Dict[int, bool] = {}
         self.is_initialized = False
         self.finishing = False
@@ -105,6 +106,7 @@ class AsyncFedMLServerManager(FedMLCommManager):
         self.aggregator.set_global_model_params(mixed)
         self.version += 1
         self.staleness_seen.append(staleness)
+        self.senders_seen.append(sender)
 
         if self.version >= self.total_updates:
             self.finishing = True
@@ -114,7 +116,8 @@ class AsyncFedMLServerManager(FedMLCommManager):
                            sum(self.staleness_seen) / len(self.staleness_seen)),
                        **metrics})
             self.result = {"updates": self.version,
-                           "staleness": list(self.staleness_seen), **metrics}
+                           "staleness": list(self.staleness_seen),
+                           "senders": list(self.senders_seen), **metrics}
             for cid in range(1, self.client_num + 1):
                 self.send_message(Message(
                     MyMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))

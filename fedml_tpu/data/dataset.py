@@ -9,7 +9,7 @@ TPU-native replacement for the reference's torch DataLoader iteration
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -91,3 +91,30 @@ def batch_epochs(
         np.concatenate(ys, axis=0),
         np.concatenate(ms, axis=0),
     )
+
+
+def assemble_slots(
+    id_matrix: np.ndarray,
+    arrays_by_cid: Dict[int, Sequence[np.ndarray]],
+) -> Tuple[np.ndarray, ...]:
+    """Gather per-client staged arrays into ``[n_dev, slots, ...]`` blocks.
+
+    ``id_matrix`` is the scheduler's ``[n_dev, slots]`` client-id matrix
+    (padded with -1); ``arrays_by_cid[cid]`` is the tuple of same-shaped
+    per-client tensors (e.g. ``(x, y, mask)`` from :func:`batch_epochs`).
+    One ``np.stack`` gather per tensor replaces the per-slot Python copy
+    loop — the stack writes each [steps, B, ...] block with one memcpy
+    instead of slots × n_dev strided assignments, and padded slots share
+    one zero template instead of re-zeroing per slot.
+    """
+    n_dev, slots = id_matrix.shape
+    flat = [int(c) for c in id_matrix.reshape(-1)]
+    template = next(iter(arrays_by_cid.values()))
+    pads = tuple(np.zeros_like(a) for a in template)
+    out = []
+    for t, pad in enumerate(pads):
+        col = np.stack(
+            [arrays_by_cid[c][t] if c >= 0 else pad for c in flat]
+        )
+        out.append(col.reshape(n_dev, slots, *pad.shape))
+    return tuple(out)

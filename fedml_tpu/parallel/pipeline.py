@@ -24,6 +24,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from fedml_tpu.utils import jax_compat
+
 
 def make_pipeline_mesh(n_stages: int, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
@@ -45,7 +47,7 @@ def gpipe(
     ``x``: ``[n_microbatches * mb, ...]``; returns same shape, equal to
     sequentially applying all stages.
     """
-    shard_map = jax.shard_map
+    shard_map = jax_compat.shard_map
 
     n_stages = mesh.shape[axis]
 
@@ -62,8 +64,8 @@ def gpipe(
             steps = n_microbatches + n_stages - 1
             # the ring buffer is device-varying from the first ppermute on;
             # mark the zero init as varying so the scan carry types agree
-            buf0 = jax.lax.pcast(
-                jnp.zeros_like(micro_all[0]), (axis,), to="varying"
+            buf0 = jax_compat.pcast_varying(
+                jnp.zeros_like(micro_all[0]), (axis,)
             )
 
             def tick(buf, t):

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from fedml_tpu.utils import jax_compat
+
 NEG_INF = -1e30
 
 
@@ -52,7 +54,7 @@ def ring_attention_shard(
         k = jnp.repeat(k, h // hkv, axis=1)
         v = jnp.repeat(v, h // hkv, axis=1)
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    sp = jax.lax.axis_size(axis_name)
+    sp = jax_compat.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     qf = q.astype(jnp.float32)
 
@@ -108,7 +110,7 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = "sp",
         ring_attention_shard, axis_name=axis_name, causal=causal
     )
     spec = P(None, None, axis_name, None)  # shard the T dim of [B,H,T,D]
-    return jax.shard_map(
+    return jax_compat.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False, axis_names=frozenset({axis_name}),
     )

@@ -18,6 +18,17 @@ re-design per SURVEY §2.10/§7.3:
 Per-client RNG: a per-slot PRNG key derived by ``fold_in(round, client_id)``
 inside the program keeps client data order deterministic and independent of
 device placement.
+
+Round pipelining (PERF_NOTES round-4 addendum 4: ~97% of steady-state
+round wall clock was synchronous host staging): staging for round ``r+1``
+— sampling, poisoning, batching, ``device_put`` — runs on a background
+worker while round ``r``'s XLA program executes
+(``simulation/parallel/pipeline.py``), the per-round
+``block_until_ready`` barrier is gone (rounds chain through the params;
+the host only syncs at eval/checkpoint/host-aggregation boundaries), and
+staged per-client batches live in a persistent byte-budgeted LRU instead
+of a clear-every-round dict. ``enable_prefetch=False`` stages inline
+through the same code path — bit-identical results, no overlap.
 """
 from __future__ import annotations
 
@@ -38,12 +49,14 @@ from fedml_tpu.core.schedule.seq_train_scheduler import (
     RuntimeEstimator,
     schedule_clients_to_devices,
 )
-from fedml_tpu.data.dataset import FederatedDataset, batch_epochs
+from fedml_tpu.data.dataset import FederatedDataset, assemble_slots, batch_epochs
 from fedml_tpu.ml.aggregator.default_aggregator import create_server_aggregator
 from fedml_tpu.ml.aggregator.server_optimizer import ServerOptimizer
 from fedml_tpu.ml.trainer.local_sgd import build_local_fn, init_local_state
 from fedml_tpu.models import model_hub
+from fedml_tpu.simulation.parallel.pipeline import RoundPipeline, StagedBatchCache
 from fedml_tpu.simulation.sampling import sample_clients
+from fedml_tpu.utils import jax_compat
 from fedml_tpu.utils.tree import tree_flatten_vector, tree_unflatten_vector
 
 Pytree = Any
@@ -152,9 +165,8 @@ class MeshFedAvgAPI:
             # the replicated (unvarying) model enters a scan whose carry
             # becomes device-varying after the first SGD step — cast it to
             # varying over the mesh axis up front so scan's type check passes
-            global_params, local_state = jax.tree.map(
-                lambda p: jax.lax.pcast(p, ("clients",), to="varying"),
-                (global_params, local_state),
+            global_params, local_state = jax_compat.pcast_varying(
+                (global_params, local_state), ("clients",)
             )
 
             def one_client(x, y, m):
@@ -208,7 +220,7 @@ class MeshFedAvgAPI:
             return agg, loss, tau_eff
 
         out_model_spec = P("clients") if self._host_agg else P()
-        shard = jax.shard_map(
+        shard = jax_compat.shard_map(
             per_device_round,
             mesh=self.mesh,
             in_specs=(P(), P(), P("clients"), P("clients"), P("clients"),
@@ -218,7 +230,39 @@ class MeshFedAvgAPI:
         self._round_fn = jax.jit(shard)
         self._local_state = init_local_state(self.global_params, args)
         self.test_history: List[dict] = []
-        self._data_cache: dict = {}
+
+        # -- pipelined staging (see module docstring) ---------------------
+        # persistent per-client staged-batch LRU keyed by (cid, seed)
+        cache_mb = float(getattr(args, "stage_cache_mb", 512))
+        self._data_cache = StagedBatchCache(int(cache_mb * 2 ** 20))
+        # adaptive scheduling re-fits the runtime estimator from real
+        # (barrier-measured) round times — opt-in, because it makes the
+        # schedule timing-dependent and therefore not bit-reproducible.
+        # The default schedules by sample counts: a pure function of
+        # round_idx, which is what lets prefetch==inline stay bit-equal.
+        self._adaptive_schedule = bool(getattr(args, "adaptive_schedule", False))
+        self._sync_each_round = self._adaptive_schedule
+        self._pipeline = RoundPipeline(
+            self._stage_round,
+            prepare_fn=(
+                (lambda r: self.estimator.snapshot())
+                if self._adaptive_schedule else None
+            ),
+            # host-path aggregation with DP draws from the same key
+            # counter DURING aggregation — prefetching the next round's
+            # keys concurrently would scramble the draw order, so that
+            # combination stages inline
+            enabled=bool(getattr(args, "enable_prefetch", True)) and not (
+                self._host_agg and dp.is_dp_enabled()),
+            tracer=self.tracer,
+        )
+        self._m_overlap = telemetry.get_registry().gauge(
+            "mesh/prefetch_overlap_ratio")
+        self._m_dispatch_ms = telemetry.get_registry().histogram(
+            "mesh/round_dispatch_ms")
+        self._dispatch_started = None  # wall time round r-1 went to device
+        self._chain_started = None  # first dispatch of the unsynced chain
+        self._dp_counter_staged = None  # DP counter as of this round's staging
 
         from fedml_tpu.core.checkpoint import engine_checkpointer
 
@@ -235,7 +279,14 @@ class MeshFedAvgAPI:
         from fedml_tpu.core.checkpoint import pack_round_state
 
         return pack_round_state(
-            self.global_params, self.server_opt, self._start_round
+            self.global_params, self.server_opt, self._start_round,
+            # with prefetch live, the worker may already have drawn the
+            # NEXT round's keys — save the counter as of this round's
+            # staging instead. Inline modes (incl. host-agg+DP, where
+            # aggregation itself draws) save the live counter.
+            dp_counter=(
+                self._dp_counter_staged if self._pipeline.enabled else None
+            ),
         )
 
     def _apply_ckpt_state(self, state: dict) -> None:
@@ -246,9 +297,19 @@ class MeshFedAvgAPI:
 
     # -- host-side data staging ------------------------------------------
     def _client_arrays(self, cid: int, round_idx: int):
-        """[steps, B, ...] arrays for one client (cached per round seed)."""
-        key = (cid, round_idx)
-        if key not in self._data_cache:
+        """[steps, B, ...] arrays for one client.
+
+        Kept in the byte-budgeted staging cache keyed by ``(cid, seed)``;
+        the seed folds in the round index, so within a run each key is
+        staged (and its stateful poison draw made) exactly once, in
+        client order — a later ``get`` returns the same tensors without
+        repeating the draw.
+        """
+        seed = (int(getattr(self.args, "random_seed", 0)) * 100003
+                + cid * 1009 + round_idx)
+        key = (cid, seed)
+        staged = self._data_cache.get(key)
+        if staged is None:
             x, y = self.dataset.train_data_local_dict[cid]
             from fedml_tpu.core.security.attacker import FedMLAttacker
 
@@ -256,41 +317,52 @@ class MeshFedAvgAPI:
             if attacker.is_data_poisoning_attack() and attacker.is_to_poison_data():
                 # same hook the sp path runs in on_before_local_training
                 x, y = attacker.poison_data((x, y))
-            seed = int(getattr(self.args, "random_seed", 0)) * 100003 + cid * 1009 + round_idx
-            self._data_cache[key] = batch_epochs(
+            staged = batch_epochs(
                 np.asarray(x), np.asarray(y), self.batch_size, self.epochs,
                 seed=seed, pad_to_batches=self.steps_per_epoch,
             )
-        return self._data_cache[key]
+            self._data_cache.put(key, staged, tag=round_idx)
+        return staged
 
-    def _stage_round(self, round_idx: int, client_ids: List[int]):
-        self._data_cache.clear()  # only the current round stays hot
+    def _stage_round(self, round_idx: int, sched_estimate=None):
+        """Full host staging for one round: sample, poison, batch, place.
+
+        Runs EITHER inline on the round loop thread or ahead-of-time on
+        the prefetch worker — every stateful draw for the round (poison
+        RNG, LDP/CDP key counter) happens inside this one call, so the
+        draw order is identical in both modes as long as rounds are
+        staged in increasing order (the pipeline guarantees that).
+        """
+        # entries older than the staged double-buffer window (this round +
+        # the one in flight) embed a past round in their seed and can
+        # never hit again this run — free them instead of letting them
+        # ride the byte budget
+        self._data_cache.trim_tags_below(round_idx - 1)
+        client_ids = self._client_sampling(round_idx)
         # stage data in client_ids order FIRST: data-poisoning attacks draw
         # from a stateful RNG per call, and the sp path poisons clients in
         # exactly this order — staging in scheduler order would give each
         # client a different poison draw and break sp==mesh parity
-        for cid in client_ids:
-            self._client_arrays(int(cid), round_idx)
+        arrays_by_cid = {
+            int(cid): self._client_arrays(int(cid), round_idx)
+            for cid in client_ids
+        }
         id_matrix = schedule_clients_to_devices(
             client_ids,
             self.dataset.train_data_local_num_dict,
             self.n_devices,
-            self.estimator,
+            sched_estimate,
         )
         n_dev, slots = id_matrix.shape
-        x0, y0, m0 = self._client_arrays(client_ids[0], round_idx)
-        xs = np.zeros((n_dev, slots, *x0.shape), dtype=x0.dtype)
-        ys = np.zeros((n_dev, slots, *y0.shape), dtype=y0.dtype)
-        ms = np.zeros((n_dev, slots, *m0.shape), dtype=m0.dtype)
-        nk = np.zeros((n_dev, slots), dtype=np.float32)
-        for d in range(n_dev):
-            for s in range(slots):
-                cid = id_matrix[d, s]
-                if cid < 0:
-                    continue
-                x, y, m = self._client_arrays(int(cid), round_idx)
-                xs[d, s], ys[d, s], ms[d, s] = x, y, m
-                nk[d, s] = self.dataset.train_data_local_num_dict[int(cid)]
+        # one vectorized gather per tensor (np.stack) instead of the old
+        # O(n_dev × slots) per-slot Python copy loop
+        xs, ys, ms = assemble_slots(id_matrix, arrays_by_cid)
+        counts = self.dataset.train_data_local_num_dict
+        nk = np.asarray(
+            [[counts[int(c)] if c >= 0 else 0.0 for c in row]
+             for row in id_matrix],
+            dtype=np.float32,
+        )
         # per-client LDP keys: the SAME counter keys, in the SAME client
         # order, the sequential sp path would draw — so in-program noise is
         # bit-identical to host-side add_local_noise (see take_key_data)
@@ -307,10 +379,13 @@ class MeshFedAvgAPI:
         cdp_kd = np.zeros((kd_width,), dtype=np.uint32)
         if self._cdp_in_program:
             cdp_kd = self._dp.take_key_data(1)[0]
-        self._last_id_matrix = id_matrix
+        # counter AFTER this round's draws: the checkpoint of this round
+        # must save THIS value, not the live counter, which the prefetch
+        # worker may already have advanced for the next round
+        dp_counter = self._dp._rng_counter
         spec = NamedSharding(self.mesh, P("clients"))
         rep = NamedSharding(self.mesh, P())
-        return (
+        device_args = (
             jax.device_put(xs, spec),
             jax.device_put(ys, spec),
             jax.device_put(ms, spec),
@@ -318,6 +393,13 @@ class MeshFedAvgAPI:
             jax.device_put(ldp_kd, spec),
             jax.device_put(cdp_kd, rep),
         )
+        return {
+            "client_ids": client_ids,
+            "id_matrix": id_matrix,
+            "nk_host": nk,
+            "dp_counter": dp_counter,
+            "device_args": device_args,
+        }
 
     def _client_sampling(self, round_idx: int) -> List[int]:
         return sample_clients(self.args, round_idx)
@@ -326,30 +408,66 @@ class MeshFedAvgAPI:
     def train_one_round(self, round_idx: int) -> dict:
         from fedml_tpu.core.alg_frame.params import Context
 
-        client_ids = self._client_sampling(round_idx)
+        self.event.log_event_started("stage", round_idx)
+        with self.tracer.span(f"round/{round_idx}/stage") as stage_span:
+            # prefetched by the worker during round r-1's compute, or
+            # staged inline through the exact same _stage_round call
+            staged = self._pipeline.get(round_idx)
+            win = self._pipeline.last_prefetch_window
+            busy_since = self._chain_started or self._dispatch_started
+            if win is not None and busy_since is not None:
+                # staging time that ran while earlier rounds' programs
+                # were in flight on the device (rounds chain, so the
+                # device is busy from the first unsynced dispatch on)
+                lo = max(win[0], busy_since)
+                hi = min(win[1], time.time())
+                dur = max(win[1] - win[0], 1e-9)
+                ratio = max(0.0, hi - lo) / dur
+                stage_span.attrs["prefetch_overlap_ratio"] = round(ratio, 4)
+                self._m_overlap.set(ratio)
+        self.event.log_event_ended("stage", round_idx)
+        client_ids = staged["client_ids"]
         ctx = Context()
         ctx.add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
         ctx.add(Context.KEY_CLIENT_NUM_IN_THIS_ROUND, len(client_ids))
-        self.event.log_event_started("stage", round_idx)
-        with self.tracer.span(f"round/{round_idx}/stage"):
-            xs, ys, ms, nk, ldp_kd, cdp_kd = self._stage_round(round_idx, client_ids)
-        self.event.log_event_ended("stage", round_idx)
+
+        # start staging round r+1 BEFORE launching round r: the worker
+        # overlaps sampling/poisoning/batching/device_put with the device
+        # executing this round's program
+        if round_idx + 1 < int(self.args.comm_round):
+            self._pipeline.schedule_next(round_idx)
 
         self.event.log_event_started("train+agg", round_idx)
         t0 = time.time()
+        self._dispatch_started = t0
+        if self._chain_started is None:
+            self._chain_started = t0
         # the whole round is ONE XLA program; round 0 pays the compile,
         # which the jax.monitoring listener books into compile_ms so the
         # report separates bridge cost from steady-state round time
         with self.tracer.span(f"round/{round_idx}/train_agg",
                               n_clients=len(client_ids)):
             out, loss, tau_eff = self._round_fn(
-                self.global_params, self._local_state, xs, ys, ms, nk, ldp_kd, cdp_kd
+                self.global_params, self._local_state, *staged["device_args"]
             )
-            out = jax.block_until_ready(out)
+            if self._sync_each_round:
+                # adaptive scheduling needs real round times — keep the
+                # barrier so the estimator observes device time, not
+                # dispatch time
+                out = jax.block_until_ready(out)
         dt = time.time() - t0
-        self._m_round_ms.observe(dt * 1e3)
+        if self._sync_each_round:
+            # only a barriered dt is a round time; feeding dispatch
+            # latency into the same histogram would silently turn the
+            # exported round_ms into a ~1000x-smaller different metric
+            self._m_round_ms.observe(dt * 1e3)
+        else:
+            self._m_dispatch_ms.observe(dt * 1e3)
         self.event.log_event_ended("train+agg", round_idx)
-        self.estimator.observe(float(np.sum(jax.device_get(nk))), dt)
+        if self._sync_each_round:
+            self.estimator.observe(float(np.sum(staged["nk_host"])), dt)
+        self._last_id_matrix = staged["id_matrix"]
+        self._dp_counter_staged = staged["dp_counter"]
 
         if self._host_agg:
             # reassemble (n_k, model) in client order and run the standard
@@ -386,10 +504,19 @@ class MeshFedAvgAPI:
             if should_save(self.args, round_idx):
                 self._start_round = round_idx + 1
                 self._ckpt.save(round_idx, self._ckpt_state())
+                self._chain_started = None  # serialization drained the queue
 
-        report = {"round": round_idx, "train_loss": float(loss), "round_sec": dt}
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
-        if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
+        eval_round = (round_idx % max(freq, 1) == 0
+                      or round_idx == int(self.args.comm_round) - 1)
+        report = {"round": round_idx, "round_sec": dt}
+        if eval_round or self._sync_each_round or self._host_agg or fednova:
+            # the loss readback is a device sync; only pay it on rounds
+            # where the host syncs anyway — otherwise rounds chain on
+            # device and dt above is dispatch time, not round time
+            report["train_loss"] = float(loss)
+            self._chain_started = None  # device queue drained here
+        if eval_round:
             with self.tracer.span(f"round/{round_idx}/eval"):
                 metrics = self.aggregator.test(
                     self.global_params, self.dataset.test_data_global, None, self.args
@@ -401,8 +528,12 @@ class MeshFedAvgAPI:
 
     def train(self) -> dict:
         t0 = time.time()
-        for round_idx in range(self._start_round, int(self.args.comm_round)):
-            self.train_one_round(round_idx)
+        try:
+            for round_idx in range(self._start_round, int(self.args.comm_round)):
+                self.train_one_round(round_idx)
+            jax.block_until_ready(self.global_params)
+        finally:
+            self._pipeline.close()
         wall = time.time() - t0
         telemetry.flush_run()
         self.event.flush()
@@ -412,5 +543,6 @@ class MeshFedAvgAPI:
             "rounds": int(self.args.comm_round),
             "rounds_per_sec": int(self.args.comm_round) / max(wall, 1e-9),
             "n_devices": self.n_devices,
+            "prefetched_rounds": self._pipeline.prefetched_rounds,
             **final,
         }

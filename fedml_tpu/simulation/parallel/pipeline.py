@@ -1,0 +1,337 @@
+"""Pipelined round engine — overlap host staging with device compute.
+
+PERF_NOTES round-4 addendum 4 measured the whole federated round at
+75.3 ms of device time inside a 2.47 s wall clock: ~97% of steady-state
+round time was synchronous host work (sampling, poisoning, batching,
+transfer) serialized *between* device rounds. This module removes that
+serialization:
+
+- :class:`StagedBatchCache` — a persistent per-client staged-batch LRU
+  keyed by ``(cid, seed)`` with a byte budget, replacing the mesh
+  simulator's clear-every-round dict, so staged tensors survive across
+  rounds and memory stays bounded instead of resetting to cold each
+  round;
+- :class:`RoundPipeline` — a single background worker that stages round
+  ``r+1`` (client sampling, poisoning, batching, ``jax.device_put``)
+  while round ``r``'s XLA program executes, double-buffered: at most one
+  round in flight ahead of the device.
+
+Parity contract (what keeps prefetch-on == prefetch-off == sp, bit for
+bit): staging for round ``r`` is a single call that performs every
+stateful draw (data-poisoning RNG, LDP/CDP key-counter advances) for
+that round, rounds are staged in strictly increasing order on exactly
+one thread at a time, and any schedule inputs that could drift between
+the two modes (the runtime-estimator fit) are captured by a
+``prepare_fn`` at one uniform point in the round sequence — when round
+``r-1`` is handed to the device — regardless of whether the staging
+itself then runs inline or on the worker.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["StagedBatchCache", "PrefetchHandle", "RoundPipeline"]
+
+
+class StagedBatchCache:
+    """Byte-budgeted store of per-client staged batch tuples.
+
+    Keys are ``(cid, seed)`` — the seed folds in the round index, so a
+    key uniquely names one client's staged tensors for one round. In the
+    training loop each key is staged exactly once (rounds stage in
+    increasing order and hold their arrays directly), so in-loop hits do
+    not occur; the ``get`` path serves out-of-loop re-access — template
+    lookups and re-gathers like ``tools/stage_bench.py``. Memory is
+    bounded two ways: the engine trims past-round tags (the double-buffer
+    window) and the LRU byte budget caps whatever remains.
+
+    Safe for the two-thread staging pattern (main thread inline, worker
+    thread prefetch): all state mutations happen under one lock.
+    """
+
+    def __init__(self, max_bytes: int = 512 << 20):
+        self.max_bytes = int(max_bytes)
+        self._entries: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        self._nbytes: Dict[Tuple, int] = {}
+        self._tags: Dict[Tuple, int] = {}
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.bytes_staged = 0  # cumulative across puts (bench counter)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: Tuple) -> Optional[Tuple]:
+        with self._lock:
+            arrays = self._entries.get(key)
+            if arrays is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return arrays
+
+    def put(self, key: Tuple, arrays: Tuple, tag: Optional[int] = None) -> None:
+        nb = int(sum(int(a.nbytes) for a in arrays))
+        with self._lock:
+            if key in self._entries:
+                self.bytes -= self._nbytes[key]
+            self._entries[key] = arrays
+            self._nbytes[key] = nb
+            if tag is not None:
+                self._tags[key] = int(tag)
+            self._entries.move_to_end(key)
+            self.bytes += nb
+            self.bytes_staged += nb
+            # keep at least the entry just inserted so one oversized
+            # client still stages; everything older yields to the budget
+            while self.bytes > self.max_bytes and len(self._entries) > 1:
+                old_key, _ = self._entries.popitem(last=False)
+                self.bytes -= self._nbytes.pop(old_key)
+                self._tags.pop(old_key, None)
+                self.evictions += 1
+
+    def trim_tags_below(self, tag: int) -> None:
+        """Drop entries whose put-time ``tag`` (round index) is older.
+
+        In the round loop a ``(cid, seed)`` key embeds the round, so past
+        rounds' entries can never hit again within the run — the byte
+        budget is a cap, not a reason to retain them. The engine trims to
+        the staged double-buffer window; untagged entries are kept.
+        """
+        with self._lock:
+            for key in [k for k, t in self._tags.items() if t < tag]:
+                self._entries.pop(key, None)
+                self.bytes -= self._nbytes.pop(key, 0)
+                del self._tags[key]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "bytes_staged": self.bytes_staged,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+class PrefetchHandle:
+    """Future-ish result slot for one prefetched round."""
+
+    __slots__ = ("round_idx", "done", "result", "exception",
+                 "started", "ended")
+
+    def __init__(self, round_idx: int):
+        self.round_idx = round_idx
+        self.done = threading.Event()
+        self.result: Any = None
+        self.exception: Optional[BaseException] = None
+        self.started: float = 0.0
+        self.ended: float = 0.0
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"prefetch of round {self.round_idx} did not complete")
+        if self.exception is not None:
+            raise self.exception
+        return self.result
+
+
+_STOP = object()
+
+
+def _worker_loop(q: "queue.Queue") -> None:
+    # deliberately closes over ONLY the queue: tasks (which reference the
+    # engine) flow through it transiently, so dropping the last engine
+    # reference lets the weakref finalizer push _STOP and the thread die
+    while True:
+        task = q.get()
+        try:
+            if task is _STOP:
+                return
+            handle, thunk = task
+            handle.started = time.time()
+            try:
+                handle.result = thunk()
+            except BaseException as e:  # noqa: BLE001 — re-raised at get()
+                handle.exception = e
+            finally:
+                handle.ended = time.time()
+                handle.done.set()
+        finally:
+            q.task_done()
+
+
+def _shutdown(q: "queue.Queue", thread: threading.Thread) -> bool:
+    """Stop the worker; True if it actually exited."""
+    if thread.is_alive():
+        q.put(_STOP)
+        thread.join(timeout=5.0)
+    return not thread.is_alive()
+
+
+class RoundPipeline:
+    """Double-buffered staging pipeline for a round-based engine.
+
+    The owning engine drives it as::
+
+        staged = pipeline.get(r)          # prefetched, or staged inline
+        pipeline.schedule_next(r)         # start staging r+1 NOW
+        launch_device_round(staged)       # overlaps with staging of r+1
+
+    ``stage_fn(round_idx, prepared)`` performs the full staging of one
+    round (all stateful draws included); ``prepare_fn(round_idx)`` runs
+    on the caller thread inside :meth:`schedule_next` and captures any
+    mutable schedule inputs at that uniform point, so inline staging
+    (prefetch disabled) consumes the exact same inputs the worker would.
+
+    With ``enabled=False`` no thread is ever started and :meth:`get`
+    stages inline — same call sequence, zero concurrency.
+    """
+
+    def __init__(
+        self,
+        stage_fn: Callable[[int, Any], Any],
+        *,
+        prepare_fn: Optional[Callable[[int], Any]] = None,
+        enabled: bool = True,
+        tracer: Any = None,
+    ):
+        self._stage_fn = stage_fn
+        self._prepare_fn = prepare_fn
+        self.enabled = bool(enabled)
+        self._tracer = tracer
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._handles: Dict[int, PrefetchHandle] = {}
+        self._prepared: Dict[int, Any] = {}
+        self._closed = False
+        self._broken: Optional[BaseException] = None
+        self._finalizer = None
+        self.prefetched_rounds = 0
+        self.inline_rounds = 0
+
+    # -- worker lifecycle -------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=_worker_loop, args=(self._queue,),
+            name="round-prefetch", daemon=True,
+        )
+        self._thread.start()
+        # GC-driven shutdown: the worker only references the queue, so
+        # when the last pipeline reference drops, this pushes the stop
+        # sentinel and joins — no orphaned worker outliving its engine
+        self._finalizer = weakref.finalize(
+            self, _shutdown, self._queue, self._thread)
+
+    def close(self) -> None:
+        """Stop the worker (idempotent). Pending handles stay readable;
+        further rounds stage inline."""
+        self._closed = True
+        if self._thread is not None:
+            if not _shutdown(self._queue, self._thread):
+                # a staging task outlived the join timeout: keep the
+                # handle so worker_alive stays truthful (the task may
+                # still be mutating singleton RNGs) instead of reporting
+                # a clean shutdown that didn't happen
+                logging.getLogger(__name__).warning(
+                    "prefetch worker did not exit within the shutdown "
+                    "timeout; a staging task is still running")
+                return
+            if self._finalizer is not None:
+                self._finalizer.detach()
+            self._thread = None
+
+    @property
+    def worker_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def __enter__(self) -> "RoundPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- round protocol ---------------------------------------------------
+    def schedule_next(self, round_idx: int) -> None:
+        """Capture schedule inputs for round ``round_idx + 1`` and, when
+        prefetch is on, hand its staging to the worker."""
+        nxt = round_idx + 1
+        if self._broken is not None or nxt in self._handles:
+            return
+        if self._prepare_fn is not None and nxt not in self._prepared:
+            self._prepared[nxt] = self._prepare_fn(nxt)
+        if not self.enabled or self._closed:
+            return
+        self._ensure_worker()
+        handle = PrefetchHandle(nxt)
+        # consumed exactly once (the inline path pops in get()) — leaving
+        # it behind would grow one entry per round for the engine's life
+        prepared = self._prepared.pop(nxt, None)
+        stage_fn, tracer = self._stage_fn, self._tracer
+
+        def thunk():
+            if tracer is None:
+                return stage_fn(nxt, prepared)
+            span = tracer.begin(f"round/{nxt}/prefetch")
+            try:
+                return stage_fn(nxt, prepared)
+            finally:
+                tracer.end(span)
+
+        self._handles[nxt] = handle
+        self._queue.put((handle, thunk))
+
+    def get(self, round_idx: int) -> Any:
+        """The staged bundle for ``round_idx`` — waits on the worker if a
+        prefetch is in flight, stages inline otherwise. Re-raises any
+        staging exception on the caller thread and marks the pipeline
+        broken (stateful RNG draws past a failed round are undefined)."""
+        if self._broken is not None:
+            raise RuntimeError(
+                "round pipeline is broken by an earlier staging failure"
+            ) from self._broken
+        handle = self._handles.pop(round_idx, None)
+        if handle is not None:
+            try:
+                result = handle.wait()
+            except BaseException as e:
+                self._broken = e
+                self.close()
+                raise
+            self.prefetched_rounds += 1
+            # keep only the wall times — holding the handle would pin its
+            # result (a full round of staged device buffers) for an extra
+            # round beyond the documented double-buffer
+            self._last_window = (handle.started, handle.ended)
+            return result
+        self.inline_rounds += 1
+        prepared = self._prepared.pop(round_idx, None)
+        self._last_window = None
+        try:
+            return self._stage_fn(round_idx, prepared)
+        except BaseException as e:
+            self._broken = e
+            self.close()
+            raise
+
+    @property
+    def last_prefetch_window(self) -> Optional[Tuple[float, float]]:
+        """(started, ended) wall times of the most recent prefetched
+        staging returned by :meth:`get`; None if it was staged inline."""
+        return getattr(self, "_last_window", None)

@@ -114,9 +114,9 @@ class FedAvgAPI:
             self.global_params, self.server_opt, self._start_round,
             extra={
                 "c_global": self._c_global if self._c_global is not None else zeros,
-                "has_c": np.int32(self._c_global is not None),
+                "has_c": np.asarray(self._c_global is not None, np.int32),
                 "mime_s": self._mime_s if self._mime_s is not None else zeros,
-                "has_mime": np.int32(self._mime_s is not None),
+                "has_mime": np.asarray(self._mime_s is not None, np.int32),
             },
         )
 
@@ -147,6 +147,15 @@ class FedAvgAPI:
         taus: List[float] = []
         mime_grads = []
         server_state = {}
+        # SCAFFOLD's control variate and Mime's server momentum share the
+        # one server_state slot the compiled local trainer reads — that is
+        # only sound while a single federated optimizer is active. Fail
+        # loud rather than silently letting Mime overwrite SCAFFOLD.
+        assert self._c_global is None or self._mime_s is None, (
+            "server_state slot conflict: SCAFFOLD c_global and Mime "
+            "momentum are both live; one run supports one server-stateful "
+            "optimizer"
+        )
         if self._c_global is not None:
             server_state["c_global"] = self._c_global
         if self._mime_s is not None:

@@ -86,21 +86,34 @@ def build_report(run_dir: str) -> Dict:
         if not m:
             continue
         n = int(m.group(1))
-        r = rounds.setdefault(n, {"round": n, "started": s["started"],
-                                  "ended": s["ended"], "phases": {},
-                                  "client_spans": []})
-        r["started"] = min(r["started"], s["started"])
-        r["ended"] = max(r["ended"], s["ended"])
         phase = normalize_name(s["name"])
+        prefetch = phase.endswith("/prefetch")
+        r = rounds.get(n)
+        if r is None:
+            r = rounds[n] = {"round": n, "started": None, "ended": None,
+                             "phases": {}, "client_spans": []}
+        # prefetch spans run DURING the previous round (that is the
+        # point) — counting them into this round's wall bounds would
+        # overlap consecutive rounds and double-count execute time; they
+        # get the dedicated stage_overlap section instead
+        if not prefetch:
+            r["started"] = (s["started"] if r["started"] is None
+                            else min(r["started"], s["started"]))
+            r["ended"] = (s["ended"] if r["ended"] is None
+                          else max(r["ended"], s["ended"]))
         r["phases"].setdefault(phase, []).append(s["duration_ms"])
         if _CLIENT_RE.match(s["name"]):
             r["client_spans"].append(s)
     round_rows = []
     for n in sorted(rounds):
         r = rounds[n]
+        # a round with only a prefetch span (staged but never dispatched,
+        # e.g. an aborted run) has no wall bounds
+        wall_ms = ((r["ended"] - r["started"]) * 1e3
+                   if r["started"] is not None else 0.0)
         round_rows.append({
             "round": n,
-            "wall_ms": (r["ended"] - r["started"]) * 1e3,
+            "wall_ms": wall_ms,
             "phases": {p: sum(v) for p, v in sorted(r["phases"].items())},
         })
 
@@ -136,6 +149,57 @@ def build_report(run_dir: str) -> Dict:
             "share": worst["duration_ms"] / total if total else 0.0,
         })
 
+    # -- stage overlap (pipelined round engine) ---------------------------
+    # how much of round r's host staging (the round/<r>/prefetch span,
+    # recorded on the prefetch worker) ran while round r-1's program was
+    # in flight. Rounds chain without a host barrier, so the device-busy
+    # window for round r-1 is approximated by the wall interval between
+    # consecutive train_agg dispatches — the chained-timing caveat from
+    # PERF_NOTES applies (host spans cannot see device queue drain).
+    ta_by_round: Dict[int, Dict] = {}
+    prefetch_by_round: Dict[int, Dict] = {}
+    for s in spans:
+        m = _ROUND_RE.match(s["name"])
+        if not m:
+            continue
+        n = int(m.group(1))
+        tail = normalize_name(s["name"])
+        if tail == "round/<n>/train_agg":
+            ta_by_round.setdefault(n, s)
+        elif tail == "round/<n>/prefetch":
+            prefetch_by_round.setdefault(n, s)
+    overlap_rows = []
+    for n in sorted(prefetch_by_round):
+        # rounds chain: the device is (assumed) busy from the FIRST prior
+        # dispatch through the dispatch of round n, not just since n-1 —
+        # prefetch(n) legitimately starts a hair before dispatch(n-1)
+        # while rounds < n-1 are still in flight
+        prior = [t for k, t in ta_by_round.items() if k < n]
+        if not prior:
+            continue
+        p = prefetch_by_round[n]
+        cur = ta_by_round.get(n)
+        win_end = (cur["started"] if cur is not None
+                   else max(t["ended"] for t in prior))
+        lo = max(p["started"], min(t["started"] for t in prior))
+        hi = min(p["ended"], win_end)
+        dur_ms = max(p["duration_ms"], 1e-9)
+        overlapped_ms = max(0.0, hi - lo) * 1e3
+        overlap_rows.append({
+            "round": n,
+            "prefetch_ms": p["duration_ms"],
+            "overlapped_ms": overlapped_ms,
+            "ratio": min(overlapped_ms / dur_ms, 1.0),
+        })
+    total_prefetch = sum(r["prefetch_ms"] for r in overlap_rows)
+    total_overlap = sum(r["overlapped_ms"] for r in overlap_rows)
+    stage_overlap = {
+        "rounds": overlap_rows,
+        "prefetch_ms": total_prefetch,
+        "overlapped_ms": total_overlap,
+        "ratio": (total_overlap / total_prefetch) if total_prefetch else 0.0,
+    }
+
     # -- compile vs execute ----------------------------------------------
     compile_ms = sum(s.get("compile_ms", 0.0) for s in spans)
     round_total = sum(r["wall_ms"] for r in round_rows)
@@ -159,6 +223,7 @@ def build_report(run_dir: str) -> Dict:
         "rounds": round_rows,
         "phases": phase_rows,
         "stragglers": stragglers,
+        "stage_overlap": stage_overlap,
         "compile_ms": compile_ms,
         "execute_ms": max(round_total - compile_ms, 0.0),
         "comm_bytes": comm,
@@ -184,6 +249,16 @@ def format_report(report: Dict) -> str:
     for p in report["phases"]:
         add(f"  {p['phase']:<44s}{p['count']:>6d}{p['p50_ms']:>10.1f}"
             f"{p['p95_ms']:>10.1f}{p['p99_ms']:>10.1f}")
+    overlap = report.get("stage_overlap") or {}
+    if overlap.get("rounds"):
+        add("")
+        add("stage overlap (prefetched staging vs in-flight round, "
+            "chained-timing caveat applies):")
+        for r in overlap["rounds"]:
+            add(f"  round {r['round']}: prefetch {r['prefetch_ms']:.1f} ms, "
+                f"overlapped {r['overlapped_ms']:.1f} ms "
+                f"(ratio {r['ratio']:.2f})")
+        add(f"  overall overlap ratio: {overlap['ratio']:.2f}")
     if report["compile_ms"]:
         add("")
         add(f"jax compile-vs-execute: compile {report['compile_ms']:.1f} ms, "

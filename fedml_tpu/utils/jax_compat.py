@@ -1,0 +1,74 @@
+"""Version shims for the narrow slice of the jax API the engine uses.
+
+The codebase targets the current jax surface (``jax.shard_map``,
+``jax.lax.pcast``); older runtimes (0.4.x) ship the same functionality
+under experimental names or simply don't enforce the varying-type system
+that ``pcast`` feeds. Routing every call site through this module keeps
+the simulators importable across the jax versions the fleet actually
+runs — one hasattr probe at import, zero per-call overhead.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+Pytree = Any
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # jax < 0.6: same callable, experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_PCAST = hasattr(jax.lax, "pcast")
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, axis_names=None):
+    """``jax.shard_map`` wherever it lives in this jax version.
+
+    ``check_vma`` / ``axis_names`` are the current-jax spellings; on the
+    experimental (0.4.x) shard_map they translate to ``check_rep`` and
+    ``auto`` (the complement: axes NOT manually mapped).
+    """
+    kwargs = {}
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+    else:
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def axis_size(axis_name: str):
+    """``jax.lax.axis_size`` with a psum(1) fallback for older jax.
+
+    Inside shard_map/pmap the axis size is static, so the fallback's
+    psum of a constant folds to a compile-time constant — no collective
+    is actually emitted.
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(tree: Pytree, axis_names) -> Pytree:
+    """Cast replicated leaves to device-varying over ``axis_names``.
+
+    On jax versions without ``jax.lax.pcast`` there is no varying-type
+    check to satisfy — the cast is the identity.
+    """
+    if not _HAS_PCAST:
+        return tree
+    return jax.tree.map(
+        lambda p: jax.lax.pcast(p, tuple(axis_names), to="varying"), tree
+    )
+
+
+__all__ = ["shard_map", "pcast_varying", "axis_size"]

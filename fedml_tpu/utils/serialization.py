@@ -72,18 +72,62 @@ def _blob_at(blobs: List[Any], idx: Any) -> Any:
     return blobs[i]
 
 
-def _decode(node: Any, blobs: List[np.ndarray]) -> Any:
+_NPY_MAGIC = b"\x93NUMPY"
+
+
+def _ndarray_from_npy(mv: memoryview) -> np.ndarray:
+    """Decode one ``.npy`` blob without copying the array payload.
+
+    The (~100-byte) header is parsed via ``np.lib.format``; the array
+    data itself is aliased straight out of the transport buffer with
+    ``np.frombuffer`` — zero-copy, so the result is read-only (writers
+    downstream feed it to jax, which copies on device transfer anyway).
+    Falls back to ``np.load`` for layouts frombuffer can't alias
+    (non-contiguous/pickled payloads are rejected there as before).
+    """
+    head = mv[: min(len(mv), 12)].tobytes()
+    if head[:6] != _NPY_MAGIC:
+        raise ValueError("array blob is not in npy format")
+    # hostile/truncated payloads must fail as ValueError (the rejection
+    # contract of safe_loads), never struct.error/IndexError
+    if len(head) < 10:
+        raise ValueError("array blob header is truncated")
+    major = head[6]
+    if major == 1:
+        (hlen,) = struct.unpack_from("<H", head, 8)
+        data_start = 10 + hlen
+        header_fn = np.lib.format.read_array_header_1_0
+    else:
+        if len(head) < 12:
+            raise ValueError("array blob header is truncated")
+        (hlen,) = struct.unpack_from("<I", head, 8)
+        data_start = 12 + hlen
+        header_fn = np.lib.format.read_array_header_2_0
+    fp = io.BytesIO(mv[8:data_start].tobytes())
+    shape, fortran_order, dtype = header_fn(fp)
+    if dtype.hasobject:
+        raise ValueError("object arrays are not allowed in safe payloads")
+    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    nbytes = count * dtype.itemsize
+    data = mv[data_start:data_start + nbytes]
+    if len(data) != nbytes:
+        raise ValueError("array blob is truncated")
+    arr = np.frombuffer(data, dtype=dtype, count=count)
+    return arr.reshape(shape, order="F" if fortran_order else "C")
+
+
+def _decode(node: Any, blobs: List[memoryview]) -> Any:
     if isinstance(node, dict):
         if _ARRAY in node and len(node) == 1:
             raw = _blob_at(blobs, node[_ARRAY])
-            if raw[:4] == b"RAW0":
+            if raw[:4].tobytes() == b"RAW0":
                 raise ValueError("array tag references a bytes blob")
-            return np.load(io.BytesIO(raw), allow_pickle=False)
+            return _ndarray_from_npy(raw)
         if _BYTES in node and len(node) == 1:
             raw = _blob_at(blobs, node[_BYTES])
-            if raw[:4] != b"RAW0":
+            if raw[:4].tobytes() != b"RAW0":
                 raise ValueError("bytes tag references a non-bytes blob")
-            return raw[4:]
+            return raw[4:].tobytes()
         if node.get(_TUPLE) == "tuple":
             return tuple(_decode(v, blobs) for v in node["items"])
         if node.get(_TUPLE) == "dict_items":
@@ -109,9 +153,15 @@ def safe_loads(data: bytes) -> Any:
     (hlen,) = struct.unpack_from("<I", data, 0)
     header = json.loads(data[4 : 4 + hlen].decode())
     offset = 4 + hlen
-    blobs: List[bytes] = []
+    # memoryview slices alias the payload — no per-blob copy; array
+    # leaves are then aliased out of these views by _ndarray_from_npy
+    mv = memoryview(data)
+    blobs: List[memoryview] = []
     for nbytes in header["arrays"]:
-        blobs.append(bytes(data[offset : offset + nbytes]))
+        nbytes = int(nbytes)
+        if nbytes < 0 or offset + nbytes > len(data):
+            raise ValueError("blob table overruns the payload")
+        blobs.append(mv[offset : offset + nbytes])
         offset += nbytes
     return _decode(header["skeleton"], blobs)
 
@@ -127,4 +177,11 @@ def tree_from_bytes(data: bytes) -> Pytree:
 
 
 def tree_nbytes(tree: Pytree) -> int:
-    return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree))
+    # x.nbytes, not np.asarray(x).nbytes: asarray on a jax array forces a
+    # device→host transfer just to read a size that both jax and numpy
+    # arrays already expose as metadata
+    total = 0
+    for x in jax.tree.leaves(tree):
+        nb = getattr(x, "nbytes", None)
+        total += int(nb) if nb is not None else np.asarray(x).nbytes
+    return total

@@ -190,10 +190,16 @@ def test_async_fedavg_cross_silo():
     res = run_cross_silo_inproc(args, ds, model, timeout=120)
     assert res is not None and res["updates"] == 12
     assert res["test_acc"] > 0.5, res
-    # staleness is recorded per update; with 3 concurrent clients at least
-    # one update must have been computed against a stale version
+    # staleness is recorded per update; whenever more than one client
+    # actually lands updates, at least one must have been computed against
+    # a stale version. (Under heavy CPU contention one fast client can
+    # legitimately supply every update before the others finish their
+    # first local training — all-staleness-0 is correct async behavior
+    # then, so the assertion is gated on real multi-client participation.)
     assert len(res["staleness"]) == 12
-    assert max(res["staleness"]) >= 1
+    assert len(res["senders"]) == 12
+    if len(set(res["senders"])) > 1:
+        assert max(res["staleness"]) >= 1, res
 
 
 def test_cross_silo_fednova_rescales_by_tau_eff():

@@ -163,3 +163,38 @@ def test_checkpointer_prunes_old_rounds(tmp_path):
     assert ck.saved_rounds() == [3, 4]
     state = ck.restore(4, {"x": np.zeros(3, np.float32)})
     np.testing.assert_array_equal(state["x"], np.arange(3, dtype=np.float32) + 4)
+
+
+def test_mesh_kill_and_resume_with_ldp_and_prefetch(tmp_path):
+    """Resume must replay the SAME LDP key sequence even though the
+    prefetch worker had already drawn the next round's keys when the
+    checkpoint was written (the saved dp_counter is as-of-staging)."""
+    from fedml_tpu.simulation.parallel.mesh_simulator import MeshFedAvgAPI
+
+    LDP = {"enable_dp": True, "dp_solution_type": "LDP",
+           "epsilon": 5.0, "delta": 1e-5, "clipping_norm": 1.0}
+
+    def run(args, ds, model):
+        api = MeshFedAvgAPI(args, None, ds, model)
+        api.train()
+        return np.asarray(tree_flatten_vector(api.global_params))
+
+    args = make_args(rounds=5, backend="mesh", **LDP)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    straight = run(args, ds, model)
+
+    args_a = make_args(rounds=3, backend="mesh", ckpt_dir=tmp_path / "ck",
+                       **LDP)
+    run(args_a, ds, model)
+    # simulate a mid-run kill: drop the final checkpoint so resume picks
+    # round 1's — which was written WHILE the worker prefetched round 2
+    # (the final round of a clean run never has a prefetch ahead of it,
+    # so resuming from it cannot catch a counter-ahead save)
+    import shutil
+
+    shutil.rmtree(tmp_path / "ck" / "round_2")
+    args_b = make_args(rounds=5, backend="mesh", ckpt_dir=tmp_path / "ck",
+                       resume=True, **LDP)
+    resumed = run(args_b, ds, model)
+    np.testing.assert_array_equal(straight, resumed)

@@ -61,3 +61,22 @@ def test_hostile_blob_index_rejected():
 def test_unsupported_type_raises():
     with pytest.raises(TypeError):
         safe_dumps({"f": object()})
+
+
+def test_array_decode_is_zero_copy_and_readonly():
+    """Array leaves alias the transport buffer (no per-blob copy) — so
+    they come back read-only; values and exotic layouts still roundtrip."""
+    obj = {"w": np.arange(1024, dtype=np.float32)}
+    out = safe_loads(safe_dumps(obj))
+    assert not out["w"].flags.writeable
+    np.testing.assert_array_equal(out["w"], obj["w"])
+    scalars = safe_loads(safe_dumps({"s": np.float32(2.5), "z": np.zeros(())}))
+    assert scalars["s"] == np.float32(2.5) and scalars["z"].shape == ()
+    f = np.asfortranarray(np.arange(12, dtype=np.int32).reshape(3, 4))
+    np.testing.assert_array_equal(safe_loads(safe_dumps({"f": f}))["f"], f)
+
+
+def test_truncated_array_blob_rejected():
+    buf = bytearray(safe_dumps({"w": np.arange(64, dtype=np.float64)}))
+    with pytest.raises(ValueError):
+        safe_loads(bytes(buf[:-8]))  # drop the array's tail bytes

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Staging micro-bench for the pipelined round engine.
+
+Runs a short mesh-simulator federation on synthetic data and prints ONE
+JSON line with the staging-path numbers the pipelined round engine is
+judged by:
+
+- ``staged_bytes`` / ``staged_bytes_per_sec`` — host staging throughput
+  (poison + batch + assemble + device_put), cumulative over the run;
+- ``assembly_ms`` — one vectorized ``assemble_slots`` gather of a full
+  round (the np.stack path that replaced the per-slot copy loop);
+- ``prefetch_overlap_ratio`` — from the telemetry report: how much of
+  each round's staging ran while the previous round's program was in
+  flight (chained-timing caveat: host spans cannot see the device queue
+  drain — see docs/performance.md).
+
+Usage: ``python tools/stage_bench.py [--rounds N] [--clients N]
+[--no-prefetch]`` (also reachable as ``python bench.py --stage``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def run_stage_bench(rounds: int = 6, clients: int = 16,
+                    prefetch: bool = True) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.data.dataset import assemble_slots
+    from fedml_tpu.simulation.parallel.mesh_simulator import MeshFedAvgAPI
+    from fedml_tpu.telemetry.report import build_report
+
+    run_dir = tempfile.mkdtemp(prefix="stage_bench_")
+    cfg = {
+        "common_args": {
+            "training_type": "simulation",
+            "random_seed": 0,
+            "run_id": "stage_bench",
+            "log_file_dir": run_dir,
+        },
+        "data_args": {
+            "dataset": "synthetic",
+            "partition_method": "hetero",
+            "partition_alpha": 0.5,
+            "train_size": 256 * clients,
+            "test_size": 256,
+            "class_num": 5,
+            "feature_dim": 32,
+        },
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": clients,
+            "client_num_per_round": clients,
+            "comm_round": rounds,
+            "epochs": 1,
+            "batch_size": 32,
+            "learning_rate": 0.1,
+            # eval only at the end: per-round eval would re-insert the
+            # host sync the pipeline exists to remove
+            "frequency_of_the_test": rounds,
+            "enable_prefetch": prefetch,
+        },
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    api = MeshFedAvgAPI(args, None, ds, model)
+
+    t0 = time.perf_counter()
+    result = api.train()
+    wall = time.perf_counter() - t0
+    # snapshot BEFORE the assembly micro-bench below: its round-0
+    # re-staging (the engine trimmed those entries rounds ago) would
+    # otherwise add untimed bytes to the counters
+    stats = api._data_cache.stats()
+
+    # assembly micro-bench: re-gather round 0 through the
+    # one-np.stack-per-tensor path (re-staged — not cache hits)
+    from fedml_tpu.core.schedule.seq_train_scheduler import (
+        schedule_clients_to_devices,
+    )
+
+    client_ids = list(range(clients))
+    arrays_by_cid = {
+        cid: api._client_arrays(cid, 0) for cid in client_ids
+    }
+    id_matrix = schedule_clients_to_devices(
+        client_ids, ds.train_data_local_num_dict, api.n_devices)
+    t1 = time.perf_counter()
+    xs, ys, ms = assemble_slots(id_matrix, arrays_by_cid)
+    assembly_ms = (time.perf_counter() - t1) * 1e3
+    sink = os.path.join(run_dir, "run_stage_bench")
+    report = build_report(sink)
+    overlap = report.get("stage_overlap") or {}
+    # staging work time, counted ONCE per round: the worker's prefetch
+    # span when the round was prefetched (the main thread's stage span is
+    # then just the get() wait, contained within it), the inline stage
+    # span otherwise
+    import re as _re
+
+    from fedml_tpu.telemetry.report import load_spans
+
+    per_round = {}
+    for s in load_spans(sink):
+        m = _re.match(r"^round/(\d+)/(prefetch|stage)$", s["name"])
+        if not m:
+            continue
+        n, kind = int(m.group(1)), m.group(2)
+        slot = per_round.setdefault(n, {})
+        slot[kind] = slot.get(kind, 0.0) + s["duration_ms"]
+    stage_ms = sum(
+        slot.get("prefetch", slot.get("stage", 0.0))
+        for slot in per_round.values()
+    )
+    return {
+        "metric": "stage_bench",
+        "rounds": rounds,
+        "clients": clients,
+        "n_devices": int(api.n_devices),
+        "prefetch": bool(prefetch),
+        "prefetched_rounds": int(result.get("prefetched_rounds", 0)),
+        "wall_sec": round(wall, 4),
+        "staged_bytes": int(stats["bytes_staged"]),
+        "staged_bytes_per_sec": (
+            round(stats["bytes_staged"] / (stage_ms / 1e3), 1)
+            if stage_ms else None
+        ),
+        "assembly_ms": round(assembly_ms, 3),
+        "assembled_bytes": int(xs.nbytes + ys.nbytes + ms.nbytes),
+        "prefetch_overlap_ratio": round(float(overlap.get("ratio", 0.0)), 4),
+        "cache": stats,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--no-prefetch", action="store_true")
+    ns = ap.parse_args()
+    print(json.dumps(run_stage_bench(
+        rounds=ns.rounds, clients=ns.clients, prefetch=not ns.no_prefetch)))
+
+
+if __name__ == "__main__":
+    main()
