@@ -247,6 +247,16 @@ def bench_reference_torch(cfg):
 
 
 def main() -> None:
+    if "--wire" in sys.argv:
+        # compressed-transport micro-bench: one JSON line per codec
+        # (bytes before/after, encode/decode ms) on a resnet-sized
+        # pytree — same ONE-line-per-record contract as --stage
+        from tools.wire_bench import run_wire_bench
+
+        for row in run_wire_bench():
+            print(json.dumps(row))
+        return
+
     if "--stage" in sys.argv:
         # staging-path micro-bench (pipelined round engine): staged
         # bytes/s, vectorized assembly ms, prefetch overlap ratio —

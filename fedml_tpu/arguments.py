@@ -187,6 +187,11 @@ _DEFAULTS = dict(
     enable_wandb=False,
     dtype="float32",
     scenario=constants.CROSS_SILO_SCENARIO_HORIZONTAL,
+    # compressed update transport (fedml_tpu/compression): '' disables;
+    # identity | bf16 | int8 | topk select the wire codec for model
+    # payloads (upload deltas + broadcast); topk keeps this fraction
+    compression="",
+    compression_topk_ratio=0.05,
 )
 
 

@@ -1,0 +1,76 @@
+"""Compressed update transport — on-device codecs for federation payloads.
+
+Quick tour::
+
+    from fedml_tpu import compression
+
+    codec = compression.get_codec("int8")          # None for ''/'none'
+    ct = codec.encode(delta_tree, key=compression.derive_key(0, r, cid),
+                      is_delta=True)               # ONE jitted program
+    tree = codec.decode(ct)                        # full pytree back
+
+    ef = compression.ErrorFeedback(codec)          # per-client residual
+    ct = ef.encode(delta_tree, key=...)
+
+The wire format is a versioned, codec-tagged extension of
+``utils/serialization.safe_dumps`` — a :class:`CompressedTree` survives
+every transport backend and unknown codec tags raise ``ValueError``.
+See ``docs/compression.md`` for the codec matrix and semantics.
+"""
+from fedml_tpu.compression.codecs import (
+    WIRE_VERSION,
+    Codec,
+    CompressedTree,
+    available_codecs,
+    derive_key,
+    derive_key_data,
+    derive_key_data_batch,
+    fused_weighted_sum,
+    get_codec,
+    register_codec,
+    tree_delta,
+    tree_undelta,
+)
+from fedml_tpu.compression.error_feedback import ErrorFeedback
+
+
+def requires_full_trees() -> bool:
+    """True when the server-side trust stack needs full per-client models.
+
+    The dequant-fused aggregation path never materializes per-client f32
+    trees — but model-poisoning attack injection, list-based defenses,
+    central-DP clipping and FHE all operate on full client models, so
+    when any of them is live the server decodes each update instead.
+    """
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy,
+    )
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+    from fedml_tpu.core.security.attacker import FedMLAttacker
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    dp = FedMLDifferentialPrivacy.get_instance()
+    return (
+        FedMLFHE.get_instance().is_fhe_enabled()
+        or FedMLAttacker.get_instance().is_model_attack()
+        or FedMLDefender.get_instance().is_defense_enabled()
+        or (dp.is_dp_enabled() and dp.is_global_dp_enabled())
+    )
+
+
+__all__ = [
+    "WIRE_VERSION",
+    "Codec",
+    "CompressedTree",
+    "ErrorFeedback",
+    "available_codecs",
+    "derive_key",
+    "derive_key_data",
+    "derive_key_data_batch",
+    "fused_weighted_sum",
+    "get_codec",
+    "register_codec",
+    "requires_full_trees",
+    "tree_delta",
+    "tree_undelta",
+]

@@ -120,16 +120,21 @@ class BrokerCommManager(BaseCommunicationManager):
                 continue
             try:
                 nbytes = tree_nbytes(payload)
-            except Exception:
+            except TypeError:
                 continue  # not a tree of arrays — ship inline
             if nbytes < self.offload_bytes:
                 continue
             store_key = self.store.new_key(
                 f"{self.run_id}/r{msg.get_sender_id()}")
+            blob = safe_dumps(payload)
             # The returned key is authoritative: content-addressed backends
             # (web3/theta CAS) return a CID, not the advisory key.
-            store_key = self.store.put_object(store_key, safe_dumps(payload))
+            store_key = self.store.put_object(store_key, blob)
             reg.counter("comm/offload_bytes").inc(nbytes)
+            # the bytes that actually landed in the store — without this
+            # the report's raw-vs-wire accounting never sums for offloaded
+            # payloads (offload_bytes counts the un-serialized tree)
+            reg.counter("comm/offload_wire_bytes").inc(len(blob))
             if self.store.content_addressed:
                 self._reclaim_cas(store_key, msg.get_receiver_id())
             del params[key]

@@ -65,6 +65,11 @@ class GRPCCommManager(BaseCommunicationManager):
         from fedml_tpu.utils.serialization import safe_loads
 
         def handler(request: bytes, context) -> bytes:
+            from fedml_tpu.telemetry import get_registry
+
+            get_registry().counter(
+                "comm/wire_bytes_in", labels={"backend": "grpc"}
+            ).inc(len(request))
             inbox.put(Message.construct_from_params(safe_loads(request)))
             return b"ok"
 

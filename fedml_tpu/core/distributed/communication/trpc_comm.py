@@ -86,18 +86,27 @@ class TRPCCommManager(BaseCommunicationManager):
 
     # -- receiver side -----------------------------------------------------
     def _enqueue(self, payload: bytes) -> None:
+        from fedml_tpu.telemetry import get_registry
         from fedml_tpu.utils.serialization import safe_loads
 
+        get_registry().counter(
+            "comm/wire_bytes_in", labels={"backend": "trpc"}
+        ).inc(len(payload))
         self._inbox.put(Message.construct_from_params(safe_loads(payload)))
 
     # -- BaseCommunicationManager ------------------------------------------
     def send_message(self, msg: Message) -> None:
+        from fedml_tpu.telemetry import get_registry
         from fedml_tpu.utils.serialization import safe_dumps
 
         receiver = int(msg.get_receiver_id())
+        payload = safe_dumps(msg.get_params())
+        get_registry().counter(
+            "comm/wire_bytes_out", labels={"backend": "trpc"}
+        ).inc(len(payload))
         ok = _rpc.rpc_sync(
             _worker_name(receiver), _deliver,
-            args=(receiver, safe_dumps(msg.get_params())))
+            args=(receiver, payload))
         if not ok:
             raise RuntimeError(
                 f"TRPC peer {receiver} has no live comm manager")

@@ -127,6 +127,21 @@ class FedMLCommManager(Observer):
         reg = telemetry.get_registry()
         reg.counter("comm/messages_sent",
                     labels={"backend": str(self.backend).lower()}).inc()
+        payload = message.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        if payload is not None:
+            # uncompressed payload size — the numerator of the
+            # compression ratio the telemetry report computes against
+            # the transport-recorded comm/wire_bytes_* counters
+            from fedml_tpu.compression import CompressedTree
+            from fedml_tpu.utils.serialization import tree_nbytes
+
+            try:
+                raw = (payload.raw_nbytes
+                       if isinstance(payload, CompressedTree)
+                       else tree_nbytes(payload))
+                reg.counter("comm/raw_bytes").inc(raw)
+            except TypeError:
+                pass  # not a tree of arrays
         self.com_manager.send_message(message)
 
     def register_message_receive_handler(self, msg_type: str, handler: Callable) -> None:

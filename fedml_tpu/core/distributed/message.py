@@ -26,6 +26,10 @@ class Message:
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
     MSG_ARG_KEY_MODEL_PARAMS_KEY = "model_params_key"
+    # negotiation header: the codec tag the receiver should use for its
+    # own model uploads (see fedml_tpu/compression); payloads are
+    # additionally self-describing via the wire format's __codec__ node
+    MSG_ARG_KEY_COMPRESSION = "compression"
 
     def __init__(self, type_: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type_)

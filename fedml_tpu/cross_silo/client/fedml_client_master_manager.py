@@ -34,6 +34,13 @@ class ClientMasterManager(FedMLCommManager):
         self.num_rounds = int(getattr(args, "comm_round", 1))
         self.round_idx = 0
         self.has_sent_online_msg = False
+        # compressed update transport: the server's negotiation header
+        # (MSG_ARG_KEY_COMPRESSION) selects the upload codec; updates are
+        # encoded as deltas vs the round's (decoded) global model with a
+        # persistent error-feedback residual. Never active under SecAgg.
+        self._upload_codec = None
+        self._error_feedback = None
+        self._global_ref = None
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -63,15 +70,42 @@ class ClientMasterManager(FedMLCommManager):
     def handle_message_check_status(self, msg: Message) -> None:
         self.send_client_status(msg.get_sender_id())
 
-    def handle_message_init(self, msg: Message) -> None:
+    def _receive_global_model(self, msg: Message):
+        """Decode a (possibly compressed) broadcast + apply negotiation."""
+        from fedml_tpu.compression import (
+            CompressedTree,
+            ErrorFeedback,
+            get_codec,
+        )
+
         global_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if isinstance(global_params, CompressedTree):
+            global_params = get_codec(global_params.codec).decode(
+                global_params)
+        negotiated = msg.get(Message.MSG_ARG_KEY_COMPRESSION)
+        if negotiated is not None and not bool(
+                getattr(self.args, "secure_aggregation", False)):
+            # the header is a SPEC ("topk@0.05"): server-advertised codec
+            # parameters win over local config, so every peer encodes
+            # blocks the fused aggregation can stack. Instances are
+            # cached per (name, params) → identity works as equality.
+            codec = get_codec(negotiated, self.args)
+            if codec is not None and codec is not self._upload_codec:
+                self._upload_codec = codec
+                self._error_feedback = ErrorFeedback(codec)
+        # deltas are computed against the model as THIS client decoded it
+        self._global_ref = global_params
+        return global_params
+
+    def handle_message_init(self, msg: Message) -> None:
+        global_params = self._receive_global_model(msg)
         data_silo_idx = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
         self.trainer_dist_adapter.update_dataset(int(data_silo_idx))
         self.__train(global_params)
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
-        global_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        global_params = self._receive_global_model(msg)
         data_silo_idx = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx + 1))
         self.trainer_dist_adapter.update_dataset(int(data_silo_idx))
@@ -89,11 +123,31 @@ class ClientMasterManager(FedMLCommManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())
         self.send_message(msg)
 
+    def _encode_update(self, weights):
+        """Delta-encode the trained model through the negotiated codec.
+
+        The delta is taken against the broadcast model as this client
+        decoded it; the error-feedback residual folds last round's
+        quantization error back in before encoding — both run inside one
+        jitted program on device, so the transport only ever pulls the
+        compressed blocks off the accelerator.
+        """
+        if self._upload_codec is None or self._global_ref is None:
+            return weights
+        from fedml_tpu.compression import derive_key
+        from fedml_tpu.compression.codecs import tree_delta
+
+        delta = tree_delta(weights, self._global_ref)
+        key = derive_key(int(getattr(self.args, "random_seed", 0)),
+                         self.round_idx, self.rank)
+        return self._error_feedback.encode(delta, key=key)
+
     def send_model_to_server(self, receive_id: int, weights, local_sample_num: int) -> None:
         msg = Message(
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.get_sender_id(), receive_id
         )
-        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                       self._encode_update(weights))
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
         # model version this update was computed from — the async server
         # uses it for staleness discounting; the sync server ignores it

@@ -98,6 +98,18 @@ class AsyncFedMLServerManager(FedMLCommManager):
             return
         sender = msg.get_sender_id()
         w_client = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        from fedml_tpu.compression import CompressedTree, get_codec
+
+        if isinstance(w_client, CompressedTree):
+            # the async server never advertises a codec (it retains no
+            # per-client base model to resolve deltas against), so a
+            # delta here means a misconfigured peer — fail loud rather
+            # than mixing against the wrong base
+            if w_client.is_delta:
+                raise ValueError(
+                    "async server cannot apply delta-encoded updates; "
+                    "disable compression= for async_aggregation runs")
+            w_client = get_codec(w_client.codec).decode(w_client)
         base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
         staleness = max(0, self.version - base_version)
         a = self.alpha * (1.0 + staleness) ** (-self.staleness_exp)

@@ -48,6 +48,11 @@ class FedMLAggregator:
 
         self._contrib = ContributionAssessorManager(args)
         self.global_params: Optional[Pytree] = None
+        # compressed uploads delta against the broadcast as the CLIENT
+        # decoded it; under a lossy broadcast codec the server manager
+        # records that decoded model here so deltas resolve against the
+        # same base (None → the exact global)
+        self._delta_base: Optional[Pytree] = None
         self.model_dict: Dict[int, Pytree] = {}
         self.sample_num_dict: Dict[int, int] = {}
         self.local_steps_dict: Dict[int, float] = {}
@@ -55,6 +60,9 @@ class FedMLAggregator:
 
     def set_global_model_params(self, params: Pytree) -> None:
         self.global_params = params
+
+    def set_delta_base(self, params: Optional[Pytree]) -> None:
+        self._delta_base = params
 
     def get_global_model_params(self) -> Pytree:
         return self.global_params
@@ -83,6 +91,47 @@ class FedMLAggregator:
             self.flag_client_model_uploaded_dict[i] = False
         return True
 
+    def _resolve_compressed(
+        self, raw_list: List[Tuple[int, Pytree]]
+    ) -> Tuple[List[Tuple[int, Pytree]], Optional[Pytree]]:
+        """Handle compressed client updates.
+
+        Fast path (no trust-stack hook needs full models): the stacked
+        compressed blocks reduce inside one dequant-fused jitted program
+        — the server never materializes N full f32 client trees. Returns
+        ``(raw_list, w_agg)`` with ``w_agg`` set.
+
+        Fallback (defense/attack-injection/central-DP/FHE/contribution
+        active): each delta is decoded back to a full client model so the
+        standard hook chain sees exactly what it would uncompressed.
+        """
+        from fedml_tpu.compression import (
+            CompressedTree,
+            get_codec,
+            requires_full_trees,
+        )
+        from fedml_tpu.compression.codecs import tree_undelta
+        from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+
+        if not any(isinstance(m, CompressedTree) for _, m in raw_list):
+            return raw_list, None
+        # deltas resolve against the broadcast as clients decoded it (the
+        # server manager records it under a lossy broadcast codec)
+        base = (self._delta_base if self._delta_base is not None
+                else self.global_params)
+        if all(isinstance(m, CompressedTree) and m.is_delta
+               for _, m in raw_list) and not (
+                   requires_full_trees() or self._contrib.is_enabled()):
+            return raw_list, FedMLAggOperator.agg_compressed(
+                self.args, raw_list, base)
+        decoded = []
+        for n, m in raw_list:
+            if isinstance(m, CompressedTree):
+                tree = get_codec(m.codec).decode(m)
+                m = tree_undelta(base, tree) if m.is_delta else tree
+            decoded.append((n, m))
+        return decoded, None
+
     def aggregate(self) -> Pytree:
         raw_list: List[Tuple[int, Pytree]] = [
             (self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)
@@ -90,9 +139,11 @@ class FedMLAggregator:
         client_idxs = sorted(self.model_dict)
         prev_global = self.global_params
         Context().add("global_model_for_defense", self.global_params)
-        w_list, _ = self.aggregator.on_before_aggregation(raw_list)
-        w_agg = self.aggregator.aggregate(w_list)
-        w_agg = self.aggregator.on_after_aggregation(w_agg)
+        raw_list, w_agg = self._resolve_compressed(raw_list)
+        if w_agg is None:
+            w_list, _ = self.aggregator.on_before_aggregation(raw_list)
+            w_agg = self.aggregator.aggregate(w_list)
+            w_agg = self.aggregator.on_after_aggregation(w_agg)
         tau_eff = None
         if (str(getattr(self.args, "federated_optimizer", "")) == "FedNova"
                 and self.local_steps_dict):

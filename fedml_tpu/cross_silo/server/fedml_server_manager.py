@@ -62,14 +62,52 @@ class FedMLServerManager(FedMLCommManager):
         self.is_initialized = False
         self.result: Optional[dict] = None
 
+        # compressed update transport: broadcast the global model through
+        # the configured codec and advertise it (negotiation header) so
+        # clients upload delta-encoded compressed updates. Disabled under
+        # SecAgg — quantizing masked models breaks exact mask cancellation
+        # (and the SecAgg FSM is a different manager class anyway).
+        from fedml_tpu.compression import get_codec
+
+        self._codec = None
+        if not bool(getattr(args, "secure_aggregation", False)):
+            self._codec = get_codec(getattr(args, "compression", ""), args)
+
     # -- lifecycle ---------------------------------------------------------
     def run(self) -> None:
         super().run()
+
+    def _broadcast_payload(self, global_params):
+        """The per-round broadcast payload: encoded ONCE, fanned out N×."""
+        if self._codec is None or not self._codec.broadcast_safe:
+            # upload-only codecs (topk) still ride the negotiation
+            # header; the broadcast itself ships plain
+            self.aggregator.set_delta_base(None)
+            return global_params
+        from fedml_tpu.compression import derive_key
+
+        # the server broadcasts under rank 0's key slot; clients encode
+        # uploads under their own rank, so streams never collide
+        ct = self._codec.encode(
+            global_params,
+            key=derive_key(int(getattr(self.args, "random_seed", 0)),
+                           int(self.args.round_idx), 0),
+        )
+        if not self._codec.lossless:
+            # clients delta against the broadcast AS THEY DECODE IT; the
+            # server must resolve those deltas against the same base or
+            # the broadcast quantization error (g − dec(g)) leaks into
+            # the aggregate every round
+            self.aggregator.set_delta_base(self._codec.decode(ct))
+        else:
+            self.aggregator.set_delta_base(None)
+        return ct
 
     def send_init_msg(self) -> None:
         from fedml_tpu import telemetry
 
         global_params = self.aggregator.get_global_model_params()
+        payload = self._broadcast_payload(global_params)
         # the open span's context rides each init message, so every
         # client's training span joins this round's server-side trace
         with telemetry.get_tracer().span(
@@ -81,9 +119,12 @@ class FedMLServerManager(FedMLCommManager):
                 msg = Message(
                     MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), client_id
                 )
-                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
                 msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
                 msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+                if self._codec is not None:
+                    msg.add_params(Message.MSG_ARG_KEY_COMPRESSION,
+                                   self._codec.spec)
                 self.send_message(msg)
         mlops.log({"event": "server.init_sent", "round": 0})
 
@@ -189,6 +230,7 @@ class FedMLServerManager(FedMLCommManager):
             return
 
         self._select_round_clients()
+        payload = self._broadcast_payload(global_params)
         with tracer.span(f"round/{self.args.round_idx}/sync",
                          n_clients=len(self.client_id_list_in_this_round)):
             for client_id in self.client_id_list_in_this_round:
@@ -196,9 +238,12 @@ class FedMLServerManager(FedMLCommManager):
                 m = Message(
                     MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.get_sender_id(), client_id
                 )
-                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
                 m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
                 m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+                if self._codec is not None:
+                    m.add_params(Message.MSG_ARG_KEY_COMPRESSION,
+                                 self._codec.spec)
                 self.send_message(m)
 
     def _send_finish(self) -> None:

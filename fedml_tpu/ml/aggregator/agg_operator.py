@@ -53,3 +53,40 @@ class FedMLAggOperator:
         w = jnp.asarray(weights, dtype=jnp.float32)
         w = w / jnp.sum(w)
         return weighted_tree_sum(tree_stack(raw_list), w)
+
+    @staticmethod
+    def _weights(args: Any, raw_list: List[Tuple[int, Any]]) -> jnp.ndarray:
+        """The same weighting rule :meth:`agg` applies, as a vector."""
+        opt = getattr(args, "federated_optimizer", "FedAvg")
+        n = len(raw_list)
+        if opt in _UNIFORM_OPTS:
+            return jnp.full((n,), 1.0 / n)
+        counts = jnp.asarray([float(num) for num, _ in raw_list])
+        return counts / jnp.sum(counts)
+
+    @staticmethod
+    def agg_compressed(
+        args: Any, raw_list: List[Tuple[int, Any]], global_params: Pytree
+    ) -> Pytree:
+        """Dequant-fused aggregation of compressed client updates.
+
+        ``raw_list`` is ``[(n_samples, CompressedTree), ...]`` where each
+        tree encodes the client's **delta** against ``global_params``
+        (float leaves; int/bool leaves ride absolute — see ``tree_delta``).
+        Since the weights are normalized, x̄ = Σpᵢxᵢ = g + Σpᵢdᵢ — so the
+        stacked int8 blocks + scales reduce inside one jitted weighted
+        sum and only the final aggregated f32 tree is materialized.
+        """
+        from fedml_tpu.compression import CompressedTree, fused_weighted_sum
+        from fedml_tpu.compression.codecs import tree_undelta
+
+        if len(raw_list) == 0:
+            raise ValueError("empty client model list")
+        cts = [ct for _, ct in raw_list]
+        if not all(isinstance(ct, CompressedTree) for ct in cts):
+            raise ValueError("agg_compressed requires CompressedTree updates")
+        if not all(ct.is_delta for ct in cts):
+            raise ValueError(
+                "agg_compressed requires delta-encoded updates")
+        weights = FedMLAggOperator._weights(args, raw_list)
+        return tree_undelta(global_params, fused_weighted_sum(cts, weights))

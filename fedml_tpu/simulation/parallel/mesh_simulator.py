@@ -122,8 +122,19 @@ class MeshFedAvgAPI:
             defender.is_defense_enabled() and defense_stacked is None
         )
         self._cdp_in_program = cdp and not self._host_agg
+        # compressed update transport simulation: per-client deltas run
+        # through the wire codec (quantize→dequantize) INSIDE the round
+        # program, keyed by staged per-(round, client) key data — a pure
+        # function of (seed, round, cid), so prefetched and inline staging
+        # stay bit-identical. Error feedback is a per-client *state* and
+        # lives on the sp/cross-silo client paths, not in this stateless
+        # in-program simulation.
+        from fedml_tpu.compression import get_codec
+
+        self._codec = get_codec(getattr(args, "compression", ""), args)
+        codec = self._codec
         self._key_width = 0
-        if self._ldp or self._cdp_in_program:
+        if self._ldp or self._cdp_in_program or codec is not None:
             import jax.random as jrandom
 
             self._key_width = np.asarray(
@@ -149,7 +160,7 @@ class MeshFedAvgAPI:
         template = self.global_params
 
         def per_device_round(global_params, local_state, xs, ys, mask, nk,
-                             ldp_kd, cdp_kd):
+                             ldp_kd, cdp_kd, q_kd):
             """One device's share: xs [slots, steps, B, ...], nk [slots].
 
             Runs every client slot via vmap, locally weight-sums the
@@ -162,6 +173,7 @@ class MeshFedAvgAPI:
             # it so vmap runs over the client *slots*.
             xs, ys, mask, nk = xs[0], ys[0], mask[0], nk[0]
             ldp_kd = ldp_kd[0]
+            q_kd = q_kd[0]
             # the replicated (unvarying) model enters a scan whose carry
             # becomes device-varying after the first SGD step — cast it to
             # varying over the mesh axis up front so scan's type check passes
@@ -175,6 +187,19 @@ class MeshFedAvgAPI:
 
             new_params, metrics = jax.vmap(one_client)(xs, ys, mask)
             new_params = per_client_postprocess(new_params, ldp_kd)
+            if codec is not None and not codec.lossless and not host_agg:
+                # simulated wire: each slot's delta goes through
+                # quantize→dequantize exactly as the transport would.
+                # Lossless codecs skip — their wire is exact, and the
+                # g + (p − g) float round-trip would perturb bits
+                def _wire_sim(p, kd):
+                    delta = jax.tree.map(jnp.subtract, p, global_params)
+                    dq = codec.qdq(delta, jax.random.wrap_key_data(kd))
+                    return jax.tree.map(
+                        lambda g, d: g + d.astype(g.dtype),
+                        global_params, dq)
+
+                new_params = jax.vmap(_wire_sim)(new_params, q_kd)
             w = nk.astype(jnp.float32)  # padded slots have nk=0 → no weight
             total = jax.lax.psum(jnp.sum(w), "clients")
             loss = jax.lax.psum(jnp.sum(w * metrics["train_loss"]), "clients") / total
@@ -224,7 +249,7 @@ class MeshFedAvgAPI:
             per_device_round,
             mesh=self.mesh,
             in_specs=(P(), P(), P("clients"), P("clients"), P("clients"),
-                      P("clients"), P("clients"), P()),
+                      P("clients"), P("clients"), P(), P("clients")),
             out_specs=(out_model_spec, P(), P()),
         )
         self._round_fn = jax.jit(shard)
@@ -379,6 +404,20 @@ class MeshFedAvgAPI:
         cdp_kd = np.zeros((kd_width,), dtype=np.uint32)
         if self._cdp_in_program:
             cdp_kd = self._dp.take_key_data(1)[0]
+        # wire-codec keys: a pure function of (seed, round, cid) — no
+        # counter is consumed, so prefetch order cannot perturb them.
+        # One vectorized derivation for the whole slot matrix (lossless
+        # codecs skip the wire-sim entirely, so no keys are needed)
+        q_kd = np.zeros((n_dev, slots, kd_width), dtype=np.uint32)
+        if self._codec is not None and not self._codec.lossless:
+            from fedml_tpu.compression import derive_key_data_batch
+
+            run_seed = int(getattr(self.args, "random_seed", 0))
+            flat = id_matrix.reshape(-1)
+            kd = derive_key_data_batch(
+                run_seed, round_idx, np.maximum(flat, 0))
+            q_kd = np.where((flat >= 0)[:, None], kd, 0).astype(
+                np.uint32).reshape(n_dev, slots, kd_width)
         # counter AFTER this round's draws: the checkpoint of this round
         # must save THIS value, not the live counter, which the prefetch
         # worker may already have advanced for the next round
@@ -392,6 +431,7 @@ class MeshFedAvgAPI:
             jax.device_put(nk, spec),
             jax.device_put(ldp_kd, spec),
             jax.device_put(cdp_kd, rep),
+            jax.device_put(q_kd, spec),
         )
         return {
             "client_ids": client_ids,
@@ -483,6 +523,18 @@ class MeshFedAvgAPI:
                     by_cid[int(cid)] = jax.tree.map(
                         lambda x: x[slot], slot_models
                     )
+            if self._codec is not None and not self._codec.lossless:
+                # host-aggregation fallback still simulates the wire —
+                # same per-(round, cid) keys as the in-program path
+                from fedml_tpu.compression import derive_key
+                from fedml_tpu.utils.tree import tree_add, tree_sub
+
+                run_seed = int(getattr(self.args, "random_seed", 0))
+                for cid, m in by_cid.items():
+                    dq = self._codec.qdq(
+                        tree_sub(m, self.global_params),
+                        derive_key(run_seed, round_idx, cid))
+                    by_cid[cid] = tree_add(self.global_params, dq)
             for cid in client_ids:
                 w_locals.append(
                     (self.dataset.train_data_local_num_dict[int(cid)], by_cid[int(cid)])

@@ -72,6 +72,24 @@ class FedAvgAPI:
 
         self._contrib = ContributionAssessorManager(args)
 
+        # compressed update transport (args compression=) — same numerics
+        # as the cross-silo wire: per-client delta encode with persistent
+        # error feedback, dequant-fused aggregation when no server hook
+        # needs full client trees. FHE ciphertexts cannot be quantized.
+        from fedml_tpu.compression import get_codec
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+
+        self._codec = None
+        self._ef_by_client: dict = {}
+        codec = get_codec(getattr(args, "compression", ""), args)
+        if codec is not None:
+            if FedMLFHE.get_instance().is_fhe_enabled():
+                logger.warning(
+                    "compression disabled: FHE ciphertext updates cannot "
+                    "be quantized")
+            else:
+                self._codec = codec
+
         # round checkpoint/resume (SURVEY §5 improvement over the reference)
         from fedml_tpu.core.checkpoint import engine_checkpointer
 
@@ -134,6 +152,42 @@ class FedAvgAPI:
     def _client_sampling(self, round_idx: int) -> List[int]:
         return sample_clients(self.args, round_idx)
 
+    # -- compressed uplink simulation -------------------------------------
+    def _compress_uplinks(self, round_idx: int, client_ids: List[int],
+                          w_locals: List[Tuple[int, Pytree]]):
+        """Run each client's update through the wire codec.
+
+        Returns ``(w_locals, w_agg)``: on the fast path ``w_agg`` is the
+        dequant-fused aggregate (stacked compressed blocks reduced in one
+        jitted program); when a trust-stack hook or contribution
+        assessment needs full client models, each delta is decoded back
+        instead and ``w_agg`` is None so the standard chain runs.
+        """
+        from fedml_tpu.compression import (
+            ErrorFeedback,
+            derive_key,
+            requires_full_trees,
+        )
+        from fedml_tpu.compression.codecs import tree_delta, tree_undelta
+        from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+
+        seed = int(getattr(self.args, "random_seed", 0))
+        enc: List[Tuple[int, Any]] = []
+        for cid, (n_k, w) in zip(client_ids, w_locals):
+            ef = self._ef_by_client.setdefault(
+                cid, ErrorFeedback(self._codec))
+            ct = ef.encode(tree_delta(w, self.global_params),
+                           key=derive_key(seed, round_idx, cid))
+            enc.append((n_k, ct))
+        if not (requires_full_trees() or self._contrib.is_enabled()):
+            return w_locals, FedMLAggOperator.agg_compressed(
+                self.args, enc, self.global_params)
+        decoded = [
+            (n, tree_undelta(self.global_params, self._codec.decode(ct)))
+            for n, ct in enc
+        ]
+        return decoded, None
+
     # -- round ------------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:
         with self.tracer.span(f"round/{round_idx}/sample"):
@@ -190,9 +244,14 @@ class FedAvgAPI:
         self.event.log_event_started("aggregate", round_idx)
         agg_span = self.tracer.begin(f"round/{round_idx}/aggregate")
         ctx.add("global_model_for_defense", self.global_params)
-        w_list, _ = self.aggregator.on_before_aggregation(w_locals)
-        w_agg = self.aggregator.aggregate(w_list)
-        w_agg = self.aggregator.on_after_aggregation(w_agg)
+        w_agg = None
+        if self._codec is not None:
+            w_locals, w_agg = self._compress_uplinks(
+                round_idx, client_ids, w_locals)
+        if w_agg is None:
+            w_list, _ = self.aggregator.on_before_aggregation(w_locals)
+            w_agg = self.aggregator.aggregate(w_list)
+            w_agg = self.aggregator.on_after_aggregation(w_agg)
         from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
 
         fhe = FedMLFHE.get_instance()

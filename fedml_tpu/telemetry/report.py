@@ -214,6 +214,28 @@ def build_report(run_dir: str) -> Dict:
                            for k, v in sorted((rec.get("labels") or {}).items()))
             comm[name + ("{" + lbl + "}" if lbl else "")] = rec["value"]
 
+    # -- compression ratio (raw payload bytes vs what hit the wire) -------
+    def _sum_counter(prefix: str) -> float:
+        return sum(v for name, v in comm.items()
+                   if name.split("{")[0] == prefix)
+
+    raw_bytes = _sum_counter("comm/raw_bytes")
+    wire_bytes = (_sum_counter("comm/wire_bytes_out")
+                  + _sum_counter("comm/offload_wire_bytes"))
+    codec_phases = {
+        p["phase"]: p for p in phase_rows
+        if p["phase"].startswith("compress/")
+    }
+    compression = {
+        "raw_bytes": raw_bytes,
+        "wire_bytes": wire_bytes,
+        # wire counters include control-frame overhead, so the ratio is a
+        # lower bound on the payload compression factor
+        "ratio": (raw_bytes / wire_bytes) if wire_bytes else 0.0,
+        "encode": codec_phases.get("compress/encode"),
+        "decode": codec_phases.get("compress/decode"),
+    }
+
     # -- stitched (cross-process) spans ----------------------------------
     stitched = [s for s in spans if s.get("remote_parent")]
 
@@ -227,6 +249,7 @@ def build_report(run_dir: str) -> Dict:
         "compile_ms": compile_ms,
         "execute_ms": max(round_total - compile_ms, 0.0),
         "comm_bytes": comm,
+        "compression": compression,
         "stitched_spans": stitched,
     }
 
@@ -275,6 +298,24 @@ def format_report(report: Dict) -> str:
         add("comm bytes breakdown:")
         for name, v in sorted(report["comm_bytes"].items()):
             add(f"  {name:<44s}{v:>14.0f}")
+    comp = report.get("compression") or {}
+    if comp.get("raw_bytes") or comp.get("encode") or comp.get("decode"):
+        add("")
+        add("compression (payload raw bytes vs wire bytes, control-frame "
+            "overhead included):")
+        if comp.get("raw_bytes"):
+            add(f"  raw {comp['raw_bytes']:.0f} B → wire "
+                f"{comp['wire_bytes']:.0f} B "
+                f"(ratio {comp['ratio']:.2f}x)")
+        else:
+            add("  in-process run: codec spans only (no transport bytes "
+                "recorded)")
+        for phase_key in ("encode", "decode"):
+            p = comp.get(phase_key)
+            if p:
+                add(f"  {p['phase']:<24s} count {p['count']:>5d}  "
+                    f"p50 {p['p50_ms']:.1f} ms  p95 {p['p95_ms']:.1f} ms  "
+                    f"total {p['total_ms']:.1f} ms")
     if report["stitched_spans"]:
         add("")
         add(f"cross-process stitched spans: {len(report['stitched_spans'])}")

@@ -11,6 +11,12 @@ worst produce wrong numbers, never code execution.
 
 Format:  [4-byte header length][header JSON][npy blob]*
          header = {"skeleton": ..., "arrays": [nbytes, ...]}
+
+Compressed payloads (``fedml_tpu/compression``) ride the same format as a
+versioned, codec-tagged skeleton node: ``{"__codec__": name, "v": 1, ...}``
+wrapping the codec's array blobs. Decode validates the tag against the
+codec registry and rejects unknown tags/versions with ``ValueError`` —
+the same rejection contract as every other hostile-payload path here.
 """
 from __future__ import annotations
 
@@ -27,21 +33,85 @@ Pytree = Any
 _ARRAY = "__ndarray__"
 _TUPLE = "__tuple__"
 _BYTES = "__bytes__"
-_RESERVED = (_ARRAY, _TUPLE, _BYTES)
+_CODEC = "__codec__"
+_RESERVED = (_ARRAY, _TUPLE, _BYTES, _CODEC)
+
+# extension dtypes with no npy descr ride the wire as a same-itemsize
+# integer view plus a "dt" tag on the array node
+_EXT_DTYPES = {"bfloat16": np.uint16}
 
 
-def _encode(obj: Any, blobs: List[bytes]) -> Any:
-    """Recursively JSON-ify; arrays become placeholders into ``blobs``."""
+def _npy_parts(arr: np.ndarray):
+    """(header_bytes, data_view) for one array — no BytesIO/np.save pass.
+
+    The ~100-byte npy header is built via ``np.lib.format``; the array
+    payload is *aliased* (a memoryview of the array's own buffer) rather
+    than copied, so the only copy is the final ``b"".join`` in
+    :func:`safe_dumps` — the encode-side counterpart of the zero-copy
+    ``frombuffer`` decode below.
+    """
+    d = np.lib.format.header_data_from_array_1_0(arr)
+    buf = io.BytesIO()
+    np.lib.format.write_array_header_1_0(buf, d)
+    header = buf.getvalue()
+    if arr.ndim == 0:
+        return header, arr.tobytes()
+    if arr.flags.c_contiguous:
+        return header, _alias_bytes(arr)
+    if d["fortran_order"] and arr.T.flags.c_contiguous:
+        # header says F order; the transposed view aliases those bytes
+        return header, _alias_bytes(arr.T)
+    return header, arr.tobytes()  # non-contiguous: one unavoidable copy
+
+
+def _alias_bytes(arr: np.ndarray):
+    """Byte view of a C-contiguous array without copying.
+
+    Extension dtypes (ml_dtypes bfloat16 etc.) refuse the buffer
+    protocol on the typed array — reinterpreting as uint8 first aliases
+    the same memory and always exports.
+    """
+    try:
+        return memoryview(arr).cast("B")
+    except (ValueError, TypeError):
+        return memoryview(arr.view(np.uint8)).cast("B")
+
+
+def _encode(obj: Any, blobs: List[Any]) -> Any:
+    """Recursively JSON-ify; arrays become placeholders into ``blobs``.
+
+    ``blobs`` entries are bytes-likes or tuples of bytes-likes (an array's
+    header + aliased data) — sized and joined by :func:`safe_dumps`.
+    """
+    from fedml_tpu.compression.codecs import CompressedTree
+
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
     if isinstance(obj, (bytes, bytearray)):
         blobs.append(b"RAW0" + bytes(obj))
         return {_BYTES: len(blobs) - 1}
+    if isinstance(obj, CompressedTree):
+        return {
+            _CODEC: obj.codec,
+            "v": obj.version,
+            "delta": obj.is_delta,
+            "raw_nbytes": obj.raw_nbytes,
+            "meta": [[dt, list(sh)] for dt, sh in obj.meta],
+            "structure": _encode(obj.structure, blobs),
+            "state": _encode(obj.arrays, blobs),
+        }
     if isinstance(obj, (np.ndarray, jax.Array, np.generic)):
-        arr = np.asarray(jax.device_get(obj))
-        buf = io.BytesIO()
-        np.save(buf, arr, allow_pickle=False)
-        blobs.append(buf.getvalue())
+        # already-host arrays skip the device_get + asarray double hop
+        arr = obj if isinstance(obj, np.ndarray) else np.asarray(
+            jax.device_get(obj))
+        dt = str(arr.dtype)
+        if dt in _EXT_DTYPES:
+            # extension dtypes (bf16) have no npy descr: ship the bytes
+            # as a same-itemsize integer view, tag the true dtype in the
+            # skeleton so decode restores it losslessly
+            blobs.append(_npy_parts(arr.view(_EXT_DTYPES[dt])))
+            return {_ARRAY: len(blobs) - 1, "dt": dt}
+        blobs.append(_npy_parts(arr))
         return {_ARRAY: len(blobs) - 1}
     if isinstance(obj, dict):
         if any(not isinstance(k, str) or k in _RESERVED for k in obj):
@@ -66,7 +136,10 @@ def _encode(obj: Any, blobs: List[bytes]) -> Any:
 
 
 def _blob_at(blobs: List[Any], idx: Any) -> Any:
-    i = int(idx)
+    try:
+        i = int(idx)
+    except (TypeError, ValueError):
+        raise ValueError(f"non-integer blob index {idx!r}") from None
     if not 0 <= i < len(blobs):
         raise ValueError(f"payload references blob {i} of {len(blobs)}")
     return blobs[i]
@@ -116,23 +189,74 @@ def _ndarray_from_npy(mv: memoryview) -> np.ndarray:
     return arr.reshape(shape, order="F" if fortran_order else "C")
 
 
+def _decode_codec(node: dict, blobs: List[memoryview]) -> Any:
+    """Rebuild a CompressedTree from its tagged skeleton node.
+
+    Unknown codec tags and unsupported wire versions are rejected with
+    ``ValueError`` — a hostile peer must not be able to smuggle bytes
+    past the registry by inventing a tag.
+    """
+    from fedml_tpu.compression.codecs import WIRE_VERSION, CompressedTree
+    from fedml_tpu.compression.codecs import available_codecs
+
+    codec = node.get(_CODEC)
+    if not isinstance(codec, str) or codec not in available_codecs():
+        raise ValueError(f"unknown compression codec tag {codec!r}")
+    version = node.get("v")
+    if version != WIRE_VERSION:
+        raise ValueError(f"unsupported compression wire version {version!r}")
+    meta = node.get("meta")
+    arrays = _decode(node.get("state"), blobs)
+    structure = _decode(node.get("structure"), blobs)
+    if not isinstance(meta, list) or not isinstance(arrays, list):
+        raise ValueError("malformed compressed payload")
+    try:
+        meta_t = tuple((str(dt), tuple(int(d) for d in sh))
+                       for dt, sh in meta)
+        return CompressedTree(
+            codec, int(version), bool(node.get("delta", False)),
+            int(node.get("raw_nbytes", 0)), meta_t, structure, arrays,
+        )
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"malformed compressed payload: {e}") from None
+
+
 def _decode(node: Any, blobs: List[memoryview]) -> Any:
     if isinstance(node, dict):
-        if _ARRAY in node and len(node) == 1:
+        if _CODEC in node:
+            return _decode_codec(node, blobs)
+        if _ARRAY in node and (
+                len(node) == 1 or (len(node) == 2 and "dt" in node)):
             raw = _blob_at(blobs, node[_ARRAY])
             if raw[:4].tobytes() == b"RAW0":
                 raise ValueError("array tag references a bytes blob")
-            return _ndarray_from_npy(raw)
+            arr = _ndarray_from_npy(raw)
+            dt = node.get("dt")
+            if dt is not None:
+                if dt not in _EXT_DTYPES:
+                    raise ValueError(f"unknown extension dtype tag {dt!r}")
+                if arr.dtype != _EXT_DTYPES[dt]:
+                    raise ValueError(
+                        f"extension dtype tag {dt!r} on a "
+                        f"{arr.dtype} blob")
+                arr = arr.view(np.dtype(jax.numpy.bfloat16))
+            return arr
         if _BYTES in node and len(node) == 1:
             raw = _blob_at(blobs, node[_BYTES])
             if raw[:4].tobytes() != b"RAW0":
                 raise ValueError("bytes tag references a non-bytes blob")
             return raw[4:].tobytes()
         if node.get(_TUPLE) == "tuple":
+            if not isinstance(node.get("items"), list):
+                raise ValueError("malformed tuple node")
             return tuple(_decode(v, blobs) for v in node["items"])
         if node.get(_TUPLE) == "dict_items":
+            items = node.get("items")
+            if not isinstance(items, list) or not all(
+                    isinstance(kv, list) and len(kv) == 2 for kv in items):
+                raise ValueError("malformed dict_items node")
             return {
-                _decode(k, blobs): _decode(v, blobs) for k, v in node["items"]
+                _decode(k, blobs): _decode(v, blobs) for k, v in items
             }
         return {k: _decode(v, blobs) for k, v in node.items()}
     if isinstance(node, list):
@@ -141,29 +265,59 @@ def _decode(node: Any, blobs: List[memoryview]) -> Any:
 
 
 def safe_dumps(obj: Any) -> bytes:
-    blobs: List[bytes] = []
+    blobs: List[Any] = []
     skeleton = _encode(obj, blobs)
+    sizes = [sum(len(p) for p in b) if isinstance(b, tuple) else len(b)
+             for b in blobs]
     header = json.dumps(
-        {"skeleton": skeleton, "arrays": [len(b) for b in blobs]}
+        {"skeleton": skeleton, "arrays": sizes}
     ).encode()
-    return b"".join([struct.pack("<I", len(header)), header, *blobs])
+    parts: List[Any] = [struct.pack("<I", len(header)), header]
+    for b in blobs:
+        if isinstance(b, tuple):
+            parts.extend(b)
+        else:
+            parts.append(b)
+    return b"".join(parts)
 
 
 def safe_loads(data: bytes) -> Any:
+    # hostile/truncated payloads must fail as ValueError — the single
+    # rejection contract callers (and the wire-format fuzz smoke) rely on
+    if len(data) < 4:
+        raise ValueError("payload too short for a header")
     (hlen,) = struct.unpack_from("<I", data, 0)
-    header = json.loads(data[4 : 4 + hlen].decode())
+    if 4 + hlen > len(data):
+        raise ValueError("header length overruns the payload")
+    try:
+        header = json.loads(data[4 : 4 + hlen].decode())
+    except UnicodeDecodeError as e:
+        raise ValueError(f"payload header is not UTF-8: {e}") from None
+    if not isinstance(header, dict) or not isinstance(
+            header.get("arrays"), list):
+        raise ValueError("malformed payload header")
     offset = 4 + hlen
     # memoryview slices alias the payload — no per-blob copy; array
     # leaves are then aliased out of these views by _ndarray_from_npy
     mv = memoryview(data)
     blobs: List[memoryview] = []
     for nbytes in header["arrays"]:
-        nbytes = int(nbytes)
-        if nbytes < 0 or offset + nbytes > len(data):
+        nbytes = _blob_size(nbytes)
+        if offset + nbytes > len(data):
             raise ValueError("blob table overruns the payload")
         blobs.append(mv[offset : offset + nbytes])
         offset += nbytes
     return _decode(header["skeleton"], blobs)
+
+
+def _blob_size(nbytes: Any) -> int:
+    try:
+        n = int(nbytes)
+    except (TypeError, ValueError):
+        raise ValueError(f"non-integer blob size {nbytes!r}") from None
+    if n < 0:
+        raise ValueError(f"negative blob size {n}")
+    return n
 
 
 # -- pytree-payload convenience (kept API-compatible) -----------------------

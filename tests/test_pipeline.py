@@ -188,6 +188,24 @@ def test_prefetch_on_off_bit_identical_with_poison_and_ldp():
     np.testing.assert_array_equal(on, off)
 
 
+def test_prefetch_on_off_bit_identical_with_compression():
+    """Acceptance: prefetch-on/off bit-identity holds with the compressed
+    update transport enabled — the in-program wire simulation draws its
+    stochastic-rounding keys from a pure function of (seed, round, cid),
+    never a shared counter, so staging order cannot perturb it. Runs on
+    top of poisoning + LDP so every stateful draw is still live."""
+    over = {**TRUST_OVER, "compression": "int8"}
+    api_on, on = _mesh_params({**over, "enable_prefetch": True})
+    assert api_on._pipeline.prefetched_rounds == 2
+    _, off = _mesh_params({**over, "enable_prefetch": False})
+    np.testing.assert_array_equal(on, off)
+    # the identity codec's wire is exact: enabling it must not move a bit
+    _, ident = _mesh_params({**TRUST_OVER, "compression": "identity",
+                             "enable_prefetch": True})
+    _, plain = _mesh_params({**TRUST_OVER, "enable_prefetch": True})
+    np.testing.assert_array_equal(ident, plain)
+
+
 def test_pipelined_mesh_matches_sp_3_rounds_poison_ldp():
     """3 prefetched mesh rounds == 3 sequential sp rounds (poison + LDP).
 

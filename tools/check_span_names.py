@@ -33,6 +33,9 @@ _METRIC_CALL = re.compile(
 _SEGMENT = re.compile(r"^(?:[a-z0-9_]+|<[a-z_]+>)$")
 _ROUND_SHAPE = re.compile(
     r"^round/<v>(?:/client/<v>)?/[a-z0-9_]+$")
+# compression spans are exactly the two codec phases — anything else
+# under compress/ is taxonomy drift
+_COMPRESS_SHAPE = re.compile(r"^compress/(?:encode|decode)$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -86,6 +89,11 @@ def check(entries):
                 problems.append(
                     f"{where}: span {name!r} must follow "
                     "round/<n>[/client/<id>]/<phase>")
+        if kind == "span" and name.startswith("compress/"):
+            if not _COMPRESS_SHAPE.match(name):
+                problems.append(
+                    f"{where}: span {name!r} must be compress/encode "
+                    "or compress/decode")
         if kind != "span":
             prev = metric_kinds.get(name)
             if prev is not None and prev[0] != kind:

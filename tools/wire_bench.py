@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Wire-format micro-bench for the compressed update transport.
+
+Encodes a resnet-sized pytree of update deltas through every registered
+codec and prints ONE JSON line per codec:
+
+- ``bytes_before`` — the full-precision ``safe_dumps`` payload (what the
+  wire carried before compression existed);
+- ``bytes_after`` — the codec-tagged compressed payload;
+- ``ratio`` — bytes_before / bytes_after;
+- ``encode_ms`` / ``decode_ms`` — steady-state codec cost (first call
+  pays the jit compile and is reported separately as ``compile_ms``);
+- ``max_abs_err`` — worst-case element error of decode(encode(x)).
+
+Usage: ``python tools/wire_bench.py [--params N] [--codecs a,b,...]``
+(also reachable as ``python bench.py --wire``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def make_resnet_sized_tree(n_params_target: int = 11_000_000, seed: int = 0):
+    """A conv-stack-shaped pytree around resnet18 size (~11.2M params)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    tree = {}
+    shapes = [("stem/conv", (7, 7, 3, 64)), ("stem/bn", (64,))]
+    widths = [(64, 64), (64, 128), (128, 256), (256, 512)]
+    for stage, (cin, cout) in enumerate(widths):
+        for block in range(2):
+            c_in = cin if block == 0 else cout
+            shapes.append((f"s{stage}b{block}/conv1", (3, 3, c_in, cout)))
+            shapes.append((f"s{stage}b{block}/conv2", (3, 3, cout, cout)))
+            shapes.append((f"s{stage}b{block}/bn1", (cout,)))
+            shapes.append((f"s{stage}b{block}/bn2", (cout,)))
+    shapes.append(("fc/w", (512, 1000)))
+    shapes.append(("fc/b", (1000,)))
+    for name, shape in shapes:
+        # update-delta-scaled values: small, zero-centered
+        tree[name] = (rng.normal(size=shape) * 1e-2).astype(np.float32)
+    n = sum(v.size for v in tree.values())
+    while n < n_params_target:  # pad with extra fc-like blocks
+        name = f"extra/w{len(tree)}"
+        tree[name] = (rng.normal(size=(512, 1000)) * 1e-2).astype(np.float32)
+        n += tree[name].size
+    return tree
+
+
+def bench_codec(name: str, tree, baseline_bytes: int) -> dict:
+    import jax
+    import numpy as np
+
+    from fedml_tpu.compression import derive_key, get_codec
+    from fedml_tpu.utils.serialization import safe_dumps
+
+    codec = get_codec(name)
+    key = derive_key(0, 0, 1)
+
+    t0 = time.perf_counter()
+    ct = jax.block_until_ready(codec.encode(tree, key=key, is_delta=True))
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ct = jax.block_until_ready(codec.encode(tree, key=key, is_delta=True))
+    encode_s = time.perf_counter() - t0
+
+    wire = safe_dumps(ct)
+
+    codec.decode(ct)  # decode compile
+    t0 = time.perf_counter()
+    decoded = jax.block_until_ready(codec.decode(ct))
+    decode_s = time.perf_counter() - t0
+
+    max_err = max(
+        float(np.max(np.abs(np.asarray(a, np.float32)
+                            - np.asarray(b, np.float32))))
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(decoded))
+    )
+    return {
+        "metric": "wire_bytes_per_codec",
+        "codec": name,
+        "bytes_before": baseline_bytes,
+        "bytes_after": len(wire),
+        "ratio": round(baseline_bytes / len(wire), 3),
+        "encode_ms": round(encode_s * 1e3, 2),
+        "decode_ms": round(decode_s * 1e3, 2),
+        "compile_ms": round(compile_s * 1e3, 2),
+        "max_abs_err": max_err,
+        "n_params": int(sum(v.size for v in jax.tree.leaves(tree))),
+    }
+
+
+def run_wire_bench(n_params: int = 11_000_000,
+                   codecs=("identity", "bf16", "int8", "topk")) -> list:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from fedml_tpu.utils.serialization import safe_dumps
+
+    tree = make_resnet_sized_tree(n_params)
+    baseline = len(safe_dumps(tree))
+    return [bench_codec(c, tree, baseline) for c in codecs]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--params", type=int, default=11_000_000)
+    ap.add_argument("--codecs", type=str, default="identity,bf16,int8,topk")
+    args = ap.parse_args()
+    for row in run_wire_bench(args.params, args.codecs.split(",")):
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
