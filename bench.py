@@ -247,6 +247,17 @@ def bench_reference_torch(cfg):
 
 
 def main() -> None:
+    if "--compare" in sys.argv:
+        # regression gate: diff the newest two archived BENCH_*.json and
+        # fail on >10% drop of the headline metric (tools/bench_compare)
+        from tools.bench_compare import run_compare
+
+        row = run_compare(os.path.dirname(os.path.abspath(__file__)))
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--wire" in sys.argv:
         # compressed-transport micro-bench: one JSON line per codec
         # (bytes before/after, encode/decode ms) on a resnet-sized

@@ -509,8 +509,11 @@ def telemetry_report(run_dir: str, as_json: bool) -> None:
     from fedml_tpu.telemetry.report import build_report, format_report
 
     report = build_report(run_dir)
-    if not report["n_spans"]:
-        click.echo(f"no spans recorded under {run_dir}")
+    if not report["n_spans"] and not report["n_metrics"]:
+        # a PARTIAL run (metrics but no spans, or vice versa) still
+        # reports, with per-section "no data" notes; only a dir with no
+        # telemetry at all is an error
+        click.echo(f"no spans or metrics recorded under {run_dir}")
         raise SystemExit(1)
     if as_json:
         stitched = report["stitched_spans"]
@@ -518,6 +521,41 @@ def telemetry_report(run_dir: str, as_json: bool) -> None:
         click.echo(json.dumps(report, indent=1))
     else:
         click.echo(format_report(report))
+
+
+@telemetry.command("doctor")
+@click.argument("run_dir")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the raw triage dict as JSON")
+@click.option("--straggler-threshold", default=2.0, show_default=True,
+              help="flag clients whose latency EWMA exceeds this multiple "
+                   "of the cohort median")
+@click.option("--anomaly-threshold", default=4.0, show_default=True,
+              help="flag clients whose median per-round update-norm/loss "
+                   "robust-z exceeds this")
+def telemetry_doctor(run_dir: str, as_json: bool,
+                     straggler_threshold: float,
+                     anomaly_threshold: float) -> None:
+    """Triage a run: stragglers, anomalous clients, memory growth,
+    compression outliers, and crash context from the flight recorder.
+
+    RUN_DIR is the same sink directory ``telemetry report`` reads; the
+    doctor additionally consumes ``health.jsonl`` (per-client health +
+    memory samples) and ``flight_recorder.jsonl`` (the black-box dump a
+    dying run leaves behind).
+    """
+    from fedml_tpu.telemetry.doctor import build_doctor, format_doctor
+
+    triage = build_doctor(run_dir,
+                          straggler_threshold=straggler_threshold,
+                          anomaly_threshold=anomaly_threshold)
+    if "run" in triage["notes"]:
+        click.echo(triage["notes"]["run"])
+        raise SystemExit(1)
+    if as_json:
+        click.echo(json.dumps(triage, indent=1, default=str))
+    else:
+        click.echo(format_doctor(triage))
 
 
 @telemetry.command("prometheus")

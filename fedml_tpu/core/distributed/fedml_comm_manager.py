@@ -91,7 +91,13 @@ class FedMLCommManager(Observer):
         # re-activate the sender's trace context (injected by send_message)
         # so this rank's handler spans stitch into the sender's timeline
         from fedml_tpu import telemetry
+        from fedml_tpu.telemetry import flight_recorder
 
+        rnd = msg_params.get("round")
+        flight_recorder.record(
+            "comm_recv", msg_type=str(msg_type), rank=self.rank,
+            sender=msg_params.get_sender_id(),
+            **({"round": rnd} if rnd is not None else {}))
         ctx = telemetry.extract_context(msg_params.get_params())
         token = telemetry.activate_context(ctx)
         try:
@@ -112,6 +118,13 @@ class FedMLCommManager(Observer):
                 self.rank,
                 msg_type,
             )
+            # the exception is caught here (never reaches threading's
+            # excepthook), so this IS the unhandled-crash moment for a
+            # federation rank — land the black box now
+            flight_recorder.record("handler_error", msg_type=str(msg_type),
+                                   rank=self.rank, error=repr(e))
+            flight_recorder.get_flight_recorder().dump(
+                reason="handler_error", exc=e)
             self.com_manager.stop_receive_message()
         finally:
             from fedml_tpu import telemetry
@@ -120,10 +133,16 @@ class FedMLCommManager(Observer):
 
     def send_message(self, message: Message) -> None:
         from fedml_tpu import telemetry
+        from fedml_tpu.telemetry import flight_recorder
 
         # carry the current trace context as a message header so the
         # receiving rank's spans join this round's timeline
         telemetry.inject_context(message.get_params())
+        rnd = message.get("round")
+        flight_recorder.record(
+            "comm_send", msg_type=message.get_type(), rank=self.rank,
+            receiver=message.get_receiver_id(),
+            **({"round": rnd} if rnd is not None else {}))
         reg = telemetry.get_registry()
         reg.counter("comm/messages_sent",
                     labels={"backend": str(self.backend).lower()}).inc()

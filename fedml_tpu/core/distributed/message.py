@@ -30,6 +30,10 @@ class Message:
     # own model uploads (see fedml_tpu/compression); payloads are
     # additionally self-describing via the wire format's __codec__ node
     MSG_ARG_KEY_COMPRESSION = "compression"
+    # piggybacked heartbeat/health fields (JSON-safe scalars only: train
+    # wall, train loss, live memory bytes) — rides existing status and
+    # model-upload messages, never its own round-trip
+    MSG_ARG_KEY_HEALTH = "health"
 
     def __init__(self, type_: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type_)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import platform
+import time
 from typing import Any
 
 from fedml_tpu import constants
@@ -41,6 +42,27 @@ class ClientMasterManager(FedMLCommManager):
         self._upload_codec = None
         self._error_feedback = None
         self._global_ref = None
+        self._last_train_ms = None
+
+    def _heartbeat_fields(self) -> dict:
+        """JSON-safe health scalars piggybacked on existing messages —
+        the server's health tracker reads them; no extra round-trips."""
+        from fedml_tpu.telemetry.device_stats import memory_snapshot
+
+        hb = {"ts": time.time()}
+        try:
+            snap = memory_snapshot()
+            hb["mem_bytes"] = snap["bytes_in_use"] or snap["live_buffer_bytes"]
+        except Exception:  # pragma: no cover - introspection is best-effort
+            pass
+        if self._last_train_ms is not None:
+            hb["train_ms"] = round(self._last_train_ms, 3)
+        metrics = getattr(self.trainer_dist_adapter, "last_train_metrics",
+                          None) or {}
+        loss = metrics.get("train_loss")
+        if isinstance(loss, (int, float)):
+            hb["train_loss"] = float(loss)
+        return hb
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -121,6 +143,7 @@ class ClientMasterManager(FedMLCommManager):
         msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.get_sender_id(), receive_id)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())
+        msg.add_params(Message.MSG_ARG_KEY_HEALTH, self._heartbeat_fields())
         self.send_message(msg)
 
     def _encode_update(self, weights):
@@ -156,6 +179,7 @@ class ClientMasterManager(FedMLCommManager):
         if metrics and metrics.get("local_steps") is not None:
             # FedNova's τ_i: the server rescales the normalized aggregate
             msg.add_params("local_steps", float(metrics["local_steps"]))
+        msg.add_params(Message.MSG_ARG_KEY_HEALTH, self._heartbeat_fields())
         self.send_message(msg)
 
     def __train(self, global_params) -> None:
@@ -166,8 +190,9 @@ class ClientMasterManager(FedMLCommManager):
         # span stitches into the server's round timeline
         with telemetry.get_tracer().span(
             f"round/{self.round_idx}/client/{self.rank}/train"
-        ):
+        ) as tspan:
             weights, local_sample_num = self.trainer_dist_adapter.train(
                 self.round_idx, global_params
             )
+        self._last_train_ms = (time.time() - tspan.started) * 1e3
         self.send_model_to_server(0, weights, local_sample_num)

@@ -112,6 +112,15 @@ class AsyncFedMLServerManager(FedMLCommManager):
             w_client = get_codec(w_client.codec).decode(w_client)
         base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
         staleness = max(0, self.version - base_version)
+        # staleness is the async FSM's health signal: a client whose
+        # updates arrive ever-staler is the async-world straggler
+        from fedml_tpu import telemetry
+        from fedml_tpu.telemetry import flight_recorder
+
+        telemetry.get_registry().histogram("health/async_staleness").observe(
+            float(staleness))
+        flight_recorder.record("async_update", round=self.version,
+                               sender=sender, staleness=staleness)
         a = self.alpha * (1.0 + staleness) ** (-self.staleness_exp)
         x = self.aggregator.get_global_model_params()
         mixed = jax.tree.map(lambda g, c: (1.0 - a) * g + a * c, x, w_client)

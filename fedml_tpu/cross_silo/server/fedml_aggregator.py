@@ -64,6 +64,13 @@ class FedMLAggregator:
     def set_delta_base(self, params: Optional[Pytree]) -> None:
         self._delta_base = params
 
+    def get_upload_base(self) -> Optional[Pytree]:
+        """The model client uploads resolve against: the broadcast as the
+        clients decoded it under a lossy codec, the exact global
+        otherwise. One definition for aggregation AND health scoring."""
+        return (self._delta_base if self._delta_base is not None
+                else self.global_params)
+
     def get_global_model_params(self) -> Pytree:
         return self.global_params
 
@@ -117,8 +124,7 @@ class FedMLAggregator:
             return raw_list, None
         # deltas resolve against the broadcast as clients decoded it (the
         # server manager records it under a lossy broadcast codec)
-        base = (self._delta_base if self._delta_base is not None
-                else self.global_params)
+        base = self.get_upload_base()
         if all(isinstance(m, CompressedTree) and m.is_delta
                for _, m in raw_list) and not (
                    requires_full_trees() or self._contrib.is_enabled()):

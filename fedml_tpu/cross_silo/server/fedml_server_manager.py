@@ -7,6 +7,7 @@ aggregate → test → select next round's clients → sync or finish.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, Optional
 
 from fedml_tpu import constants
@@ -73,6 +74,20 @@ class FedMLServerManager(FedMLCommManager):
         if not bool(getattr(args, "secure_aggregation", False)):
             self._codec = get_codec(getattr(args, "compression", ""), args)
 
+        # run health: per-client latency EWMA + update-norm/loss z-scores
+        # fed from the upload path, device memory sampled per aggregate —
+        # surfaced as health/* and mem/* metrics and health.jsonl events
+        from fedml_tpu import telemetry
+        from fedml_tpu.telemetry.device_stats import DeviceStatsSampler
+        from fedml_tpu.telemetry.health import ClientHealthTracker
+
+        # bind the run-dir sinks (spans/health/flight recorder) for
+        # cross-silo runs the same way the simulation engines do
+        telemetry.configure_from_args(args)
+        self._health = ClientHealthTracker()
+        self._devstats = DeviceStatsSampler()
+        self._bcast_ts: Dict[int, float] = {}
+
     # -- lifecycle ---------------------------------------------------------
     def run(self) -> None:
         super().run()
@@ -125,6 +140,7 @@ class FedMLServerManager(FedMLCommManager):
                 if self._codec is not None:
                     msg.add_params(Message.MSG_ARG_KEY_COMPRESSION,
                                    self._codec.spec)
+                self._bcast_ts[client_id] = time.time()
                 self.send_message(msg)
         mlops.log({"event": "server.init_sent", "round": 0})
 
@@ -154,6 +170,9 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_client_status_update(self, msg: Message) -> None:
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        hb = msg.get(Message.MSG_ARG_KEY_HEALTH)
+        if isinstance(hb, dict):
+            self._health.heartbeat(msg.get_sender_id(), hb)
         if status == MyMessage.MSG_CLIENT_STATUS_IDLE:
             self.client_online_status[msg.get_sender_id()] = True
         all_online = all(
@@ -193,6 +212,7 @@ class FedMLServerManager(FedMLCommManager):
         sender = msg.get_sender_id()
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self._observe_client_upload(sender, msg, model_params)
         self.aggregator.add_local_trained_result(
             self.client_id_list_in_this_round.index(sender), model_params,
             local_sample_num, local_steps=msg.get("local_steps"),
@@ -208,6 +228,8 @@ class FedMLServerManager(FedMLCommManager):
         with tracer.span(f"round/{self.args.round_idx}/aggregate",
                          n_clients=len(self.client_id_list_in_this_round)):
             global_params = self.aggregator.aggregate()
+        self._health.finish_round(self.args.round_idx)
+        self._devstats.sample("aggregate", self.args.round_idx)
         with tracer.span(f"round/{self.args.round_idx}/eval"):
             metrics = self.aggregator.test_on_server_for_all_clients(
                 self.args.round_idx)
@@ -244,7 +266,34 @@ class FedMLServerManager(FedMLCommManager):
                 if self._codec is not None:
                     m.add_params(Message.MSG_ARG_KEY_COMPRESSION,
                                  self._codec.spec)
+                self._bcast_ts[client_id] = time.time()
                 self.send_message(m)
+
+    def _observe_client_upload(self, sender: int, msg: Message,
+                               model_params) -> None:
+        """Feed the health tracker from one upload: round latency vs the
+        broadcast timestamp, update norm on the decoded aggregate path
+        (compressed deltas included), loss/memory from the piggybacked
+        heartbeat. Never lets introspection break the round."""
+        from fedml_tpu.compression import CompressedTree
+        from fedml_tpu.telemetry.health import update_norm
+
+        try:
+            sent = self._bcast_ts.get(sender)
+            hb = msg.get(Message.MSG_ARG_KEY_HEALTH)
+            hb = hb if isinstance(hb, dict) else {}
+            if isinstance(model_params, CompressedTree) and model_params.is_delta:
+                norm = update_norm(model_params)
+            else:
+                norm = update_norm(model_params,
+                                   base=self.aggregator.get_upload_base())
+            self._health.observe(
+                sender, self.args.round_idx,
+                latency_s=(time.time() - sent) if sent else None,
+                update_norm=norm, train_loss=hb.get("train_loss"),
+                heartbeat=hb or None)
+        except Exception:  # pragma: no cover - observability must not kill
+            logger.exception("client health observation failed")
 
     def _send_finish(self) -> None:
         for client_id in range(1, self.client_num + 1):

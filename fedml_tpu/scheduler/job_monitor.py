@@ -91,6 +91,17 @@ class JobMonitor:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.sweeps = 0
+        # job/endpoint health rides the telemetry registry (not private
+        # attrs), so `telemetry report` and the Prometheus exposition see
+        # the scheduler plane without polling this object
+        from fedml_tpu.telemetry import get_registry
+
+        reg = get_registry()
+        self._m_sweeps = reg.counter("scheduler/sweeps")
+        self._m_runs_fixed = reg.counter("scheduler/runs_fixed")
+        self._m_endpoint_flips = reg.counter("scheduler/endpoint_flips")
+        self._g_runs_running = reg.gauge("scheduler/runs_running")
+        self._g_endpoints_offline = reg.gauge("scheduler/endpoints_offline")
 
     # -- singleton (reference keeps one monitor per agent process) -----
     @classmethod
@@ -158,6 +169,22 @@ class JobMonitor:
         result = {"runs_fixed": self.sweep_runs(),
                   "endpoint_flips": self.sweep_endpoints()}
         self.sweeps += 1
+        self._m_sweeps.inc()
+        if result["runs_fixed"]:
+            self._m_runs_fixed.inc(len(result["runs_fixed"]))
+        n_flips = sum(len(v) for v in result["endpoint_flips"].values())
+        if n_flips:
+            self._m_endpoint_flips.inc(n_flips)
+        if self.compute_store is not None:
+            self._g_runs_running.set(
+                len(self.compute_store.runs(status=RunStatus.RUNNING)))
+        if self.endpoint_cache is not None:
+            offline = sum(
+                1
+                for ep in self.endpoint_cache.list_endpoints()
+                for rep in (ep.get("replicas") or {}).values()
+                if rep.get("status") == EndpointStatus.OFFLINE)
+            self._g_endpoints_offline.set(offline)
         return result
 
     # -- loop ----------------------------------------------------------

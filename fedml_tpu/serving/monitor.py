@@ -1,17 +1,16 @@
 """Endpoint monitor — liveness + rolling latency/throughput stats.
 
 Parity target: ``model_scheduler/device_model_monitor.py`` (the reference
-samples endpoint health and replica metrics into its MLOps plane). Here the
-monitor is an in-process stats aggregator the inference runner feeds;
-latency rides a telemetry :class:`~fedml_tpu.telemetry.Histogram` so the
-snapshot reports real p50/p95/p99 (the old sum/max pair could not answer
-"what does a slow request look like"), and the snapshot lands in the JSONL
-metrics sink (``core/mlops``) so the scheduler plane can poll endpoint
-health without a hosted backend.
+samples endpoint health and replica metrics into its MLOps plane). Every
+stat lives in the telemetry registry — counters for request/error totals,
+a histogram for latency (real p50/p95/p99), gauges for uptime and last
+activity — so endpoint health appears in ``telemetry report`` /
+``telemetry doctor`` and the Prometheus exposition without this object
+keeping a private shadow copy; :meth:`snapshot` is just a read of those
+instruments, plus the optional JSONL mirror for the scheduler plane.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict
 
@@ -21,19 +20,27 @@ from fedml_tpu.telemetry import get_registry
 class EndpointMonitor:
     def __init__(self, endpoint_id: str = "default", args: Any = None):
         self.endpoint_id = endpoint_id
-        self._lock = threading.Lock()
-        self._count = 0
-        self._errors = 0
-        self._lat_sum = 0.0
-        self._lat_max = 0.0
         self._started = time.time()
-        self._last_request = None
         self._metrics = None
         reg = get_registry()
         labels = {"endpoint": endpoint_id}
         self._hist = reg.histogram("serving/request_ms", labels=labels)
         self._m_requests = reg.counter("serving/requests", labels=labels)
         self._m_errors = reg.counter("serving/errors", labels=labels)
+        self._g_uptime = reg.gauge("serving/uptime_s", labels=labels)
+        self._g_uptime.set(0.0)  # fresh deployment starts its clock
+        self._g_last_request = reg.gauge("serving/last_request_ts",
+                                         labels=labels)
+        # registry instruments are cumulative per (endpoint, process) —
+        # a redeploy reuses them. Baselines make snapshot() report THIS
+        # deployment's counts/average, consistent with its uptime.
+        # (Percentiles/max stay process-lifetime: histogram buckets
+        # cannot be differenced.)
+        self._base_requests = self._m_requests.value
+        self._base_errors = self._m_errors.value
+        base = self._hist.snapshot()
+        self._base_lat_sum = base["sum"]
+        self._base_lat_count = base["count"]
         if args is not None:
             try:
                 from fedml_tpu.core.mlops.metrics import MLOpsMetrics
@@ -43,34 +50,35 @@ class EndpointMonitor:
                 self._metrics = None
 
     def record_request(self, latency_s: float, ok: bool = True) -> None:
-        with self._lock:
-            self._count += 1
-            if not ok:
-                self._errors += 1
-            self._lat_sum += latency_s
-            self._lat_max = max(self._lat_max, latency_s)
-            self._last_request = time.time()
         self._hist.observe(latency_s * 1e3)
         self._m_requests.inc()
         if not ok:
             self._m_errors.inc()
+        now = time.time()
+        self._g_last_request.set(now)
+        # keep the exported gauge fresh under traffic even when nothing
+        # polls snapshot() — a flush mid-serve must not report uptime 0
+        self._g_uptime.set(round(now - self._started, 1))
 
     def snapshot(self) -> Dict:
         hist = self._hist.snapshot()
-        with self._lock:
-            n = max(self._count, 1)
-            snap = {
-                "endpoint_id": self.endpoint_id,
-                "requests": self._count,
-                "errors": self._errors,
-                "latency_avg_ms": round(1e3 * self._lat_sum / n, 3),
-                "latency_max_ms": round(1e3 * self._lat_max, 3),
-                "latency_p50_ms": round(hist["p50"], 3),
-                "latency_p95_ms": round(hist["p95"], 3),
-                "latency_p99_ms": round(hist["p99"], 3),
-                "uptime_s": round(time.time() - self._started, 1),
-                "last_request_ts": self._last_request,
-            }
+        uptime = round(time.time() - self._started, 1)
+        self._g_uptime.set(uptime)
+        n = max(hist["count"] - self._base_lat_count, 1)
+        last_ts = self._g_last_request.value
+        snap = {
+            "endpoint_id": self.endpoint_id,
+            "requests": int(self._m_requests.value - self._base_requests),
+            "errors": int(self._m_errors.value - self._base_errors),
+            "latency_avg_ms": round(
+                (hist["sum"] - self._base_lat_sum) / n, 3),
+            "latency_max_ms": round(hist["max"], 3),
+            "latency_p50_ms": round(hist["p50"], 3),
+            "latency_p95_ms": round(hist["p95"], 3),
+            "latency_p99_ms": round(hist["p99"], 3),
+            "uptime_s": uptime,
+            "last_request_ts": last_ts or None,
+        }
         if self._metrics is not None:
             try:
                 self._metrics.log({"endpoint": snap})

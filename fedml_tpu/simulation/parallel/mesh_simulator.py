@@ -78,6 +78,12 @@ class MeshFedAvgAPI:
         self.event = MLOpsProfilerEvent(args)
         self.tracer = telemetry.configure_from_args(args)
         self._m_round_ms = telemetry.get_registry().histogram("mesh/round_ms")
+        # per-phase device/HBM introspection: stage vs dispatch vs eval
+        # (the prefetch worker samples its own "prefetch" phase, so
+        # staging-induced growth is attributable — see pipeline.py)
+        from fedml_tpu.telemetry.device_stats import DeviceStatsSampler
+
+        self._devstats = DeviceStatsSampler()
 
         batch_size = int(getattr(args, "batch_size", 32))
         epochs = int(getattr(args, "epochs", 1))
@@ -466,6 +472,10 @@ class MeshFedAvgAPI:
                 stage_span.attrs["prefetch_overlap_ratio"] = round(ratio, 4)
                 self._m_overlap.set(ratio)
         self.event.log_event_ended("stage", round_idx)
+        self._devstats.sample("stage", round_idx)
+        from fedml_tpu.telemetry import flight_recorder
+
+        flight_recorder.record("round_start", round=round_idx)
         client_ids = staged["client_ids"]
         ctx = Context()
         ctx.add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
@@ -504,6 +514,7 @@ class MeshFedAvgAPI:
         else:
             self._m_dispatch_ms.observe(dt * 1e3)
         self.event.log_event_ended("train+agg", round_idx)
+        self._devstats.sample("train_agg", round_idx)
         if self._sync_each_round:
             self.estimator.observe(float(np.sum(staged["nk_host"])), dt)
         self._last_id_matrix = staged["id_matrix"]
@@ -556,6 +567,7 @@ class MeshFedAvgAPI:
             if should_save(self.args, round_idx):
                 self._start_round = round_idx + 1
                 self._ckpt.save(round_idx, self._ckpt_state())
+                flight_recorder.record("checkpoint", round=round_idx)
                 self._chain_started = None  # serialization drained the queue
 
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
@@ -573,9 +585,11 @@ class MeshFedAvgAPI:
                 metrics = self.aggregator.test(
                     self.global_params, self.dataset.test_data_global, None, self.args
                 )
+            self._devstats.sample("eval", round_idx)
             report.update(metrics)
             self.test_history.append(report)
             logger.info("mesh round %d acc=%.4f", round_idx, metrics.get("test_acc", -1))
+        flight_recorder.record("round_end", round=round_idx)
         return report
 
     def train(self) -> dict:

@@ -286,13 +286,24 @@ class RoundPipeline:
         stage_fn, tracer = self._stage_fn, self._tracer
 
         def thunk():
-            if tracer is None:
-                return stage_fn(nxt, prepared)
-            span = tracer.begin(f"round/{nxt}/prefetch")
             try:
-                return stage_fn(nxt, prepared)
+                if tracer is None:
+                    return stage_fn(nxt, prepared)
+                span = tracer.begin(f"round/{nxt}/prefetch")
+                try:
+                    return stage_fn(nxt, prepared)
+                finally:
+                    tracer.end(span)
             finally:
-                tracer.end(span)
+                # sample device/host memory ON the worker, attributed to
+                # the prefetch phase — staged double-buffer growth shows
+                # up as mem/*{phase=prefetch}, separate from round memory
+                try:
+                    from fedml_tpu.telemetry.device_stats import sample_now
+
+                    sample_now("prefetch", nxt)
+                except Exception:  # pragma: no cover - never break staging
+                    pass
 
         self._handles[nxt] = handle
         self._queue.put((handle, thunk))

@@ -67,6 +67,14 @@ class FedAvgAPI:
         self._m_client_ms = telemetry.get_registry().histogram(
             "sp/client_train_ms")
         self._m_rounds = telemetry.get_registry().counter("sp/rounds")
+        # run health: per-phase device/HBM sampling + per-client
+        # latency/update-norm/loss scoring (health.jsonl + health/* and
+        # mem/* metrics; `telemetry doctor` triages them post-run)
+        from fedml_tpu.telemetry.device_stats import DeviceStatsSampler
+        from fedml_tpu.telemetry.health import ClientHealthTracker
+
+        self._devstats = DeviceStatsSampler()
+        self._health = ClientHealthTracker()
 
         from fedml_tpu.core.contribution import ContributionAssessorManager
 
@@ -170,6 +178,7 @@ class FedAvgAPI:
         )
         from fedml_tpu.compression.codecs import tree_delta, tree_undelta
         from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+        from fedml_tpu.telemetry.health import update_norm
 
         seed = int(getattr(self.args, "random_seed", 0))
         enc: List[Tuple[int, Any]] = []
@@ -178,6 +187,10 @@ class FedAvgAPI:
                 cid, ErrorFeedback(self._codec))
             ct = ef.encode(tree_delta(w, self.global_params),
                            key=derive_key(seed, round_idx, cid))
+            # anomaly scoring sees the norm of the delta AS ENCODED —
+            # quantization error and EF residual included, exactly what
+            # the wire would carry
+            self._health.observe(cid, round_idx, update_norm=update_norm(ct))
             enc.append((n_k, ct))
         if not (requires_full_trees() or self._contrib.is_enabled()):
             return w_locals, FedMLAggOperator.agg_compressed(
@@ -214,6 +227,11 @@ class FedAvgAPI:
             server_state["c_global"] = self._c_global
         if self._mime_s is not None:
             server_state["c_global"] = self._mime_s  # Mime rides the same slot
+        from fedml_tpu.telemetry import flight_recorder
+        from fedml_tpu.telemetry.health import update_norm
+
+        flight_recorder.record("round_start", round=round_idx,
+                               clients=[int(c) for c in client_ids])
         self.event.log_event_started("train", round_idx)
         with self.tracer.span(f"round/{round_idx}/train"):
             for cid in client_ids:
@@ -231,8 +249,18 @@ class FedAvgAPI:
                     w, metrics = self.trainer.run_local_training(
                         self.global_params, train_data, self.device, self.args
                     )
-                self._m_client_ms.observe(
-                    (time.time() - cspan.started) * 1e3)
+                client_wall_s = time.time() - cspan.started
+                self._m_client_ms.observe(client_wall_s * 1e3)
+                loss = metrics.get("train_loss")
+                self._health.observe(
+                    cid, round_idx, latency_s=client_wall_s,
+                    # uncompressed runs score the raw displacement; with a
+                    # codec the encoded delta's norm (quantization error
+                    # included) is observed in _compress_uplinks instead
+                    update_norm=(update_norm(w, base=self.global_params)
+                                 if self._codec is None else None),
+                    train_loss=loss if isinstance(loss, (int, float)) else None,
+                )
                 if metrics.get("scaffold_c_delta") is not None:
                     c_deltas.append(metrics["scaffold_c_delta"])
                 if metrics.get("mime_full_grad") is not None:
@@ -240,6 +268,7 @@ class FedAvgAPI:
                 taus.append(float(metrics.get("local_steps", 0.0)))
                 w_locals.append((n_k, w))
         self.event.log_event_ended("train", round_idx)
+        self._devstats.sample("train", round_idx)
 
         self.event.log_event_started("aggregate", round_idx)
         agg_span = self.tracer.begin(f"round/{round_idx}/aggregate")
@@ -298,6 +327,8 @@ class FedAvgAPI:
         self.tracer.end(agg_span)
         self.event.log_event_ended("aggregate", round_idx)
         self._m_rounds.inc()
+        self._devstats.sample("aggregate", round_idx)
+        self._health.finish_round(round_idx)
 
         if self._ckpt is not None:
             from fedml_tpu.core.checkpoint import should_save
@@ -305,8 +336,12 @@ class FedAvgAPI:
             if should_save(self.args, round_idx):
                 self._start_round = round_idx + 1
                 self._ckpt.save(round_idx, self._ckpt_state())
+                # the black box must agree with the checkpoint about the
+                # last durable round — recorded only after a completed save
+                flight_recorder.record("checkpoint", round=round_idx)
 
         report = {"round": round_idx, "clients": client_ids}
+        flight_recorder.record("round_end", round=round_idx)
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
         if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
             with self.tracer.span(f"round/{round_idx}/eval"):
@@ -314,6 +349,7 @@ class FedAvgAPI:
                     self.global_params, self.dataset.test_data_global,
                     self.device, self.args
                 )
+            self._devstats.sample("eval", round_idx)
             report.update(metrics)
             self.test_history.append(report)
             logger.info(

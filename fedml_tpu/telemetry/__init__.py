@@ -46,6 +46,24 @@ from fedml_tpu.telemetry.spans import (
     wrap_frame_body,
 )
 from fedml_tpu.telemetry.report import build_report, format_report, load_spans
+from fedml_tpu.telemetry import flight_recorder
+from fedml_tpu.telemetry.device_stats import (
+    DeviceStatsSampler,
+    install_compile_cache_counters,
+    memory_snapshot,
+    sample_now,
+)
+from fedml_tpu.telemetry.doctor import build_doctor, format_doctor
+from fedml_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    reset_flight_recorder,
+)
+from fedml_tpu.telemetry.health import (
+    ClientHealthTracker,
+    log_health_event,
+    update_norm,
+)
 
 __all__ = [
     "BYTES_BUCKETS",
@@ -76,4 +94,17 @@ __all__ = [
     "build_report",
     "format_report",
     "load_spans",
+    "flight_recorder",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "reset_flight_recorder",
+    "DeviceStatsSampler",
+    "install_compile_cache_counters",
+    "memory_snapshot",
+    "sample_now",
+    "build_doctor",
+    "format_doctor",
+    "ClientHealthTracker",
+    "log_health_event",
+    "update_norm",
 ]

@@ -1,0 +1,413 @@
+"""``telemetry doctor`` — triage a run dir into an actionable summary.
+
+The report CLI answers "where did the time go"; the doctor answers "what
+is wrong with this run": which clients straggled or diverged, whether
+memory is creeping toward OOM, whether compression is paying off, and —
+for a dead run — what the flight recorder saw last. Every section
+degrades to an explicit "no data" note when its sink is missing or
+truncated, so a partial run triages instead of tracebacking.
+
+Data sources (all under ``<run_dir>/``):
+
+- ``health.jsonl``          — ``client_health`` + ``mem_sample`` events
+- ``flight_recorder.jsonl`` — crash context + last events before death
+- ``spans.jsonl``           — codec/encode outliers, span-based straggler
+  fallback when no health events exist
+- ``telemetry.jsonl``       — comm/wire counters, service health metrics
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.telemetry.health import _median
+from fedml_tpu.telemetry.report import (
+    _load_jsonl,
+    build_report,
+    normalize_name,
+)
+
+__all__ = ["build_doctor", "format_doctor"]
+
+
+def _fit_slope(xs: List[float], ys: List[float]) -> float:
+    """Least-squares slope of y over x (0 when degenerate)."""
+    n = len(xs)
+    if n < 2:
+        return 0.0
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom <= 0:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.1f} {unit}"
+        b /= 1024
+    return f"{b:.1f} GiB"  # pragma: no cover
+
+
+def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
+                 anomaly_threshold: float = 4.0,
+                 mem_growth_threshold: float = 1.5,
+                 min_rounds: int = 3) -> Dict:
+    notes: Dict[str, str] = {}
+    verdict: List[str] = []
+
+    health_path = os.path.join(run_dir, "health.jsonl")
+    health_events = _load_jsonl(health_path)
+    if not os.path.exists(health_path):
+        notes["health"] = "no data: health.jsonl missing (run predates the " \
+                          "health layer, or no health events fired)"
+    elif not health_events:
+        notes["health"] = "no data: health.jsonl is empty or unparseable"
+
+    fr_path = os.path.join(run_dir, "flight_recorder.jsonl")
+    fr_events = _load_jsonl(fr_path)
+    if not os.path.exists(fr_path):
+        notes["crash"] = "no data: flight_recorder.jsonl missing (process " \
+                         "still alive, or recorder not bound)"
+    elif not fr_events:
+        notes["crash"] = "no data: flight_recorder.jsonl is empty"
+
+    report = build_report(run_dir)
+    for key, val in (report.get("notes") or {}).items():
+        notes.setdefault(key, val)
+
+    # -- crash context ----------------------------------------------------
+    crash: Optional[Dict] = None
+    if fr_events:
+        header = next((e for e in fr_events
+                       if e.get("kind") == "crash_context"), None)
+        tail = [e for e in fr_events if e.get("kind") != "crash_context"]
+        last_round = None
+        last_checkpoint = None
+        for e in reversed(tail):
+            if last_round is None and "round" in e:
+                try:
+                    last_round = int(e["round"])
+                except (TypeError, ValueError):
+                    pass
+            if last_checkpoint is None and e.get("kind") == "checkpoint":
+                try:
+                    last_checkpoint = int(e["round"])
+                except (TypeError, ValueError, KeyError):
+                    pass
+            if last_round is not None and last_checkpoint is not None:
+                break
+        crash = {
+            "reason": (header or {}).get("reason"),
+            "exc_type": (header or {}).get("exc_type"),
+            "exc_message": (header or {}).get("exc_message"),
+            "n_events": (header or {}).get("n_events", len(tail)),
+            "dropped": (header or {}).get("dropped", 0),
+            "last_round": last_round,
+            "last_checkpoint_round": last_checkpoint,
+            "last_events": tail[-8:],
+        }
+        if crash["reason"] in ("sigterm", "exception", "handler_error"):
+            what = crash["exc_type"] or crash["reason"]
+            where = (f" at round {last_round}" if last_round is not None
+                     else "")
+            resume = (f"; last checkpoint: round {last_checkpoint} (resume "
+                      "with resume: true)" if last_checkpoint is not None
+                      else "")
+            verdict.append(f"run died ({what}{where}){resume}")
+
+    # -- per-client health ------------------------------------------------
+    ch = [e for e in health_events if e.get("kind") == "client_health"]
+    clients: Dict[str, Dict] = {}
+    for e in ch:
+        c = clients.setdefault(str(e.get("client")), {
+            "rounds": 0, "round_scores": [], "round_zs": [],
+            "latency_ms": [], "max_abs_z": 0.0, "flag_rounds": 0})
+        c["rounds"] += 1
+        # prefer the raw per-round score; fall back to the tracker's own
+        # running median for events from older writers
+        score = e.get("round_straggler_score", e.get("straggler_score"))
+        if score is not None:
+            c["round_scores"].append(float(score))
+        z = e.get("round_max_abs_z", e.get("anomaly_score"))
+        if z is not None:
+            c["round_zs"].append(float(z))
+        if e.get("latency_ms") is not None:
+            c["latency_ms"].append(float(e["latency_ms"]))
+        c["max_abs_z"] = max(c["max_abs_z"],
+                             abs(float(e.get("z_norm") or 0.0)),
+                             abs(float(e.get("z_loss") or 0.0)))
+        if e.get("flagged_straggler") or e.get("flagged_anomaly"):
+            c["flag_rounds"] += 1
+
+    stragglers: List[Dict] = []
+    anomalies: List[Dict] = []
+    for cid, c in sorted(clients.items()):
+        s_scores = c["round_scores"]
+        zs = c["round_zs"]
+        row = {
+            "client": cid,
+            "rounds": c["rounds"],
+            # medians across rounds: robust to one compile-heavy or
+            # MAD-unstable round; flags need min_rounds of evidence
+            "straggler_score": _median(s_scores) if s_scores else 0.0,
+            "anomaly_score": _median(zs) if zs else 0.0,
+            "max_abs_z": c["max_abs_z"],
+            "mean_latency_ms": (sum(c["latency_ms"]) / len(c["latency_ms"])
+                                if c["latency_ms"] else None),
+        }
+        if (len(s_scores) >= min_rounds
+                and row["straggler_score"] >= straggler_threshold):
+            stragglers.append(row)
+            lat = (f" (mean {row['mean_latency_ms']:.0f} ms/round)"
+                   if row["mean_latency_ms"] is not None else "")
+            verdict.append(
+                f"client {cid} is a straggler: latency "
+                f"{row['straggler_score']:.1f}x the cohort median"
+                + lat)
+        if (len(zs) >= min_rounds
+                and row["anomaly_score"] >= anomaly_threshold):
+            anomalies.append(row)
+            verdict.append(
+                f"client {cid} sends anomalous updates: median |z| "
+                f"{row['anomaly_score']:.1f} (max {row['max_abs_z']:.1f}) on "
+                "update-norm/loss — inspect its data or drop it from "
+                "sampling")
+    span_stragglers: List[Dict] = []
+    if not ch and report.get("stragglers"):
+        # span-based fallback: aggregate the report's slowest-client-per-
+        # round attribution so a pre-health run still names its slow
+        # client (no anomaly scoring possible without update norms)
+        by_client: Dict[str, List[Dict]] = {}
+        for s in report["stragglers"]:
+            by_client.setdefault(str(s["client"]), []).append(s)
+        total_rounds = max(len(report["stragglers"]), 1)
+        for cid, rows in sorted(by_client.items()):
+            span_stragglers.append({
+                "client": cid,
+                "rounds_slowest": len(rows),
+                "mean_share": sum(r["share"] for r in rows) / len(rows),
+                "mean_duration_ms": (sum(r["duration_ms"] for r in rows)
+                                     / len(rows)),
+            })
+        span_stragglers.sort(key=lambda r: -r["rounds_slowest"])
+        worst = span_stragglers[0]
+        if (worst["rounds_slowest"] >= max(min_rounds, total_rounds // 2)
+                and worst["mean_share"] >= 0.5):
+            verdict.append(
+                f"client {worst['client']} was the slowest client in "
+                f"{worst['rounds_slowest']}/{total_rounds} rounds "
+                f"({100 * worst['mean_share']:.0f}% of client time; "
+                "span-based fallback, no health events)")
+        notes.setdefault(
+            "stragglers",
+            "no client_health events; falling back to span-based slowest-"
+            "client-per-round (no anomaly scoring possible)")
+
+    # -- memory growth ----------------------------------------------------
+    mem = [e for e in health_events if e.get("kind") == "mem_sample"]
+    memory: Dict[str, Dict] = {}
+    by_phase: Dict[str, List] = {}
+    for e in mem:
+        if "round" not in e:
+            continue
+        by_phase.setdefault(str(e.get("phase")), []).append(e)
+    for phase, events in sorted(by_phase.items()):
+        events.sort(key=lambda e: (int(e["round"]), e.get("ts", 0)))
+        # prefer the accelerator's own allocator stats; fall back to live
+        # buffer bytes on backends without memory_stats (CPU)
+        key = ("bytes_in_use"
+               if any(e.get("bytes_in_use") for e in events)
+               else "live_buffer_bytes")
+        xs = [float(e["round"]) for e in events]
+        ys = [float(e.get(key) or 0.0) for e in events]
+        if not any(ys):
+            continue
+        slope = _fit_slope(xs, ys)
+        first, last = ys[0], ys[-1]
+        row = {
+            "phase": phase,
+            "metric": key,
+            "samples": len(ys),
+            "first_bytes": first,
+            "last_bytes": last,
+            "slope_bytes_per_round": slope,
+            "growth_ratio": (last / first) if first > 0 else 0.0,
+        }
+        limit = max((float(e.get("bytes_limit") or 0.0) for e in events),
+                    default=0.0)
+        if limit > 0 and slope > 0:
+            row["rounds_to_limit"] = max(0.0, (limit - last) / slope)
+        memory[phase] = row
+        if (row["growth_ratio"] >= mem_growth_threshold and slope > 0
+                and len(ys) >= 3):
+            msg = (f"memory grows in phase {phase!r}: "
+                   f"{_fmt_bytes(first)} -> {_fmt_bytes(last)} "
+                   f"({_fmt_bytes(slope)}/round)")
+            if "rounds_to_limit" in row:
+                msg += f", ~{row['rounds_to_limit']:.0f} rounds to OOM"
+            msg += " — check staging cache budget / prefetch double-buffer"
+            verdict.append(msg)
+    if not mem:
+        notes.setdefault("memory",
+                         "no data: no mem_sample events in health.jsonl")
+
+    # -- compression + wire bytes ----------------------------------------
+    comp = report.get("compression") or {}
+    compression: Dict[str, Any] = {
+        "ratio": comp.get("ratio", 0.0),
+        "raw_bytes": comp.get("raw_bytes", 0.0),
+        "wire_bytes": comp.get("wire_bytes", 0.0),
+        "outlier_spans": [],
+    }
+    codec_active = bool(comp.get("encode") or comp.get("decode"))
+    if codec_active and comp.get("raw_bytes") and comp.get("ratio", 0) < 1.5:
+        verdict.append(
+            f"compression is not paying off: raw->wire ratio "
+            f"{comp['ratio']:.2f}x — check codec choice vs payload dtypes")
+    # encode/decode duration outliers: individual spans way past the p50
+    from fedml_tpu.telemetry.report import load_spans
+
+    spans = load_spans(run_dir)
+    codec_spans = [s for s in spans
+                   if normalize_name(s["name"]).startswith("compress/")]
+    by_name: Dict[str, List[Dict]] = {}
+    for s in codec_spans:
+        by_name.setdefault(normalize_name(s["name"]), []).append(s)
+    for name, group in sorted(by_name.items()):
+        durs = sorted(s["duration_ms"] for s in group)
+        p50 = durs[len(durs) // 2]
+        for s in group:
+            if p50 > 0 and s["duration_ms"] > 5 * p50 and len(group) >= 4:
+                compression["outlier_spans"].append({
+                    "name": name, "duration_ms": s["duration_ms"],
+                    "p50_ms": p50})
+    wire = {k: v for k, v in (report.get("comm_bytes") or {}).items()
+            if k.split("{")[0].startswith("comm/")}
+    compression["wire_counters"] = wire
+    if not codec_active and not wire:
+        notes.setdefault("compression",
+                         "no data: no codec spans or comm byte counters")
+
+    # -- service health (serving/scheduler via the registry) --------------
+    services = dict(report.get("services") or {})
+    if not services:
+        notes.setdefault("services",
+                         "no data: no serving/* or scheduler/* metrics")
+
+    if not (fr_events or health_events or report["n_spans"]
+            or report.get("n_metrics")):
+        notes["run"] = f"no telemetry data of any kind under {run_dir}"
+    if not verdict:
+        verdict.append("no issues detected")
+
+    return {
+        "run_dir": run_dir,
+        "notes": notes,
+        "crash": crash,
+        "clients": sorted(clients),
+        "stragglers": stragglers,
+        "span_stragglers": span_stragglers,
+        "anomalies": anomalies,
+        "memory": memory,
+        "compression": compression,
+        "services": services,
+        "verdict": verdict,
+    }
+
+
+def format_doctor(d: Dict) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add(f"telemetry doctor: {d['run_dir']}")
+    add("")
+    add("verdict:")
+    for v in d["verdict"]:
+        add(f"  - {v}")
+    notes = d.get("notes") or {}
+
+    add("")
+    add("crash context:")
+    crash = d.get("crash")
+    if crash:
+        add(f"  reason: {crash['reason']}"
+            + (f" ({crash['exc_type']}: {crash['exc_message']})"
+               if crash.get("exc_type") else ""))
+        add(f"  last round seen: {crash['last_round']}; "
+            f"last checkpoint: {crash['last_checkpoint_round']}")
+        for e in crash["last_events"][-4:]:
+            add(f"    last event: {e.get('kind')} "
+                + " ".join(f"{k}={v}" for k, v in e.items()
+                           if k not in ("kind", "ts") and not
+                           isinstance(v, (dict, list))))
+    else:
+        add(f"  {notes.get('crash', 'no data')}")
+
+    add("")
+    add("stragglers (latency EWMA vs cohort median):")
+    if d["stragglers"]:
+        for r in d["stragglers"]:
+            lat = (f" (mean {r['mean_latency_ms']:.0f} ms)"
+                   if r["mean_latency_ms"] is not None else "")
+            add(f"  client {r['client']}: {r['straggler_score']:.2f}x "
+                f"median over {r['rounds']} rounds" + lat)
+    elif d["clients"]:
+        add("  none flagged")
+    elif d.get("span_stragglers"):
+        add(f"  {notes.get('stragglers', '')}")
+        for r in d["span_stragglers"][:8]:
+            add(f"  client {r['client']}: slowest in {r['rounds_slowest']} "
+                f"round(s), mean {r['mean_duration_ms']:.1f} ms "
+                f"({100 * r['mean_share']:.0f}% of client time)")
+    else:
+        add(f"  {notes.get('stragglers', notes.get('health', 'no data'))}")
+
+    add("")
+    add("anomalous clients (robust z on update-norm / train-loss):")
+    if d["anomalies"]:
+        for r in d["anomalies"]:
+            add(f"  client {r['client']}: median anomaly score "
+                f"{r['anomaly_score']:.1f} (max |z| {r['max_abs_z']:.1f})")
+    elif d["clients"]:
+        add("  none flagged")
+    else:
+        add(f"  {notes.get('health', 'no data')}")
+
+    add("")
+    add("memory (per phase):")
+    if d["memory"]:
+        for phase, r in sorted(d["memory"].items()):
+            line = (f"  {phase:<12s} {r['metric']}: "
+                    f"{_fmt_bytes(r['first_bytes'])} -> "
+                    f"{_fmt_bytes(r['last_bytes'])} over {r['samples']} "
+                    f"samples ({_fmt_bytes(r['slope_bytes_per_round'])}/round)")
+            if "rounds_to_limit" in r:
+                line += f", ~{r['rounds_to_limit']:.0f} rounds to limit"
+            add(line)
+    else:
+        add(f"  {notes.get('memory', 'no data')}")
+
+    add("")
+    add("compression / wire:")
+    comp = d["compression"]
+    if comp.get("raw_bytes"):
+        add(f"  raw {comp['raw_bytes']:.0f} B -> wire "
+            f"{comp['wire_bytes']:.0f} B (ratio {comp['ratio']:.2f}x)")
+    for name, v in sorted((comp.get("wire_counters") or {}).items()):
+        add(f"  {name:<44s}{v:>14.0f}")
+    for o in comp.get("outlier_spans", [])[:8]:
+        add(f"  outlier: {o['name']} took {o['duration_ms']:.1f} ms "
+            f"(p50 {o['p50_ms']:.1f} ms)")
+    if not comp.get("raw_bytes") and not comp.get("wire_counters"):
+        add(f"  {notes.get('compression', 'no data')}")
+
+    add("")
+    add("service health:")
+    if d["services"]:
+        for name, v in sorted(d["services"].items()):
+            add(f"  {name:<44s}{v!s:>14s}")
+    else:
+        add(f"  {notes.get('services', 'no data')}")
+    return "\n".join(lines)

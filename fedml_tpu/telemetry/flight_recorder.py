@@ -1,0 +1,273 @@
+"""Flight recorder — a bounded black box for post-mortem run triage.
+
+The reference's MLOps plane can answer "why did this run die" because a
+hosted backend saw every status transition; this build has no backend, so
+the recorder keeps the last few thousand telemetry events — spans, comm
+headers, health samples, round/checkpoint markers — in a byte-budgeted
+in-memory ring and lands them as ``<run_dir>/flight_recorder.jsonl`` the
+moment the process dies abnormally:
+
+- **SIGTERM** (preemption, ``kill``, scheduler stop): dump, then re-raise
+  the signal with the default handler so the exit code stays honest;
+- **unhandled exception** (main thread via ``sys.excepthook``, any other
+  thread via ``threading.excepthook``): dump with the exception type,
+  message, and traceback as crash context, then chain to the previous
+  hook;
+- **atexit**: dump unless a crash path already did, so even a clean run
+  leaves its tail of events for ``fedml_tpu telemetry doctor``.
+
+Events are serialized at ``record()`` time (one ``json.dumps``, stored as
+the final line string), so the byte budget is exact and the dump path —
+which may run inside a signal handler — only writes pre-built lines.
+``Tracer.end`` feeds every completed span in via :func:`on_span`; the ring
+evicts oldest-first, so a span flood can never grow the recorder past
+``max_bytes``.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "get_flight_recorder",
+    "record",
+    "on_span",
+    "bind",
+    "reset_flight_recorder",
+]
+
+DUMP_FILENAME = "flight_recorder.jsonl"
+
+# reasons that mark a *crash* dump; a later atexit dump must not
+# overwrite the crash context they captured
+_CRASH_REASONS = ("sigterm", "exception", "handler_error")
+
+
+class FlightRecorder:
+    """Byte-budgeted ring of pre-serialized telemetry events.
+
+    Uses an ``RLock`` deliberately: the SIGTERM handler runs on the main
+    thread and may interrupt a ``record()`` in progress there — with a
+    plain lock the dump would self-deadlock. Deque mutations are atomic
+    under the GIL, so re-entry at worst mis-counts a few bytes.
+    """
+
+    def __init__(self, max_bytes: int = 1 << 20, max_events: int = 4096):
+        self.max_bytes = int(max_bytes)
+        self.max_events = int(max_events)
+        self._lines: "deque[str]" = deque()
+        self._sizes: "deque[int]" = deque()
+        self._bytes = 0
+        self._lock = threading.RLock()
+        self._dir: Optional[str] = None
+        self.dumped_reason: Optional[str] = None
+        self.dropped = 0
+
+    # -- recording --------------------------------------------------------
+    def record(self, kind: str, **fields: Any) -> None:
+        rec = {"ts": time.time(), "kind": str(kind), **fields}
+        try:
+            line = json.dumps(rec, default=str)
+        except (TypeError, ValueError):  # pragma: no cover - default=str
+            line = json.dumps({"ts": rec["ts"], "kind": rec["kind"],
+                               "unserializable": True})
+        size = len(line) + 1
+        with self._lock:
+            self._lines.append(line)
+            self._sizes.append(size)
+            self._bytes += size
+            while self._lines and (
+                    self._bytes > self.max_bytes
+                    or len(self._lines) > self.max_events):
+                self._lines.popleft()
+                self._bytes -= self._sizes.popleft()
+                self.dropped += 1
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lines)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            lines = list(self._lines)
+        return [json.loads(l) for l in lines]
+
+    def last_round(self) -> Optional[int]:
+        """The highest-recency event carrying a ``round`` field."""
+        with self._lock:
+            lines = list(self._lines)
+        for line in reversed(lines):
+            try:
+                rec = json.loads(line)
+            except ValueError:  # pragma: no cover
+                continue
+            if "round" in rec:
+                try:
+                    return int(rec["round"])
+                except (TypeError, ValueError):
+                    continue
+        return None
+
+    # -- binding + dumping ------------------------------------------------
+    def bind(self, run_dir: str) -> None:
+        self._dir = run_dir
+
+    @property
+    def sink_dir(self) -> Optional[str]:
+        return self._dir
+
+    def dump(self, run_dir: Optional[str] = None, reason: str = "manual",
+             exc: Optional[BaseException] = None) -> Optional[str]:
+        """Write the ring (oldest→newest) behind one crash-context header.
+
+        Overwrites any previous dump — the file always reflects the
+        latest process state, and the header records why it was written.
+        """
+        target = run_dir or self._dir
+        if target is None:
+            return None
+        header: Dict[str, Any] = {
+            "ts": time.time(),
+            "kind": "crash_context",
+            "reason": reason,
+            "n_events": len(self),
+            "dropped": self.dropped,
+            "pid": os.getpid(),
+        }
+        lr = self.last_round()
+        if lr is not None:
+            header["last_round"] = lr
+        if exc is not None:
+            header["exc_type"] = type(exc).__name__
+            header["exc_message"] = str(exc)
+            header["traceback"] = "".join(
+                traceback.format_exception(type(exc), exc, exc.__traceback__)
+            )[-4096:]
+        with self._lock:
+            lines = list(self._lines)
+        try:
+            os.makedirs(target, exist_ok=True)
+            path = os.path.join(target, DUMP_FILENAME)
+            with open(path, "w") as f:
+                f.write(json.dumps(header, default=str) + "\n")
+                for line in lines:
+                    f.write(line + "\n")
+        except OSError:  # pragma: no cover - sink dir gone at crash time
+            return None
+        self.dumped_reason = reason
+        return path
+
+
+_recorder = FlightRecorder()
+_recorder_lock = threading.Lock()
+_hooks_installed = False
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def record(kind: str, **fields: Any) -> None:
+    """Record one event into the process-global ring (always cheap; the
+    ring exists even before a run dir is bound)."""
+    _recorder.record(kind, **fields)
+
+
+def on_span(rec: Dict) -> None:
+    """Span feed from ``Tracer.end`` — a condensed copy rides the ring."""
+    _recorder.record(
+        "span",
+        name=rec.get("name"),
+        duration_ms=round(float(rec.get("duration_ms", 0.0)), 3),
+        started=rec.get("started"),
+    )
+
+
+def reset_flight_recorder() -> None:
+    """Fresh unbound ring (test isolation). Crash hooks stay installed —
+    they always act on the *current* global recorder."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = FlightRecorder()
+
+
+# -- crash hooks -----------------------------------------------------------
+def _dump_current(reason: str, exc: Optional[BaseException] = None) -> None:
+    rec = _recorder
+    if rec.sink_dir is None:
+        return
+    rec.dump(reason=reason, exc=exc)
+
+
+def _install_hooks() -> None:
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+
+    prev_excepthook = sys.excepthook
+
+    def _excepthook(exc_type, exc, tb):
+        _dump_current("exception", exc)
+        prev_excepthook(exc_type, exc, tb)
+
+    sys.excepthook = _excepthook
+
+    prev_thread_hook = threading.excepthook
+
+    def _thread_hook(args):
+        _dump_current("exception", args.exc_value)
+        prev_thread_hook(args)
+
+    threading.excepthook = _thread_hook
+
+    try:
+        prev_sig = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            _dump_current("sigterm")
+            if callable(prev_sig) and prev_sig not in (
+                    signal.SIG_DFL, signal.SIG_IGN):
+                prev_sig(signum, frame)
+                return
+            # restore the default disposition and re-raise so the exit
+            # status is a real SIGTERM death, not a masked clean exit
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # signal.signal only works on the main thread; a worker-thread
+        # configure() still gets excepthook + atexit coverage
+        pass
+
+    def _atexit_dump():
+        if _recorder.dumped_reason not in _CRASH_REASONS:
+            _dump_current("atexit")
+
+    atexit.register(_atexit_dump)
+
+
+def bind(run_dir: str) -> FlightRecorder:
+    """Point the global recorder at a run dir and arm the crash hooks.
+
+    Called by ``telemetry.configure`` so every engine that lands spans in
+    a run dir gets the black box for free.
+    """
+    _recorder.bind(run_dir)
+    _install_hooks()
+    return _recorder

@@ -1,0 +1,378 @@
+"""Per-client run health — straggler and anomaly scoring while training.
+
+FedScale-style per-client runtime attribution, computed server-side from
+signals the federation already carries (no new message round-trips):
+
+- **round latency** per client (broadcast→upload wall time, or the SP
+  loop's per-client train wall): each round scores a client as
+  ``latency / cohort median``, and the straggler flag fires on the
+  MEDIAN of those per-round scores — robust by construction, so one
+  compile-heavy round 0 cannot brand a client for the whole run (the
+  latency EWMA is also kept, as the reported smoothed latency);
+- **update norm** of each client's delta vs the round's broadcast base —
+  computed on the already-decoded aggregate path, including compressed
+  deltas (int8 blocks / top-k values are summed without materializing a
+  full f32 tree), so a noise-injected or diverging client stands out
+  even under the PR 3 lossy transport;
+- **train loss** piggybacked on the existing model-upload header.
+
+Norms and losses are scored per round with a robust z (median/MAD over
+this round's cohort, cohorts of ≥ 4); the per-client anomaly score is
+the MEDIAN of per-round max-|z| values. Medians everywhere is
+deliberate: small cohorts make single-round z spikes of 6–8 normal for
+honest-but-heterogeneous clients (MAD instability), while an attacker
+is extreme *every* round — and a flag additionally needs ≥ 3 scored
+rounds of evidence, so a client seen once can't be branded. Scores land
+three ways: ``health/*`` gauges in the metrics registry (labelled by
+client), one ``client_health`` event per client per round in
+``<run_dir>/health.jsonl``, and the flight-recorder ring — so both
+``telemetry report`` and ``telemetry doctor`` can reconstruct who was
+slow or weird, round by round, after the fact.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.telemetry import flight_recorder
+from fedml_tpu.telemetry.registry import get_registry
+
+__all__ = [
+    "HEALTH_FILENAME",
+    "ClientHealthTracker",
+    "log_health_event",
+    "update_norm",
+]
+
+HEALTH_FILENAME = "health.jsonl"
+
+_log_lock = threading.Lock()
+_log_fh = None
+_log_path: Optional[str] = None
+
+
+def _sink_dir() -> Optional[str]:
+    from fedml_tpu.telemetry.spans import get_tracer
+
+    return get_tracer().sink_dir
+
+
+def log_health_event(rec: Dict[str, Any]) -> None:
+    """Append one event to ``<run_dir>/health.jsonl`` (write-through, so a
+    crashed run keeps everything up to its last event). No-op until the
+    tracer is bound to a run dir; the flight recorder still sees the
+    event either way."""
+    global _log_fh, _log_path
+    run_dir = _sink_dir()
+    if run_dir is None:
+        return
+    rec = {"ts": rec.get("ts", time.time()), **rec}
+    path = os.path.join(run_dir, HEALTH_FILENAME)
+    with _log_lock:
+        if _log_fh is None or _log_path != path or not os.path.exists(path):
+            if _log_fh is not None:
+                try:
+                    _log_fh.close()
+                except OSError:  # pragma: no cover
+                    pass
+            os.makedirs(run_dir, exist_ok=True)
+            _log_fh = open(path, "a")
+            _log_path = path
+        _log_fh.write(json.dumps(rec, default=str) + "\n")
+        _log_fh.flush()
+
+
+def reset_health_log() -> None:
+    """Drop the cached append handle (test isolation)."""
+    global _log_fh, _log_path
+    with _log_lock:
+        if _log_fh is not None:
+            try:
+                _log_fh.close()
+            except OSError:  # pragma: no cover
+                pass
+        _log_fh = None
+        _log_path = None
+
+
+# -- update-norm helper ----------------------------------------------------
+def update_norm(update: Any, base: Any = None) -> Optional[float]:
+    """L2 norm of a client update, compression-aware.
+
+    - ``CompressedTree`` **delta**: the norm is read straight off the
+      compressed blocks (int8 q·scale, bf16 leaves, top-k values) — no
+      full-tree decode, so the fused-aggregation path keeps its memory
+      contract;
+    - ``CompressedTree`` full model: decoded, then diffed against
+      ``base``;
+    - plain pytree: ``‖update − base‖₂`` (or ``‖update‖₂`` without a
+      base).
+
+    Returns None when the payload isn't norm-able (unknown codec, FHE
+    ciphertexts, non-array leaves).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.compression import CompressedTree, get_codec
+
+    def _tree_sq(tree, ref=None):
+        # accumulate a TRACED scalar across leaves; the single float()
+        # at the end is the only device→host sync, not one per leaf
+        total = jnp.float32(0.0)
+        leaves = jax.tree.leaves(tree)
+        refs = jax.tree.leaves(ref) if ref is not None else [None] * len(leaves)
+        for a, b in zip(leaves, refs):
+            a = jnp.asarray(a).astype(jnp.float32)
+            if b is not None:
+                a = a - jnp.asarray(b).astype(jnp.float32)
+            total = total + jnp.sum(jnp.square(a))
+        return total
+
+    try:
+        if isinstance(update, CompressedTree):
+            codec = get_codec(update.codec)
+            if codec is None:
+                return None
+            if not update.is_delta:
+                tree = codec.decode(update)
+                return math.sqrt(float(_tree_sq(tree, base)))
+            from fedml_tpu.compression.codecs import _is_float_meta
+
+            total = jnp.float32(0.0)
+            for parts, (dt, shape) in zip(update.arrays, update.meta):
+                if not _is_float_meta(dt):
+                    # int/bool leaves ride the wire uncompressed as a
+                    # single passthrough array — multi-part decode_leaf
+                    # would unpack-fail on them
+                    total = total + jnp.sum(jnp.square(
+                        jnp.asarray(parts[0]).astype(jnp.float32)))
+                elif codec.name == "topk":
+                    # values carry the whole mass; indices are positions
+                    total = total + jnp.sum(jnp.square(
+                        jnp.asarray(parts[0]).astype(jnp.float32)))
+                else:
+                    leaf = codec.decode_leaf(parts, dt, shape)
+                    total = total + jnp.sum(jnp.square(
+                        leaf.astype(jnp.float32)))
+            return math.sqrt(float(total))
+        return math.sqrt(float(_tree_sq(update, base)))
+    except (TypeError, ValueError):
+        return None
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def robust_z(values: Dict[Any, float]) -> Dict[Any, float]:
+    """Median/MAD z-score per key; {} when the cohort is too small for a
+    meaningful spread. n < 4 is degenerate: with three values the MAD is
+    the *smaller* of two deviations, so any legitimate spread between two
+    honest clients explodes the third's z."""
+    if len(values) < 4:
+        return {}
+    vals = list(values.values())
+    med = _median(vals)
+    mad = _median([abs(v - med) for v in vals])
+    scale = 1.4826 * mad
+    if scale <= 0:
+        # degenerate cohort (ties): fall back to mean absolute deviation
+        scale = sum(abs(v - med) for v in vals) / len(vals) or 1e-12
+    return {k: (v - med) / scale for k, v in values.items()}
+
+
+class ClientHealthTracker:
+    """Server-side per-client health state machine.
+
+    Drive it with :meth:`observe` as uploads arrive, then
+    :meth:`finish_round` once the round's cohort is complete — that is
+    when cross-client z-scores are computable. Thread-safe: cross-silo
+    handlers run on the comm receive thread.
+    """
+
+    def __init__(self, registry=None, ewma_alpha: float = 0.4,
+                 straggler_threshold: float = 2.0,
+                 anomaly_threshold: float = 4.0,
+                 min_rounds: int = 3,
+                 heartbeat_window_s: float = 300.0):
+        self._reg = registry or get_registry()
+        self.ewma_alpha = float(ewma_alpha)
+        self.straggler_threshold = float(straggler_threshold)
+        self.anomaly_threshold = float(anomaly_threshold)
+        self.min_rounds = int(min_rounds)
+        self.heartbeat_window_s = float(heartbeat_window_s)
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Dict[Any, Dict]] = {}
+        self.latency_ewma: Dict[Any, float] = {}
+        # per-round score histories, newest last (bounded); client-level
+        # scores are MEDIANS of these
+        self._score_hist: Dict[Any, deque] = {}
+        self._z_hist: Dict[Any, deque] = {}
+        self.last_seen: Dict[Any, float] = {}
+        self.rounds_scored = 0
+
+    def straggler_score(self, client_id: Any) -> float:
+        """Median of the client's per-round latency/cohort-median scores
+        (1.0 until any latency is observed)."""
+        with self._lock:
+            hist = self._score_hist.get(client_id)
+            return _median(list(hist)) if hist else 1.0
+
+    def anomaly_score(self, client_id: Any) -> float:
+        """Median of the client's per-round max-|z| values."""
+        with self._lock:
+            hist = self._z_hist.get(client_id)
+            return _median(list(hist)) if hist else 0.0
+
+    # -- inputs -----------------------------------------------------------
+    def heartbeat(self, client_id: Any, fields: Optional[Dict] = None) -> None:
+        """A liveness ping piggybacked on an existing comm header."""
+        now = time.time()
+        with self._lock:
+            self.last_seen[client_id] = now
+            # "reporting" means heard from recently — a client that went
+            # silent must age out of the gauge, not count forever
+            n = sum(1 for ts in self.last_seen.values()
+                    if now - ts <= self.heartbeat_window_s)
+        self._reg.gauge("health/clients_reporting").set(n)
+        if fields and fields.get("mem_bytes"):
+            self._reg.gauge(
+                "health/client_mem_bytes",
+                labels={"client": str(client_id)}).set(float(fields["mem_bytes"]))
+
+    def observe(self, client_id: Any, round_idx: int,
+                latency_s: Optional[float] = None,
+                update_norm: Optional[float] = None,
+                train_loss: Optional[float] = None,
+                heartbeat: Optional[Dict] = None) -> None:
+        with self._lock:
+            obs = self._pending.setdefault(int(round_idx), {}).setdefault(
+                client_id, {})
+            if latency_s is not None:
+                obs["latency_s"] = float(latency_s)
+            if update_norm is not None and math.isfinite(update_norm):
+                obs["update_norm"] = float(update_norm)
+            if train_loss is not None:
+                try:
+                    obs["train_loss"] = float(train_loss)
+                except (TypeError, ValueError):
+                    pass
+            self.last_seen[client_id] = time.time()
+        if heartbeat:
+            self.heartbeat(client_id, heartbeat)
+
+    # -- scoring ----------------------------------------------------------
+    def finish_round(self, round_idx: int) -> Dict[Any, Dict]:
+        """Score the round's cohort; returns {client: health record}."""
+        with self._lock:
+            cohort = self._pending.pop(int(round_idx), {})
+            if not cohort:
+                return {}
+            a = self.ewma_alpha
+            lats = {}
+            for cid, obs in cohort.items():
+                lat = obs.get("latency_s")
+                if lat is None:
+                    continue
+                lats[cid] = lat
+                prev = self.latency_ewma.get(cid)
+                self.latency_ewma[cid] = (
+                    lat if prev is None else a * lat + (1 - a) * prev)
+            med_lat = _median(list(lats.values())) if lats else 0.0
+            z_norm = robust_z({c: o["update_norm"] for c, o in cohort.items()
+                               if "update_norm" in o})
+            z_loss = robust_z({c: o["train_loss"] for c, o in cohort.items()
+                               if "train_loss" in o})
+            out: Dict[Any, Dict] = {}
+            for cid, obs in cohort.items():
+                # per-round scores vs THIS round's cohort; client-level
+                # scores are medians across rounds, so one compile- or
+                # MAD-instability-polluted round cannot brand an honest
+                # client (nor absolve a consistently bad one), and a
+                # flag needs min_rounds of evidence
+                round_score = (lats[cid] / med_lat
+                               if cid in lats and med_lat > 0 else 1.0)
+                hist = self._score_hist.setdefault(cid, deque(maxlen=64))
+                hist.append(round_score)
+                s_score = _median(list(hist))
+                raw_anom = max(abs(z_norm.get(cid, 0.0)),
+                               abs(z_loss.get(cid, 0.0)))
+                zh = self._z_hist.setdefault(cid, deque(maxlen=64))
+                zh.append(raw_anom)
+                anom = _median(list(zh))
+                enough = len(hist) >= self.min_rounds
+                out[cid] = {
+                    "kind": "client_health",
+                    "round": int(round_idx),
+                    "client": cid,
+                    "latency_ms": round(obs["latency_s"] * 1e3, 3)
+                    if "latency_s" in obs else None,
+                    "latency_ewma_ms": (
+                        round(self.latency_ewma[cid] * 1e3, 3)
+                        if cid in self.latency_ewma else None),
+                    "update_norm": obs.get("update_norm"),
+                    "train_loss": obs.get("train_loss"),
+                    "z_norm": round(z_norm.get(cid, 0.0), 3),
+                    "z_loss": round(z_loss.get(cid, 0.0), 3),
+                    "round_straggler_score": round(round_score, 3),
+                    "straggler_score": round(s_score, 3),
+                    "round_max_abs_z": round(raw_anom, 3),
+                    "anomaly_score": round(anom, 3),
+                    "flagged_straggler": (
+                        enough and s_score >= self.straggler_threshold),
+                    "flagged_anomaly": (
+                        enough and anom >= self.anomaly_threshold),
+                }
+            self.rounds_scored += 1
+        for cid, rec in out.items():
+            labels = {"client": str(cid)}
+            self._reg.gauge("health/straggler_score", labels=labels).set(
+                rec["straggler_score"])
+            self._reg.gauge("health/anomaly_score", labels=labels).set(
+                rec["anomaly_score"])
+            if rec["latency_ms"] is not None:
+                self._reg.histogram("health/client_round_ms",
+                                    labels=labels).observe(rec["latency_ms"])
+            log_health_event(rec)
+            if rec["flagged_straggler"] or rec["flagged_anomaly"]:
+                flight_recorder.record(
+                    "health_flag",
+                    **{k: v for k, v in rec.items() if k != "kind"})
+        self._reg.counter("health/rounds_scored").inc()
+        return out
+
+    # -- outputs ----------------------------------------------------------
+    def flagged(self) -> Dict[str, List]:
+        with self._lock:
+            return {
+                "stragglers": sorted(
+                    c for c, h in self._score_hist.items()
+                    if len(h) >= self.min_rounds
+                    and _median(list(h)) >= self.straggler_threshold),
+                "anomalies": sorted(
+                    c for c, h in self._z_hist.items()
+                    if len(h) >= self.min_rounds
+                    and _median(list(h)) >= self.anomaly_threshold),
+            }
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                "latency_ewma_s": dict(self.latency_ewma),
+                "straggler_score": {
+                    c: _median(list(h))
+                    for c, h in self._score_hist.items() if h},
+                "anomaly_score": {
+                    c: _median(list(h))
+                    for c, h in self._z_hist.items() if h},
+                "rounds_scored": self.rounds_scored,
+            }

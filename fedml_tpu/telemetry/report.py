@@ -78,6 +78,23 @@ def build_report(run_dir: str) -> Dict:
     spans = load_spans(run_dir)
     metrics = load_metrics(run_dir)
 
+    # partial runs degrade to explicit per-section notes, not tracebacks:
+    # a crashed writer leaves missing/truncated sinks and the report must
+    # still triage whatever did land
+    notes: Dict[str, str] = {}
+    if not spans:
+        have = [f for f in ("spans.jsonl", "events.jsonl")
+                if os.path.exists(os.path.join(run_dir, f))]
+        notes["spans"] = (
+            "no data: " + (" and ".join(have) + " present but empty/"
+                           "unparseable" if have
+                           else "spans.jsonl/events.jsonl missing"))
+    if not metrics:
+        notes["metrics"] = (
+            "no data: telemetry.jsonl "
+            + ("present but empty/unparseable" if os.path.exists(
+                os.path.join(run_dir, "telemetry.jsonl")) else "missing"))
+
     # -- per-round timeline (one pass; client spans collected for the
     # straggler section as we go) ----------------------------------------
     rounds: Dict[int, Dict] = {}
@@ -236,12 +253,40 @@ def build_report(run_dir: str) -> Dict:
         "decode": codec_phases.get("compress/decode"),
     }
 
+    # -- client health (health/* gauges, latest snapshot per client) ------
+    client_health: Dict[str, Dict[str, float]] = {}
+    mem_gauges: Dict[str, float] = {}
+    services: Dict[str, float] = {}
+    for rec in metrics:
+        name = rec.get("name", "")
+        labels = rec.get("labels") or {}
+        if name in ("health/straggler_score", "health/anomaly_score") and (
+                "client" in labels):
+            row = client_health.setdefault(str(labels["client"]), {})
+            row[name.split("/")[1]] = rec.get("value", 0.0)
+        elif name.startswith("mem/") and rec.get("kind") == "gauge":
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            mem_gauges[name + ("{" + lbl + "}" if lbl else "")] = rec.get(
+                "value", 0.0)
+        elif name.startswith(("serving/", "scheduler/")):
+            # endpoint/job health routed through the registry (not the old
+            # private monitor dicts) — latest snapshot per name+labels
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            key = name + ("{" + lbl + "}" if lbl else "")
+            if rec.get("kind") == "histogram":
+                services[key + ".p95"] = rec.get("p95", 0.0)
+                services[key + ".count"] = rec.get("count", 0)
+            else:
+                services[key] = rec.get("value", 0.0)
+
     # -- stitched (cross-process) spans ----------------------------------
     stitched = [s for s in spans if s.get("remote_parent")]
 
     return {
         "run_dir": run_dir,
         "n_spans": len(spans),
+        "n_metrics": len(metrics),
+        "notes": notes,
         "rounds": round_rows,
         "phases": phase_rows,
         "stragglers": stragglers,
@@ -250,6 +295,9 @@ def build_report(run_dir: str) -> Dict:
         "execute_ms": max(round_total - compile_ms, 0.0),
         "comm_bytes": comm,
         "compression": compression,
+        "client_health": client_health,
+        "mem_gauges": mem_gauges,
+        "services": services,
         "stitched_spans": stitched,
     }
 
@@ -259,8 +307,11 @@ def format_report(report: Dict) -> str:
     add = lines.append
     add(f"telemetry report: {report['run_dir']} "
         f"({report['n_spans']} spans)")
+    notes = report.get("notes") or {}
     add("")
     add("per-round timeline:")
+    if not report["rounds"] and "spans" in notes:
+        add(f"  {notes['spans']}")
     for r in report["rounds"]:
         add(f"  round {r['round']}: wall {r['wall_ms']:.1f} ms")
         for phase, total in r["phases"].items():
@@ -298,6 +349,26 @@ def format_report(report: Dict) -> str:
         add("comm bytes breakdown:")
         for name, v in sorted(report["comm_bytes"].items()):
             add(f"  {name:<44s}{v:>14.0f}")
+    elif "metrics" in notes:
+        add("")
+        add(f"comm bytes breakdown: {notes['metrics']}")
+    if report.get("client_health"):
+        add("")
+        add("client health (latest straggler/anomaly scores):")
+        for cid, row in sorted(report["client_health"].items()):
+            add(f"  client {cid}: straggler "
+                f"{row.get('straggler_score', 0.0):.2f}x, anomaly "
+                f"{row.get('anomaly_score', 0.0):.2f}")
+    if report.get("mem_gauges"):
+        add("")
+        add("device/host memory (latest sampled gauges):")
+        for name, v in sorted(report["mem_gauges"].items()):
+            add(f"  {name:<44s}{v:>14.0f}")
+    if report.get("services"):
+        add("")
+        add("service health (serving/scheduler):")
+        for name, v in sorted(report["services"].items()):
+            add(f"  {name:<44s}{v:>14}")
     comp = report.get("compression") or {}
     if comp.get("raw_bytes") or comp.get("encode") or comp.get("decode"):
         add("")

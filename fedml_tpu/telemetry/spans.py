@@ -36,6 +36,7 @@ import uuid
 import weakref
 from typing import Any, Dict, Iterator, List, Optional
 
+from fedml_tpu.telemetry import flight_recorder
 from fedml_tpu.telemetry.registry import get_registry
 
 CTX_KEY = "telemetry_ctx"
@@ -278,6 +279,9 @@ class Tracer:
                 self._records = []
         if overflow is not None:
             self._write(overflow)
+        # a condensed copy rides the flight-recorder ring so a crash dump
+        # shows the last spans even when the sink buffer died with them
+        flight_recorder.on_span(rec)
         return rec
 
     @contextlib.contextmanager
@@ -326,14 +330,17 @@ def get_tracer() -> Tracer:
 
 
 def configure(run_dir: str, service: str = "") -> Tracer:
-    """Bind the global tracer to a run dir (idempotent per dir)."""
+    """Bind the global tracer to a run dir (idempotent per dir). Also
+    points the flight recorder's crash dump at the same dir, so every
+    engine that lands spans gets the black box for free."""
     global _default_tracer
     with _default_lock:
         t = _default_tracer
         if t is None or t._dir != run_dir:
             t = Tracer(sink_dir=run_dir, service=service)
             _default_tracer = t
-        return t
+    flight_recorder.bind(run_dir)
+    return t
 
 
 def configure_from_args(args: Any) -> Tracer:

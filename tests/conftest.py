@@ -69,9 +69,13 @@ def _reset_singletons():
     FedMLDifferentialPrivacy.reset()
     FedMLFHE.reset()
     Context.reset()
-    # telemetry globals: fresh registry + tracer per test so counters and
-    # span sinks never leak across tests
+    # telemetry globals: fresh registry + tracer + flight recorder +
+    # health-log handle per test so counters, span sinks and crash rings
+    # never leak across tests
     from fedml_tpu import telemetry
+    from fedml_tpu.telemetry.health import reset_health_log
 
     telemetry.reset_registry()
     telemetry.reset_tracer()
+    telemetry.reset_flight_recorder()
+    reset_health_log()

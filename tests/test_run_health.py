@@ -1,0 +1,561 @@
+"""Run health layer: device introspection, client anomaly/straggler
+scoring, flight recorder, and `telemetry doctor`.
+
+Acceptance (ISSUE 4): a 5-round SP run with one artificially slowed and
+one noise-injected client yields nonzero mem/* samples each round, both
+clients flagged by the doctor, and a kill -TERM mid-run produces a
+flight_recorder.jsonl whose last recorded round matches the checkpoint.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import telemetry
+from fedml_tpu.telemetry.flight_recorder import FlightRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SLOW_CLIENT = 1
+NOISY_CLIENT = 2
+SLOW_SLEEP_S = 0.15
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+# -- flight recorder unit contract ----------------------------------------
+def test_flight_recorder_byte_budget_under_span_flood(tmp_path):
+    rec = FlightRecorder(max_bytes=64 * 1024, max_events=100000)
+    for i in range(20000):
+        rec.record("span", name=f"round/{i}/client/{i % 7}/train",
+                   duration_ms=float(i), attrs={"pad": "x" * 32})
+    assert rec.nbytes <= 64 * 1024
+    assert rec.dropped > 0
+    path = rec.dump(run_dir=str(tmp_path), reason="manual")
+    assert os.path.getsize(path) <= 64 * 1024 + 4096  # + header slack
+    events = _read_jsonl(path)
+    assert events[0]["kind"] == "crash_context"
+    # ring keeps the newest events, oldest evicted
+    assert events[-1]["name"] == f"round/19999/client/{19999 % 7}/train"
+
+
+def test_flight_recorder_last_round_and_dump_shape(tmp_path):
+    rec = FlightRecorder()
+    rec.record("round_start", round=0)
+    rec.record("checkpoint", round=0)
+    rec.record("round_start", round=1)
+    rec.record("comm_send", msg_type="X", rank=0)
+    assert rec.last_round() == 1
+    path = rec.dump(run_dir=str(tmp_path), reason="manual",
+                    exc=ValueError("boom"))
+    header = _read_jsonl(path)[0]
+    assert header["last_round"] == 1
+    assert header["exc_type"] == "ValueError"
+    assert "boom" in header["exc_message"]
+
+
+def test_flight_recorder_unhandled_exception_subprocess(tmp_path):
+    """An uncaught exception must leave a parseable dump with crash
+    context (type, message, traceback) chained through sys.excepthook."""
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        from fedml_tpu import telemetry
+        telemetry.configure({str(tmp_path)!r})
+        telemetry.flight_recorder.record("round_start", round=3)
+        raise ValueError("injected-crash")
+    """)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "injected-crash" in proc.stderr  # default hook still chained
+    events = _read_jsonl(tmp_path / "flight_recorder.jsonl")
+    header = events[0]
+    assert header["reason"] == "exception"
+    assert header["exc_type"] == "ValueError"
+    assert "injected-crash" in header["traceback"]
+    assert any(e.get("kind") == "round_start" and e.get("round") == 3
+               for e in events)
+
+
+# -- device stats ----------------------------------------------------------
+def test_device_stats_sample_sets_gauges_and_events(tmp_path):
+    telemetry.configure(str(tmp_path))
+    x = jax.numpy.ones((256, 256))  # keep a live buffer
+    sampler = telemetry.DeviceStatsSampler()
+    snap = sampler.sample("train", round_idx=4)
+    assert snap["live_buffer_bytes"] > 0
+    assert snap["host_rss_bytes"] > 0
+    reg = telemetry.get_registry()
+    labels = {"phase": "train"}
+    assert reg.gauge("mem/live_buffer_bytes", labels=labels).value > 0
+    assert reg.gauge("mem/host_rss_bytes", labels=labels).value > 0
+    events = _read_jsonl(tmp_path / "health.jsonl")
+    assert events[-1]["kind"] == "mem_sample"
+    assert events[-1]["round"] == 4
+    del x
+
+
+def test_device_stats_rate_limit():
+    sampler = telemetry.DeviceStatsSampler(min_interval_s=3600)
+    assert sampler.sample("train", 0) is not None
+    assert sampler.sample("train", 1) is None  # rate-limited
+    assert sampler.sample("eval", 1) is not None  # other phase unaffected
+
+
+# -- health scoring --------------------------------------------------------
+def test_client_health_tracker_flags_slow_and_noisy():
+    tracker = telemetry.ClientHealthTracker()
+    for rnd in range(4):
+        for cid in range(4):
+            tracker.observe(
+                cid, rnd,
+                latency_s=1.2 if cid == 1 else 0.1,
+                update_norm=50.0 if cid == 2 else 1.0 + 0.01 * cid,
+                train_loss=0.5)
+        tracker.finish_round(rnd)
+    flagged = tracker.flagged()
+    assert 1 in flagged["stragglers"]
+    assert 2 in flagged["anomalies"]
+    assert 0 not in flagged["stragglers"] and 3 not in flagged["anomalies"]
+    reg = telemetry.get_registry()
+    assert reg.gauge("health/straggler_score",
+                     labels={"client": "1"}).value > 2.0
+    assert reg.gauge("health/anomaly_score",
+                     labels={"client": "2"}).value > 3.0
+
+
+def test_update_norm_plain_and_compressed():
+    from fedml_tpu.compression import get_codec
+
+    tree = {"a": np.full((32,), 3.0, np.float32),
+            "b": np.zeros((16,), np.float32)}
+    base = {"a": np.zeros((32,), np.float32),
+            "b": np.zeros((16,), np.float32)}
+    exact = float(np.sqrt(32 * 9.0))
+    assert telemetry.update_norm(tree, base=base) == pytest.approx(exact)
+    codec = get_codec("int8")
+    ct = codec.encode({"a": tree["a"], "b": tree["b"]},
+                      key=jax.random.key(0), is_delta=True)
+    # int8 quantization error is bounded by one step per element
+    assert telemetry.update_norm(ct) == pytest.approx(exact, rel=0.1)
+    topk = get_codec("topk@0.5")
+    ct2 = topk.encode({"a": tree["a"], "b": tree["b"]},
+                      key=jax.random.key(0), is_delta=True)
+    # per-leaf top-50% keeps 16 of "a"'s 32 threes — the norm reflects
+    # exactly the mass the wire carries, sqrt(16 * 9)
+    assert telemetry.update_norm(ct2) == pytest.approx(
+        float(np.sqrt(16 * 9.0)))
+    # int leaves ride the wire as uncompressed passthrough parts; the
+    # norm must include them instead of bailing to None on the whole tree
+    mixed = {"a": tree["a"], "n": np.full((4,), 2, np.int32)}
+    ct3 = codec.encode(mixed, key=jax.random.key(0), is_delta=True)
+    assert telemetry.update_norm(ct3) == pytest.approx(
+        float(np.sqrt(32 * 9.0 + 4 * 4.0)), rel=0.1)
+
+
+# -- SP acceptance run -----------------------------------------------------
+def _sp_run(tmp_path, run_id, comm_round=5, extra_train_args=None):
+    from fedml_tpu import device as device_mod
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.ml.trainer.classification_trainer import (
+        ClassificationTrainer,
+    )
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    cfg = {
+        "common_args": {"training_type": "simulation", "random_seed": 0,
+                        "run_id": run_id, "log_file_dir": str(tmp_path)},
+        "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                      "partition_alpha": 0.5, "train_size": 200,
+                      "test_size": 80, "class_num": 3, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 4, "client_num_per_round": 4,
+                       "comm_round": comm_round, "epochs": 1,
+                       "batch_size": 16, "learning_rate": 0.3,
+                       **(extra_train_args or {})},
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    dataset = load_federated(args)
+    model = models_mod.create(args, dataset.class_num)
+
+    class FaultyTrainer(ClassificationTrainer):
+        """One artificially slowed client, one noise-injected client."""
+
+        def train(self, params, train_data, device, args):
+            new_params, metrics = super().train(params, train_data, device,
+                                                args)
+            if self.id == SLOW_CLIENT:
+                time.sleep(SLOW_SLEEP_S)
+            if self.id == NOISY_CLIENT:
+                new_params = jax.tree.map(
+                    lambda x: x + 40.0 * jax.numpy.ones_like(x), new_params)
+                metrics = {**metrics, "train_loss": 1e4}
+            return new_params, metrics
+
+    api = FedAvgAPI(args, device_mod.get_device(args), dataset, model,
+                    client_trainer=FaultyTrainer(model, args))
+    api.train()
+    return os.path.join(str(tmp_path), f"run_{run_id}")
+
+
+def test_sp_run_health_acceptance(tmp_path):
+    """5-round SP run, slow client + noisy client: nonzero mem/* samples
+    every round, the pair flagged by `telemetry doctor`, and the report's
+    health sections populated."""
+    run_dir = _sp_run(tmp_path, "health_acc", comm_round=5)
+
+    # nonzero mem samples in EVERY sampled round
+    events = _read_jsonl(os.path.join(run_dir, "health.jsonl"))
+    mem = [e for e in events if e["kind"] == "mem_sample"
+           and e.get("phase") == "train"]
+    rounds = {e["round"] for e in mem}
+    assert rounds == {0, 1, 2, 3, 4}
+    assert all(e["live_buffer_bytes"] > 0 or e["host_rss_bytes"] > 0
+               for e in mem)
+
+    # per-client health events for every round, both fault modes flagged
+    ch = [e for e in events if e["kind"] == "client_health"]
+    assert {e["round"] for e in ch} == {0, 1, 2, 3, 4}
+    doctor = telemetry.build_doctor(run_dir)
+    straggler_ids = {r["client"] for r in doctor["stragglers"]}
+    anomaly_ids = {r["client"] for r in doctor["anomalies"]}
+    assert str(SLOW_CLIENT) in straggler_ids, doctor["stragglers"]
+    assert str(NOISY_CLIENT) in anomaly_ids, doctor["anomalies"]
+    # healthy clients stay unflagged
+    assert "0" not in straggler_ids and "0" not in anomaly_ids
+    assert "3" not in straggler_ids and "3" not in anomaly_ids
+    verdict = "\n".join(doctor["verdict"])
+    assert f"client {SLOW_CLIENT} is a straggler" in verdict
+    assert f"client {NOISY_CLIENT}" in verdict
+
+    # doctor CLI renders it; report shows the health + mem sections
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    res = CliRunner().invoke(cli, ["telemetry", "doctor", run_dir])
+    assert res.exit_code == 0, res.output
+    assert "straggler" in res.output
+    assert f"client {SLOW_CLIENT}" in res.output
+    res = CliRunner().invoke(cli, ["telemetry", "report", run_dir])
+    assert res.exit_code == 0, res.output
+    assert "client health" in res.output
+    assert "mem/live_buffer_bytes" in res.output
+
+
+def test_sp_run_health_with_compression(tmp_path):
+    """Anomaly scoring works on the compressed-delta path: norms come off
+    the encoded int8 blocks, and the noisy client still stands out."""
+    run_dir = _sp_run(tmp_path, "health_comp", comm_round=3,
+                      extra_train_args={"compression": "int8"})
+    events = _read_jsonl(os.path.join(run_dir, "health.jsonl"))
+    ch = [e for e in events if e["kind"] == "client_health"]
+    norms = {}
+    for e in ch:
+        norms.setdefault(e["client"], []).append(e["update_norm"])
+    assert all(v and all(n is not None for n in v) for v in norms.values())
+    doctor = telemetry.build_doctor(run_dir)
+    assert str(NOISY_CLIENT) in {r["client"] for r in doctor["anomalies"]}
+
+
+def test_sigterm_flight_dump_matches_checkpoint(tmp_path):
+    """kill -TERM mid-run: the dump exists, records sigterm, and its last
+    checkpoint round agrees with what the checkpointer durably saved."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    script = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        import jax
+        import fedml_tpu
+        from fedml_tpu import device as device_mod, models as models_mod
+        from fedml_tpu.arguments import load_arguments_from_dict
+        from fedml_tpu.data import load_federated
+        from fedml_tpu.ml.trainer.classification_trainer import (
+            ClassificationTrainer,
+        )
+        from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+        class SlowTrainer(ClassificationTrainer):
+            def train(self, *a, **kw):
+                out = super().train(*a, **kw)
+                time.sleep(0.06)
+                return out
+
+        cfg = {{
+            "common_args": {{"training_type": "simulation",
+                             "random_seed": 0, "run_id": "sigterm",
+                             "log_file_dir": {str(tmp_path)!r}}},
+            "data_args": {{"dataset": "synthetic", "train_size": 120,
+                           "test_size": 40, "class_num": 3,
+                           "feature_dim": 8}},
+            "model_args": {{"model": "lr"}},
+            "train_args": {{"federated_optimizer": "FedAvg",
+                            "client_num_in_total": 3,
+                            "client_num_per_round": 3,
+                            "comm_round": 300, "epochs": 1,
+                            "batch_size": 16, "learning_rate": 0.3,
+                            "frequency_of_the_test": 1000,
+                            "checkpoint_dir": {ckpt_dir!r},
+                            "checkpoint_frequency": 1}},
+        }}
+        args = fedml_tpu.init(load_arguments_from_dict(cfg))
+        ds = load_federated(args)
+        model = models_mod.create(args, ds.class_num)
+        api = FedAvgAPI(args, device_mod.get_device(args), ds, model,
+                        client_trainer=SlowTrainer(model, args))
+        api.train()
+    """)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        # wait for at least two durable checkpoints, then kill mid-round
+        deadline = time.time() + 150
+        from fedml_tpu.core.checkpoint import RoundCheckpointer
+
+        while time.time() < deadline:
+            if (os.path.isdir(ckpt_dir)
+                    and len(RoundCheckpointer(ckpt_dir).saved_rounds()) >= 2):
+                break
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                pytest.fail(f"run exited early: {err.decode()[-2000:]}")
+            time.sleep(0.05)
+        else:
+            pytest.fail("no checkpoint appeared before the deadline")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == -signal.SIGTERM
+
+    dump_path = tmp_path / "run_sigterm" / "flight_recorder.jsonl"
+    assert dump_path.exists(), "SIGTERM left no flight recorder dump"
+    events = _read_jsonl(dump_path)
+    header = events[0]
+    assert header["reason"] == "sigterm"
+    ckpt_events = [e for e in events if e.get("kind") == "checkpoint"]
+    assert ckpt_events, "no checkpoint events reached the ring"
+    last_recorded = ckpt_events[-1]["round"]
+    durable = RoundCheckpointer(ckpt_dir).latest_round()
+    assert last_recorded == durable, (
+        f"flight recorder says round {last_recorded}, checkpointer has "
+        f"round {durable}")
+    # the doctor reads the same dump and names the death + resume point
+    doctor = telemetry.build_doctor(str(tmp_path / "run_sigterm"))
+    assert doctor["crash"]["reason"] == "sigterm"
+    assert doctor["crash"]["last_checkpoint_round"] == durable
+    assert any("died" in v and "sigterm" in v for v in doctor["verdict"])
+
+
+# -- cross-silo wiring -----------------------------------------------------
+def test_cross_silo_server_scores_clients(tmp_path):
+    """The cross-silo server tracks per-client health from the upload
+    path and the piggybacked heartbeats — no new message round-trips."""
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.cross_silo.run_inproc import run_cross_silo_inproc
+    from fedml_tpu.data import load_federated
+
+    cfg = {
+        "common_args": {"training_type": "cross_silo", "random_seed": 0,
+                        "run_id": "cs_health",
+                        "log_file_dir": str(tmp_path)},
+        "data_args": {"dataset": "synthetic", "train_size": 300,
+                      "test_size": 60, "class_num": 4, "feature_dim": 12},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 3, "client_num_per_round": 3,
+                       "comm_round": 2, "epochs": 1, "batch_size": 32,
+                       "learning_rate": 0.3},
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    result = run_cross_silo_inproc(args, ds, model, timeout=120)
+    assert result is not None
+    run_dir = os.path.join(str(tmp_path), "run_cs_health")
+    events = _read_jsonl(os.path.join(run_dir, "health.jsonl"))
+    ch = [e for e in events if e["kind"] == "client_health"]
+    assert {e["round"] for e in ch} == {0, 1}
+    # every client scored, with latency AND update norm AND the
+    # heartbeat-piggybacked train loss all present
+    by_client = {e["client"] for e in ch}
+    assert by_client == {"1", "2", "3"} or by_client == {1, 2, 3}
+    assert all(e["latency_ms"] is not None for e in ch)
+    assert all(e["update_norm"] is not None for e in ch)
+    assert all(e["train_loss"] is not None for e in ch)
+    # memory sampled on the aggregate path each round
+    mem = [e for e in events if e["kind"] == "mem_sample"
+           and e.get("phase") == "aggregate"]
+    assert {e["round"] for e in mem} == {0, 1}
+    # homogeneous synthetic clients: nobody should be flagged
+    doctor = telemetry.build_doctor(run_dir)
+    assert not doctor["stragglers"] and not doctor["anomalies"]
+
+
+# -- graceful degradation on partial runs ---------------------------------
+def test_report_degrades_on_metrics_only_dir(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("comm/raw_bytes").inc(1000)
+    reg.gauge("mem/live_buffer_bytes", labels={"phase": "train"}).set(5.0)
+    reg.flush_jsonl(str(tmp_path))
+    report = telemetry.build_report(str(tmp_path))
+    assert report["n_spans"] == 0 and report["n_metrics"] > 0
+    assert "spans" in report["notes"]
+    text = telemetry.format_report(report)
+    assert "no data" in text
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    res = CliRunner().invoke(cli, ["telemetry", "report", str(tmp_path)])
+    assert res.exit_code == 0, res.output
+    assert "no data" in res.output
+
+
+def test_report_survives_truncated_sinks(tmp_path):
+    with open(tmp_path / "spans.jsonl", "w") as f:
+        f.write('{"name": "round/0/train", "duration_ms": 5.0, '
+                '"started": 1.0, "ended": 1.005}\n')
+        f.write('{"name": "round/1/train", "dur')  # torn mid-crash
+    with open(tmp_path / "telemetry.jsonl", "w") as f:
+        f.write("not json at all\n")
+    report = telemetry.build_report(str(tmp_path))
+    assert report["n_spans"] == 1
+    assert "metrics" in report["notes"]
+    telemetry.format_report(report)  # must not raise
+
+
+def test_doctor_degrades_on_empty_and_partial_dirs(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    res = CliRunner().invoke(cli, ["telemetry", "doctor", str(empty)])
+    assert res.exit_code == 1
+    assert "no telemetry data" in res.output
+
+    partial = tmp_path / "partial"
+    partial.mkdir()
+    FlightRecorder().dump(run_dir=str(partial), reason="manual")
+    triage = telemetry.build_doctor(str(partial))
+    assert "health" in triage["notes"]
+    out = telemetry.format_doctor(triage)
+    assert "no data" in out
+    res = CliRunner().invoke(cli, ["telemetry", "doctor", str(partial)])
+    assert res.exit_code == 0, res.output
+
+
+# -- bench compare ---------------------------------------------------------
+def _write_bench(path, value, metric="m", wrapped=False):
+    rec = {"metric": metric, "value": value, "unit": "x"}
+    if wrapped:
+        rec = {"n": 1, "rc": 0,
+               "tail": "log noise\n" + json.dumps(rec) + "\n"}
+    with open(path, "w") as f:
+        json.dump(rec, f)
+
+
+def test_bench_compare_regression_gate(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(REPO, "tools", "bench_compare.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+
+    _write_bench(tmp_path / "BENCH_r01.json", 1.0)
+    assert bc.run_compare(str(tmp_path))["ok"]  # single file: no gate
+    _write_bench(tmp_path / "BENCH_r02.json", 0.95, wrapped=True)
+    row = bc.run_compare(str(tmp_path))
+    assert row["ok"] and row["delta_pct"] == pytest.approx(-5.0)
+    _write_bench(tmp_path / "BENCH_r03.json", 0.7)
+    row = bc.run_compare(str(tmp_path))
+    assert not row["ok"]  # 0.95 -> 0.7 is a 26% regression
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert bc.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    _write_bench(tmp_path / "BENCH_r04.json", 2.0, metric="other")
+    row = bc.run_compare(str(tmp_path))
+    assert row["ok"] and "not comparable" in row["note"]
+
+
+def test_bench_compare_natural_sort(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(REPO, "tools", "bench_compare.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    _write_bench(tmp_path / "BENCH_r9.json", 1.0)
+    _write_bench(tmp_path / "BENCH_r10.json", 2.0)
+    _write_bench(tmp_path / "BENCH_r100.json", 3.0)
+    row = bc.run_compare(str(tmp_path))
+    # lexicographic order would compare (r9, r10); natural order must
+    # pick (r10, r100)
+    assert row["prev_file"] == "BENCH_r10.json"
+    assert row["new_file"] == "BENCH_r100.json"
+    assert row["ok"]
+
+
+def test_doctor_span_straggler_fallback(tmp_path):
+    """A run with spans but no health events still names its slow client
+    (span-based fallback), instead of promising data it never shows."""
+    spans = []
+    for rnd in range(4):
+        for cid, d in ((0, 900.0), (1, 50.0), (2, 40.0)):
+            spans.append({"name": f"round/{rnd}/client/{cid}/train",
+                          "trace_id": "t", "span_id": f"s{rnd}{cid}",
+                          "parent_id": None, "started": float(rnd),
+                          "ended": rnd + d / 1e3, "duration_ms": d})
+    with open(tmp_path / "spans.jsonl", "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+    triage = telemetry.build_doctor(str(tmp_path))
+    assert triage["span_stragglers"]
+    worst = triage["span_stragglers"][0]
+    assert worst["client"] == "0" and worst["rounds_slowest"] == 4
+    assert any("client 0 was the slowest" in v for v in triage["verdict"])
+    out = telemetry.format_doctor(triage)
+    assert "client 0: slowest in 4 round(s)" in out
+
+
+# -- taxonomy lint ---------------------------------------------------------
+def test_span_lint_health_and_mem_rules():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_span_names",
+        os.path.join(REPO, "tools", "check_span_names.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    bad = [
+        ("x.py", 1, "counter", "mem/bytes"),          # mem/* must be gauge
+        ("x.py", 2, "gauge", "mem/a/b"),              # one segment only
+        ("x.py", 3, "gauge", "health/client/score"),  # ids go in labels
+        ("x.py", 4, "span", "mem/snapshot"),          # metric namespace
+        ("x.py", 5, "gauge", "mem/ok_reading"),       # fine
+        ("x.py", 6, "histogram", "health/round_ms"),  # fine
+    ]
+    problems = lint.check(bad)
+    assert len(problems) == 4, problems

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Compare the newest two ``BENCH_*.json`` headline metrics.
+
+The bench driver emits ONE JSON line (``{"metric", "value", ...}``) and
+the round harness archives it — either as that raw object or wrapped in a
+``{"n", "cmd", "rc", "tail"}`` record whose ``tail`` holds the emitted
+line among log noise. This tool accepts both shapes, diffs the newest
+two files (natural name order — ``BENCH_r99`` < ``BENCH_r100``), and
+fails when the headline metric regressed by more than ``threshold``
+(10% default).
+
+Exit codes: 0 = ok / nothing to compare, 1 = regression. Wired as
+``bench.py --compare`` so CI can gate a perf PR with one invocation.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, Optional, Tuple
+
+__all__ = ["load_headline", "run_compare", "main"]
+
+
+def _natural_key(path: str):
+    """Numeric-aware sort key: BENCH_r100 comes after BENCH_r99, not
+    between r10 and r11 as a plain lexicographic sort would put it."""
+    name = os.path.basename(path)
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", name)]
+
+
+def load_headline(path: str) -> Optional[Tuple[str, float]]:
+    """(metric, value) from a BENCH file, or None if unrecognizable."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+        return str(obj["metric"]), float(obj["value"])
+    # harness-wrapped shape: the emitted line is the LAST parseable JSON
+    # object in the captured tail
+    tail = obj.get("tail") if isinstance(obj, dict) else None
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+                return str(rec["metric"]), float(rec["value"])
+    return None
+
+
+def run_compare(bench_dir: str = ".", threshold: float = 0.10,
+                pattern: str = "BENCH_*.json") -> Dict:
+    """Diff the newest two BENCH files; ``ok`` is False only on a real,
+    same-metric regression past the threshold."""
+    files = sorted(glob.glob(os.path.join(bench_dir, pattern)),
+                   key=_natural_key)
+    if len(files) < 2:
+        return {"ok": True,
+                "note": f"need at least two {pattern} files to compare "
+                        f"(found {len(files)})"}
+    prev_path, new_path = files[-2], files[-1]
+    prev = load_headline(prev_path)
+    new = load_headline(new_path)
+    if prev is None or new is None:
+        bad = prev_path if prev is None else new_path
+        return {"ok": True,
+                "note": f"no headline metric parseable from {bad}"}
+    (prev_metric, prev_value), (new_metric, new_value) = prev, new
+    if prev_metric != new_metric:
+        return {"ok": True,
+                "note": f"metric changed ({prev_metric} -> {new_metric}); "
+                        "not comparable",
+                "prev_file": prev_path, "new_file": new_path}
+    delta = ((new_value - prev_value) / prev_value if prev_value
+             else 0.0)
+    return {
+        "ok": delta >= -threshold,
+        "metric": new_metric,
+        "prev_file": os.path.basename(prev_path),
+        "new_file": os.path.basename(new_path),
+        "prev_value": prev_value,
+        "new_value": new_value,
+        "delta_pct": round(delta * 100.0, 2),
+        "threshold_pct": round(threshold * 100.0, 2),
+    }
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    bench_dir = "."
+    threshold = 0.10
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--dir" and i + 1 < len(argv):
+            bench_dir = argv[i + 1]
+            i += 2
+        elif argv[i] == "--threshold" and i + 1 < len(argv):
+            threshold = float(argv[i + 1])
+            i += 2
+        else:
+            i += 1
+    row = run_compare(bench_dir, threshold)
+    print(json.dumps(row))
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

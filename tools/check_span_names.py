@@ -36,6 +36,11 @@ _ROUND_SHAPE = re.compile(
 # compression spans are exactly the two codec phases — anything else
 # under compress/ is taxonomy drift
 _COMPRESS_SHAPE = re.compile(r"^compress/(?:encode|decode)$")
+# run-health namespaces: one segment after the prefix, per-entity
+# dimensions (client id, phase) ride LABELS, never the name — and memory
+# readings are instantaneous by definition, so mem/* must be gauges
+_MEM_SHAPE = re.compile(r"^mem/[a-z0-9_]+$")
+_HEALTH_SHAPE = re.compile(r"^health/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -94,6 +99,24 @@ def check(entries):
                 problems.append(
                     f"{where}: span {name!r} must be compress/encode "
                     "or compress/decode")
+        if kind == "span" and name.startswith(("mem/", "health/")):
+            problems.append(
+                f"{where}: {name!r} — mem/ and health/ are metric "
+                "namespaces, not span names")
+        if kind != "span" and name.startswith("mem/"):
+            if kind != "gauge":
+                problems.append(
+                    f"{where}: {kind} {name!r} — mem/* readings are "
+                    "instantaneous and must be gauges")
+            elif not _MEM_SHAPE.match(name):
+                problems.append(
+                    f"{where}: gauge {name!r} must be mem/<reading> "
+                    "(one segment; device/phase go in labels)")
+        if kind != "span" and name.startswith("health/"):
+            if not _HEALTH_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be health/<signal> "
+                    "(one segment; client ids go in labels)")
         if kind != "span":
             prev = metric_kinds.get(name)
             if prev is not None and prev[0] != kind:
