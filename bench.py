@@ -268,6 +268,18 @@ def main() -> None:
             print(json.dumps(row))
         return
 
+    if "--chaos" in sys.argv:
+        # resilience micro-bench: seam overhead on the hot send path
+        # (< 1% acceptance) + broker kill/restart recovery time — same
+        # ONE-JSON-line contract as --wire/--stage
+        from tools.chaos_bench import run_chaos_bench
+
+        row = run_chaos_bench()
+        print(json.dumps(row))
+        if not (row["ok_overhead"] and row["recovered"]):
+            raise SystemExit(1)
+        return
+
     if "--stage" in sys.argv:
         # staging-path micro-bench (pipelined round engine): staged
         # bytes/s, vectorized assembly ms, prefetch overlap ratio —

@@ -490,6 +490,52 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
     runner.run()
 
 
+@cli.command()
+@click.option("--seed", default=0, show_default=True,
+              help="chaos seed: fault decisions replay bit-identically")
+@click.option("--rounds", default=5, show_default=True)
+@click.option("--clients", default=3, show_default=True)
+@click.option("--kill-rank", default=None, type=int,
+              help="crash this client rank for a round window")
+@click.option("--kill-round", default=2, show_default=True)
+@click.option("--revive-round", default=None, type=int,
+              help="round at which the killed client's network heals "
+                   "[default: kill-round + 1]")
+@click.option("--drop", default=0.0, show_default=True,
+              help="P(drop) per sent message")
+@click.option("--duplicate", default=0.0, show_default=True,
+              help="P(duplicate) per sent message")
+@click.option("--delay-ms", default=0.0, show_default=True,
+              help="injected send delay in milliseconds")
+@click.option("--compression", default="", show_default=True,
+              help="update codec (e.g. int8) — proves recovery paths "
+                   "compose with the compressed transport")
+@click.option("--round-deadline-s", default=30.0, show_default=True)
+@click.option("--round-quorum", default=2.0 / 3.0, show_default=True)
+def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
+          revive_round, drop: float, duplicate: float, delay_ms: float,
+          compression: str, round_deadline_s: float,
+          round_quorum: float) -> None:
+    """Run a seeded chaos scenario against an in-proc federation.
+
+    Injects deterministic faults (message drop/duplicate/delay, client
+    kill for a round window) at the comm boundary and runs a cross-silo
+    federation through the resilience layer: round deadlines + quorum
+    aggregation, dropout/eviction, rejoin resync. Prints ONE JSON line —
+    the same scenario with the same --seed reproduces bit-identically.
+    """
+    from fedml_tpu.resilience import run_chaos_scenario
+
+    out = run_chaos_scenario(
+        seed=seed, rounds=rounds, clients=clients, kill_rank=kill_rank,
+        kill_round=kill_round, revive_round=revive_round, drop=drop,
+        duplicate=duplicate, delay_ms=delay_ms, compression=compression,
+        round_deadline_s=round_deadline_s, round_quorum=round_quorum)
+    click.echo(json.dumps(out))
+    if not out["completed"]:
+        raise SystemExit(1)
+
+
 @cli.group()
 def telemetry() -> None:
     """Inspect a run's telemetry sinks (spans, metrics, traces)."""

@@ -64,6 +64,21 @@ def _recv_frame(sock: socket.socket) -> Optional[bytes]:
     return _recv_exact(sock, n)
 
 
+def _hard_close(sock: socket.socket) -> None:
+    """shutdown + close: a bare close() while another thread is blocked
+    in recv() on the same socket can defer the FIN on some kernels
+    (gVisor), leaving the peer parked forever; shutdown always wakes
+    both sides immediately."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass  # never connected / already down
+    try:
+        sock.close()
+    except OSError:  # pragma: no cover - already closed
+        pass
+
+
 def _pack(op: bytes, topic: str, body: bytes = b"") -> bytes:
     t = topic.encode()
     return op + struct.pack(">H", len(t)) + t + body
@@ -81,6 +96,10 @@ class PubSubBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._srv = socket.create_server((host, port))
+        # a finite accept timeout keeps the loop interruptible: on some
+        # kernels (gVisor) closing a listener does NOT unblock a thread
+        # parked in accept(), which would pin the port against restarts
+        self._srv.settimeout(0.5)
         self._subs: Dict[str, List[socket.socket]] = {}
         self._lock = threading.Lock()
         reg = get_registry()
@@ -112,8 +131,11 @@ class PubSubBroker:
         while not self._stopping.is_set():
             try:
                 conn, _ = self._srv.accept()
+            except TimeoutError:
+                continue  # periodic stop check (see settimeout above)
             except OSError:
                 return
+            conn.settimeout(None)  # serve threads use blocking reads
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -144,7 +166,7 @@ class PubSubBroker:
                 self._wlocks.pop(conn, None)
                 self._m_subscribers.set(
                     sum(len(s) for s in self._subs.values()))
-            conn.close()
+            _hard_close(conn)
 
     def _route(self, topic: str, body: bytes) -> None:
         with self._lock:
@@ -167,10 +189,25 @@ class PubSubBroker:
 
     def stop(self) -> None:
         self._stopping.set()
+        # wake a parked accept() so the close below actually releases the
+        # binding (close-while-blocked leaks the port on some kernels)
+        try:
+            socket.create_connection(self.address, timeout=1).close()
+        except OSError:  # pragma: no cover - already unreachable
+            pass
         try:
             self._srv.close()
         except OSError:
             pass
+        # drop every client connection too: a dead broker has no live
+        # sockets (subscribers must observe the loss to reconnect), and
+        # lingering conns would hold the port against a restart
+        with self._lock:
+            conns = set(self._wlocks)
+            for subs in self._subs.values():
+                conns.update(subs)
+        for conn in conns:
+            _hard_close(conn)
 
 
 class NativePubSubBroker:
@@ -218,12 +255,17 @@ class NativePubSubBroker:
         return self  # the process is already serving
 
     def stop(self) -> None:
+        from subprocess import TimeoutExpired
+
         if self._proc.poll() is None:
             self._proc.terminate()
             try:
                 self._proc.wait(timeout=5)
-            except Exception:
+            except TimeoutExpired:
                 self._proc.kill()
+                # reap the killed process — without this wait the broker
+                # lingers as a zombie for the rest of the test run
+                self._proc.wait()
 
 
 class BrokerClient:
@@ -234,19 +276,41 @@ class BrokerClient:
     body (opaque to both broker implementations); the subscriber strips
     it and activates the context around the handler, so handler-side
     spans stitch into the publisher's trace.
+
+    Resilience: a lost connection is always logged and reported through
+    ``on_disconnect``; with ``reconnect=True`` the reader additionally
+    re-dials the SAME host:port with jittered backoff, resubscribes
+    every topic, and ``publish`` blocks (bounded) for the new socket
+    instead of failing — a broker kill/restart mid-run heals without
+    the federation noticing beyond the retry metrics. Receiver-side
+    dedup of resent frames is the comm manager's job (message ids), not
+    the socket layer's.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 propagate_trace: bool = True):
+                 propagate_trace: bool = True, reconnect: bool = False,
+                 reconnect_attempts: int = 30,
+                 reconnect_max_delay_s: float = 2.0,
+                 on_disconnect: Optional[Callable[[], None]] = None):
+        self._addr = (host, port)
+        self._timeout = float(timeout)
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(None)
         self._handlers: Dict[str, Callable[[bytes], None]] = {}
         self._lock = threading.Lock()
         self._stopping = threading.Event()
+        self._connected = threading.Event()
+        self._connected.set()
         self._propagate = bool(propagate_trace)
+        self._reconnect = bool(reconnect)
+        self._reconnect_attempts = int(reconnect_attempts)
+        self._reconnect_max_delay_s = float(reconnect_max_delay_s)
+        self.on_disconnect = on_disconnect
         reg = get_registry()
         self._m_pub_bytes = reg.counter("broker/client_bytes_out")
         self._m_recv_bytes = reg.counter("broker/client_bytes_in")
+        self._m_disconnects = reg.counter("resilience/broker_disconnects")
+        self._m_reconnects = reg.counter("resilience/broker_reconnects")
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -259,16 +323,89 @@ class BrokerClient:
         if self._propagate and current_context() is not None:
             body = wrap_frame_body(body)
         self._m_pub_bytes.inc(len(body))
-        with self._lock:
-            _send_frame(self._sock, _pack(_OP_PUB, topic, body))
+        # one bounded resend after the reader's reconnect restores the
+        # socket; without reconnect the caller sees the raw OSError
+        for attempt in (0, 1):
+            if self._reconnect and not self._connected.wait(
+                    timeout=self._timeout):
+                raise ConnectionError(
+                    f"broker {self._addr} not reconnected within "
+                    f"{self._timeout}s")
+            try:
+                with self._lock:
+                    sock = self._sock
+                    _send_frame(sock, _pack(_OP_PUB, topic, body))
+                return
+            except OSError:
+                if not self._reconnect or attempt or self._stopping.is_set():
+                    raise
+                # only gate on the reader's reconnect if the socket we
+                # failed on is STILL current — clearing after the reader
+                # already swapped in a healthy socket (and set the
+                # event) would wedge every future publish
+                with self._lock:
+                    if sock is self._sock:
+                        self._connected.clear()  # reader will re-dial
+
+    def _on_connection_lost(self) -> None:
+        self._connected.clear()
+        _hard_close(self._sock)  # release the dead fd before re-dialing
+        self._m_disconnects.inc()
+        logger.warning("broker connection %s lost%s", self._addr,
+                       " - reconnecting" if self._reconnect else "")
+        if self.on_disconnect is not None:
+            try:
+                self.on_disconnect()
+            except Exception:  # pragma: no cover - observer must not kill IO
+                logger.exception("on_disconnect callback failed")
+
+    def _try_reconnect(self) -> bool:
+        """Re-dial with deterministic jittered backoff + resubscribe."""
+        from fedml_tpu.resilience.policy import RetryPolicy
+
+        delays = RetryPolicy(
+            max_attempts=self._reconnect_attempts + 1, base_delay_s=0.05,
+            max_delay_s=self._reconnect_max_delay_s,
+            key=f"broker:{self._addr}").delays()
+        for delay in delays:
+            if self._stopping.is_set():
+                return False
+            time.sleep(delay)
+            try:
+                sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+                sock.settimeout(None)
+            except OSError:
+                continue
+            with self._lock:
+                try:
+                    # a restarted broker has empty subscription state:
+                    # replay every topic BEFORE publishing the socket —
+                    # a half-subscribed socket must not become current
+                    for topic in self._handlers:
+                        _send_frame(sock, _pack(_OP_SUB, topic))
+                except OSError:
+                    _hard_close(sock)  # don't leak the failed dial
+                    continue
+                self._sock = sock
+            self._m_reconnects.inc()
+            self._connected.set()
+            logger.info("broker connection %s restored", self._addr)
+            return True
+        return False
 
     def _read_loop(self) -> None:
         while not self._stopping.is_set():
             try:
                 payload = _recv_frame(self._sock)
             except OSError:
-                return
+                payload = None
             if payload is None:
+                if self._stopping.is_set():
+                    return
+                self._on_connection_lost()
+                if self._reconnect and self._try_reconnect():
+                    continue
                 return
             _, topic, body = _unpack(payload)
             self._m_recv_bytes.inc(len(body))
@@ -285,7 +422,5 @@ class BrokerClient:
 
     def close(self) -> None:
         self._stopping.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._connected.set()  # unblock publishers waiting on a reconnect
+        _hard_close(self._sock)

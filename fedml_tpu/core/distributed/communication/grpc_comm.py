@@ -117,6 +117,10 @@ class GRPCCommManager(BaseCommunicationManager):
         get_registry().counter(
             "comm/wire_bytes_out", labels={"backend": "grpc"}
         ).inc(len(payload))
+        # transient failures surface as grpc.RpcError (UNAVAILABLE /
+        # DEADLINE_EXCEEDED), which FedMLCommManager's retry policy
+        # treats as retryable; wait_for_ready already rides out a peer
+        # that is listening but not yet serving
         self._stub(msg.get_receiver_id())(payload, wait_for_ready=True, timeout=120)
 
     def add_observer(self, observer: Observer) -> None:

@@ -54,10 +54,16 @@ class TcpBrokerClient(PubSubClient):
     context as a ``telemetry_ctx`` param header (FedMLCommManager), and
     stacking the frame envelope on top would propagate the same context
     twice per hop.
+
+    Auto-reconnect is ON for the federation transport (paho does the
+    same under its own loop): a broker kill/restart mid-run re-dials,
+    resubscribes, and resumes delivery; receiver-side message-id dedup
+    (FedMLCommManager) absorbs any resulting resends.
     """
 
-    def __init__(self, host: str, port: int, **_):
-        self._client = BrokerClient(host, port, propagate_trace=False)
+    def __init__(self, host: str, port: int, reconnect: bool = True, **_):
+        self._client = BrokerClient(host, port, propagate_trace=False,
+                                    reconnect=reconnect)
 
     def subscribe(self, topic, handler):
         self._client.subscribe(topic, handler)

@@ -108,7 +108,10 @@ class TRPCCommManager(BaseCommunicationManager):
             _worker_name(receiver), _deliver,
             args=(receiver, payload))
         if not ok:
-            raise RuntimeError(
+            # ConnectionError (not RuntimeError): the peer exists but its
+            # manager isn't up yet / is restarting — exactly the class of
+            # failure FedMLCommManager's backoff retry is meant to absorb
+            raise ConnectionError(
                 f"TRPC peer {receiver} has no live comm manager")
 
     def add_observer(self, observer: Observer) -> None:

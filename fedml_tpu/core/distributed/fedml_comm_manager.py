@@ -37,6 +37,43 @@ class FedMLCommManager(Observer):
         self.message_handler_dict: Dict[str, Callable] = {}
         self._receive_thread: Optional[threading.Thread] = None
         self.handler_error: Optional[BaseException] = None
+        # transport resilience: unique msg ids + receiver-side dedup make
+        # sends idempotent; a bounded backoff retry absorbs transient
+        # transport failures (broker reconnecting, peer restarting);
+        # liveness notes every sender for the dropout/rejoin layer; the
+        # chaos injector (None in production) sits at this same boundary
+        from itertools import count
+        from uuid import uuid4
+
+        from fedml_tpu.resilience import (
+            MessageDeduper,
+            PeerLiveness,
+            ResilienceConfig,
+            chaos_from_args,
+            transient_exceptions,
+        )
+
+        self.resilience = ResilienceConfig(args)
+        self._mgr_uid = uuid4().hex[:8]
+        # precomputed prefix: the msg-id stamp is on the hot send path;
+        # itertools.count is atomic under the GIL — the deadline timer,
+        # heartbeat thread, and receive thread all send concurrently, and
+        # a shared non-atomic seq would mint duplicate ids (the receiver
+        # would then drop a legitimate message as a duplicate)
+        self._msg_id_prefix = f"{self._mgr_uid}:{self.rank}:"
+        self._send_seq = count(1)
+        self._deduper = MessageDeduper()
+        self.liveness = PeerLiveness(
+            silent_after_s=max(30.0,
+                               3 * self.resilience.heartbeat_interval_s))
+        self._send_retry = self.resilience.retry_policy(key=f"rank{rank}")
+        self._retry_on = transient_exceptions()
+        # the authoritative round for windowed chaos faults: the client
+        # FSM's own round_idx, or the server's args.round_idx
+        self._chaos = chaos_from_args(
+            args, self.rank,
+            round_provider=lambda: getattr(
+                self, "round_idx", getattr(self.args, "round_idx", None)))
         if self.com_manager is None:
             self._init_manager()
         self.com_manager.add_observer(self)
@@ -84,14 +121,32 @@ class FedMLCommManager(Observer):
         return self.rank
 
     def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        from fedml_tpu import telemetry
+        from fedml_tpu.telemetry import flight_recorder
+
+        # chaos inbound filter: a partitioned/killed peer's in-flight
+        # messages must not leak through the cut (None in production)
+        if self._chaos is not None and not self._chaos.on_deliver(msg_params):
+            return
+        # receiver-side dedup: transport resends (reconnect replays,
+        # sender retries after an uncertain failure) carry the SAME
+        # msg_id and must be applied exactly once
+        msg_id = msg_params.get(Message.MSG_ARG_KEY_MSG_ID)
+        if msg_id is not None and self._deduper.seen(msg_id):
+            telemetry.get_registry().counter(
+                "resilience/duplicates_dropped").inc()
+            flight_recorder.record("duplicate_dropped", rank=self.rank,
+                                   msg_type=str(msg_type), msg_id=msg_id)
+            logger.debug("rank %d: duplicate %s dropped (%s)",
+                         self.rank, msg_type, msg_id)
+            return
+        self.liveness.note(msg_params.get_sender_id())
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.warning("rank %d: no handler for %s", self.rank, msg_type)
             return
         # re-activate the sender's trace context (injected by send_message)
         # so this rank's handler spans stitch into the sender's timeline
-        from fedml_tpu import telemetry
-        from fedml_tpu.telemetry import flight_recorder
 
         rnd = msg_params.get("round")
         flight_recorder.record(
@@ -161,7 +216,40 @@ class FedMLCommManager(Observer):
                 reg.counter("comm/raw_bytes").inc(raw)
             except TypeError:
                 pass  # not a tree of arrays
-        self.com_manager.send_message(message)
+        # idempotent-send header: stamped once per logical message (a
+        # retried send reuses it, so the receiver's deduper catches the
+        # case where the first attempt DID land)
+        if message.get(Message.MSG_ARG_KEY_MSG_ID) is None:
+            message.add_params(Message.MSG_ARG_KEY_MSG_ID,
+                               self._msg_id_prefix + str(next(self._send_seq)))
+        copies, delay_s = (1, 0.0) if self._chaos is None else (
+            self._chaos.on_send(message))
+        if delay_s > 0:
+            import time as _time
+
+            _time.sleep(delay_s)
+        for _ in range(copies):
+            self._send_with_retry(message)
+
+    def _send_with_retry(self, message: Message) -> None:
+        """One transport send under the jittered-backoff retry policy."""
+        from fedml_tpu import telemetry
+
+        reg = telemetry.get_registry()
+
+        def on_retry(attempt: int, exc: BaseException) -> None:
+            reg.counter("resilience/send_retries").inc()
+            telemetry.flight_recorder.record(
+                "send_retry", rank=self.rank, attempt=attempt,
+                msg_type=message.get_type(), error=repr(exc))
+
+        try:
+            self._send_retry.call(
+                lambda: self.com_manager.send_message(message),
+                retry_on=self._retry_on, on_retry=on_retry)
+        except self._retry_on:
+            reg.counter("resilience/send_failures").inc()
+            raise
 
     def register_message_receive_handler(self, msg_type: str, handler: Callable) -> None:
         self.message_handler_dict[str(msg_type)] = handler

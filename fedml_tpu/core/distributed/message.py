@@ -34,6 +34,13 @@ class Message:
     # wall, train loss, live memory bytes) — rides existing status and
     # model-upload messages, never its own round-trip
     MSG_ARG_KEY_HEALTH = "health"
+    # idempotent-send header: unique per logical message, stamped once by
+    # FedMLCommManager.send_message and preserved across transport-level
+    # resends so the receiver's deduper can drop duplicate deliveries
+    MSG_ARG_KEY_MSG_ID = "msg_id"
+    # rejoin marker on a server->client resync after an eviction: the
+    # client must reset per-identity compression state (EF residuals)
+    MSG_ARG_KEY_REJOIN = "rejoin"
 
     def __init__(self, type_: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type_)

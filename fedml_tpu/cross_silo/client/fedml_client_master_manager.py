@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import platform
+import threading
 import time
 from typing import Any
 
@@ -43,6 +44,11 @@ class ClientMasterManager(FedMLCommManager):
         self._error_feedback = None
         self._global_ref = None
         self._last_train_ms = None
+        # resilience: optional periodic heartbeat (liveness signal that
+        # survives long local epochs and drives rejoin detection after a
+        # partition heals); started once the connection is up
+        self._heartbeat_thread = None
+        self._finished = threading.Event()
 
     def _heartbeat_fields(self) -> dict:
         """JSON-safe health scalars piggybacked on existing messages —
@@ -82,12 +88,41 @@ class ClientMasterManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish
         )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_REJOIN_SYNC, self.handle_message_rejoin_sync
+        )
 
     # -- handlers ----------------------------------------------------------
     def handle_message_connection_ready(self, msg: Message) -> None:
         if not self.has_sent_online_msg:
             self.has_sent_online_msg = True
             self.send_client_status(0)
+            self._start_heartbeat()
+
+    def _start_heartbeat(self) -> None:
+        """Periodic liveness heartbeat (heartbeat_interval_s > 0): keeps
+        the server's last-seen fresh through long local epochs, and is
+        the client's own path back in after a partition heals (the first
+        heartbeat that gets through triggers the server's rejoin)."""
+        interval = self.resilience.heartbeat_interval_s
+        if interval <= 0 or self._heartbeat_thread is not None:
+            return
+
+        def beat() -> None:
+            from fedml_tpu.telemetry import get_registry
+
+            m_sent = get_registry().counter("resilience/heartbeats_sent")
+            while not self._finished.wait(interval):
+                try:
+                    self.send_client_status(0)
+                    m_sent.inc()
+                except Exception:
+                    logger.debug("heartbeat send failed (transport down?)",
+                                 exc_info=True)
+
+        self._heartbeat_thread = threading.Thread(
+            target=beat, name=f"heartbeat-{self.rank}", daemon=True)
+        self._heartbeat_thread.start()
 
     def handle_message_check_status(self, msg: Message) -> None:
         self.send_client_status(msg.get_sender_id())
@@ -127,15 +162,47 @@ class ClientMasterManager(FedMLCommManager):
         self.__train(global_params)
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        new_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx + 1))
+        if new_round > self.round_idx + 1 and self._error_feedback is not None:
+            # rounds were missed (dropout without a rejoin resync): the
+            # EF residual belongs to a stale global reference — carrying
+            # it forward would leak pre-gap quantization error
+            logger.info("client %d skipped rounds %d..%d; resetting EF",
+                        self.rank, self.round_idx + 1, new_round - 1)
+            self._error_feedback.reset()
         global_params = self._receive_global_model(msg)
         data_silo_idx = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
-        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx + 1))
+        self.round_idx = new_round
         self.trainer_dist_adapter.update_dataset(int(data_silo_idx))
         self.__train(global_params)
+
+    def handle_message_rejoin_sync(self, msg: Message) -> None:
+        """Dropout/rejoin: the server re-admitted this client. Catch up to
+        the current global round + model WITHOUT training (we re-enter
+        the cohort at the next selection), and reset the error-feedback
+        residual — compression state must not leak across the client's
+        pre-crash and post-rejoin identities."""
+        from fedml_tpu.telemetry import get_registry
+
+        self._receive_global_model(msg)  # sets _global_ref + negotiation
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                     self.round_idx))
+        if self._error_feedback is not None:
+            self._error_feedback.reset()
+        get_registry().counter("resilience/rejoin_syncs").inc()
+        logger.info("client %d re-synced at round %d after rejoin",
+                    self.rank, self.round_idx)
 
     def handle_message_finish(self, msg: Message) -> None:
         logger.debug("client %d finished", self.rank)
         self.finish()
+
+    def finish(self) -> None:
+        # every shutdown path (FINISH message, harness error/timeout
+        # shutdown) must stop the heartbeat thread, or it keeps sending
+        # into a dead transport for the rest of the process
+        self._finished.set()
+        super().finish()
 
     # -- actions -----------------------------------------------------------
     def send_client_status(self, receive_id: int, status: str = None) -> None:

@@ -10,6 +10,11 @@ class MyMessage:
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT"
     MSG_TYPE_S2C_FINISH = "MSG_TYPE_S2C_FINISH"
     MSG_TYPE_S2C_CHECK_CLIENT_STATUS = "MSG_TYPE_S2C_CHECK_CLIENT_STATUS"
+    # dropout/rejoin: re-sync an evicted client that reconnected with the
+    # CURRENT global round + model; the client updates its state and
+    # resets per-identity compression residuals but does NOT train —
+    # it re-enters the cohort at the next round's selection
+    MSG_TYPE_S2C_REJOIN_SYNC = "MSG_TYPE_S2C_REJOIN_SYNC"
 
     # client → server
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = "MSG_TYPE_C2S_SEND_MODEL_TO_SERVER"

@@ -98,6 +98,24 @@ class FedMLAggregator:
             self.flag_client_model_uploaded_dict[i] = False
         return True
 
+    def n_received(self) -> int:
+        """Uploads staged for the current round (the quorum count)."""
+        return len(self.model_dict)
+
+    def close_round_quorum(self, expected: int) -> List[int]:
+        """Close a round on quorum instead of all-received: reset the
+        per-position upload flags (``check_whether_all_receive_subset``
+        only resets them on the full-cohort path) and return the cohort
+        positions that never reported. ``aggregate()`` then reduces the
+        received subset — ``FedMLAggOperator`` normalizes sample weights
+        over exactly that subset, which IS the reweighting for the
+        missing cohort."""
+        missing = [i for i in range(expected)
+                   if not self.flag_client_model_uploaded_dict.get(i, False)]
+        for i in range(expected):
+            self.flag_client_model_uploaded_dict[i] = False
+        return missing
+
     def _resolve_compressed(
         self, raw_list: List[Tuple[int, Pytree]]
     ) -> Tuple[List[Tuple[int, Pytree]], Optional[Pytree]]:

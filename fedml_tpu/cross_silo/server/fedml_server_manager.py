@@ -88,6 +88,22 @@ class FedMLServerManager(FedMLCommManager):
         self._devstats = DeviceStatsSampler()
         self._bcast_ts: Dict[int, float] = {}
 
+        # round deadlines + quorum aggregation: with round_deadline_s
+        # configured, a dead client can no longer hang a round — the
+        # deadline (static ceiling, tightened by straggler EWMAs once
+        # history exists) fires, the round closes on a quorum of uploads
+        # (sample weights renormalize over the received subset), and the
+        # missing clients are evicted until they reconnect
+        import threading
+
+        from fedml_tpu.resilience import RoundDeadline
+
+        self._round_lock = threading.Lock()
+        self._round_closed = False
+        self._deadline_expired = False
+        self._deadline_extensions_used = 0
+        self._deadline = RoundDeadline(self._on_round_deadline)
+
     # -- lifecycle ---------------------------------------------------------
     def run(self) -> None:
         super().run()
@@ -123,6 +139,10 @@ class FedMLServerManager(FedMLCommManager):
 
         global_params = self.aggregator.get_global_model_params()
         payload = self._broadcast_payload(global_params)
+        with self._round_lock:
+            self._round_closed = False
+            self._deadline_expired = False
+            self._deadline_extensions_used = 0
         # the open span's context rides each init message, so every
         # client's training span joins this round's server-side trace
         with telemetry.get_tracer().span(
@@ -142,6 +162,7 @@ class FedMLServerManager(FedMLCommManager):
                                    self._codec.spec)
                 self._bcast_ts[client_id] = time.time()
                 self.send_message(msg)
+        self._arm_round_deadline()
         mlops.log({"event": "server.init_sent", "round": 0})
 
     def register_message_receive_handlers(self) -> None:
@@ -173,6 +194,11 @@ class FedMLServerManager(FedMLCommManager):
         hb = msg.get(Message.MSG_ARG_KEY_HEALTH)
         if isinstance(hb, dict):
             self._health.heartbeat(msg.get_sender_id(), hb)
+        # any sign of life from an evicted client is its reconnect
+        if self.is_initialized and self.liveness.is_evicted(
+                msg.get_sender_id()):
+            self._readmit_client(msg.get_sender_id())
+            return
         if status == MyMessage.MSG_CLIENT_STATUS_IDLE:
             self.client_online_status[msg.get_sender_id()] = True
         all_online = all(
@@ -196,8 +222,20 @@ class FedMLServerManager(FedMLCommManager):
 
     def _select_round_clients(self) -> None:
         client_ids = list(range(1, self.client_num + 1))
+        # dropout: evicted clients sit out selection until they rejoin;
+        # probe them each round so a revived client has a deterministic
+        # path back in (its status reply triggers the rejoin resync)
+        evicted = set(self.liveness.evicted())
+        if evicted:
+            client_ids = [c for c in client_ids if c not in evicted]
+            if not client_ids:
+                raise RuntimeError(
+                    "every client is evicted; federation cannot make "
+                    "progress (check round_deadline_s / network health)")
+            self._probe_evicted(sorted(evicted))
         self.client_id_list_in_this_round = self.aggregator.client_selection(
-            self.args.round_idx, client_ids, int(self.args.client_num_per_round)
+            self.args.round_idx, client_ids,
+            min(int(self.args.client_num_per_round), len(client_ids))
         )
         silo_indexes = self.aggregator.data_silo_selection(
             self.args.round_idx,
@@ -212,21 +250,156 @@ class FedMLServerManager(FedMLCommManager):
         sender = msg.get_sender_id()
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        self._observe_client_upload(sender, msg, model_params)
-        self.aggregator.add_local_trained_result(
-            self.client_id_list_in_this_round.index(sender), model_params,
-            local_sample_num, local_steps=msg.get("local_steps"),
-        )
-        if not self.aggregator.check_whether_all_receive_subset(
-            len(self.client_id_list_in_this_round)
-        ):
+        msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
+        with self._round_lock:
+            cohort = list(self.client_id_list_in_this_round or [])
+            stale = (
+                self._round_closed
+                or sender not in cohort
+                or (msg_round is not None
+                    and int(msg_round) != int(self.args.round_idx))
+            )
+            if stale:
+                pass  # logged below, outside the lock
+            else:
+                self._observe_client_upload(sender, msg, model_params)
+                self.aggregator.add_local_trained_result(
+                    cohort.index(sender), model_params,
+                    local_sample_num, local_steps=msg.get("local_steps"),
+                )
+                missing = self._try_close_round(cohort)
+        if stale:
+            # a quorum round already closed (or the sender was never in
+            # this cohort): the upload is stale — logged, counted, never
+            # applied. A stale upload from an evicted client is also its
+            # sign of life, so it re-enters via the rejoin path.
+            self._resilience_event(
+                "stale_upload", client=sender,
+                upload_round=msg_round, server_round=self.args.round_idx,
+                counter="resilience/stale_uploads")
+            logger.warning(
+                "dropping stale upload from client %s (round %s, server at "
+                "round %s)", sender, msg_round, self.args.round_idx)
+            if self.liveness.is_evicted(sender):
+                self._readmit_client(sender)
             return
+        if missing is not None:
+            self._finish_round(missing)
 
+    def _try_close_round(self, cohort) -> Optional[list]:
+        """Under ``_round_lock``: close the round if complete. Returns the
+        missing cohort ids (possibly []) once closed, else None.
+
+        Completion = all expected uploads arrived, OR the deadline
+        expired and at least the quorum arrived.
+        """
+        from fedml_tpu.resilience import quorum_size
+
+        expected = len(cohort)
+        received = self.aggregator.n_received()
+        if received < expected:
+            if not (self._deadline_expired
+                    and received >= quorum_size(
+                        expected, self.resilience.round_quorum)):
+                return None
+        missing_idx = self.aggregator.close_round_quorum(expected)
+        self._round_closed = True
+        self._deadline.cancel()
+        return [cohort[i] for i in missing_idx]
+
+    def _on_round_deadline(self, round_idx: int) -> None:
+        """Timer-thread path: the armed round ran out of wall clock."""
+        from fedml_tpu.resilience import quorum_size
+
+        with self._round_lock:
+            if (self._round_closed or not self.is_initialized
+                    or int(round_idx) != int(self.args.round_idx)):
+                return  # the round closed normally; stale fire
+            self._deadline_expired = True
+            cohort = list(self.client_id_list_in_this_round or [])
+            missing = self._try_close_round(cohort)
+            received = self.aggregator.n_received()
+            extended = False
+            if missing is None:
+                # below quorum: any later upload that reaches quorum
+                # closes the round (the handler re-checks), but a
+                # federation that never gets there must NOT revert to
+                # wait-forever — re-arm a bounded number of times, then
+                # abort loudly. Bookkeeping + re-arm stay under the
+                # round lock: an unlocked re-arm could race the round
+                # closing and cancel the NEXT round's fresh deadline.
+                self._deadline_extensions_used += 1
+                extended = (self._deadline_extensions_used
+                            <= self.resilience.deadline_extensions)
+                if extended:
+                    self._deadline.arm(round_idx,
+                                       self.resilience.round_deadline_s)
+        need = quorum_size(len(cohort), self.resilience.round_quorum)
+        self._resilience_event(
+            "deadline_expired", round=round_idx, received=received,
+            expected=len(cohort), quorum=need,
+            counter="resilience/deadline_fired")
+        if missing is None:
+            if extended:
+                logger.warning(
+                    "round %d deadline expired with %d/%d uploads (< "
+                    "quorum %d); extension %d/%d armed", round_idx,
+                    received, len(cohort), need,
+                    self._deadline_extensions_used,
+                    self.resilience.deadline_extensions)
+                return
+            self._abort_federation(
+                f"round {round_idx} stuck below quorum: {received}/"
+                f"{len(cohort)} uploads after "
+                f"{self.resilience.deadline_extensions} deadline "
+                f"extensions (need {need})")
+            return
+        logger.warning(
+            "round %d closing on quorum: %d/%d uploads, missing %s",
+            round_idx, received, len(cohort), missing)
+        # the timer thread has no receive_message wrapper around it: an
+        # exception escaping _finish_round here would hit
+        # threading.excepthook and hang the federation silently instead
+        # of failing it loudly
+        try:
+            self._finish_round(missing)
+        except BaseException as e:  # noqa: BLE001 - must surface, not hang
+            logger.exception("round advance failed on the deadline path")
+            self._abort_federation(
+                f"round advance failed after quorum close: {e!r}")
+
+    def _abort_federation(self, reason: str) -> None:
+        """Turn an unrecoverable stall into a loud failure: record it,
+        surface it as a handler error (the in-proc harness and any
+        supervisor watch that), and stop the receive loop."""
+        logger.error("aborting federation: %s", reason)
+        self._resilience_event("federation_aborted", reason=reason,
+                               counter="resilience/aborts")
+        from fedml_tpu.telemetry import flight_recorder
+
+        err = RuntimeError(reason)
+        flight_recorder.get_flight_recorder().dump(reason="federation_abort",
+                                                   exc=err)
+        self.handler_error = err
+        self.com_manager.stop_receive_message()
+
+    def _finish_round(self, missing_clients: list) -> None:
+        """Aggregate the received cohort and advance the FSM — the shared
+        tail of the all-received and quorum paths."""
         from fedml_tpu import telemetry
 
+        if missing_clients:
+            telemetry.get_registry().counter(
+                "resilience/quorum_rounds").inc()
+            for cid in missing_clients:
+                if self.liveness.evict(cid):
+                    self._resilience_event(
+                        "evicted", client=cid, round=self.args.round_idx,
+                        counter="resilience/clients_evicted")
         tracer = telemetry.get_tracer()
         with tracer.span(f"round/{self.args.round_idx}/aggregate",
-                         n_clients=len(self.client_id_list_in_this_round)):
+                         n_clients=len(self.client_id_list_in_this_round)
+                         - len(missing_clients)):
             global_params = self.aggregator.aggregate()
         self._health.finish_round(self.args.round_idx)
         self._devstats.sample("aggregate", self.args.round_idx)
@@ -253,6 +426,10 @@ class FedMLServerManager(FedMLCommManager):
 
         self._select_round_clients()
         payload = self._broadcast_payload(global_params)
+        with self._round_lock:
+            self._round_closed = False
+            self._deadline_expired = False
+            self._deadline_extensions_used = 0
         with tracer.span(f"round/{self.args.round_idx}/sync",
                          n_clients=len(self.client_id_list_in_this_round)):
             for client_id in self.client_id_list_in_this_round:
@@ -268,6 +445,90 @@ class FedMLServerManager(FedMLCommManager):
                                  self._codec.spec)
                 self._bcast_ts[client_id] = time.time()
                 self.send_message(m)
+        self._arm_round_deadline()
+
+    # -- resilience helpers ------------------------------------------------
+    def _probe_evicted(self, client_ids: list) -> None:
+        """Fire-and-forget status probes to evicted (likely dead) peers.
+
+        Off-thread and failure-swallowing on purpose: a probe to a dead
+        grpc/trpc peer blocks for its connect timeout x retry budget,
+        and the round-advance path must not stall (or crash) on clients
+        that are the reason we're probing in the first place."""
+        import threading
+
+        def probe() -> None:
+            for cid in client_ids:
+                try:
+                    self.send_message(Message(
+                        MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS,
+                        self.get_sender_id(), cid))
+                except Exception:
+                    logger.debug("probe to evicted client %s failed "
+                                 "(still down)", cid, exc_info=True)
+
+        threading.Thread(target=probe, name="evicted-probe",
+                         daemon=True).start()
+
+    def _arm_round_deadline(self) -> None:
+        cfg = self.resilience
+        if not cfg.deadline_enabled:
+            return
+        from fedml_tpu.resilience import adaptive_deadline_s
+
+        timeout = cfg.round_deadline_s
+        if cfg.deadline_adaptive:
+            # straggler-EWMA adaptive: never fires early on a cold
+            # compile-heavy round (no history -> the static ceiling)
+            timeout = adaptive_deadline_s(
+                self._health.snapshot()["latency_ewma_s"],
+                cfg.deadline_multiplier, cfg.deadline_grace_s,
+                cfg.deadline_min_s, cfg.round_deadline_s)
+        self._deadline.arm(int(self.args.round_idx), timeout)
+
+    def _readmit_client(self, client_id: int) -> None:
+        """Dropout/rejoin: an evicted client reconnected — re-admit it and
+        re-sync it with the CURRENT global round + model. The rejoin
+        marker makes the client reset its per-identity compression state
+        (EF residuals), so residuals from its pre-crash life can't leak
+        into post-rejoin uploads. It re-enters the cohort at the next
+        selection."""
+        if not self.liveness.readmit(client_id):
+            return
+        self._resilience_event(
+            "rejoined", client=client_id, round=self.args.round_idx,
+            counter="resilience/clients_rejoined")
+        logger.info("client %s rejoined at round %s", client_id,
+                    self.args.round_idx)
+        m = Message(MyMessage.MSG_TYPE_S2C_REJOIN_SYNC,
+                    self.get_sender_id(), client_id)
+        # plain (uncompressed) model: the rejoiner only needs the current
+        # state to catch up — encoding here would clobber the in-flight
+        # round's delta base; it gets the codec path again at next sync
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                     self.aggregator.get_global_model_params())
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+        m.add_params(Message.MSG_ARG_KEY_REJOIN, True)
+        if self._codec is not None:
+            m.add_params(Message.MSG_ARG_KEY_COMPRESSION, self._codec.spec)
+        self.send_message(m)
+
+    def _resilience_event(self, event: str, counter: Optional[str] = None,
+                          **fields) -> None:
+        """One resilience event, landed everywhere the doctor looks:
+        resilience/* counter, health.jsonl record, flight recorder."""
+        from fedml_tpu import telemetry
+        from fedml_tpu.telemetry import flight_recorder
+        from fedml_tpu.telemetry.health import log_health_event
+
+        if counter:
+            telemetry.get_registry().counter(counter).inc()
+        rec = {"kind": "resilience_event", "event": event, **fields}
+        try:
+            log_health_event(rec)
+        except Exception:  # pragma: no cover - observability must not kill
+            logger.exception("resilience event logging failed")
+        flight_recorder.record("resilience_event", event=event, **fields)
 
     def _observe_client_upload(self, sender: int, msg: Message,
                                model_params) -> None:
@@ -299,3 +560,7 @@ class FedMLServerManager(FedMLCommManager):
         for client_id in range(1, self.client_num + 1):
             m = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), client_id)
             self.send_message(m)
+
+    def finish(self) -> None:
+        self._deadline.cancel()
+        super().finish()

@@ -1,0 +1,58 @@
+"""Fault-tolerance subsystem — the layer that *survives* a dying run.
+
+PR 4's health layer diagnoses stragglers and anomalies; this package is
+what keeps the federation making progress when clients crash, brokers
+restart, and uploads stall — the standard partial-participation /
+unreliable-client setting of production FL (FedAvg partial
+participation; Bonawitz et al.'s cross-device system design):
+
+- :mod:`policy` — jittered exponential backoff (:class:`RetryPolicy`)
+  and the per-run :class:`ResilienceConfig` read off the args;
+- :mod:`dedup` — receiver-side :class:`MessageDeduper` so idempotent
+  resends can never double-apply an upload;
+- :mod:`liveness` — :class:`PeerLiveness`, heartbeat-driven last-seen
+  tracking with eviction windows;
+- :mod:`quorum` — :class:`RoundDeadline` (static or straggler-EWMA
+  adaptive per-round timer) + :func:`quorum_size`;
+- :mod:`chaos` — :class:`ChaosInjector`, a seeded deterministic fault
+  injector at the comm boundary (drop/delay/duplicate messages, kill a
+  client for a round window, partition the broker), exposed as
+  ``fedml_tpu chaos``.
+
+Everything lands in the ``resilience/*`` metric namespace (one segment
+after the prefix, entities in labels — lint-enforced) plus
+``resilience_event`` records in ``health.jsonl`` and the flight
+recorder, which is what ``telemetry doctor``'s connectivity section
+reads.
+"""
+from fedml_tpu.resilience.chaos import (
+    ChaosInjector,
+    chaos_from_args,
+    run_chaos_scenario,
+)
+from fedml_tpu.resilience.dedup import MessageDeduper
+from fedml_tpu.resilience.liveness import PeerLiveness
+from fedml_tpu.resilience.policy import (
+    ResilienceConfig,
+    RetryPolicy,
+    transient_exceptions,
+)
+from fedml_tpu.resilience.quorum import (
+    RoundDeadline,
+    adaptive_deadline_s,
+    quorum_size,
+)
+
+__all__ = [
+    "ChaosInjector",
+    "chaos_from_args",
+    "run_chaos_scenario",
+    "MessageDeduper",
+    "PeerLiveness",
+    "ResilienceConfig",
+    "RetryPolicy",
+    "transient_exceptions",
+    "RoundDeadline",
+    "adaptive_deadline_s",
+    "quorum_size",
+]

@@ -1,0 +1,264 @@
+"""Seeded deterministic fault injection at the comm boundary.
+
+Every recovery path in this subsystem is only trustworthy if its
+failure is *reproducible*. The injector therefore never consults a
+wall-clock RNG: probabilistic faults (drop/duplicate/delay) hash
+``(seed, rank, receiver, msg_type, per-peer send sequence)`` — in a
+deterministic FSM the k-th message a rank sends to a peer is the same
+message every run — and windowed faults (kill a client, partition
+ranks) trigger on the authoritative *round number*, not on time.
+
+Spec (``args.chaos`` — dict or JSON string; ``args.chaos_seed``)::
+
+    chaos:
+      drop: 0.05            # P(drop) per sent message
+      duplicate: 0.05       # P(send twice) — dedup's job to absorb
+      delay_ms: 20          # hold the send thread this long
+      delay: 0.1            # P(delay) per sent message
+      kill:                 # crash client 2 for rounds [2, 3)
+        rank: 2
+        round: 2
+        revive_round: 3
+      partition:            # or: split arbitrary rank sets
+        ranks: [1, 2]
+        round: 1
+        heal_round: 3
+
+Faults are injected sender-side (deterministic sequence) except the
+kill/partition window, which also filters inbound delivery so a "dead"
+peer's in-flight messages cannot leak through. ``fedml_tpu chaos`` runs
+a full in-proc cross-silo federation under a spec and prints one JSON
+summary line (:func:`run_chaos_scenario`).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from fedml_tpu.resilience.policy import _unit_hash
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosSpec:
+    def __init__(self, spec: Optional[Dict] = None, seed: int = 0):
+        spec = dict(spec or {})
+        self.seed = int(seed)
+        self.drop = float(spec.get("drop", 0.0))
+        self.duplicate = float(spec.get("duplicate", 0.0))
+        self.delay_ms = float(spec.get("delay_ms", 0.0))
+        self.delay = float(spec.get("delay", 1.0 if self.delay_ms else 0.0))
+        # kill is sugar for a single-rank partition
+        partitions: List[Dict] = []
+        kill = spec.get("kill")
+        if kill:
+            partitions.append({
+                "ranks": [int(kill["rank"])],
+                "round": int(kill.get("round", 0)),
+                "heal_round": int(kill.get("revive_round",
+                                           kill.get("heal_round", 1 << 30))),
+            })
+        part = spec.get("partition")
+        if part:
+            partitions.append({
+                "ranks": [int(r) for r in part.get("ranks", [])],
+                "round": int(part.get("round", 0)),
+                "heal_round": int(part.get("heal_round", 1 << 30)),
+            })
+        self.partitions = partitions
+
+    @property
+    def any_probabilistic(self) -> bool:
+        return self.drop > 0 or self.duplicate > 0 or (
+            self.delay > 0 and self.delay_ms > 0)
+
+    @classmethod
+    def parse(cls, raw: Any, seed: int = 0) -> Optional["ChaosSpec"]:
+        if raw is None or raw == "" or raw is False:
+            return None
+        if isinstance(raw, str):
+            raw = json.loads(raw)
+        if not isinstance(raw, dict):
+            raise ValueError(f"chaos spec must be a dict/JSON object, "
+                             f"got {type(raw).__name__}")
+        return cls(raw, seed=seed)
+
+
+class ChaosInjector:
+    """Per-manager injector consulted by ``FedMLCommManager`` on every
+    send and delivery. ``round_provider`` supplies the authoritative
+    round for windowed faults (the server's ``args.round_idx``; clients
+    fall back to the message's own ``round`` header when present)."""
+
+    def __init__(self, spec: ChaosSpec, rank: int,
+                 round_provider: Optional[Callable[[], int]] = None):
+        self.spec = spec
+        self.rank = int(rank)
+        self.round_provider = round_provider
+        self._seq: Dict[Tuple[str, int], int] = {}
+        from fedml_tpu.telemetry import get_registry
+
+        self._m_injected = lambda action: get_registry().counter(
+            "resilience/chaos_injections", labels={"action": action}).inc()
+
+    # -- helpers -----------------------------------------------------------
+    def _round_of(self, msg: Any) -> Optional[int]:
+        rnd = msg.get("round")
+        if rnd is None and self.round_provider is not None:
+            try:
+                rnd = self.round_provider()
+            except Exception:  # pragma: no cover - provider is best-effort
+                rnd = None
+        try:
+            return int(rnd) if rnd is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def _partitioned(self, a: int, b: int, rnd: Optional[int]) -> bool:
+        if rnd is None:
+            return False
+        for p in self.spec.partitions:
+            if p["round"] <= rnd < p["heal_round"]:
+                ranks = set(p["ranks"])
+                if (a in ranks) != (b in ranks):  # across the cut
+                    return True
+        return False
+
+    def _roll(self, kind: str, peer: int, seq: int) -> float:
+        return _unit_hash(self.spec.seed, kind, self.rank, peer, seq)
+
+    # -- comm-boundary hooks ----------------------------------------------
+    def on_send(self, msg: Any) -> Tuple[int, float]:
+        """Decide a send's fate: ``(copies, delay_s)`` — 0 copies = drop,
+        2 = duplicate. Deterministic per (seed, peer, send sequence)."""
+        peer = int(msg.get_receiver_id())
+        seq = self._seq[("send", peer)] = self._seq.get(("send", peer), 0) + 1
+        if self._partitioned(self.rank, peer, self._round_of(msg)):
+            self._m_injected("partition_drop")
+            return 0, 0.0
+        copies, delay_s = 1, 0.0
+        if self.spec.drop and self._roll("drop", peer, seq) < self.spec.drop:
+            self._m_injected("drop")
+            return 0, 0.0
+        if self.spec.duplicate and (
+                self._roll("dup", peer, seq) < self.spec.duplicate):
+            self._m_injected("duplicate")
+            copies = 2
+        if self.spec.delay_ms and (
+                self._roll("delay", peer, seq) < self.spec.delay):
+            self._m_injected("delay")
+            delay_s = self.spec.delay_ms / 1e3
+        return copies, delay_s
+
+    def on_deliver(self, msg: Any) -> bool:
+        """Inbound filter: False = swallow (the sender was partitioned
+        from us when this message would have crossed the cut)."""
+        sender = int(msg.get_sender_id())
+        if self._partitioned(self.rank, sender, self._round_of(msg)):
+            self._m_injected("partition_drop")
+            return False
+        return True
+
+
+def chaos_from_args(args: Any, rank: int,
+                    round_provider: Optional[Callable[[], int]] = None
+                    ) -> Optional[ChaosInjector]:
+    """The comm manager's constructor hook: None unless ``args.chaos``
+    is configured, so the production hot path stays a None-check."""
+    spec = ChaosSpec.parse(getattr(args, "chaos", None),
+                           seed=int(getattr(args, "chaos_seed", 0)))
+    if spec is None:
+        return None
+    return ChaosInjector(spec, rank, round_provider=round_provider)
+
+
+# -- the `fedml_tpu chaos` scenario runner ---------------------------------
+def run_chaos_scenario(
+    seed: int = 0,
+    rounds: int = 5,
+    clients: int = 3,
+    kill_rank: Optional[int] = None,
+    kill_round: int = 2,
+    revive_round: Optional[int] = None,
+    drop: float = 0.0,
+    duplicate: float = 0.0,
+    delay_ms: float = 0.0,
+    compression: str = "",
+    round_deadline_s: float = 30.0,
+    round_quorum: float = 2.0 / 3.0,
+    timeout: float = 300.0,
+) -> Dict:
+    """Run an in-proc cross-silo federation under a chaos spec; return a
+    JSON-safe summary (shared by the CLI and the recovery tests)."""
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.cross_silo.run_inproc import run_cross_silo_inproc
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.telemetry import get_registry
+
+    chaos: Dict[str, Any] = {}
+    if kill_rank is not None:
+        chaos["kill"] = {
+            "rank": int(kill_rank), "round": int(kill_round),
+            "revive_round": int(revive_round if revive_round is not None
+                                else kill_round + 1)}
+    if drop:
+        chaos["drop"] = float(drop)
+    if duplicate:
+        chaos["duplicate"] = float(duplicate)
+    if delay_ms:
+        chaos["delay_ms"] = float(delay_ms)
+    cfg = {
+        "common_args": {"training_type": "cross_silo", "random_seed": seed,
+                        "run_id": f"chaos_{seed}"},
+        "data_args": {"dataset": "synthetic", "train_size": 60 * clients,
+                      "test_size": 60, "class_num": 4, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": clients,
+            "client_num_per_round": clients,
+            "comm_round": rounds, "epochs": 1, "batch_size": 32,
+            "learning_rate": 0.3,
+            "round_deadline_s": round_deadline_s,
+            "round_quorum": round_quorum,
+            "chaos": chaos, "chaos_seed": seed,
+            **({"compression": compression} if compression else {}),
+        },
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    reg = get_registry()
+
+    def grab(name: str) -> float:
+        total = 0.0
+        for rec in reg.snapshot():
+            if rec.get("name") == name:
+                total += float(rec.get("value", rec.get("count", 0)) or 0)
+        return total
+
+    before = {n: grab(n) for n in (
+        "resilience/quorum_rounds", "resilience/clients_evicted",
+        "resilience/clients_rejoined", "resilience/stale_uploads",
+        "resilience/duplicates_dropped", "resilience/chaos_injections")}
+    t0 = time.time()
+    result = run_cross_silo_inproc(args, ds, model, timeout=timeout)
+    wall_s = time.time() - t0
+    from fedml_tpu.telemetry import flush_run
+
+    # land the registry snapshot in the run dir so `telemetry doctor`'s
+    # connectivity section sees the resilience/* counters
+    flush_run()
+    return {
+        "seed": int(seed), "rounds": int(rounds), "clients": int(clients),
+        "chaos": chaos, "wall_s": round(wall_s, 3),
+        "completed": result is not None,
+        "result": {k: (round(float(v), 6) if isinstance(v, (int, float))
+                       else v) for k, v in (result or {}).items()},
+        "counters": {n.split("/")[1]: grab(n) - v
+                     for n, v in before.items()},
+    }

@@ -1,0 +1,41 @@
+"""Receiver-side message dedup — the other half of idempotent resend.
+
+A sender that retries a publish it *might* have delivered (socket died
+mid-``sendall``, broker restarted between accept and fan-out) can only
+be safe if the receiver drops the second copy. Every federation message
+carries a ``msg_id`` header (stamped by ``FedMLCommManager``); this
+bounded LRU set answers "seen it?" in O(1) without growing with run
+length.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class MessageDeduper:
+    """Bounded LRU membership set keyed by message id (thread-safe: the
+    comm receive thread and transport callback threads both touch it)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(1, int(capacity))
+        self._seen: "OrderedDict[str, None]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.duplicates = 0
+
+    def seen(self, msg_id: str) -> bool:
+        """Record ``msg_id``; True if it was already recorded (drop it)."""
+        key = str(msg_id)
+        with self._lock:
+            if key in self._seen:
+                self._seen.move_to_end(key)
+                self.duplicates += 1
+                return True
+            self._seen[key] = None
+            while len(self._seen) > self.capacity:
+                self._seen.popitem(last=False)
+            return False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._seen)

@@ -1,0 +1,69 @@
+"""Heartbeat-driven peer liveness — last-seen tracking with eviction.
+
+Fed from two sources, both free of extra round-trips: every message a
+peer sends (``FedMLCommManager.receive_message`` notes the sender) and
+the periodic client heartbeat thread when ``heartbeat_interval_s`` is
+configured. The server's dropout/rejoin FSM asks two questions: "who
+went silent?" (eviction sweep) and "is this sender someone we evicted?"
+(rejoin detection).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class PeerLiveness:
+    """Last-seen timestamps per peer + an explicit evicted set.
+
+    Eviction is *policy-driven by the caller* (missed round deadline, or
+    a silent-window sweep) — this class only keeps the bookkeeping
+    consistent under concurrent comm/timer threads.
+    """
+
+    def __init__(self, silent_after_s: float = 30.0):
+        self.silent_after_s = float(silent_after_s)
+        self._lock = threading.Lock()
+        self._last_seen: Dict[Any, float] = {}
+        self._evicted: Dict[Any, float] = {}  # peer -> evicted-at ts
+
+    def note(self, peer: Any, now: Optional[float] = None) -> None:
+        with self._lock:
+            self._last_seen[peer] = time.time() if now is None else now
+
+    def last_seen(self, peer: Any) -> Optional[float]:
+        with self._lock:
+            return self._last_seen.get(peer)
+
+    def silent_peers(self, now: Optional[float] = None) -> List[Any]:
+        """Peers seen at least once whose silence exceeds the window and
+        that are not already evicted."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return sorted(
+                p for p, ts in self._last_seen.items()
+                if now - ts > self.silent_after_s and p not in self._evicted)
+
+    # -- eviction / rejoin -------------------------------------------------
+    def evict(self, peer: Any) -> bool:
+        """Mark evicted; False if it already was."""
+        with self._lock:
+            if peer in self._evicted:
+                return False
+            self._evicted[peer] = time.time()
+            return True
+
+    def is_evicted(self, peer: Any) -> bool:
+        with self._lock:
+            return peer in self._evicted
+
+    def readmit(self, peer: Any) -> bool:
+        """Clear the evicted mark on reconnect; False if it wasn't set."""
+        with self._lock:
+            self._last_seen[peer] = time.time()
+            return self._evicted.pop(peer, None) is not None
+
+    def evicted(self) -> List[Any]:
+        with self._lock:
+            return sorted(self._evicted)

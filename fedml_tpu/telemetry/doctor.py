@@ -297,6 +297,74 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         notes.setdefault("services",
                          "no data: no serving/* or scheduler/* metrics")
 
+    # -- connectivity (resilience/* counters + resilience_event records) --
+    from fedml_tpu.telemetry.report import load_metrics
+
+    # telemetry.jsonl holds append-mode CUMULATIVE registry snapshots:
+    # keep the LATEST record per (name, labels) — like report.py does —
+    # then sum across label sets (e.g. chaos_injections per action)
+    latest: Dict[Any, float] = {}
+    for rec in load_metrics(run_dir):
+        name = rec.get("name", "")
+        if name.startswith("resilience/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    res_counters: Dict[str, float] = {}
+    for (name, _), val in latest.items():
+        key = name.split("/", 1)[1]
+        res_counters[key] = res_counters.get(key, 0.0) + val
+    res_events = [e for e in health_events
+                  if e.get("kind") == "resilience_event"]
+    # episode pairing IN EVENT ORDER: each eviction opens a new episode
+    # and clears any earlier rejoin — a client that dropped out AGAIN
+    # after rejoining must surface as unresolved, not as recovered
+    evict_round: Dict[str, Any] = {}
+    rejoin_round: Dict[str, Any] = {}
+    for e in res_events:
+        cid = str(e.get("client"))
+        if e.get("event") == "evicted":
+            evict_round[cid] = e.get("round")
+            rejoin_round.pop(cid, None)
+        elif e.get("event") == "rejoined" and cid in evict_round:
+            rejoin_round[cid] = e.get("round")
+    connectivity: Dict[str, Any] = {
+        "counters": res_counters,
+        "events": res_events[-16:],
+        "evicted_clients": evict_round,
+        "rejoined_clients": rejoin_round,
+    }
+    if res_counters.get("quorum_rounds"):
+        verdict.append(
+            f"{res_counters['quorum_rounds']:.0f} round(s) closed on "
+            "quorum after the deadline — the missing clients' uploads "
+            "were reweighted out (see evicted/rejoined below)")
+    for cid, r in sorted(evict_round.items()):
+        if cid in rejoin_round:
+            verdict.append(
+                f"client {cid} dropped out at round {r} and rejoined at "
+                f"round {rejoin_round[cid]}")
+        else:
+            verdict.append(
+                f"client {cid} dropped out at round {r} and NEVER "
+                "rejoined — check its process/network")
+    disc = res_counters.get("broker_disconnects", 0.0)
+    reco = res_counters.get("broker_reconnects", 0.0)
+    if disc > reco:
+        verdict.append(
+            f"{disc - reco:.0f} broker connection(s) lost and never "
+            "restored — transport died before the run finished")
+    if res_counters.get("send_failures"):
+        verdict.append(
+            f"{res_counters['send_failures']:.0f} send(s) exhausted their "
+            "retry budget — messages were LOST (raise send_max_retries "
+            "or fix the transport)")
+    if not res_counters and not res_events:
+        notes.setdefault(
+            "connectivity",
+            "no data: no resilience/* metrics or resilience_event records "
+            "(run predates the resilience layer, or nothing went wrong)")
+
     if not (fr_events or health_events or report["n_spans"]
             or report.get("n_metrics")):
         notes["run"] = f"no telemetry data of any kind under {run_dir}"
@@ -314,6 +382,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "memory": memory,
         "compression": compression,
         "services": services,
+        "connectivity": connectivity,
         "verdict": verdict,
     }
 
@@ -402,6 +471,21 @@ def format_doctor(d: Dict) -> str:
             f"(p50 {o['p50_ms']:.1f} ms)")
     if not comp.get("raw_bytes") and not comp.get("wire_counters"):
         add(f"  {notes.get('compression', 'no data')}")
+
+    add("")
+    add("connectivity (disconnects / retries / quorum / dropout-rejoin):")
+    conn = d.get("connectivity") or {}
+    counters = conn.get("counters") or {}
+    if counters:
+        for name, v in sorted(counters.items()):
+            add(f"  resilience/{name:<33s}{v:>14.0f}")
+    for cid, r in sorted((conn.get("evicted_clients") or {}).items()):
+        rj = (conn.get("rejoined_clients") or {}).get(cid)
+        add(f"  client {cid}: evicted at round {r}, "
+            + (f"rejoined at round {rj}" if rj is not None
+               else "never rejoined"))
+    if not counters and not conn.get("events"):
+        add(f"  {notes.get('connectivity', 'no data')}")
 
     add("")
     add("service health:")

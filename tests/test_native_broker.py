@@ -128,6 +128,17 @@ def test_native_broker_survives_protocol_violation(native_broker):
     a.close()
 
 
+def test_native_broker_stop_reaps_process():
+    """Satellite: stop() must wait out (or kill + reap) the child — a
+    zombie broker process surviving a test run is the failure mode the
+    narrowed TimeoutExpired handling closes."""
+    b = NativePubSubBroker(port=0).start()
+    b.stop()
+    # reaped: returncode recorded, no zombie left behind
+    assert b._proc.returncode is not None
+    b.stop()  # idempotent on an already-dead child
+
+
 def test_native_broker_handles_many_subscribers():
     b = NativePubSubBroker(port=0).start()
     try:

@@ -356,13 +356,18 @@ def test_sigterm_flight_dump_matches_checkpoint(tmp_path):
     assert ckpt_events, "no checkpoint events reached the ring"
     last_recorded = ckpt_events[-1]["round"]
     durable = RoundCheckpointer(ckpt_dir).latest_round()
-    assert last_recorded == durable, (
+    # the ring records a checkpoint only AFTER its save completed, so a
+    # recorded round is always durable — but SIGTERM can land inside the
+    # save-returned→event-recorded window, leaving the ring one save
+    # behind. The resume hint stays valid either way (that checkpoint
+    # exists); what must never happen is the ring running AHEAD of disk.
+    assert last_recorded in (durable, durable - 1), (
         f"flight recorder says round {last_recorded}, checkpointer has "
         f"round {durable}")
     # the doctor reads the same dump and names the death + resume point
     doctor = telemetry.build_doctor(str(tmp_path / "run_sigterm"))
     assert doctor["crash"]["reason"] == "sigterm"
-    assert doctor["crash"]["last_checkpoint_round"] == durable
+    assert doctor["crash"]["last_checkpoint_round"] == last_recorded
     assert any("died" in v and "sigterm" in v for v in doctor["verdict"])
 
 

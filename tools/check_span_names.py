@@ -41,6 +41,10 @@ _COMPRESS_SHAPE = re.compile(r"^compress/(?:encode|decode)$")
 # readings are instantaneous by definition, so mem/* must be gauges
 _MEM_SHAPE = re.compile(r"^mem/[a-z0-9_]+$")
 _HEALTH_SHAPE = re.compile(r"^health/[a-z0-9_]+$")
+# resilience namespace: same one-segment rule (client ids, chaos actions
+# and backends are labels); counters or gauges only — retry/reconnect/
+# quorum signals are occurrence counts, not latency distributions
+_RESILIENCE_SHAPE = re.compile(r"^resilience/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -99,10 +103,11 @@ def check(entries):
                 problems.append(
                     f"{where}: span {name!r} must be compress/encode "
                     "or compress/decode")
-        if kind == "span" and name.startswith(("mem/", "health/")):
+        if kind == "span" and name.startswith(
+                ("mem/", "health/", "resilience/")):
             problems.append(
-                f"{where}: {name!r} — mem/ and health/ are metric "
-                "namespaces, not span names")
+                f"{where}: {name!r} — mem/, health/ and resilience/ are "
+                "metric namespaces, not span names")
         if kind != "span" and name.startswith("mem/"):
             if kind != "gauge":
                 problems.append(
@@ -117,6 +122,16 @@ def check(entries):
                 problems.append(
                     f"{where}: {kind} {name!r} must be health/<signal> "
                     "(one segment; client ids go in labels)")
+        if kind != "span" and name.startswith("resilience/"):
+            if not _RESILIENCE_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be resilience/<signal> "
+                    "(one segment; clients/actions/backends go in labels)")
+            elif kind == "histogram":
+                problems.append(
+                    f"{where}: {kind} {name!r} — resilience/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
+                    "histograms")
         if kind != "span":
             prev = metric_kinds.get(name)
             if prev is not None and prev[0] != kind:
