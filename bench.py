@@ -280,6 +280,19 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--tree" in sys.argv:
+        # hierarchical-federation bench: a seeded 3-tier 100k-client
+        # aggregation tree on this machine — rounds/s, peak wire bytes
+        # per tier, peak host RSS (one JSON line, env-tunable via
+        # FEDML_TREE_*; see tools/tree_bench.py)
+        from tools.tree_bench import run_tree_bench
+
+        row = run_tree_bench()
+        print(json.dumps(row))
+        if not (row["completed"] and row["ok_no_f32_trees"]):
+            raise SystemExit(1)
+        return
+
     if "--stage" in sys.argv:
         # staging-path micro-bench (pipelined round engine): staged
         # bytes/s, vectorized assembly ms, prefetch overlap ratio —

@@ -536,6 +536,62 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
         raise SystemExit(1)
 
 
+@cli.command()
+@click.option("--clients", default=100_000, show_default=True,
+              help="virtual leaf clients in the cohort")
+@click.option("--tiers", default=3, show_default=True,
+              help="tree depth incl. root and leaves")
+@click.option("--rounds", default=2, show_default=True)
+@click.option("--params", default=256, show_default=True,
+              help="virtual model size (elements)")
+@click.option("--codec", default="int8", show_default=True,
+              help="wire codec at every tier (identity/bf16/int8/topk)")
+@click.option("--seed", default=0, show_default=True,
+              help="scenario seed: two runs reproduce bit-identically")
+@click.option("--quorum", default=2.0 / 3.0, show_default=True,
+              help="per-cohort close fraction")
+@click.option("--kill-tier", default=None, type=int,
+              help="chaos: tier of the node to kill (e.g. 1 = edge)")
+@click.option("--kill-node", default=0, show_default=True)
+@click.option("--kill-round", default=1, show_default=True)
+@click.option("--revive-round", default=None, type=int,
+              help="round the killed node comes back [default: +1]")
+def tree(clients: int, tiers: int, rounds: int, params: int, codec: str,
+         seed: int, quorum: float, kill_tier, kill_node: int,
+         kill_round: int, revive_round) -> None:
+    """Run a seeded hierarchical (aggregation-tree) federation scenario.
+
+    Simulates an N-tier tree in-process: virtual leaf clients upload
+    compressed deltas, edge aggregators forward partial sums in the
+    compressed block domain, every tier closes on quorum and survives
+    chaos kills. Prints ONE JSON line — the same scenario with the same
+    --seed reproduces bit-identically.
+    """
+    from fedml_tpu.hierarchy import (
+        KillWindow,
+        TreeRunner,
+        TreeTopology,
+        default_template,
+    )
+
+    chaos = []
+    if kill_tier is not None:
+        chaos.append(KillWindow(kill_tier, kill_node, kill_round,
+                                until=revive_round))
+    runner = TreeRunner(
+        TreeTopology.build(clients, tiers=tiers),
+        template=default_template(params), codec=codec, seed=seed,
+        quorum=quorum, chaos=chaos)
+    try:
+        out = runner.run(rounds)
+    except RuntimeError as e:
+        click.echo(json.dumps({"completed": False, "error": str(e)}))
+        raise SystemExit(1)
+    click.echo(json.dumps(out))
+    if not out["completed"]:
+        raise SystemExit(1)
+
+
 @cli.group()
 def telemetry() -> None:
     """Inspect a run's telemetry sinks (spans, metrics, traces)."""

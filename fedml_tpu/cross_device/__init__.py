@@ -1,11 +1,17 @@
-"""Cross-device ("BeeHive") engine: server + on-device client runtime."""
+"""Cross-device ("BeeHive") engine: server + on-device client runtime.
+
+Flat cohorts run the cross-silo FSM with device clients; planet-scale
+cohorts (``hierarchy_tiers`` configured) route through the hierarchical
+federation subsystem — see :func:`run_hierarchical` and
+:mod:`fedml_tpu.hierarchy`.
+"""
 from fedml_tpu.cross_device.client import (
     DeviceClient,
     FedMLBaseTrainer,
     JaxDeviceTrainer,
     build_device_client,
 )
-from fedml_tpu.cross_device.server import ServerCrossDevice
+from fedml_tpu.cross_device.server import ServerCrossDevice, run_hierarchical
 
 __all__ = [
     "DeviceClient",
@@ -13,4 +19,5 @@ __all__ = [
     "JaxDeviceTrainer",
     "ServerCrossDevice",
     "build_device_client",
+    "run_hierarchical",
 ]

@@ -213,6 +213,13 @@ class DeviceClient:
 def build_device_client(args: Any) -> DeviceClient:
     """Assemble a device client from flat args: local data shard + model
     apply fn + JaxDeviceTrainer + wire manager."""
+    if int(getattr(args, "hierarchy_tiers", 0) or 0) >= 2:
+        raise NotImplementedError(
+            "hierarchy_tiers is set, but device clients do not speak the "
+            "aggregation-tree wire protocol yet (they would need an edge "
+            "aggregator to upload to) — simulate the cohort with "
+            "fedml_tpu.cross_device.run_hierarchical(args) / "
+            "fedml_tpu.hierarchy.TreeRunner (CLI: `fedml_tpu tree`)")
     from fedml_tpu import models as models_mod
     from fedml_tpu.data import load_federated
 

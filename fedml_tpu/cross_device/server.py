@@ -12,6 +12,15 @@ clients speak the same typed-message wire format (pickle-free, see
 pytree deltas instead of ``.mnn`` files. The device-side runtime
 (FedMLBaseTrainer engine seam, JAX engine, plain + SecAgg managers) is
 :mod:`fedml_tpu.cross_device.client`.
+
+Cohorts beyond what one flat FSM can carry route through the
+hierarchical federation subsystem (:mod:`fedml_tpu.hierarchy`):
+``hierarchy_tiers >= 2`` in the config selects :func:`run_hierarchical`,
+which simulates the whole aggregation tree (compressed partial sums,
+per-tier quorum/evict/rejoin, chaos) in-process. Wire-level tree
+deployment — real edge-aggregator processes between the phones and the
+root — is the part that does not exist yet, and the flat server refuses
+hierarchy configs loudly instead of silently running flat.
 """
 from __future__ import annotations
 
@@ -20,11 +29,50 @@ from typing import Any
 from fedml_tpu.cross_silo.server.server import Server
 
 
+def run_hierarchical(args: Any) -> dict:
+    """Run a cross-device cohort as an in-process aggregation tree.
+
+    Reads the flat args: ``client_num_in_total`` (virtual cohort size),
+    ``hierarchy_tiers`` (tree depth, default 3), ``compression`` (wire
+    codec at every tier, default int8), ``round_quorum`` (per-cohort
+    close fraction), ``comm_round`` (global rounds),
+    ``hierarchy_params`` (virtual model size), ``hierarchy_ef``
+    (stacked per-client error feedback — small cohorts only). Returns
+    the :class:`~fedml_tpu.hierarchy.TreeRunner` scenario stats.
+    """
+    from fedml_tpu import telemetry
+    from fedml_tpu.hierarchy import TreeRunner, TreeTopology, default_template
+
+    telemetry.configure_from_args(args)
+    topo = TreeTopology.build(
+        int(getattr(args, "client_num_in_total", 1000)),
+        tiers=int(getattr(args, "hierarchy_tiers", 3) or 3))
+    runner = TreeRunner(
+        topo,
+        template=default_template(int(getattr(args, "hierarchy_params",
+                                              1024))),
+        codec=str(getattr(args, "compression", "") or "int8"),
+        seed=int(getattr(args, "random_seed", 0)),
+        quorum=float(getattr(args, "round_quorum", 1.0) or 1.0),
+        ef=bool(getattr(args, "hierarchy_ef", False)),
+    )
+    stats = runner.run(int(getattr(args, "comm_round", 1)))
+    telemetry.flush_run()
+    return stats
+
+
 class ServerCrossDevice(Server):
     """Cross-device aggregation server (cross-silo FSM, device clients)."""
 
     def __init__(self, args: Any, device: Any, dataset: Any, model: Any,
                  server_aggregator=None):
+        if int(getattr(args, "hierarchy_tiers", 0) or 0) >= 2:
+            raise NotImplementedError(
+                "hierarchy_tiers is set, but the flat cross-device server "
+                "FSM does not drive wire-level aggregation trees yet — "
+                "run the in-process tree engine instead: "
+                "fedml_tpu.cross_device.run_hierarchical(args) / "
+                "fedml_tpu.hierarchy.TreeRunner (CLI: `fedml_tpu tree`)")
         # device clients are never co-scheduled as mesh slices: force the
         # federation transport (broker/grpc/local), never 'sp'/'mesh'
         super().__init__(args, device, dataset, model, server_aggregator)

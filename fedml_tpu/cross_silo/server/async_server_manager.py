@@ -1,19 +1,43 @@
-"""Asynchronous FedAvg server FSM (FedAsync-style).
+"""Asynchronous FedAvg server FSM (FedAsync + FedBuff).
 
 Parity: ``simulation/mpi/async_fedavg/`` in the reference — the only
 asynchronous variant it ships. Here async aggregation is a first-class
-cross-silo server: there is NO round barrier. Each client update is
-applied the moment it arrives,
+cross-silo server: there is NO round barrier.
 
-    x ← (1 − α_s)·x + α_s·x_i,   α_s = α·(1 + staleness)^(−a)
+Two modes:
 
-(polynomial staleness discount, Xie et al. '19), and the *same* client is
-immediately handed the new model for its next local round. A lost client
-therefore slows nothing down — the exact failure mode that stalls the
-synchronous FSM's ``check_whether_all_receive``.
+- **Instant apply** (``async_buffer_size`` ≤ 1, the legacy FedAsync
+  path): each client update is applied the moment it arrives,
 
-Budget: ``async_total_updates`` applied updates (default
-comm_round × client_num), then test + finish.
+      x ← (1 − α_s)·x + α_s·x_i,   α_s = α·(1 + staleness)^(−a)
+
+  (polynomial staleness discount, Xie et al. '19). Delta-encoded
+  compressed uploads apply as ``x ← x + α_s·decode(Δ_i)``.
+
+- **Buffered (FedBuff**, Nguyen et al. '22**)** (``async_buffer_size``
+  = K > 1): contributions collect in a bounded buffer and apply in ONE
+  fused program when it fills — compressed delta blocks reduce through
+  the dequant-fused weighted sum with staleness weights ``n_i/sqrt(1+τ_i)``
+  (see :mod:`fedml_tpu.hierarchy.fedbuff`), then
+  ``x ← x + η·Σw̄ᵢΔᵢ``. A buffer of fresh (τ=0) contributions is
+  exactly a synchronous FedAvg round; the flush is arrival-order
+  independent bit-wise.
+
+Either way the reporting client is immediately handed the current model
+for its next local round, so a lost client slows nothing down — the
+exact failure mode that stalls the synchronous FSM's
+``check_whether_all_receive``.
+
+The server advertises the configured codec (negotiation header) so
+clients upload compressed deltas; the broadcast itself ships plain (the
+async server re-broadcasts per-client at different versions, so there is
+no once-per-round encode to amortize). The only upload that is refused
+is a compressed FULL model from a non-broadcast-safe codec (a
+topk-sparsified model is not a model) — that codec genuinely cannot
+ride the async path.
+
+Budget: ``async_total_updates`` applied contributions (default
+comm_round × client_num), then final partial flush + test + finish.
 """
 from __future__ import annotations
 
@@ -50,13 +74,34 @@ class AsyncFedMLServerManager(FedMLCommManager):
         self.total_updates = int(getattr(
             args, "async_total_updates",
             int(getattr(args, "comm_round", 1)) * client_num))
-        self.version = 0  # server model version == #applied updates
+        self.version = 0  # server model version: one bump per applied step
+        self.applied = 0  # contributions consumed toward the budget
         self.staleness_seen: list = []
         self.senders_seen: list = []  # participation skew diagnostics
         self.client_online_status: Dict[int, bool] = {}
         self.is_initialized = False
         self.finishing = False
         self.result: Optional[dict] = None
+
+        # compressed update transport: advertise the codec so clients
+        # upload delta-encoded compressed updates (never under SecAgg —
+        # a different manager class anyway)
+        from fedml_tpu.compression import get_codec
+
+        self._codec = None
+        if not bool(getattr(args, "secure_aggregation", False)):
+            self._codec = get_codec(getattr(args, "compression", ""), args)
+
+        # FedBuff: K > 1 buffers contributions and applies them fused
+        self.buffer_size = int(getattr(args, "async_buffer_size", 0) or 0)
+        self.server_lr = float(getattr(args, "async_server_lr", 1.0))
+        self._buffer = None
+        self.flushes = 0
+        if self.buffer_size > 1:
+            from fedml_tpu.hierarchy.fedbuff import FedBuffBuffer
+
+            self._buffer = FedBuffBuffer(
+                self.buffer_size, staleness_exponent=self.staleness_exp)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -90,26 +135,78 @@ class AsyncFedMLServerManager(FedMLCommManager):
                 m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
                 m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
                 m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.version)
+                if self._codec is not None:
+                    m.add_params(Message.MSG_ARG_KEY_COMPRESSION,
+                                 self._codec.spec)
                 self.send_message(m)
 
     # -- async hot path ----------------------------------------------------
+    def _apply_instant(self, w_client, is_delta: bool,
+                       staleness: int) -> None:
+        """Legacy FedAsync step: staleness-discounted mix (full model) or
+        staleness-discounted delta add (compressed-delta upload)."""
+        a = self.alpha * (1.0 + staleness) ** (-self.staleness_exp)
+        x = self.aggregator.get_global_model_params()
+        if is_delta:
+            mixed = jax.tree.map(
+                lambda g, d: g + a * d.astype(jax.numpy.asarray(g).dtype)
+                if jax.numpy.issubdtype(jax.numpy.asarray(g).dtype,
+                                        jax.numpy.floating) else d,
+                x, w_client)
+        else:
+            mixed = jax.tree.map(lambda g, c: (1.0 - a) * g + a * c,
+                                 x, w_client)
+        self.aggregator.set_global_model_params(mixed)
+        self.version += 1
+
+    def _flush_buffer(self) -> None:
+        """Apply the FedBuff buffer as one fused staleness-weighted step."""
+        from fedml_tpu.telemetry import flight_recorder
+
+        x = self.aggregator.get_global_model_params()
+        new_global, stats = self._buffer.flush(self.version, x)
+        if self.server_lr != 1.0:
+            new_global = jax.tree.map(
+                lambda g, n: g + self.server_lr * (n - g)
+                if jax.numpy.issubdtype(jax.numpy.asarray(g).dtype,
+                                        jax.numpy.floating) else n,
+                x, new_global)
+        self.aggregator.set_global_model_params(new_global)
+        self.version += 1
+        self.flushes += 1
+        flight_recorder.record("fedbuff_flush", round=self.version,
+                               flushed=stats["flushed"],
+                               mean_staleness=stats["mean_staleness"])
+
     def handle_client_update(self, msg: Message) -> None:
         if self.finishing:
             return
         sender = msg.get_sender_id()
         w_client = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        n_samples = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1) or 1)
         from fedml_tpu.compression import CompressedTree, get_codec
 
+        is_delta = False
         if isinstance(w_client, CompressedTree):
-            # the async server never advertises a codec (it retains no
-            # per-client base model to resolve deltas against), so a
-            # delta here means a misconfigured peer — fail loud rather
-            # than mixing against the wrong base
+            codec = get_codec(w_client.codec)
             if w_client.is_delta:
+                is_delta = True
+                if self._buffer is None:
+                    # instant path applies the decoded delta directly;
+                    # the buffered path keeps the blocks for the fused
+                    # flush
+                    w_client = codec.decode(w_client)
+            elif not codec.broadcast_safe:
+                # the one genuinely impossible upload: a sparsified FULL
+                # model (topk drops 1-ratio of the weights — that is a
+                # different model, not a compressed one)
                 raise ValueError(
-                    "async server cannot apply delta-encoded updates; "
-                    "disable compression= for async_aggregation runs")
-            w_client = get_codec(w_client.codec).decode(w_client)
+                    f"async server cannot apply a {codec.spec!r} "
+                    "compressed FULL model: upload-only codecs must ride "
+                    "as deltas (the negotiation header enables that); "
+                    "use compression=identity/bf16/int8 or delta uploads")
+            else:
+                w_client = codec.decode(w_client)
         base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
         staleness = max(0, self.version - base_version)
         # staleness is the async FSM's health signal: a client whose
@@ -121,22 +218,29 @@ class AsyncFedMLServerManager(FedMLCommManager):
             float(staleness))
         flight_recorder.record("async_update", round=self.version,
                                sender=sender, staleness=staleness)
-        a = self.alpha * (1.0 + staleness) ** (-self.staleness_exp)
-        x = self.aggregator.get_global_model_params()
-        mixed = jax.tree.map(lambda g, c: (1.0 - a) * g + a * c, x, w_client)
-        self.aggregator.set_global_model_params(mixed)
-        self.version += 1
+        self.applied += 1
         self.staleness_seen.append(staleness)
         self.senders_seen.append(sender)
 
-        if self.version >= self.total_updates:
+        if self._buffer is not None:
+            self._buffer.add(sender, base_version, n_samples, w_client)
+            telemetry.get_registry().gauge(
+                "health/async_buffer_fill").set(len(self._buffer))
+            if self._buffer.full or self.applied >= self.total_updates:
+                self._flush_buffer()
+        else:
+            self._apply_instant(w_client, is_delta, staleness)
+
+        if self.applied >= self.total_updates:
             self.finishing = True
             metrics = self.aggregator.test_on_server_for_all_clients(self.version)
-            mlops.log({"async_updates": self.version,
+            mlops.log({"async_updates": self.applied,
                        "mean_staleness": float(
                            sum(self.staleness_seen) / len(self.staleness_seen)),
                        **metrics})
-            self.result = {"updates": self.version,
+            self.result = {"updates": self.applied,
+                           "versions": self.version,
+                           "flushes": self.flushes,
                            "staleness": list(self.staleness_seen),
                            "senders": list(self.senders_seen), **metrics}
             for cid in range(1, self.client_num + 1):
@@ -153,4 +257,6 @@ class AsyncFedMLServerManager(FedMLCommManager):
                      self.aggregator.get_global_model_params())
         m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, sender - 1)
         m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.version)
+        if self._codec is not None:
+            m.add_params(Message.MSG_ARG_KEY_COMPRESSION, self._codec.spec)
         self.send_message(m)

@@ -1,0 +1,52 @@
+"""Hierarchical federation: aggregation trees + buffered-async (FedBuff).
+
+The planet-scale half of the cross-device story. Two compositions over
+the PR 3 compressed transport and the PR 5 resilience machinery:
+
+- **Aggregation trees** — leaf clients upload compressed deltas to edge
+  aggregators; every tier reduces its cohort with the dequant-fused
+  weighted sum and forwards a :class:`~fedml_tpu.hierarchy.partial_sum.
+  PartialSum` (re-encoded blocks + accumulated weight) upward — no tier
+  ever materializes a per-contributor f32 tree. Each cohort closes on
+  all-received or quorum, evicts the missing and readmits rejoiners
+  (EF residual reset at the edge). :class:`TreeRunner` simulates a
+  100k+-client N-tier federation in one process, with chaos kill
+  windows at any tier and per-tier ``tier/<d>/...`` telemetry.
+
+- **FedBuff** (:mod:`fedml_tpu.hierarchy.fedbuff`) — bounded buffer of
+  K delta contributions, staleness-weighted ``1/sqrt(1+τ)``, applied in
+  one fused program when the buffer fills; the async cross-silo server
+  (``cross_silo/server/async_server_manager.py``) rides it for
+  compressed-delta uploads.
+
+CLI: ``fedml_tpu tree`` runs a seeded scenario and prints one JSON line;
+``python bench.py --tree`` measures the 100k-client claim. See
+``docs/hierarchy.md``.
+"""
+from fedml_tpu.hierarchy.edge import EdgeAggregator, LeafCohort
+from fedml_tpu.hierarchy.fedbuff import FedBuffBuffer, staleness_weight
+from fedml_tpu.hierarchy.partial_sum import (
+    PartialSum,
+    compressed_nbytes,
+    finalize_root,
+    flat_reference,
+    reduce_cohort,
+)
+from fedml_tpu.hierarchy.runner import KillWindow, TreeRunner, default_template
+from fedml_tpu.hierarchy.tree import TreeTopology
+
+__all__ = [
+    "EdgeAggregator",
+    "FedBuffBuffer",
+    "KillWindow",
+    "LeafCohort",
+    "PartialSum",
+    "TreeRunner",
+    "TreeTopology",
+    "compressed_nbytes",
+    "default_template",
+    "finalize_root",
+    "flat_reference",
+    "reduce_cohort",
+    "staleness_weight",
+]

@@ -1,0 +1,152 @@
+"""Buffered asynchronous aggregation (FedBuff, Nguyen et al. 2022).
+
+The synchronous tree closes rounds; FedBuff closes *buffers*: the server
+collects K delta contributions — each tagged with the model version it
+was trained against — and applies them in one fused step,
+
+    x ← x + η · Σᵢ wᵢ·Δᵢ / Σᵢ wᵢ,     wᵢ = nᵢ · s(τᵢ),  s(τ) = (1+τ)^(-a)
+
+where τᵢ is the contribution's staleness (server versions advanced since
+its base) and ``a = 0.5`` gives the paper's ``1/sqrt(1+τ)`` discount.
+At τ = 0 the weight reduces to the plain sample count, so a buffer of
+fresh contributions is EXACTLY a synchronous FedAvg step.
+
+Determinism: the flush sorts contributions by ``(base_version, sender,
+seq)`` before the fused reduction, so arrival-order races (the async
+server's whole point) cannot change the aggregate bit-wise — the same
+set of contributions flushes to the same result regardless of the order
+the transport delivered them.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression import CompressedTree, get_codec
+from fedml_tpu.compression.codecs import fused_weighted_sum, tree_delta
+
+Pytree = Any
+
+__all__ = ["FedBuffBuffer", "staleness_weight"]
+
+
+def staleness_weight(tau: float, exponent: float = 0.5) -> float:
+    """Polynomial staleness discount ``(1+τ)^(-exponent)``.
+
+    ``staleness_weight(0) == 1.0`` (a fresh contribution carries full
+    synchronous-FedAvg weight) and the discount decays monotonically.
+    """
+    return float((1.0 + max(0.0, float(tau))) ** (-float(exponent)))
+
+
+class _Entry:
+    __slots__ = ("sender", "base_version", "n_samples", "payload", "seq")
+
+    def __init__(self, sender, base_version, n_samples, payload, seq):
+        self.sender = int(sender)
+        self.base_version = int(base_version)
+        self.n_samples = float(n_samples)
+        self.payload = payload
+        self.seq = int(seq)
+
+
+class FedBuffBuffer:
+    """Bounded buffer of (possibly compressed) delta contributions.
+
+    ``add`` accepts either a delta-encoded :class:`CompressedTree` (the
+    compressed transport's native upload) or a plain full model tree
+    (compression off) — plain models are converted to deltas against the
+    CURRENT global at flush, which makes the τ=0 full-buffer flush equal
+    a synchronous FedAvg round in both modes.
+    """
+
+    def __init__(self, capacity: int, staleness_exponent: float = 0.5):
+        self.capacity = max(1, int(capacity))
+        self.staleness_exponent = float(staleness_exponent)
+        self._entries: List[_Entry] = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def full(self) -> bool:
+        return len(self._entries) >= self.capacity
+
+    def add(self, sender: int, base_version: int, n_samples: float,
+            payload: Any) -> None:
+        if self.full:
+            raise RuntimeError(
+                f"FedBuff buffer overflow (capacity {self.capacity}); "
+                "flush before adding")
+        if isinstance(payload, CompressedTree) and not payload.is_delta:
+            raise ValueError(
+                "FedBuff buffers delta contributions; got a compressed "
+                "FULL model (decode it first or enable delta uploads)")
+        self._entries.append(_Entry(sender, base_version, n_samples,
+                                    payload, next(self._seq)))
+
+    def flush(self, current_version: int,
+              global_params: Pytree) -> Tuple[Pytree, Dict]:
+        """Apply the buffer: returns ``(new_global, stats)``.
+
+        Homogeneous compressed entries reduce through the dequant-fused
+        weighted sum (ONE jitted program over the stacked blocks);
+        plain-tree entries deltify against ``global_params`` and reduce
+        in the same canonical order. Mixed buffers decode the compressed
+        minority (K-bounded) rather than failing the round.
+        """
+        if not self._entries:
+            raise RuntimeError("flush of an empty FedBuff buffer")
+        # canonical order: arrival order must never change the aggregate
+        entries = sorted(self._entries,
+                         key=lambda e: (e.base_version, e.sender, e.seq))
+        self._entries = []
+        stale = [max(0, int(current_version) - e.base_version)
+                 for e in entries]
+        weights = np.asarray(
+            [e.n_samples * staleness_weight(t, self.staleness_exponent)
+             for e, t in zip(entries, stale)], np.float64)
+        total = float(weights.sum())
+        if total <= 0:
+            weights = np.ones(len(entries), np.float64)
+            total = float(len(entries))
+        w = (weights / total).astype(np.float32)
+
+        payloads = [e.payload for e in entries]
+        compressed = [isinstance(p, CompressedTree) for p in payloads]
+        if all(compressed) and len({p.codec for p in payloads}) == 1:
+            mean_delta = fused_weighted_sum(payloads, w)
+        else:
+            # mixed or plain: K-bounded decode, same canonical order
+            deltas = [
+                get_codec(p.codec).decode(p) if isinstance(
+                    p, CompressedTree)
+                else tree_delta(p, global_params)
+                for p in payloads
+            ]
+            mean_delta = deltas[0]
+            mean_delta = jax.tree.map(
+                lambda d: w[0] * d.astype(jnp.float32), mean_delta)
+            for wi, d in zip(w[1:], deltas[1:]):
+                mean_delta = jax.tree.map(
+                    lambda acc, x: acc + wi * x.astype(jnp.float32),
+                    mean_delta, d)
+            mean_delta = jax.tree.map(
+                lambda acc, g: acc.astype(jnp.asarray(g).dtype),
+                mean_delta, global_params)
+        from fedml_tpu.compression.codecs import tree_undelta
+
+        new_global = tree_undelta(global_params, mean_delta)
+        stats = {
+            "flushed": len(entries),
+            "staleness": stale,
+            "mean_staleness": float(sum(stale)) / len(stale),
+            "senders": [e.sender for e in entries],
+            "weights": [float(x) for x in w],
+        }
+        return new_global, stats

@@ -1,0 +1,141 @@
+"""Partial sums in the compressed block domain — the tree's wire unit.
+
+Hierarchical aggregation (HierFAVG, Liu et al. 2020) only scales if the
+intermediate tiers stay cheap: an edge aggregator that decodes its
+cohort into N f32 trees has already paid the memory bill the tree was
+built to avoid. The unit that travels UP the tree is therefore a
+:class:`PartialSum` — a :class:`CompressedTree` (int8 blocks + scales,
+bf16 halves, …) holding the cohort's *weighted mean*, plus the
+**accumulated sample weight** of everything underneath it. Any tier can
+combine partial sums from its children with the PR 3 dequant-fused
+weighted sum (``fused_weighted_sum``: the blocks reduce inside ONE
+jitted program) and re-encode the result for its own uplink — the only
+f32 tree a tier ever materializes is its single cohort aggregate.
+
+Carrying (mean, weight) instead of raw sums keeps the arithmetic
+associative by construction::
+
+    combine(combine(a, b), c) == combine(a, combine(b, c))
+      where combine(x, y).mean = (Wx·x.mean + Wy·y.mean) / (Wx + Wy)
+            combine(x, y).weight = Wx + Wy
+
+so a 2-tier tree, a 3-tier tree and flat aggregation compute the same
+weighted mean (bit-identically for the identity codec on exactly
+representable data; within per-tier re-quantization error for int8).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fedml_tpu.compression.codecs import (
+    Codec,
+    CompressedTree,
+    fused_weighted_sum,
+)
+
+Pytree = Any
+
+__all__ = [
+    "PartialSum",
+    "compressed_nbytes",
+    "finalize_root",
+    "reduce_cohort",
+]
+
+
+class PartialSum:
+    """A cohort's aggregate, ready for the uplink.
+
+    ``ct``      the cohort weighted mean, encoded by the tier codec
+    ``weight``  accumulated sample weight under this subtree
+    ``count``   leaf contributions folded in (diagnostics only)
+    """
+
+    __slots__ = ("ct", "weight", "count")
+
+    def __init__(self, ct: CompressedTree, weight: float, count: int):
+        self.ct = ct
+        self.weight = float(weight)
+        self.count = int(count)
+
+    @property
+    def nbytes(self) -> int:
+        return compressed_nbytes(self.ct)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"PartialSum(codec={self.ct.codec}, weight={self.weight:g}, "
+                f"count={self.count})")
+
+
+def compressed_nbytes(ct: CompressedTree) -> int:
+    """Wire bytes of a compressed tree's blocks (q/scales/values/indices).
+
+    Counts the encoded arrays only — the structure/meta envelope is a few
+    hundred bytes of JSON and identical at every tier.
+    """
+    total = 0
+    for parts in ct.arrays:
+        for a in parts:
+            dt = getattr(a, "dtype", None)
+            sh = getattr(a, "shape", ())
+            if dt is None:
+                total += np.asarray(a).nbytes
+            else:
+                itemsize = 2 if str(dt) == "bfloat16" else np.dtype(
+                    str(dt)).itemsize
+                total += int(np.prod(sh, dtype=np.int64)) * itemsize
+    return total
+
+
+def _weighted_mean(contribs: Sequence[Tuple[CompressedTree, float]]) -> Tuple[
+        Pytree, float]:
+    """Dequant-fused weighted mean over (ct, weight) contributions.
+
+    ONE jitted program; per-contributor f32 trees are never materialized
+    (the blocks reduce inside the einsum/scatter of the codec's fused
+    ``weighted_sum_leaf``). Contribution counts travel separately (the
+    ``counts`` argument of :func:`reduce_cohort`), never through here.
+    """
+    if not contribs:
+        raise ValueError("empty cohort: nothing to reduce")
+    cts = [ct for ct, _ in contribs]
+    weights = np.asarray([w for _, w in contribs], np.float64)
+    total = float(weights.sum())
+    if total <= 0:
+        raise ValueError(f"cohort weights must sum > 0, got {total}")
+    mean = fused_weighted_sum(cts, (weights / total).astype(np.float32))
+    return mean, total
+
+
+def reduce_cohort(contribs: Sequence[Tuple[CompressedTree, float]],
+                  out_codec: Codec, key,
+                  counts: Optional[Sequence[int]] = None) -> PartialSum:
+    """Reduce one cohort's compressed contributions into a PartialSum.
+
+    ``contribs`` are ``(CompressedTree, weight)`` pairs — leaf-client
+    deltas at the bottom tier, child PartialSum.ct's anywhere above. The
+    dequant-fused weighted mean and the re-encode each run as one jitted
+    program; nothing per-contributor ever exists in f32.
+    """
+    mean, total = _weighted_mean(contribs)
+    is_delta = contribs[0][0].is_delta
+    ct = out_codec.encode(mean, key=key, is_delta=is_delta)
+    count = int(sum(counts)) if counts is not None else len(contribs)
+    return PartialSum(ct, total, count)
+
+
+def finalize_root(contribs: Sequence[Tuple[CompressedTree, float]]) -> Tuple[
+        Pytree, float]:
+    """Close the global round: fused weighted mean of the top-tier partial
+    sums, decoded exactly once — the only full f32 tree of the round."""
+    mean, total = _weighted_mean(contribs)
+    return mean, total
+
+
+def flat_reference(contribs: Sequence[Tuple[CompressedTree, float]]) -> Pytree:
+    """Flat (tree-less) aggregation of the same contributions — the
+    baseline the associativity acceptance test compares against."""
+    mean, _ = _weighted_mean(contribs)
+    return mean

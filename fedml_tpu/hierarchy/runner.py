@@ -1,0 +1,404 @@
+"""In-process aggregation-tree runner: 100k+ virtual clients, one machine.
+
+The :class:`TreeRunner` drives a whole N-tier federation round-by-round
+in one process: virtual leaf clients generate seeded deltas and upload
+them compressed (the generate → EF → encode → fused-reduce pipeline runs
+as one jitted program per fixed-size chunk), edge aggregators forward
+partial sums in the compressed block domain, and the root closes the
+global round. Chaos (kill windows at ANY tier), quorum closes, eviction
+and rejoin are deterministic functions of the seed — two runs of the
+same scenario end bit-identical.
+
+Telemetry lands per tier under ``tier/<d>/...`` (upload bytes,
+contributions, quorum closes, evict/rejoin counts, peak buffered bytes)
+plus ``resilience_event`` records carrying a ``tier`` field, which is
+what ``telemetry doctor``'s tier-triage section reads.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression.codecs import (
+    _is_float_meta,
+    _tree_meta,
+    derive_key,
+    get_codec,
+    tree_undelta,
+)
+from fedml_tpu.hierarchy.edge import EdgeAggregator, LeafCohort
+from fedml_tpu.hierarchy.partial_sum import PartialSum, compressed_nbytes
+from fedml_tpu.hierarchy.tree import TreeTopology
+from fedml_tpu.resilience import quorum_size
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+__all__ = ["KillWindow", "TreeRunner", "default_template"]
+
+# key-space offset for tier-aggregator encode keys, so edge re-encode
+# streams can never collide with leaf-client upload streams
+_EDGE_KEY_BASE = 0x40000000
+
+
+class KillWindow:
+    """Chaos: node ``node`` at tier ``tier`` is dead for rounds
+    ``[round, until)`` (default: one round). At the leaf tier ``node``
+    is a global client index."""
+
+    __slots__ = ("tier", "node", "round", "until")
+
+    def __init__(self, tier: int, node: int, round: int,
+                 until: Optional[int] = None):
+        self.tier = int(tier)
+        self.node = int(node)
+        self.round = int(round)
+        self.until = int(until) if until is not None else self.round + 1
+
+    def dead_at(self, tier: int, round_idx: int) -> bool:
+        return self.tier == tier and self.round <= round_idx < self.until
+
+
+def default_template(n_params: int = 1024) -> Dict[str, np.ndarray]:
+    """A small two-leaf f32 model template with ~n_params elements."""
+    d = max(2, int(round((int(n_params) * 3 // 4) ** 0.5)))
+    k = max(1, (int(n_params) - d) // d)
+    return {"w": np.zeros((d, k), np.float32),
+            "b": np.zeros((k,), np.float32)}
+
+
+def _make_delta_fn(meta) -> Callable:
+    """Seeded virtual-client delta: per-leaf normal draws (traceable)."""
+
+    def delta_fn(key):
+        out = []
+        for i, (dt, sh) in enumerate(meta):
+            k = jax.random.fold_in(key, i)
+            out.append(0.05 * jax.random.normal(k, sh, jnp.float32))
+        return tuple(out)
+
+    return delta_fn
+
+
+class TreeRunner:
+    """Run a hierarchical federation on a :class:`TreeTopology`.
+
+    ``codec`` is the wire codec at EVERY tier (leaf uploads and partial
+    sums); ``quorum`` the per-cohort close fraction; ``chaos`` a list of
+    :class:`KillWindow`; ``ef=True`` keeps stacked per-client error
+    feedback at the leaf tier (small-cohort mode). ``delta_fn`` may
+    replace the virtual clients' update generator (a traceable
+    ``key -> flat leaf tuple`` over the template's leaves).
+    """
+
+    def __init__(self, topology: TreeTopology, template: Optional[Pytree]
+                 = None, codec: str = "int8", seed: int = 0,
+                 quorum: float = 1.0, chunk: int = 2048, ef: bool = False,
+                 chaos: Optional[Sequence[KillWindow]] = None,
+                 delta_fn: Optional[Callable] = None,
+                 server_lr: float = 1.0):
+        self.topology = topology
+        self.codec = get_codec(codec)
+        if self.codec is None:
+            raise ValueError("TreeRunner needs a codec; use 'identity' for "
+                             "an uncompressed wire")
+        self.seed = int(seed)
+        self.quorum = float(quorum)
+        self.chaos = list(chaos or [])
+        self.server_lr = float(server_lr)
+        template = default_template() if template is None else template
+        leaves, self._treedef = jax.tree.flatten(template)
+        self.global_leaves = [np.array(x) for x in leaves]
+        self.meta = _tree_meta(leaves)
+        if not all(_is_float_meta(dt) for dt, _ in self.meta):
+            raise ValueError(
+                "TreeRunner virtual cohorts support float-leaf templates "
+                "only (int/bool leaves have no mean-delta semantics here)")
+        self.delta_fn = delta_fn or _make_delta_fn(self.meta)
+        self._f32_tree_nbytes = sum(
+            int(np.prod(sh, dtype=np.int64)) * 4 for _, sh in self.meta)
+
+        L = topology.leaf_tier
+        # leaf cohorts (tier L), owned by the tier L-1 edges
+        self.cohorts: List[LeafCohort] = []
+        for e in range(topology.levels[L - 1]):
+            cids = topology.children(L - 1, e)
+            self.cohorts.append(LeafCohort(
+                L, e, cids, self.codec, self.meta, self.delta_fn,
+                self.seed, chunk=chunk, ef=ef))
+        # interior aggregators for tiers 0..L-2 (children are tier d+1
+        # node indices; the tier L-1 edges' children are their cohorts,
+        # handled vectorized above)
+        self.aggregators: Dict[int, List[EdgeAggregator]] = {}
+        for d in range(0, L - 1):
+            self.aggregators[d] = [
+                EdgeAggregator(d, i, topology.children(d, i).tolist(),
+                               self.codec, self.quorum)
+                for i in range(topology.levels[d])
+            ]
+        # per-client wire bytes, computed once from an encoded template
+        ct = self.codec.encode(
+            jax.tree.unflatten(self._treedef,
+                               [jnp.asarray(x) for x in leaves]),
+            key=derive_key(self.seed, 0, 0), is_delta=True)
+        self.per_client_wire_nbytes = compressed_nbytes(ct)
+        # PR 4 health scoring, one tier up: each leaf-parent edge is a
+        # "client" of the health tracker — per-round reduce walls feed
+        # the straggler EWMA/median machinery, so a consistently slow
+        # edge aggregator surfaces through `telemetry doctor` exactly
+        # like a straggling cross-silo client
+        from fedml_tpu.telemetry.health import ClientHealthTracker
+
+        self._health = ClientHealthTracker()
+        self.stats: Dict[str, Any] = {}
+
+    # -- chaos + telemetry helpers ----------------------------------------
+    def _dead(self, tier: int, round_idx: int) -> set:
+        return {kw.node for kw in self.chaos if kw.dead_at(tier, round_idx)}
+
+    def _event(self, event: str, tier: int, counter, n: int = 1,
+               **fields) -> None:
+        """One tier event, landed where the doctor looks: the tier/<d>/*
+        counter (the caller registers it with a LITERAL signal segment,
+        keeping the taxonomy lintable) plus a tier-tagged
+        resilience_event in health.jsonl."""
+        from fedml_tpu.telemetry.health import log_health_event
+
+        counter.inc(n)
+        try:
+            log_health_event({"kind": "resilience_event", "event": event,
+                              "tier": tier, **fields})
+        except Exception:  # pragma: no cover - observability must not kill
+            logger.exception("tier event logging failed")
+
+    # -- the round ---------------------------------------------------------
+    def _leaf_round(self, round_idx: int, reg) -> Dict[int, PartialSum]:
+        """Reduce every leaf cohort; returns tier-(L-1) node partials."""
+        topo = self.topology
+        L = topo.leaf_tier
+        dead_clients = self._dead(L, round_idx)
+        partials: Dict[int, PartialSum] = {}
+        upload_bytes = 0
+        peak_chunk_bytes = 0
+        for e, cohort in enumerate(self.cohorts):
+            lo = int(cohort.client_ids[0]) if len(cohort.client_ids) else 0
+            # probe/rejoin BEFORE selection: an evicted client alive again
+            # this round answers the probe, readmits (EF residual reset at
+            # this edge) and re-enters the cohort
+            if cohort.evicted_mask.any():
+                ev_local = np.nonzero(cohort.evicted_mask)[0]
+                alive_again = np.asarray(
+                    [i for i in ev_local
+                     if (lo + int(i)) not in dead_clients], np.int64)
+                back = cohort.readmit(alive_again)
+                if len(back):
+                    self._event("rejoined", L,
+                                reg.counter(f"tier/{L}/rejoined"),
+                                len(back),
+                                round=round_idx,
+                                clients=[int(c) for c in back[:16]])
+            alive = np.ones(len(cohort.client_ids), bool)
+            for c in dead_clients:
+                if 0 <= c - lo < len(alive):
+                    alive[c - lo] = False
+            expected = cohort.n_expected()
+            t_reduce = time.perf_counter()
+            sum_leaves, total_w, n_recv = cohort.reduce(round_idx, alive)
+            # PR 4 health scoring per edge: the reduce wall is the edge's
+            # round latency; a persistently slow edge scores as a
+            # straggler in doctor triage
+            self._health.observe(int(e), round_idx,
+                                 latency_s=time.perf_counter() - t_reduce)
+            dead_local = np.nonzero(~alive & ~cohort.evicted_mask)[0]
+            if len(dead_local):
+                gone = cohort.evict(dead_local)
+                self._event("evicted", L,
+                            reg.counter(f"tier/{L}/evicted"), len(gone),
+                            round=round_idx,
+                            clients=[int(c) for c in gone[:16]])
+            if n_recv < quorum_size(max(1, expected), self.quorum) or (
+                    sum_leaves is None):
+                self._event("quorum_failed", L - 1,
+                            reg.counter(f"tier/{L - 1}/quorum_failures"), 1,
+                            round=round_idx, node=e, received=n_recv,
+                            expected=expected)
+                continue
+            if n_recv < expected:
+                self._event("quorum_close", L - 1,
+                            reg.counter(f"tier/{L - 1}/quorum_closes"), 1,
+                            round=round_idx, node=e, received=n_recv,
+                            expected=expected)
+            mean = jax.tree.unflatten(
+                self._treedef,
+                [s / jnp.float32(total_w) for s in sum_leaves])
+            key = derive_key(self.seed, round_idx,
+                             _EDGE_KEY_BASE + ((L - 1) << 20) + e)
+            ct = self.codec.encode(mean, key=key, is_delta=True)
+            partials[e] = PartialSum(ct, total_w, n_recv)
+            upload_bytes += n_recv * self.per_client_wire_nbytes
+            peak_chunk_bytes = max(
+                peak_chunk_bytes,
+                min(len(cohort.client_ids), cohort.chunk)
+                * self.per_client_wire_nbytes)
+        reg.counter(f"tier/{L}/upload_bytes").inc(upload_bytes)
+        reg.counter(f"tier/{L}/contributions").inc(
+            sum(p.count for p in partials.values()))
+        self._tier_round_bytes[L] = upload_bytes
+        # leaf-tier buffering is the in-flight chunk of compressed blocks
+        self._tier_peak_buffer[L] = max(
+            self._tier_peak_buffer.get(L, 0), peak_chunk_bytes)
+        return partials
+
+    def _interior_round(self, round_idx: int, tier: int,
+                        child_partials: Dict[int, PartialSum],
+                        reg) -> Dict[int, PartialSum]:
+        """One interior tier: children's partials → this tier's partials."""
+        dead_here = self._dead(tier + 1, round_idx)  # children that died
+        out: Dict[int, PartialSum] = {}
+        upload_bytes = 0
+        for node, agg in enumerate(self.aggregators[tier]):
+            # probe/rejoin before the round opens (same rule as leaves)
+            for c in agg.evicted():
+                if c not in dead_here and c in child_partials:
+                    if agg.readmit(c):
+                        self._event(
+                            "rejoined", tier + 1,
+                            reg.counter(f"tier/{tier + 1}/rejoined"), 1,
+                                    round=round_idx, node=c)
+            expected = agg.begin_round(round_idx)
+            for c in expected:
+                ps = child_partials.get(c)
+                if ps is not None and c not in dead_here:
+                    agg.offer(c, ps)
+                    upload_bytes += ps.nbytes
+            received = agg.received()
+            key = derive_key(self.seed, round_idx,
+                             _EDGE_KEY_BASE + (tier << 20) + node)
+            if tier == 0:
+                mean, total_w, missing = agg.close_round_root()
+                if missing:
+                    self._event("evicted", 1,
+                                reg.counter("tier/1/evicted"), len(missing),
+                                round=round_idx, nodes=missing)
+                if mean is None:
+                    raise RuntimeError(
+                        f"global round {round_idx} below quorum at the "
+                        f"root: {received}/{len(expected)} tier-1 partial "
+                        f"sums (need {quorum_size(max(1, len(expected)), self.quorum)})")
+                if received < len(expected):
+                    self._event("quorum_close", 0,
+                                reg.counter("tier/0/quorum_closes"), 1,
+                                round=round_idx, received=received,
+                                expected=len(expected))
+                self._root_close = (mean, total_w)
+            else:
+                ps, missing = agg.close_round(key)
+                if missing:
+                    self._event("evicted", tier + 1,
+                                reg.counter(f"tier/{tier + 1}/evicted"),
+                                len(missing), round=round_idx,
+                                nodes=missing)
+                if ps is None:
+                    self._event("quorum_failed", tier,
+                                reg.counter(f"tier/{tier}/quorum_failures"),
+                                1,
+                                round=round_idx, node=node,
+                                received=received, expected=len(expected))
+                    continue
+                if received < len(expected):
+                    self._event("quorum_close", tier,
+                                reg.counter(f"tier/{tier}/quorum_closes"),
+                                1,
+                                round=round_idx, node=node,
+                                received=received, expected=len(expected))
+                out[node] = ps
+            self._tier_peak_buffer[tier] = max(
+                self._tier_peak_buffer.get(tier, 0),
+                agg.peak_buffered_nbytes)
+        reg.counter(f"tier/{tier + 1}/upload_bytes").inc(upload_bytes)
+        self._tier_round_bytes[tier + 1] = max(
+            self._tier_round_bytes.get(tier + 1, 0), upload_bytes)
+        return out
+
+    def run(self, rounds: int) -> Dict[str, Any]:
+        """Run ``rounds`` global rounds; returns the scenario result."""
+        from fedml_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        topo = self.topology
+        L = topo.leaf_tier
+        for d in range(L + 1):
+            reg.gauge(f"tier/{d}/nodes").set(topo.levels[d])
+        self._tier_peak_buffer: Dict[int, int] = {}
+        peak_round_bytes: Dict[int, int] = {}
+        t0 = time.perf_counter()
+        for r in range(int(rounds)):
+            self._tier_round_bytes: Dict[int, int] = {}
+            self._root_close = None
+            partials = self._leaf_round(r, reg)
+            if L == 1:
+                # 2-tier degenerate tree: the root IS the single leaf
+                # cohort's edge — decode its partial directly
+                if 0 not in partials:
+                    raise RuntimeError(
+                        f"global round {r} below quorum at the root "
+                        "(leaf cohort did not reach quorum)")
+                self._root_close = (self.codec.decode(partials[0].ct),
+                                    partials[0].weight)
+            for tier in range(L - 2, -1, -1):
+                partials = self._interior_round(r, tier, partials, reg)
+            if self._root_close is None:  # pragma: no cover - defensive
+                raise RuntimeError(f"round {r} never reached the root")
+            self._health.finish_round(r)  # edge straggler/EWMA scoring
+            mean, _ = self._root_close
+            new_global = tree_undelta(
+                jax.tree.unflatten(self._treedef, [
+                    jnp.asarray(x) for x in self.global_leaves]),
+                jax.tree.map(
+                    lambda m: jnp.float32(self.server_lr) * m, mean))
+            self.global_leaves = [
+                np.array(x) for x in jax.tree.leaves(new_global)]
+            for d, b in self._tier_round_bytes.items():
+                peak_round_bytes[d] = max(peak_round_bytes.get(d, 0), b)
+        wall = time.perf_counter() - t0
+        for d, v in self._tier_peak_buffer.items():
+            reg.gauge(f"tier/{d}/peak_buffer_bytes").set(v)
+
+        digest = hashlib.blake2b(digest_size=16)
+        for x in self.global_leaves:
+            digest.update(np.ascontiguousarray(x).tobytes())
+        per_tier = {}
+        for d in range(L + 1):
+            per_tier[str(d)] = {
+                "nodes": topo.levels[d],
+                "peak_round_upload_bytes": peak_round_bytes.get(d, 0),
+                "peak_buffer_bytes": self._tier_peak_buffer.get(d, 0),
+            }
+        self.stats = {
+            "clients": topo.n_clients,
+            "tiers": topo.n_tiers,
+            "levels": list(topo.levels),
+            "rounds": int(rounds),
+            "codec": self.codec.spec,
+            "seed": self.seed,
+            "quorum": self.quorum,
+            "wall_s": wall,
+            "rounds_per_s": (rounds / wall) if wall > 0 else 0.0,
+            "per_client_wire_bytes": self.per_client_wire_nbytes,
+            "f32_tree_nbytes": self._f32_tree_nbytes,
+            "per_tier": per_tier,
+            "final_digest": digest.hexdigest(),
+            "completed": True,
+        }
+        return self.stats
+
+    @property
+    def global_params(self) -> Pytree:
+        return jax.tree.unflatten(self._treedef, list(self.global_leaves))

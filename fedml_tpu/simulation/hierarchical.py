@@ -53,6 +53,15 @@ class HierarchicalFedAvgAPI:
         }
         self.trainer = create_model_trainer(model, args)
         self.aggregator = create_server_aggregator(model, args)
+        # hierarchy_compression: the cloud round rides the aggregation-
+        # tree wire format — each group uploads its model as a compressed
+        # DELTA partial sum vs the global, and the cloud reduces the
+        # blocks with the dequant-fused weighted sum (one program, no
+        # per-group f32 stack). See fedml_tpu/hierarchy/partial_sum.py.
+        from fedml_tpu.compression import get_codec
+
+        self._cloud_codec = get_codec(
+            getattr(args, "hierarchy_compression", ""), args)
         sample_x = dataset.train_data_global[0][: int(getattr(args, "batch_size", 32))]
         self.global_params = model_hub.init_params(model, args, sample_x)
         max_n = max(dataset.train_data_local_num_dict.values())
@@ -87,9 +96,29 @@ class HierarchicalFedAvgAPI:
             )
         # cloud round: one weighted tree-reduce over group models (the
         # TurboAggregate multi-group reduce collapses to the same program)
-        self.global_params = FedMLAggOperator.agg_with_weights(
-            group_models, group_weights
-        )
+        if self._cloud_codec is not None:
+            from fedml_tpu.compression.codecs import (
+                derive_key,
+                tree_delta,
+                tree_undelta,
+            )
+            from fedml_tpu.hierarchy.partial_sum import finalize_root
+
+            seed = int(getattr(self.args, "random_seed", 0))
+            contribs = [
+                (self._cloud_codec.encode(
+                    tree_delta(gp, self.global_params),
+                    key=derive_key(seed, round_idx, g), is_delta=True),
+                 float(w))
+                for g, (gp, w) in enumerate(
+                    zip(group_models, group_weights))
+            ]
+            mean, _ = finalize_root(contribs)
+            self.global_params = tree_undelta(self.global_params, mean)
+        else:
+            self.global_params = FedMLAggOperator.agg_with_weights(
+                group_models, group_weights
+            )
         report = {"round": round_idx, "groups": self.n_groups}
         freq = int(getattr(self.args, "frequency_of_the_test", 1))
         if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:

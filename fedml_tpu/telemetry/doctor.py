@@ -322,6 +322,11 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
     evict_round: Dict[str, Any] = {}
     rejoin_round: Dict[str, Any] = {}
     for e in res_events:
+        # tier-tagged events (hierarchical runs) carry node/clients
+        # fields, not a per-client identity — they belong to the tiers
+        # section below, not the per-client evict/rejoin pairing
+        if e.get("tier") is not None or e.get("client") is None:
+            continue
         cid = str(e.get("client"))
         if e.get("event") == "evicted":
             evict_round[cid] = e.get("round")
@@ -365,6 +370,50 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "no data: no resilience/* metrics or resilience_event records "
             "(run predates the resilience layer, or nothing went wrong)")
 
+    # -- tiers (hierarchical federation: tier/<d>/* metrics + events) -----
+    latest_tier: Dict[Any, float] = {}
+    for rec in load_metrics(run_dir):
+        name = rec.get("name", "")
+        if name.startswith("tier/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest_tier[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    tier_metrics: Dict[str, Dict[str, float]] = {}
+    for (name, _), val in latest_tier.items():
+        parts = name.split("/")
+        if len(parts) != 3:
+            continue
+        sig = tier_metrics.setdefault(parts[1], {})
+        sig[parts[2]] = sig.get(parts[2], 0.0) + val
+    tier_events = [e for e in res_events if e.get("tier") is not None]
+    tiers: Dict[str, Any] = {"metrics": tier_metrics,
+                             "events": tier_events[-16:]}
+    for d, sig in sorted(tier_metrics.items(), key=lambda kv: kv[0]):
+        qc = sig.get("quorum_closes", 0.0)
+        qf = sig.get("quorum_failures", 0.0)
+        ev = sig.get("evicted", 0.0)
+        rj = sig.get("rejoined", 0.0)
+        if qf:
+            verdict.append(
+                f"tier {d}: {qf:.0f} cohort close(s) fell BELOW quorum — "
+                "that subtree contributed nothing to its global round")
+        if qc:
+            verdict.append(
+                f"tier {d}: {qc:.0f} cohort(s) closed on quorum after "
+                "losing children — the missing were reweighted out")
+        if ev > rj:
+            verdict.append(
+                f"tier {d}: {ev - rj:.0f} of {ev:.0f} evicted node(s) "
+                "never rejoined — check that tier's processes/links")
+        elif ev:
+            verdict.append(
+                f"tier {d}: {ev:.0f} eviction(s), all rejoined "
+                f"({rj:.0f} rejoin syncs, EF residuals reset at the edge)")
+    if not tier_metrics and not tier_events:
+        notes.setdefault(
+            "tiers", "no data: no tier/* metrics or tier-tagged events "
+            "(not a hierarchical-federation run)")
+
     if not (fr_events or health_events or report["n_spans"]
             or report.get("n_metrics")):
         notes["run"] = f"no telemetry data of any kind under {run_dir}"
@@ -383,6 +432,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "compression": compression,
         "services": services,
         "connectivity": connectivity,
+        "tiers": tiers,
         "verdict": verdict,
     }
 
@@ -486,6 +536,21 @@ def format_doctor(d: Dict) -> str:
                else "never rejoined"))
     if not counters and not conn.get("events"):
         add(f"  {notes.get('connectivity', 'no data')}")
+
+    add("")
+    add("tiers (hierarchical federation):")
+    tiers = d.get("tiers") or {}
+    tier_metrics = tiers.get("metrics") or {}
+    if tier_metrics:
+        for td, sig in sorted(tier_metrics.items(), key=lambda kv: kv[0]):
+            row = " ".join(f"{k}={v:.0f}" for k, v in sorted(sig.items()))
+            add(f"  tier {td}: {row}")
+        for e in (tiers.get("events") or [])[-6:]:
+            add("  event: " + " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("kind", "ts") and not isinstance(v, dict)))
+    else:
+        add(f"  {notes.get('tiers', 'no data')}")
 
     add("")
     add("service health:")

@@ -113,6 +113,44 @@ def test_device_secagg_round(tmp_path):
     assert result["test_acc"] > 0.4
 
 
+def test_hierarchy_config_routes_through_tree_subsystem(tmp_path):
+    """A cross-device cohort with hierarchy_tiers set must NOT silently
+    run the flat FSM: the server and device-client builders refuse with
+    a pointer to the hierarchy subsystem, and run_hierarchical actually
+    drives the cohort through the aggregation tree."""
+    from fedml_tpu.cross_device import (
+        ServerCrossDevice,
+        build_device_client,
+        run_hierarchical,
+    )
+
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "cross_device", "random_seed": 0,
+                        "run_id": "beehive_tree"},
+        "data_args": {"dataset": "synthetic", "train_size": 200,
+                      "test_size": 40, "class_num": 3, "feature_dim": 8},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 500,
+                       "client_num_per_round": 500, "comm_round": 2,
+                       "hierarchy_tiers": 3, "hierarchy_params": 64,
+                       "round_quorum": 0.5, "compression": "int8",
+                       "log_file_dir": str(tmp_path)},
+    }))
+    with pytest.raises(NotImplementedError, match="hierarchy"):
+        ServerCrossDevice(args, None, None, None)
+    args.rank = 1
+    with pytest.raises(NotImplementedError, match="TreeRunner"):
+        build_device_client(args)
+    stats = run_hierarchical(args)
+    assert stats["completed"] and stats["clients"] == 500
+    assert stats["tiers"] == 3 and stats["rounds"] == 2
+    assert stats["codec"] == "int8"
+    # telemetry landed in the run dir for doctor/report
+    run_dir = str(tmp_path / "run_beehive_tree")
+    assert os.path.exists(os.path.join(run_dir, "telemetry.jsonl"))
+
+
 def test_device_trainer_callbacks_and_stop():
     """FedMLBaseTrainer.h shape: per-epoch loss/accuracy/progress
     callbacks fire; the stop flag halts the loop."""

@@ -45,6 +45,10 @@ _HEALTH_SHAPE = re.compile(r"^health/[a-z0-9_]+$")
 # and backends are labels); counters or gauges only — retry/reconnect/
 # quorum signals are occurrence counts, not latency distributions
 _RESILIENCE_SHAPE = re.compile(r"^resilience/[a-z0-9_]+$")
+# hierarchical-federation namespace: tier/<depth>/<signal> — exactly one
+# interpolated tier depth then one signal segment (node/client ids are
+# event fields, never name segments); counters or gauges only
+_TIER_SHAPE = re.compile(r"^tier/<v>/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -104,10 +108,10 @@ def check(entries):
                     f"{where}: span {name!r} must be compress/encode "
                     "or compress/decode")
         if kind == "span" and name.startswith(
-                ("mem/", "health/", "resilience/")):
+                ("mem/", "health/", "resilience/", "tier/")):
             problems.append(
-                f"{where}: {name!r} — mem/, health/ and resilience/ are "
-                "metric namespaces, not span names")
+                f"{where}: {name!r} — mem/, health/, resilience/ and "
+                "tier/ are metric namespaces, not span names")
         if kind != "span" and name.startswith("mem/"):
             if kind != "gauge":
                 problems.append(
@@ -130,6 +134,17 @@ def check(entries):
             elif kind == "histogram":
                 problems.append(
                     f"{where}: {kind} {name!r} — resilience/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
+                    "histograms")
+        if kind != "span" and name.startswith("tier/"):
+            if not _TIER_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be tier/<depth>/"
+                    "<signal> (one depth segment, one signal segment; "
+                    "node/client ids ride event fields)")
+            elif kind == "histogram":
+                problems.append(
+                    f"{where}: {kind} {name!r} — tier/* signals are "
                     "occurrence counts (counter) or levels (gauge), not "
                     "histograms")
         if kind != "span":

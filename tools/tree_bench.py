@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Hierarchical-federation bench: the 100k-client claim, measured.
+
+Runs a seeded N-tier aggregation tree (``fedml_tpu.hierarchy.TreeRunner``)
+on this machine and prints ONE JSON line: clients simulated, tiers,
+rounds/s, peak wire bytes per tier, peak compressed-buffer bytes per
+tier, and peak host RSS — the numbers behind "a 3-tier, 100k+ virtual-
+client federation runs on one machine without ever materializing a
+per-client f32 tree".
+
+Same contract as the other ``tools/*_bench.py`` (also reachable as
+``python bench.py --tree``). Environment knobs for the driver:
+``FEDML_TREE_CLIENTS`` / ``FEDML_TREE_TIERS`` / ``FEDML_TREE_ROUNDS`` /
+``FEDML_TREE_PARAMS`` / ``FEDML_TREE_CODEC``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _peak_rss_bytes() -> int:
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # linux reports KiB, macOS bytes
+    return int(ru) * (1 if sys.platform == "darwin" else 1024)
+
+
+def run_tree_bench(clients: int = None, tiers: int = None, rounds: int = None,
+                   n_params: int = None, codec: str = None, seed: int = 0,
+                   quorum: float = 2.0 / 3.0, chunk: int = 4096) -> dict:
+    # None -> the FEDML_TREE_* env knob (driver contract), then the
+    # 100k-claim default — so `python bench.py --tree` honors the env
+    clients = int(os.environ.get("FEDML_TREE_CLIENTS", 100_000)
+                  if clients is None else clients)
+    tiers = int(os.environ.get("FEDML_TREE_TIERS", 3)
+                if tiers is None else tiers)
+    rounds = int(os.environ.get("FEDML_TREE_ROUNDS", 2)
+                 if rounds is None else rounds)
+    n_params = int(os.environ.get("FEDML_TREE_PARAMS", 256)
+                   if n_params is None else n_params)
+    codec = str(os.environ.get("FEDML_TREE_CODEC", "int8")
+                if codec is None else codec)
+    from fedml_tpu.hierarchy import (
+        TreeRunner,
+        TreeTopology,
+        default_template,
+    )
+
+    topo = TreeTopology.build(int(clients), tiers=int(tiers))
+    runner = TreeRunner(topo, template=default_template(int(n_params)),
+                        codec=codec, seed=int(seed), quorum=float(quorum),
+                        chunk=int(chunk))
+    stats = runner.run(int(rounds))
+    per_tier = stats["per_tier"]
+    peak_wire = {d: row["peak_round_upload_bytes"]
+                 for d, row in per_tier.items()}
+    peak_buffer = {d: row["peak_buffer_bytes"] for d, row in per_tier.items()}
+    # the claim the gauge bound enforces: no tier ever buffers anything
+    # near a per-client f32 tree set
+    f32_worst = stats["f32_tree_nbytes"] * stats["clients"]
+    peak_any = max(peak_buffer.values() or [0])
+    return {
+        "bench": "tree",
+        "clients": stats["clients"],
+        "tiers": stats["tiers"],
+        "levels": stats["levels"],
+        "rounds": stats["rounds"],
+        "codec": stats["codec"],
+        "seed": stats["seed"],
+        "rounds_per_s": round(stats["rounds_per_s"], 4),
+        "wall_s": round(stats["wall_s"], 3),
+        "per_client_wire_bytes": stats["per_client_wire_bytes"],
+        "f32_tree_nbytes": stats["f32_tree_nbytes"],
+        "peak_wire_bytes_per_tier": peak_wire,
+        "peak_buffer_bytes_per_tier": peak_buffer,
+        "peak_buffer_vs_f32_trees": round(peak_any / max(f32_worst, 1), 6),
+        "peak_host_rss_bytes": _peak_rss_bytes(),
+        "final_digest": stats["final_digest"],
+        "ok_no_f32_trees": peak_any < 0.5 * f32_worst,
+        "completed": bool(stats["completed"]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--tiers", type=int, default=None)
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--params", type=int, default=None)
+    ap.add_argument("--codec", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    row = run_tree_bench(clients=args.clients, tiers=args.tiers,
+                         rounds=args.rounds, n_params=args.params,
+                         codec=args.codec, seed=args.seed)
+    print(json.dumps(row))
+    return 0 if (row["completed"] and row["ok_no_f32_trees"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
