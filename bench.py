@@ -293,6 +293,19 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--serve" in sys.argv:
+        # live-serving SLO gate: sustained concurrent HTTP load through
+        # the OpenAI endpoint across N federation hot swaps — qps,
+        # latency percentiles vs the no-swap baseline, swap stalls,
+        # dropped MUST be 0 (tools/serve_bench.py; FEDML_SERVE_* env)
+        from tools.serve_bench import run_serve_bench
+
+        row = run_serve_bench()
+        print(json.dumps(row))
+        if not (row["completed"] and row["ok_p99"]):
+            raise SystemExit(1)
+        return
+
     if "--stage" in sys.argv:
         # staging-path micro-bench (pipelined round engine): staged
         # bytes/s, vectorized assembly ms, prefetch overlap ratio —

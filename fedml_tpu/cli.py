@@ -428,9 +428,18 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
 @click.option("--checkpoint", default=None,
               help="orbax round checkpoint (LLMTrainer.save_checkpoint) "
                    "to serve — LoRA payloads merge onto the base")
+@click.option("--live", "live_run_id", default=None,
+              help="federation run id: subscribe to its round publishes "
+                   "and hot-swap each aggregate into this endpoint "
+                   "(serving/live bridge; zero dropped requests)")
+@click.option("--live-backend", default="BROKER", show_default=True,
+              type=click.Choice(["LOCAL", "BROKER", "GRPC", "TRPC"]),
+              help="transport the ServingPublisher speaks")
+@click.option("--broker", default="127.0.0.1:1883", show_default=True,
+              help="host:port of the federation broker (BROKER backend)")
 def serve(model_size: str, host: str, port: int, batch_slots: int,
           max_len: int, lora_rank: int, quantize, hf_checkpoint,
-          checkpoint) -> None:
+          checkpoint, live_run_id, live_backend: str, broker: str) -> None:
     """Boot a continuous-batching LLM inference endpoint (blocking)."""
     import jax
     import jax.numpy as jnp
@@ -485,6 +494,26 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
         LlamaPredictor(engine), host=host, port=port,
         openai=OpenAIServing(engine, model_name=model_size),
     )
+    engine.model_slots.monitor = runner.monitor
+    if live_run_id:
+        from fedml_tpu.serving.live import FederatedServingBridge
+
+        import types
+
+        bhost, _, bport = broker.partition(":")
+        b = types.SimpleNamespace(run_id=live_run_id, broker_host=bhost,
+                                  broker_port=int(bport or 1883))
+        # compile the swap-transition decode programs BEFORE traffic:
+        # the first mid-swap partitioned step would otherwise JIT on the
+        # engine thread and stall every in-flight stream
+        engine.warm_swap_paths()
+        bridge = FederatedServingBridge(engine.model_slots, args=b,
+                                        run_id=live_run_id,
+                                        backend=live_backend)
+        bridge.run_async()  # announces itself → resync to latest round
+        click.echo(f"live serving plane: subscribed to federation "
+                   f"{live_run_id} over {live_backend} — each round "
+                   "hot-swaps into this endpoint")
     click.echo(f"serving {model_size} on http://{host}:{runner.port} "
                f"(/predict + /v1/completions + /v1/chat/completions)")
     runner.run()

@@ -104,6 +104,26 @@ class FedMLServerManager(FedMLCommManager):
         self._deadline_extensions_used = 0
         self._deadline = RoundDeadline(self._on_round_deadline)
 
+        # live serving plane: listeners see every closed round's aggregate
+        # (round_idx, global_params) — the serving publisher attaches here
+        # (serving/live/bridge.py). Guarded at call time: a serving-plane
+        # failure must never break training.
+        self._round_listeners = []
+
+    def add_round_listener(self, fn) -> None:
+        """Register ``fn(round_idx, global_params)`` to run after each
+        round aggregates (before the next broadcast)."""
+        self._round_listeners.append(fn)
+
+    def _notify_round_listeners(self, round_idx: int, global_params) -> None:
+        for fn in self._round_listeners:
+            try:
+                fn(round_idx, global_params)
+            except Exception:
+                logger.exception(
+                    "round listener %r failed at round %d (training "
+                    "continues)", fn, round_idx)
+
     # -- lifecycle ---------------------------------------------------------
     def run(self) -> None:
         super().run()
@@ -403,6 +423,7 @@ class FedMLServerManager(FedMLCommManager):
             global_params = self.aggregator.aggregate()
         self._health.finish_round(self.args.round_idx)
         self._devstats.sample("aggregate", self.args.round_idx)
+        self._notify_round_listeners(self.args.round_idx, global_params)
         with tracer.span(f"round/{self.args.round_idx}/eval"):
             metrics = self.aggregator.test_on_server_for_all_clients(
                 self.args.round_idx)

@@ -103,7 +103,8 @@ class TreeRunner:
                  quorum: float = 1.0, chunk: int = 2048, ef: bool = False,
                  chaos: Optional[Sequence[KillWindow]] = None,
                  delta_fn: Optional[Callable] = None,
-                 server_lr: float = 1.0):
+                 server_lr: float = 1.0,
+                 on_round: Optional[Callable[[int, Pytree], None]] = None):
         self.topology = topology
         self.codec = get_codec(codec)
         if self.codec is None:
@@ -122,6 +123,11 @@ class TreeRunner:
                 "TreeRunner virtual cohorts support float-leaf templates "
                 "only (int/bool leaves have no mean-delta semantics here)")
         self.delta_fn = delta_fn or _make_delta_fn(self.meta)
+        # live serving plane: called with (round_idx, global_params) after
+        # every root close — the serving publisher hooks here so the tree's
+        # aggregate hot-swaps into a running endpoint. Guarded at call
+        # time: a serving failure must not corrupt the federation.
+        self.on_round = on_round
         self._f32_tree_nbytes = sum(
             int(np.prod(sh, dtype=np.int64)) * 4 for _, sh in self.meta)
 
@@ -365,6 +371,11 @@ class TreeRunner:
                     lambda m: jnp.float32(self.server_lr) * m, mean))
             self.global_leaves = [
                 np.array(x) for x in jax.tree.leaves(new_global)]
+            if self.on_round is not None:
+                try:
+                    self.on_round(r, self.global_params)
+                except Exception:  # serving must never corrupt training
+                    logger.exception("round listener failed at round %d", r)
             for d, b in self._tier_round_bytes.items():
                 peak_round_bytes[d] = max(peak_round_bytes.get(d, 0), b)
         wall = time.perf_counter() - t0

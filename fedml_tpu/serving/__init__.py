@@ -6,7 +6,14 @@ Parity: reference ``serving/`` (``fedml_predictor.py``,
 (``model_scheduler/device_model_inference.py``).
 """
 from fedml_tpu.serving.inference_runner import FedMLInferenceRunner
-from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+from fedml_tpu.serving.live import (
+    FederatedServingBridge,
+    ModelSlots,
+    ServingPublisher,
+    SlotLease,
+    attach_round_publisher,
+)
+from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine, TokenStream
 from fedml_tpu.serving.llm_predictor import LlamaPredictor
 from fedml_tpu.serving.monitor import EndpointMonitor
 from fedml_tpu.serving.predictor import FedMLPredictor
@@ -15,6 +22,12 @@ __all__ = [
     "FedMLPredictor",
     "FedMLInferenceRunner",
     "ContinuousBatchingEngine",
+    "TokenStream",
     "LlamaPredictor",
     "EndpointMonitor",
+    "ModelSlots",
+    "SlotLease",
+    "FederatedServingBridge",
+    "ServingPublisher",
+    "attach_round_publisher",
 ]

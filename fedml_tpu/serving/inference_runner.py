@@ -15,9 +15,17 @@ exposes the OpenAI-compatible surface (parity:
 
   POST /v1/completions        text completion (JSON or SSE stream)
   POST /v1/chat/completions   chat completion (JSON or SSE stream)
+  GET  /v1/models             live slot's federation round + codec
 
-Every request is recorded in the EndpointMonitor (latency, errors), which
-mirrors the reference's endpoint monitoring into the local metrics sink.
+Overload shedding: the threading server accepts one OS thread per
+connection, but predictor work admission is bounded (``max_inflight``) —
+a request that cannot get a work permit within ``queue_wait_s`` is shed
+immediately with ``429`` + ``Retry-After``, so a load spike measures the
+engine's queue policy instead of piling unbounded threads onto it.
+
+Every request is recorded in the EndpointMonitor (latency, errors,
+rejections), which mirrors the reference's endpoint monitoring into the
+local metrics sink.
 """
 from __future__ import annotations
 
@@ -39,10 +47,16 @@ class FedMLInferenceRunner:
         port: int = 0,
         monitor: Optional[EndpointMonitor] = None,
         openai=None,
+        max_inflight: int = 64,
+        queue_wait_s: float = 0.05,
     ):
         self.predictor = predictor
         self.monitor = monitor or EndpointMonitor()
         self.openai = openai  # OpenAIServing adapter (optional)
+        # bounded admission: a permit per in-flight predictor request;
+        # acquisition waits at most queue_wait_s before shedding with 429
+        self._inflight = threading.BoundedSemaphore(int(max_inflight))
+        self._queue_wait_s = float(queue_wait_s)
         runner = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -54,17 +68,24 @@ class FedMLInferenceRunner:
             def log_message(self, *a):  # quiet
                 pass
 
+            def _send_json(self, obj, status: int = 200) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                if self.path.rstrip("/") in ("", "/ready", "/health"):
-                    body = json.dumps(
+                path = self.path.rstrip("/")
+                if path in ("", "/ready", "/health"):
+                    self._send_json(
                         {"ready": bool(runner.predictor.ready()),
-                         **runner.monitor.snapshot()}
-                    ).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                         **runner.monitor.snapshot()})
+                elif path == "/v1/models" and runner.openai is not None:
+                    # clients observe hot swaps end-to-end: the listing
+                    # names the live slot's federation round + codec
+                    self._send_json(runner.openai.models())
                 else:
                     self.send_error(404)
 
@@ -74,6 +95,34 @@ class FedMLInferenceRunner:
                 if path != "/predict" and not is_openai:
                     self.send_error(404)
                     return
+                if not runner._inflight.acquire(
+                        timeout=runner._queue_wait_s):
+                    # overload: shed fast with backpressure advice instead
+                    # of queueing unboundedly behind a saturated engine.
+                    # Drain the unread body first — the connection is
+                    # keep-alive (HTTP/1.1) and leftover bytes would be
+                    # parsed as the NEXT request's request line (400)
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n > (1 << 20):
+                        # too big to drain cheaply — drop the connection
+                        self.close_connection = True
+                    elif n > 0:
+                        self.rfile.read(n)
+                    runner.monitor.record_rejected()
+                    body = json.dumps({"error": "overloaded"}).encode()
+                    self.send_response(429)
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                try:
+                    self._do_post_admitted(path, is_openai)
+                finally:
+                    runner._inflight.release()
+
+            def _do_post_admitted(self, path, is_openai):
                 t0 = time.time()
                 ok = True
                 # distributed callers (gateway hops, federated serving)

@@ -1,0 +1,31 @@
+"""Live serving plane — each federation round hot-swaps into the running
+endpoint without dropping requests.
+
+- :mod:`~fedml_tpu.serving.live.slots`: double-buffered
+  :class:`ModelSlots` with lease refcounting and an atomic pointer flip;
+  compressed aggregates stage via ``device_put`` of the int8 blocks +
+  one jitted on-device decode (no host-side f32 tree).
+- :mod:`~fedml_tpu.serving.live.bridge`: :class:`ServingPublisher` /
+  :class:`FederatedServingBridge` — round-close → swap message → slot
+  staging over the standard transports with PR 5 retry/dedup semantics.
+
+See ``docs/serving.md`` ("Live serving plane") for the slot lifecycle.
+"""
+from fedml_tpu.serving.live.bridge import (
+    FederatedServingBridge,
+    ServeMessage,
+    ServingPublisher,
+    attach_round_publisher,
+    serve_namespace,
+)
+from fedml_tpu.serving.live.slots import ModelSlots, SlotLease
+
+__all__ = [
+    "ModelSlots",
+    "SlotLease",
+    "FederatedServingBridge",
+    "ServingPublisher",
+    "ServeMessage",
+    "attach_round_publisher",
+    "serve_namespace",
+]

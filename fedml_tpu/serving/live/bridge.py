@@ -1,0 +1,253 @@
+"""Federated serving bridge — round-close → live endpoint hot swap.
+
+Two small FSMs over the standard federation transports (LOCAL for tests
+and single-host, BROKER/GRPC/TRPC for deployments), riding the PR 5
+resilience layer for free (msg-id stamping + receiver dedup, jittered
+retry, auto-reconnect):
+
+- :class:`ServingPublisher` (rank 0) lives next to the training plane. It
+  is attached to the cross-silo server (``attach_round_publisher``) or
+  the hierarchy :class:`~fedml_tpu.hierarchy.TreeRunner` (``on_round=``)
+  and, each time a global round closes, encodes the aggregate ONCE with
+  the serving codec and sends a ``serve.p2s.swap`` message.
+- :class:`FederatedServingBridge` (rank 1) lives in the serving process.
+  Each swap message is staged into the endpoint's shadow
+  :class:`~fedml_tpu.serving.live.ModelSlots` slot and published with an
+  atomic flip.
+
+Loss semantics: every swap message carries the FULL aggregate for its
+round (never a delta against the previous swap), so a lost round r is
+simply superseded by r+1 — the endpoint can lag but can never wedge on a
+stale round. The bridge additionally announces itself (``serve.s2p.hello``)
+on startup and once per failed swap, and the publisher answers with a
+fresh copy of its latest round; duplicates are dropped by the comm-layer
+deduper and by the slots' round monotonicity.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+from fedml_tpu import constants
+from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.message import Message
+from fedml_tpu.serving.live.slots import ModelSlots
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+class ServeMessage:
+    MSG_TYPE_CONNECTION_IS_READY = "MSG_TYPE_CONNECTION_IS_READY"
+    MSG_TYPE_P2S_SWAP = "serve.p2s.swap"
+    MSG_TYPE_S2P_HELLO = "serve.s2p.hello"
+    MSG_TYPE_P2S_FINISH = "serve.p2s.finish"
+
+    ARG_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
+    ARG_ROUND = "round"
+    ARG_CODEC = Message.MSG_ARG_KEY_COMPRESSION
+
+
+def serve_namespace(run_id: str) -> str:
+    """The serving plane's comm namespace for a federation ``run_id``."""
+    return f"{run_id}/serve"
+
+
+class _BridgeArgs:
+    """Serving-plane comm namespace derived from the caller's args.
+
+    The publisher/bridge pair must NOT share the training federation's
+    (run_id, rank) channels: the publisher is rank 0, so it would share
+    the real server's LOCAL inbox (messages stolen nondeterministically),
+    its broker topics (every client upload fanned out to the serving
+    plane and every full-model swap to training client 1), and its
+    GRPC/TRPC port (bind error). The pair talks on ``<run_id>/serve``
+    with its own port block, inheriting every other transport/resilience
+    setting from the caller's args.
+    """
+
+    PORT_OFFSET = 32  # past any federation's rank range on this host
+
+    def __init__(self, args: Any, run_id: Optional[str]):
+        if args is not None:
+            try:
+                self.__dict__.update(vars(args))
+            except TypeError:  # args without __dict__ (mocks, slots)
+                pass
+        base = run_id if run_id is not None else str(
+            getattr(args, "run_id", "serve"))
+        self.run_id = serve_namespace(str(base))
+        self.grpc_base_port = int(
+            getattr(args, "grpc_base_port", 8890)) + self.PORT_OFFSET
+        self.trpc_master_port = int(
+            getattr(args, "trpc_master_port", 29500)) + self.PORT_OFFSET
+
+
+class ServingPublisher(FedMLCommManager):
+    """Training-side half: publish each closed round to the endpoint.
+
+    ``codec`` names the wire codec for swap payloads (e.g. ``int8``);
+    upload-only codecs (topk sparsifies a FULL model into a different
+    model) and ``None`` ship the aggregate plain.
+    """
+
+    def __init__(self, args: Any = None, run_id: Optional[str] = None,
+                 codec: Optional[str] = None, seed: int = 0,
+                 backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(_BridgeArgs(args, run_id), None, 0, 2, backend)
+        from fedml_tpu.compression import get_codec
+
+        self._codec = get_codec(codec) if isinstance(codec, str) else codec
+        if self._codec is not None and not self._codec.broadcast_safe:
+            logger.warning(
+                "serving codec %s is upload-only; swap payloads ship plain",
+                self._codec.spec)
+            self._codec = None
+        self.seed = int(seed)
+        self._latest_lock = threading.Lock()
+        self._latest = None  # (round_idx, payload, spec)
+        from fedml_tpu.telemetry import get_registry
+
+        self._g_published = get_registry().gauge("serving/round_published")
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            ServeMessage.MSG_TYPE_S2P_HELLO, self._handle_hello)
+
+    def publish(self, round_idx: int, global_params: Pytree) -> None:
+        """Encode once, remember as latest, send to the serving rank."""
+        from fedml_tpu import telemetry
+        from fedml_tpu.compression import derive_key
+
+        round_idx = int(round_idx)
+        with telemetry.get_tracer().span("serve/publish", round=round_idx):
+            if self._codec is not None:
+                payload = self._codec.encode(
+                    global_params,
+                    key=derive_key(self.seed, round_idx, 0))
+                spec = self._codec.spec
+            else:
+                payload, spec = global_params, None
+        with self._latest_lock:
+            self._latest = (round_idx, payload, spec)
+        self._g_published.set(float(round_idx))
+        self._send_swap(round_idx, payload, spec)
+
+    def _send_swap(self, round_idx: int, payload, spec) -> None:
+        m = Message(ServeMessage.MSG_TYPE_P2S_SWAP, self.get_sender_id(), 1)
+        m.add_params(ServeMessage.ARG_MODEL_PARAMS, payload)
+        m.add_params(ServeMessage.ARG_ROUND, round_idx)
+        if spec is not None:
+            m.add_params(ServeMessage.ARG_CODEC, spec)
+        self.send_message(m)
+
+    def _handle_hello(self, msg: Message) -> None:
+        """A (re)connecting endpoint asks for the latest round: resend it.
+        The bridge's slots drop it if it already landed — idempotent."""
+        with self._latest_lock:
+            latest = self._latest
+        if latest is not None:
+            self._send_swap(*latest)
+
+    def finish(self) -> None:
+        try:
+            self.send_message(Message(ServeMessage.MSG_TYPE_P2S_FINISH,
+                                      self.get_sender_id(), 1))
+        except Exception:  # pragma: no cover - peer may already be gone
+            logger.debug("serving finish notify failed", exc_info=True)
+        super().finish()
+
+
+class FederatedServingBridge(FedMLCommManager):
+    """Serving-side half: swap messages → slot staging → atomic flip."""
+
+    def __init__(self, slots: ModelSlots, args: Any = None,
+                 run_id: Optional[str] = None,
+                 backend: str = constants.COMM_BACKEND_LOCAL):
+        super().__init__(_BridgeArgs(args, run_id), None, 1, 2, backend)
+        self.slots = slots
+        self.round_published: Optional[int] = None
+        self.swap_errors = 0
+        self._failed_rounds: set = set()
+        from fedml_tpu.telemetry import get_registry
+
+        self._g_published = get_registry().gauge("serving/round_published")
+
+    def run_async(self):
+        """Start the receive loop AND announce ourselves: on distributed
+        backends the startup hello/resync must fire here too — ``run()``
+        self-delivers CONNECTION_IS_READY but ``run_async`` (the serve
+        CLI path) does not, and without it an endpoint booted
+        mid-federation would serve its boot checkpoint until the next
+        round happens to close. LOCAL keeps its explicit test kick."""
+        t = super().run_async()
+        self._notify_connection_ready()
+        return t
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            ServeMessage.MSG_TYPE_CONNECTION_IS_READY, self._handle_ready)
+        self.register_message_receive_handler(
+            ServeMessage.MSG_TYPE_P2S_SWAP, self._handle_swap)
+        self.register_message_receive_handler(
+            ServeMessage.MSG_TYPE_P2S_FINISH, lambda m: self.finish())
+
+    def _handle_ready(self, msg: Message) -> None:
+        self.request_resync()
+
+    def request_resync(self) -> None:
+        """Ask the publisher for its latest round (startup / lag heal)."""
+        self.send_message(Message(ServeMessage.MSG_TYPE_S2P_HELLO,
+                                  self.get_sender_id(), 0))
+
+    @property
+    def lag(self) -> int:
+        """Rounds the endpoint trails the newest round it has SEEN."""
+        cur = self.slots.live_round
+        if self.round_published is None or cur is None:
+            return 0
+        return max(0, self.round_published - cur)
+
+    def _handle_swap(self, msg: Message) -> None:
+        round_idx = int(msg.get(ServeMessage.ARG_ROUND))
+        payload = msg.get(ServeMessage.ARG_MODEL_PARAMS)
+        spec = msg.get(ServeMessage.ARG_CODEC)
+        if self.round_published is None or round_idx > self.round_published:
+            self.round_published = round_idx
+            self._g_published.set(float(round_idx))
+        try:
+            swapped = self.slots.publish_payload(payload, round_idx, spec)
+        except Exception:
+            # a corrupt payload must not wedge the endpoint: keep serving
+            # the current round, count the failure, and re-request the
+            # latest state — but only ONCE per failing round. A payload
+            # that fails deterministically (unknown codec spec, shape
+            # mismatch) would otherwise livelock: hello → identical
+            # resend → same failure, a full model per iteration. After
+            # one retry the round is written off; the next round's
+            # publish supersedes it.
+            self.swap_errors += 1
+            logger.exception("swap for round %d failed; endpoint stays on "
+                             "round %s", round_idx, self.slots.live_round)
+            if round_idx not in self._failed_rounds:
+                self._failed_rounds.add(round_idx)
+                self._failed_rounds = {
+                    r for r in self._failed_rounds if r > round_idx - 128}
+                self.request_resync()
+            return
+        if swapped:
+            logger.info("endpoint hot-swapped to round %d%s", round_idx,
+                        f" ({spec})" if spec else "")
+
+
+def attach_round_publisher(server_manager: Any,
+                           publisher: ServingPublisher) -> None:
+    """Wire a cross-silo server's round close to the serving publisher.
+
+    Uses the server manager's round-listener hook; the publisher's send
+    path (encode + comm) runs on the server's round-advance thread but is
+    guarded there so a serving-plane failure can never break training.
+    """
+    server_manager.add_round_listener(publisher.publish)

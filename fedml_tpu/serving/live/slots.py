@@ -1,0 +1,260 @@
+"""Double-buffered live model slots — hot-swap weights under traffic.
+
+The serving engine never reads "the params"; it *acquires a lease* on the
+currently-published slot. A federation round publishes its new aggregate
+by staging it into the shadow slot (``device_put`` + any on-device dequant
+happen OFF the request path, on the publisher/bridge thread) and flipping
+the live pointer atomically. Requests that acquired the old slot finish on
+it — a generation never mixes two rounds' weights — and the old slot's
+device buffers are reclaimed only when its lease refcount drains to zero.
+
+Int8-native weight path: a :class:`~fedml_tpu.compression.CompressedTree`
+aggregate (the cross-silo server's / tree root's wire format) is staged by
+``device_put``-ing the compressed blocks (int8 q + f32 scales — the only
+thing that crosses host→device) and decoding INSIDE one jitted on-device
+program; a host-side f32 tree is never materialized. When the engine runs
+int8-resident weights, its quantize transform chains onto the same staging
+program, so the slot holds int8 blocks end to end.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+class _Slot:
+    """One weight generation: params + round identity + lease refcount."""
+
+    __slots__ = ("params", "round_idx", "codec_spec", "refs", "retired",
+                 "reclaimed")
+
+    def __init__(self, params: Pytree, round_idx: Optional[int],
+                 codec_spec: Optional[str]):
+        self.params = params
+        self.round_idx = round_idx
+        self.codec_spec = codec_spec
+        self.refs = 0
+        self.retired = False
+        self.reclaimed = threading.Event()
+
+
+class SlotLease:
+    """A refcounted handle on one slot; ``release`` exactly once.
+
+    The params behind a held lease are guaranteed stable: the slot cannot
+    be reclaimed (its device buffers freed) until every lease on it is
+    released, even after a newer round is published.
+    """
+
+    __slots__ = ("_slots", "_slot", "_released")
+
+    def __init__(self, slots: "ModelSlots", slot: _Slot):
+        self._slots = slots
+        self._slot = slot
+        self._released = False
+
+    @property
+    def params(self) -> Pytree:
+        return self._slot.params
+
+    @property
+    def round_idx(self) -> Optional[int]:
+        return self._slot.round_idx
+
+    @property
+    def codec_spec(self) -> Optional[str]:
+        return self._slot.codec_spec
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._slots._release(self._slot)
+
+    def __enter__(self) -> "SlotLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class ModelSlots:
+    """Atomic-flip holder for the endpoint's live weights.
+
+    ``round_idx=None`` marks a static deployment (a frozen checkpoint that
+    no federation ever updates) — protocol layers then keep their legacy
+    model naming. The first :meth:`publish` makes the deployment live.
+
+    ``transform`` (optional) is a device-side post-stage hook — the engine
+    installs its int8 weight-quantization here so published aggregates
+    land in the same representation its compiled programs consume.
+    """
+
+    def __init__(self, params: Pytree, round_idx: Optional[int] = None,
+                 codec_spec: Optional[str] = None,
+                 transform: Optional[Callable[[Pytree], Pytree]] = None,
+                 monitor: Any = None):
+        self._lock = threading.Lock()
+        self._live = _Slot(params, round_idx, codec_spec)
+        self.transform = transform
+        self.monitor = monitor
+        self.swap_count = 0
+        self.stale_drops = 0
+        from fedml_tpu.telemetry import get_registry
+
+        self._reg = get_registry()
+        self._g_round = self._reg.gauge("serving/round_current")
+        self._c_swaps = self._reg.counter("serving/swaps")
+        self._c_stale = self._reg.counter("serving/swaps_stale")
+        self._c_reclaimed = self._reg.counter("serving/slots_reclaimed")
+        self._h_stall = self._reg.histogram("serving/swap_stall_ms")
+        self._g_wire = self._reg.gauge("serving/stage_wire_bytes")
+        if round_idx is not None:
+            self._g_round.set(float(round_idx))
+
+    # -- read side (request path) -----------------------------------------
+    @property
+    def live_params(self) -> Pytree:
+        return self._live.params
+
+    @property
+    def live_round(self) -> Optional[int]:
+        return self._live.round_idx
+
+    @property
+    def live_codec(self) -> Optional[str]:
+        return self._live.codec_spec
+
+    def acquire(self) -> SlotLease:
+        with self._lock:
+            slot = self._live
+            slot.refs += 1
+            return SlotLease(self, slot)
+
+    def _release(self, slot: _Slot) -> None:
+        with self._lock:
+            slot.refs -= 1
+            reclaim = slot.retired and slot.refs <= 0
+        if reclaim:
+            self._reclaim(slot)
+
+    def _reclaim(self, slot: _Slot) -> None:
+        # dropping the reference is the reclamation: jax frees the old
+        # generation's device buffers once nothing points at them
+        slot.params = None
+        slot.reclaimed.set()
+        self._c_reclaimed.inc()
+
+    # -- write side (publisher/bridge thread, off the request path) -------
+    def stage(self, payload: Pytree, codec_spec: Optional[str] = None):
+        """Move one aggregate onto the device, decode + transform there.
+
+        ``payload`` is either a plain pytree or a ``CompressedTree``; the
+        return value is the ready-to-serve params tree (still on device).
+        Only the payload's wire representation crosses host→device — for
+        int8 that is the blocks + scales, ~4x smaller than the f32 tree
+        it decodes to, and the decode itself is one jitted program whose
+        output stays on device (no host f32 round trip).
+        """
+        import jax
+
+        from fedml_tpu import telemetry
+        from fedml_tpu.compression import CompressedTree, get_codec
+        from fedml_tpu.utils.serialization import tree_nbytes
+
+        t0 = time.perf_counter()
+        wire_nbytes = tree_nbytes(payload)
+        with telemetry.get_tracer().span(
+                "serve/stage",
+                codec=(payload.codec if isinstance(payload, CompressedTree)
+                       else "plain"), wire_nbytes=wire_nbytes):
+            if isinstance(payload, CompressedTree):
+                ct = jax.device_put(payload)  # compressed blocks only
+                codec = get_codec(codec_spec or ct.codec)
+                params = codec.decode(ct)  # one jitted on-device program
+            else:
+                params = jax.device_put(payload)
+                if self.transform is not None:
+                    # device_put is a NO-COPY for arrays already on the
+                    # target device, and the transform may donate
+                    # (delete) its input — an in-process publisher's
+                    # retained resync payload / the training loop's own
+                    # params must never lose their buffers. Copy exactly
+                    # the aliased leaves.
+                    import jax.numpy as jnp
+
+                    params = jax.tree.map(
+                        lambda orig, staged: (jnp.copy(staged)
+                                              if staged is orig
+                                              else staged),
+                        payload, params)
+            if self.transform is not None:
+                params = self.transform(params)
+        self._g_wire.set(float(wire_nbytes))
+        telemetry.sample_now("serve_stage")
+        logger.debug("staged %d wire bytes in %.1f ms", wire_nbytes,
+                     (time.perf_counter() - t0) * 1e3)
+        return params
+
+    def publish(self, params: Pytree, round_idx: int,
+                codec_spec: Optional[str] = None) -> bool:
+        """Atomic pointer flip to already-staged ``params``.
+
+        Monotonic in ``round_idx``: a duplicate or late-arriving older
+        round is dropped (counted), so transport resends and reordering
+        can never roll the endpoint backwards.
+        """
+        from fedml_tpu import telemetry
+
+        round_idx = int(round_idx)
+        with telemetry.get_tracer().span("serve/swap", round=round_idx), \
+                self._lock:
+            cur = self._live.round_idx
+            if cur is not None and round_idx <= cur:
+                self.stale_drops += 1
+                self._c_stale.inc()
+                return False
+            old = self._live
+            self._live = _Slot(params, round_idx, codec_spec)
+            old.retired = True
+            reclaim_now = old.refs <= 0
+            self.swap_count += 1
+        if reclaim_now:
+            self._reclaim(old)
+        self._g_round.set(float(round_idx))
+        self._c_swaps.inc()
+        if self.monitor is not None:
+            try:
+                self.monitor.record_swap(round_idx)
+            except Exception:  # pragma: no cover - telemetry must not kill
+                logger.exception("swap monitor record failed")
+        return True
+
+    def publish_payload(self, payload: Pytree, round_idx: int,
+                        codec_spec: Optional[str] = None) -> bool:
+        """Stage (device_put + on-device decode) then flip — the one call
+        the federation bridge makes per round."""
+        with self._lock:
+            cur = self._live.round_idx
+        if cur is not None and int(round_idx) <= cur:
+            # don't pay device staging for a round that can't win the flip
+            self.stale_drops += 1
+            self._c_stale.inc()
+            return False
+        params = self.stage(payload, codec_spec)
+        return self.publish(params, round_idx, codec_spec)
+
+    def record_swap_stall(self, round_idx: int, stall_ms: float) -> None:
+        """The serving engine reports the request-visible pause it saw at
+        its first step on a freshly-published slot (0 when it was idle)."""
+        self._h_stall.observe(float(stall_ms))
+        if self.monitor is not None:
+            try:
+                self.monitor.record_swap_stall(round_idx, stall_ms)
+            except Exception:  # pragma: no cover
+                logger.exception("swap stall record failed")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -29,13 +30,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu.serving.live.slots import ModelSlots, SlotLease
+
 Pytree = Any
+
+
+class TokenStream(queue.Queue):
+    """The per-request token queue, annotated with the weight generation
+    that served it (set at admission; ``None`` until then / for static
+    deployments). Yields ints then a final ``None`` like a plain Queue."""
+
+    round_idx: Optional[int] = None
 
 
 @dataclass
 class _Slot:
     request_id: int = -1
-    out: Optional[queue.Queue] = None
+    out: Optional[TokenStream] = None
     last_token: int = 0
     generated: int = 0
     max_new: int = 0
@@ -44,6 +55,7 @@ class _Slot:
     eos_id: Optional[int] = None
     active: bool = False
     tokens: List[int] = field(default_factory=list)
+    lease: Optional[SlotLease] = None
 
 
 class ContinuousBatchingEngine:
@@ -66,8 +78,10 @@ class ContinuousBatchingEngine:
         eos_id: Optional[int] = None,
         quantize: Optional[str] = None,
         quantize_donate: bool = False,
+        initial_round: Optional[int] = None,
     ):
         self.model = model
+        param_transform = None
         if quantize in ("int8", "int8_w8a8", "w8a8", "int8_pallas", "pallas",
                         "int8_dequant"):
             # int8 (default = fused pallas kernel): halves HBM residency
@@ -87,9 +101,21 @@ class ContinuousBatchingEngine:
             # caller's params tree (class docstring)
             params = quantize_params_int8(params, mode=mode,
                                           donate=quantize_donate)
+            # hot-swapped rounds must land in the same int8-resident
+            # representation the compiled programs consume; staged trees
+            # are fresh device copies, so donating them is always safe
+            param_transform = lambda p: quantize_params_int8(  # noqa: E731
+                p, mode=mode, donate=True)
         elif quantize is not None:
             raise ValueError(f"unknown quantize mode: {quantize!r}")
-        self.params = params
+        # live-weights indirection: the engine never holds "the params" —
+        # every request leases the currently-published slot, so a
+        # federation round can hot-swap weights under traffic without
+        # touching in-flight generations (see serving/live/slots.py)
+        self.model_slots = ModelSlots(params, round_idx=initial_round,
+                                      transform=param_transform)
+        self._round_in_use = self.model_slots.live_round
+        self._last_step_end: Optional[float] = None
         self.n_slots = int(batch_slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
@@ -167,8 +193,61 @@ class ContinuousBatchingEngine:
             logits = logits[:, 0, :]
             return caches, logits, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+        def decode_group_fn(params, caches, last_tokens, lengths, idx):
+            """Advance only the slot rows in ``idx`` with THESE params —
+            the swap-transition path, where in-flight streams pinned to
+            the old weight generation and new streams on the fresh one
+            must decode against different trees in the same step. Rows
+            outside ``idx`` (the other generation's) are untouched."""
+            idx_len = lengths[idx]
+            sub = [(k[idx], v[idx], idx_len) for k, v in caches]
+            logits, new_sub = model_apply(
+                params,
+                last_tokens[idx][:, None],
+                positions=idx_len[:, None],
+                kv_caches=sub,
+            )
+            caches = [
+                (k.at[idx].set(nk), v.at[idx].set(nv))
+                for (k, v), (nk, nv, _) in zip(caches, new_sub)
+            ]
+            logits = logits[:, 0, :]
+            return caches, logits, jnp.argmax(logits, axis=-1).astype(
+                jnp.int32)
+
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+        self._decode_group = jax.jit(decode_group_fn, donate_argnums=(1,))
+
+    @property
+    def params(self) -> Pytree:
+        """The currently-published weight generation (live slot)."""
+        return self.model_slots.live_params
+
+    def warm_swap_paths(self) -> None:
+        """Pre-compile the grouped (swap-transition) decode program for
+        every group size. The first hot swap under traffic otherwise
+        JIT-compiles ``_decode_group`` ON the engine thread, freezing
+        every in-flight stream for the compile — exactly the stall the
+        live plane exists to avoid. Call once at boot, before traffic
+        (the serve CLI does when ``--live`` is set; idle-only: it runs
+        the program, so active streams would read a garbage token)."""
+        if self.active_slots:
+            # must fail even under python -O: warming runs the decode
+            # program over live KV rows and then resets the caches
+            raise RuntimeError("warm_swap_paths needs an idle pool")
+        params = self.model_slots.live_params
+        last = jnp.zeros((self.n_slots,), jnp.int32)
+        for k in range(1, self.n_slots + 1):
+            # executing (not AOT-lowering) is what populates the jit
+            # cache; caches are donated, so thread the result through
+            self.caches, _, _ = self._decode_group(
+                params, self.caches, last, jnp.asarray(self.lengths),
+                jnp.arange(k, dtype=jnp.int32))
+        # the warm steps wrote model output into cache position 0 of the
+        # warmed rows; reset so the pool starts from a pristine state
+        self.caches = [(jnp.zeros_like(c[0]), jnp.zeros_like(c[1]))
+                       for c in self.caches]
 
     # -- public API -------------------------------------------------------
     def submit(
@@ -178,17 +257,19 @@ class ContinuousBatchingEngine:
         temperature: float = 0.0,
         seed: int = 0,
         eos_id: Optional[int] = None,
-    ) -> "queue.Queue":
+    ) -> TokenStream:
         """Enqueue a generation request; returns the token stream queue.
 
-        The queue yields ints (generated token ids) and a final ``None``.
+        The queue yields ints (generated token ids) and a final ``None``;
+        its ``round_idx`` attribute names the weight generation that
+        served it once the request is admitted.
         """
         if len(prompt_tokens) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt({len(prompt_tokens)}) + max_new({max_new_tokens}) "
                 f"exceeds max_len={self.max_len}"
             )
-        out: "queue.Queue" = queue.Queue()
+        out = TokenStream()
         with self._lock:
             self._req_counter += 1
             rid = self._req_counter
@@ -243,18 +324,49 @@ class ContinuousBatchingEngine:
         p /= p.sum()
         return int(slot.rng.choice(len(p), p=p))
 
+    def _note_slot_use(self, lease: SlotLease) -> None:
+        """Swap-stall accounting: the first admission on a freshly-
+        published slot reports the request-visible pause since the last
+        device step (0 when the engine was idle at the flip)."""
+        if lease.round_idx is None or lease.round_idx == self._round_in_use:
+            return
+        prev = self._round_in_use
+        self._round_in_use = lease.round_idx
+        if prev is None:
+            return
+        stall_ms = 0.0
+        if self.active_slots and self._last_step_end is not None:
+            stall_ms = max(
+                0.0, (time.perf_counter() - self._last_step_end) * 1e3)
+        self.model_slots.record_swap_stall(lease.round_idx, stall_ms)
+
+    def _retire(self, slot: _Slot) -> None:
+        slot.out.put(None)
+        slot.active = False
+        if slot.lease is not None:
+            slot.lease.release()
+            slot.lease = None
+
     def _admit(self, req) -> None:
         rid, prompt, max_new, temp, seed, eos, out = req
         slot_idx = next(i for i, s in enumerate(self.slots) if not s.active)
+        # pin the request to the CURRENT weight generation: every prefill
+        # and decode step of this stream runs against the leased params,
+        # so a mid-request hot swap can never mix rounds in one response
+        lease = self.model_slots.acquire()
+        self._note_slot_use(lease)
         p = self._bucket(len(prompt))
         self.oplog.append(("prefill", p, self.active_slots))
         padded = np.zeros((1, p), np.int32)
         padded[0, : len(prompt)] = prompt
         self.caches, last_logits, greedy = self._prefill(
-            self.params, self.caches, jnp.asarray(padded),
+            lease.params, self.caches, jnp.asarray(padded),
             jnp.int32(slot_idx), jnp.int32(len(prompt)),
         )
+        self._last_step_end = time.perf_counter()
         slot = self.slots[slot_idx]
+        slot.lease = lease
+        out.round_idx = lease.round_idx
         slot.request_id = rid
         slot.out = out
         slot.generated = 0
@@ -284,8 +396,7 @@ class ContinuousBatchingEngine:
         if (slot.eos_id is not None and tok == slot.eos_id) or (
             slot.generated >= slot.max_new
         ):
-            slot.out.put(None)
-            slot.active = False
+            self._retire(slot)
 
     def _loop(self) -> None:
         while not self._stopping.is_set():
@@ -314,29 +425,73 @@ class ContinuousBatchingEngine:
             self.step()
 
     def step(self) -> None:
-        """One batched decode step for every active slot."""
-        self.oplog.append(("decode", self.active_slots, 0))
+        """One batched decode step for every active slot.
+
+        Steady state (every active stream leases the same weight
+        generation) runs the ONE whole-pool decode program. During a swap
+        transition — old-round streams finishing while new-round streams
+        start — the step partitions by generation and advances each group
+        with its own params through the gather/scatter decode program, so
+        no stream ever sees the other generation's weights.
+        """
+        active = [i for i, s in enumerate(self.slots) if s.active]
+        if not active:
+            return
+        groups: Dict[int, List[int]] = {}
+        leases: Dict[int, SlotLease] = {}
+        for i in active:
+            lease = self.slots[i].lease
+            key = id(lease._slot)
+            groups.setdefault(key, []).append(i)
+            leases[key] = lease
         last = np.asarray([s.last_token for s in self.slots], np.int32)
         lengths = jnp.asarray(self.lengths)
-        self.caches, logits_dev, greedy_dev = self._decode(
-            self.params, self.caches, jnp.asarray(last), lengths
-        )
-        # pull the [B, V] logits only if some active slot samples; greedy
-        # streams need just the [B] int32 argmax
-        need_logits = any(s.active and s.temperature > 0.0
-                          for s in self.slots)
-        logits = np.asarray(logits_dev) if need_logits else None
-        greedy = np.asarray(greedy_dev)
-        for i, slot in enumerate(self.slots):
-            if not slot.active:
-                continue
+        greedy_by: Dict[int, int] = {}
+        logits_by: Dict[int, np.ndarray] = {}
+        if len(groups) == 1:
+            (key,) = groups
+            self.oplog.append(("decode", len(active), 0))
+            self.caches, logits_dev, greedy_dev = self._decode(
+                leases[key].params, self.caches, jnp.asarray(last), lengths
+            )
+            # pull the [B, V] logits only if some active slot samples;
+            # greedy streams need just the [B] int32 argmax
+            need = any(self.slots[i].temperature > 0.0 for i in active)
+            logits = np.asarray(logits_dev) if need else None
+            greedy = np.asarray(greedy_dev)
+            for i in active:
+                greedy_by[i] = int(greedy[i])
+                if logits is not None:
+                    logits_by[i] = logits[i]
+        else:
+            # deterministic group order (oldest round first) so two runs
+            # of the same swap schedule replay identically
+            order = sorted(groups, key=lambda k: (
+                -1 if leases[k].round_idx is None else leases[k].round_idx))
+            last_dev = jnp.asarray(last)
+            for key in order:
+                idxs = groups[key]
+                self.oplog.append(("decode_part", len(idxs), 0))
+                self.caches, logits_dev, greedy_dev = self._decode_group(
+                    leases[key].params, self.caches, last_dev, lengths,
+                    jnp.asarray(np.asarray(idxs, np.int32)),
+                )
+                need = any(self.slots[i].temperature > 0.0 for i in idxs)
+                logits = np.asarray(logits_dev) if need else None
+                greedy = np.asarray(greedy_dev)
+                for j, i in enumerate(idxs):
+                    greedy_by[i] = int(greedy[j])
+                    if logits is not None:
+                        logits_by[i] = logits[j]
+        for i in active:
+            slot = self.slots[i]
             # this step wrote the slot's last token at position lengths[i]
             self.lengths[i] += 1
             if self.lengths[i] >= self.max_len:
-                slot.out.put(None)
-                slot.active = False
+                self._retire(slot)
                 continue
             if slot.temperature > 0.0:
-                self._emit(i, logits=logits[i])
+                self._emit(i, logits=logits_by[i])
             else:
-                self._emit(i, tok=int(greedy[i]))
+                self._emit(i, tok=greedy_by[i])
+        self._last_step_end = time.perf_counter()

@@ -18,12 +18,19 @@ from fedml_tpu.telemetry import get_registry
 
 
 class EndpointMonitor:
-    def __init__(self, endpoint_id: str = "default", args: Any = None):
+    def __init__(self, endpoint_id: str = "default", args: Any = None,
+                 slo_ms: float = 0.0):
         self.endpoint_id = endpoint_id
         self._started = time.time()
         self._metrics = None
         reg = get_registry()
         labels = {"endpoint": endpoint_id}
+        # exported so `telemetry doctor` can judge p99 against the
+        # deployment's own latency objective (0 = no SLO declared)
+        self._g_slo = reg.gauge("serving/slo_ms", labels=labels)
+        # set unconditionally: the gauge is cumulative per process, so a
+        # redeploy that declares NO SLO must clear the previous one
+        self._g_slo.set(float(slo_ms or 0))
         self._hist = reg.histogram("serving/request_ms", labels=labels)
         self._m_requests = reg.counter("serving/requests", labels=labels)
         self._m_errors = reg.counter("serving/errors", labels=labels)
@@ -31,6 +38,16 @@ class EndpointMonitor:
         self._g_uptime.set(0.0)  # fresh deployment starts its clock
         self._g_last_request = reg.gauge("serving/last_request_ts",
                                          labels=labels)
+        # live serving plane: which federation round the endpoint serves,
+        # how many hot swaps it absorbed, the request-visible stall each
+        # one caused, and overload rejections from the bounded queue
+        self._g_round = reg.gauge("serving/round_current", labels=labels)
+        self._c_swaps = reg.counter("serving/swaps", labels=labels)
+        self._h_swap_stall = reg.histogram("serving/swap_stall_ms",
+                                           labels=labels)
+        self._c_rejected = reg.counter("serving/rejected", labels=labels)
+        self._base_rejected = self._c_rejected.value
+        self._base_swaps = self._c_swaps.value
         # registry instruments are cumulative per (endpoint, process) —
         # a redeploy reuses them. Baselines make snapshot() report THIS
         # deployment's counts/average, consistent with its uptime.
@@ -60,6 +77,20 @@ class EndpointMonitor:
         # polls snapshot() — a flush mid-serve must not report uptime 0
         self._g_uptime.set(round(now - self._started, 1))
 
+    def record_swap(self, round_idx: int) -> None:
+        """A new federation round was hot-swapped into the endpoint."""
+        self._g_round.set(float(round_idx))
+        self._c_swaps.inc()
+
+    def record_swap_stall(self, round_idx: int, stall_ms: float) -> None:
+        """Request-visible pause the engine attributed to one swap."""
+        self._h_swap_stall.observe(float(stall_ms))
+
+    def record_rejected(self) -> None:
+        """A request was shed with 429 by the bounded request queue."""
+        self._c_rejected.inc()
+        self._g_last_request.set(time.time())
+
     def snapshot(self) -> Dict:
         hist = self._hist.snapshot()
         uptime = round(time.time() - self._started, 1)
@@ -78,7 +109,15 @@ class EndpointMonitor:
             "latency_p99_ms": round(hist["p99"], 3),
             "uptime_s": uptime,
             "last_request_ts": last_ts or None,
+            "rejected": int(self._c_rejected.value - self._base_rejected),
+            "swaps": int(self._c_swaps.value - self._base_swaps),
+            "round_current": (int(self._g_round.value)
+                              if self._c_swaps.value - self._base_swaps
+                              else None),
         }
+        stall = self._h_swap_stall.snapshot()
+        if stall["count"]:
+            snap["swap_stall_max_ms"] = round(stall["max"], 3)
         if self._metrics is not None:
             try:
                 self._metrics.log({"endpoint": snap})

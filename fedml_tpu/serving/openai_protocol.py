@@ -58,6 +58,31 @@ class OpenAIServing:
         self.max_tokens_cap = max_tokens_cap
         engine.start()
 
+    # -- live-plane observability ------------------------------------------
+    def _model_tag(self, base: str, round_idx: Optional[int]) -> str:
+        """Clients observe hot swaps end-to-end: when the endpoint serves
+        a live federation, the model field names the round that actually
+        served the request (``fedml-tpu/round-42``). Static deployments
+        (no published round) keep the plain model name."""
+        if round_idx is None:
+            return base
+        return f"{base}/round-{round_idx}"
+
+    def models(self) -> Dict:
+        """The ``/v1/models`` listing: the live slot's round + codec."""
+        slots = getattr(self.engine, "model_slots", None)
+        round_idx = slots.live_round if slots is not None else None
+        return {
+            "object": "list",
+            "data": [{
+                "id": self._model_tag(self.model_name, round_idx),
+                "object": "model",
+                "owned_by": "fedml-tpu",
+                "round": round_idx,
+                "codec": slots.live_codec if slots is not None else None,
+            }],
+        }
+
     # -- routing -----------------------------------------------------------
     def handle(self, path: str, request: Dict) -> Any:
         path = path.rstrip("/")
@@ -106,33 +131,47 @@ class OpenAIServing:
         created = int(time.time())
         obj = "chat.completion" if chat else "text_completion"
 
-        if request.get("stream"):
-            q = self.engine.submit(prompt_ids, max_tokens, temperature,
-                                   seed, eos_id=self.tok.eos_id)
+        base_model = str(request.get("model", self.model_name))
+        q = self.engine.submit(prompt_ids, max_tokens, temperature,
+                               seed, eos_id=self.tok.eos_id)
 
+        if request.get("stream"):
             def events():
+                # the serving round is pinned at admission — wait for the
+                # first token before framing any chunk, so every chunk of
+                # the stream (preamble included) names the round that is
+                # actually generating it
+                tok = q.get()
+                model = self._model_tag(base_model, q.round_idx)
                 if chat:  # role preamble chunk, as the OpenAI API sends
-                    yield self._chunk(rid, created, {"role": "assistant"},
-                                      None)
+                    yield self._chunk(rid, created, model,
+                                      {"role": "assistant"}, None)
                 while True:
-                    tok = q.get()
                     if tok is None or tok == self.tok.eos_id:
                         if chat:
-                            yield self._chunk(rid, created, {}, "stop")
+                            yield self._chunk(rid, created, model, {},
+                                              "stop")
                         else:
-                            yield self._text_chunk(rid, created, "", "stop")
+                            yield self._text_chunk(rid, created, model, "",
+                                                   "stop")
                         return
                     piece = self.tok.decode([tok])
                     if chat:
-                        yield self._chunk(rid, created, {"content": piece},
-                                          None)
+                        yield self._chunk(rid, created, model,
+                                          {"content": piece}, None)
                     else:
-                        yield self._text_chunk(rid, created, piece, None)
+                        yield self._text_chunk(rid, created, model, piece,
+                                               None)
+                    tok = q.get()
 
             return SSEStream(events())
 
-        out_ids = self.engine.generate(prompt_ids, max_tokens, temperature,
-                                       seed, eos_id=self.tok.eos_id)
+        out_ids = []
+        while True:
+            tok = q.get()
+            if tok is None:
+                break
+            out_ids.append(tok)
         text = self.tok.decode(out_ids)
         finish = "stop" if (out_ids and out_ids[-1] == self.tok.eos_id) \
             else "length"
@@ -148,17 +187,17 @@ class OpenAIServing:
             choice = {"index": 0, "finish_reason": finish, "text": text,
                       "logprobs": None}
         return {"id": rid, "object": obj, "created": created,
-                "model": request.get("model", self.model_name),
+                "model": self._model_tag(base_model, q.round_idx),
                 "choices": [choice], "usage": usage}
 
-    def _chunk(self, rid, created, delta, finish) -> Dict:
+    def _chunk(self, rid, created, model, delta, finish) -> Dict:
         return {"id": rid, "object": "chat.completion.chunk",
-                "created": created, "model": self.model_name,
+                "created": created, "model": model,
                 "choices": [{"index": 0, "delta": delta,
                              "finish_reason": finish}]}
 
-    def _text_chunk(self, rid, created, text, finish) -> Dict:
+    def _text_chunk(self, rid, created, model, text, finish) -> Dict:
         return {"id": rid, "object": "text_completion", "created": created,
-                "model": self.model_name,
+                "model": model,
                 "choices": [{"index": 0, "text": text,
                              "finish_reason": finish, "logprobs": None}]}

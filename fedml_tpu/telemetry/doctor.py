@@ -24,6 +24,7 @@ from fedml_tpu.telemetry.health import _median
 from fedml_tpu.telemetry.report import (
     _load_jsonl,
     build_report,
+    load_metrics,
     normalize_name,
 )
 
@@ -297,14 +298,76 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         notes.setdefault("services",
                          "no data: no serving/* or scheduler/* metrics")
 
-    # -- connectivity (resilience/* counters + resilience_event records) --
-    from fedml_tpu.telemetry.report import load_metrics
+    # telemetry.jsonl is read once and shared by the serving /
+    # connectivity / tier sections below — it holds append-mode
+    # CUMULATIVE registry snapshots, so each section keeps the latest
+    # record per key rather than summing the stream.
+    metric_records = load_metrics(run_dir)
 
-    # telemetry.jsonl holds append-mode CUMULATIVE registry snapshots:
+    # -- live serving plane (hot-swap freshness + latency SLO) ------------
+    serving: Dict[str, Any] = {}
+    latest_serve: Dict[str, Dict] = {}
+    for rec in metric_records:
+        name = rec.get("name", "")
+        if name.startswith("serving/"):
+            # several label sets may exist (labelled endpoint monitor +
+            # unlabelled slots); the file is append-order, so the LAST
+            # record per name is the live reading. Not max: slo_ms and
+            # round_current are not monotone (a no-SLO redeploy clears
+            # the gauge to 0, a restarted endpoint re-serves its boot
+            # round) and a stale larger record must not shadow them.
+            latest_serve[name.split("/", 1)[1]] = rec
+    if latest_serve:
+        def _sval(key, default=None):
+            rec = latest_serve.get(key)
+            if rec is None:
+                return default
+            return float(rec.get("value", rec.get("count", 0)) or 0)
+
+        cur = _sval("round_current")
+        pub = _sval("round_published")
+        swaps = _sval("swaps", 0.0)
+        rejected = _sval("rejected", 0.0)
+        stall = latest_serve.get("swap_stall_ms") or {}
+        req = latest_serve.get("request_ms") or {}
+        slo_ms = _sval("slo_ms")
+        serving = {
+            "round_current": None if cur is None else int(cur),
+            "round_published": None if pub is None else int(pub),
+            "swaps": int(swaps),
+            "rejected": int(rejected),
+            "swap_stall_p99_ms": stall.get("p99"),
+            "swap_stall_max_ms": stall.get("max"),
+            "request_p99_ms": req.get("p99"),
+            "slo_ms": slo_ms,
+        }
+        if cur is not None and pub is not None and pub - cur >= 2:
+            verdict.append(
+                f"endpoint is serving a STALE round: round {cur:.0f} while "
+                f"training published round {pub:.0f} "
+                f"({pub - cur:.0f} behind) — check the serving bridge / "
+                "swap transport")
+        if (slo_ms and req.get("p99") is not None
+                and float(req["p99"]) > slo_ms):
+            verdict.append(
+                f"endpoint p99 latency {float(req['p99']):.1f} ms exceeds "
+                f"its SLO of {slo_ms:.1f} ms — engine saturated or swap "
+                "stalls too long (see serving/swap_stall_ms)")
+        if rejected:
+            verdict.append(
+                f"endpoint shed {rejected:.0f} request(s) with 429 — "
+                "offered load exceeded the bounded request queue "
+                "(raise max_inflight or add replicas)")
+    else:
+        notes.setdefault("serving",
+                         "no data: no serving/* metrics (no endpoint in "
+                         "this run)")
+
+    # -- connectivity (resilience/* counters + resilience_event records) --
     # keep the LATEST record per (name, labels) — like report.py does —
     # then sum across label sets (e.g. chaos_injections per action)
     latest: Dict[Any, float] = {}
-    for rec in load_metrics(run_dir):
+    for rec in metric_records:
         name = rec.get("name", "")
         if name.startswith("resilience/"):
             labels = tuple(sorted((rec.get("labels") or {}).items()))
@@ -372,7 +435,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
 
     # -- tiers (hierarchical federation: tier/<d>/* metrics + events) -----
     latest_tier: Dict[Any, float] = {}
-    for rec in load_metrics(run_dir):
+    for rec in metric_records:
         name = rec.get("name", "")
         if name.startswith("tier/"):
             labels = tuple(sorted((rec.get("labels") or {}).items()))
@@ -431,6 +494,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "memory": memory,
         "compression": compression,
         "services": services,
+        "serving": serving,
         "connectivity": connectivity,
         "tiers": tiers,
         "verdict": verdict,
@@ -551,6 +615,24 @@ def format_doctor(d: Dict) -> str:
                 if k not in ("kind", "ts") and not isinstance(v, dict)))
     else:
         add(f"  {notes.get('tiers', 'no data')}")
+
+    add("")
+    add("serving (live endpoint freshness / SLO):")
+    serving = d.get("serving") or {}
+    if serving:
+        cur, pub = serving.get("round_current"), serving.get("round_published")
+        add(f"  endpoint round {cur} / published round {pub} "
+            f"({serving.get('swaps', 0)} swap(s), "
+            f"{serving.get('rejected', 0)} rejected)")
+        if serving.get("swap_stall_max_ms") is not None:
+            add(f"  swap stall p99 {serving.get('swap_stall_p99_ms')} ms, "
+                f"max {serving['swap_stall_max_ms']} ms")
+        if serving.get("request_p99_ms") is not None:
+            slo = serving.get("slo_ms")
+            add(f"  request p99 {serving['request_p99_ms']} ms"
+                + (f" (SLO {slo:.0f} ms)" if slo else ""))
+    else:
+        add(f"  {notes.get('serving', 'no data')}")
 
     add("")
     add("service health:")

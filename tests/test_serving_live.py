@@ -1,0 +1,603 @@
+"""Live serving plane: hot-swap correctness under traffic.
+
+The invariant under test everywhere: a generation is pinned at admission
+to ONE weight generation (slot lease) — a mid-request hot swap never
+changes the weights behind an in-flight stream, and two streams pinned to
+different rounds advance against their own params in the same engine
+step. References are produced by a second, identical engine run in
+steady state on each round's tree, so swap-path outputs are compared to
+static-deployment outputs program-for-program.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import telemetry
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+from fedml_tpu.serving import (
+    ContinuousBatchingEngine,
+    EndpointMonitor,
+    FederatedServingBridge,
+    FedMLInferenceRunner,
+    FedMLPredictor,
+    LlamaPredictor,
+    ModelSlots,
+    ServingPublisher,
+)
+from fedml_tpu.serving.openai_protocol import OpenAIServing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny(vocab_size=64, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _round_tree(params, r: float):
+    """Deterministic per-round weights (round folded into the values)."""
+    return jax.tree.map(lambda x, _r=r: x + jnp.asarray(0.05 * _r, x.dtype),
+                        params)
+
+
+def _drain(q):
+    toks = []
+    while True:
+        t = q.get(timeout=60)
+        if t is None:
+            return toks
+        toks.append(t)
+
+
+def _steady_reference(model, params, rounds, prompts, max_new):
+    """expected[r][tuple(prompt)] from an identical engine serving each
+    round in steady state (publish → drain → generate)."""
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   initial_round=0)
+    expected = {}
+    try:
+        for r in rounds:
+            if r > 0:
+                assert eng.model_slots.publish_payload(
+                    _round_tree(params, r), r)
+            eng.start()
+            expected[r] = {
+                tuple(p): eng.generate(list(p), max_new_tokens=max_new)
+                for p in prompts
+            }
+    finally:
+        eng.stop()
+    return expected
+
+
+# -- ModelSlots unit behaviour --------------------------------------------
+
+def test_slots_flip_is_monotonic_and_lease_pins_params():
+    slots = ModelSlots({"w": np.zeros(4, np.float32)}, round_idx=0)
+    lease = slots.acquire()
+    assert lease.round_idx == 0
+
+    assert slots.publish({"w": np.ones(4, np.float32)}, 3)
+    assert slots.live_round == 3
+    # the held lease still sees round 0's tree, untouched by the flip
+    np.testing.assert_array_equal(lease.params["w"], 0.0)
+    # its slot is retired but NOT reclaimed while the lease is out
+    assert lease._slot.retired and not lease._slot.reclaimed.is_set()
+    lease.release()
+    assert lease._slot.reclaimed.is_set()
+    assert lease._slot.params is None  # device buffers dropped
+
+    # duplicate / out-of-order publishes can never roll the endpoint back
+    for stale in (3, 2, 0):
+        assert not slots.publish({"w": np.zeros(4, np.float32)}, stale)
+    assert slots.live_round == 3 and slots.stale_drops == 3
+    assert slots.swap_count == 1
+    # publish_payload refuses to pay device staging for a losing round
+    assert not slots.publish_payload({"w": np.zeros(4, np.float32)}, 1)
+    assert slots.stale_drops == 4
+
+
+def test_plain_staging_with_donating_transform_spares_caller_buffers():
+    """In-process publisher topology: a plain (uncompressed) payload of
+    jax Arrays already on the default device stages through device_put
+    as a NO-COPY alias — a donating engine transform (int8 quantize)
+    must not delete the caller's buffers out from under the publisher's
+    retained resync payload / the training loop's params."""
+    deleted = []
+
+    def donating_transform(tree):
+        for leaf in jax.tree.leaves(tree):
+            deleted.append(leaf)
+            leaf.delete()
+        return {"q": np.int8(1)}
+
+    payload = {"w": jnp.arange(8, dtype=jnp.float32)}  # on-device jax tree
+    slots = ModelSlots({"q": np.int8(0)}, round_idx=0,
+                       transform=donating_transform)
+    assert slots.publish_payload(payload, 1)
+    # the caller's own array survived the donation (a copy was staged)
+    np.testing.assert_array_equal(
+        np.asarray(payload["w"]), np.arange(8, dtype=np.float32))
+    assert deleted and all(d is not payload["w"] for d in deleted)
+
+
+def test_slots_release_is_idempotent_and_refcounted():
+    slots = ModelSlots({"w": np.zeros(2)}, round_idx=0)
+    l1, l2 = slots.acquire(), slots.acquire()
+    slots.publish({"w": np.ones(2)}, 1)
+    l1.release()
+    l1.release()  # double release must not free the slot under l2
+    assert not l1._slot.reclaimed.is_set()
+    np.testing.assert_array_equal(l2.params["w"], 0.0)
+    l2.release()
+    assert l2._slot.reclaimed.is_set()
+
+
+# -- swap correctness in the engine ---------------------------------------
+
+def test_midflight_flip_completes_on_admission_round(tiny_model):
+    """A request admitted on round r finishes on round r's weights even
+    when the live slot flips mid-generation; the next request picks up
+    the new round — both match a static deployment of their round."""
+    model, params = tiny_model
+    prompts = [(1, 2, 3, 4), (7, 9, 11)]
+    expected = _steady_reference(model, params, [0, 1], prompts, max_new=8)
+    # the perturbation must actually change the generation, or round
+    # pinning would be vacuously true
+    assert expected[0] != expected[1]
+
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   initial_round=0)
+    try:
+        qa = eng.submit(list(prompts[0]), max_new_tokens=8)
+        eng._admit(eng._requests.get())
+        eng.step()
+        eng.step()  # A is mid-flight on round 0
+
+        assert eng.model_slots.publish_payload(_round_tree(params, 1), 1)
+
+        # B admitted AFTER the flip: pool now holds round-0 and round-1
+        # streams, advanced by the partitioned (grouped) decode path
+        qb = eng.submit(list(prompts[1]), max_new_tokens=8)
+        eng._admit(eng._requests.get())
+        while eng.active_slots:
+            eng.step()
+
+        a_toks, b_toks = _drain(qa), _drain(qb)
+        assert qa.round_idx == 0 and qb.round_idx == 1
+        assert a_toks == expected[0][prompts[0]]
+        assert b_toks == expected[1][prompts[1]]
+        # the transition really exercised the grouped decode program
+        assert any(op[0] == "decode_part" for op in eng.oplog)
+    finally:
+        eng.stop()
+
+
+def test_three_swaps_under_load_never_interleave_rounds(tiny_model):
+    """Seeded 3-swap run with concurrent submitters: every response is
+    bit-identical to a static deployment of the round it reports."""
+    model, params = tiny_model
+    prompts = [(1, 2, 3, 4), (7, 9, 11), (5, 6)]
+    max_new = 6
+    expected = _steady_reference(model, params, [0, 1, 2, 3], prompts,
+                                 max_new)
+
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   initial_round=0).start()
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        p = prompts[i % len(prompts)]
+        q = eng.submit(list(p), max_new_tokens=max_new)
+        toks = _drain(q)
+        with lock:
+            results.append((p, q.round_idx, toks))
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(18)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i in (5, 10, 15):  # three mid-load hot swaps
+                r = i // 5
+                assert eng.model_slots.publish_payload(
+                    _round_tree(params, r), r)
+            time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        eng.stop()
+
+    assert len(results) == 18
+    served = {r for _, r, _ in results}
+    assert served >= {0, 3}, served  # load spanned first and last round
+    for p, r, toks in results:
+        assert toks == expected[r][p], (p, r)
+    assert eng.model_slots.live_round == 3
+
+
+# -- int8-native staging (acceptance: no host-side f32 tree) ---------------
+
+def test_int8_staging_never_materializes_host_f32(tiny_model):
+    from fedml_tpu.compression import CompressedTree, derive_key, get_codec
+    from fedml_tpu.utils.serialization import tree_nbytes
+
+    model, params = tiny_model
+    f32_nbytes = tree_nbytes(params)
+    codec = get_codec("int8")
+    wire = codec.encode(_round_tree(params, 1), key=derive_key(0, 1, 0))
+    assert isinstance(wire, CompressedTree)
+    wire_nbytes = tree_nbytes(wire)
+    # the wire is int8 blocks + per-block scales: a fraction of the tree
+    assert wire_nbytes < 0.5 * f32_nbytes
+
+    slots = ModelSlots(params, round_idx=0)
+    assert slots.publish_payload(wire, 1, codec.spec)
+    # what crossed host→device is the compressed wire, not an f32 tree
+    staged = telemetry.get_registry().gauge("serving/stage_wire_bytes").value
+    assert 0 < staged == wire_nbytes < 0.5 * f32_nbytes
+    assert slots.live_codec == codec.spec
+    # ... and the decoded slot serves values close to the round-1 tree
+    want = jax.tree.leaves(_round_tree(params, 1))
+    got = jax.tree.leaves(slots.live_params)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=0.02)
+
+
+# -- federation bridge over the comm layer ---------------------------------
+
+def _ns(run_id):
+    from fedml_tpu.serving.live import serve_namespace
+
+    return serve_namespace(run_id)
+
+
+def _kick(run_id, bridge):
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+    from fedml_tpu.core.distributed.message import Message
+
+    LocalBroker.get(_ns(run_id)).post(1, Message(
+        bridge.MSG_TYPE_CONNECTION_IS_READY, 1, 1))
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while not pred() and time.time() < deadline:
+        time.sleep(0.02)
+    assert pred()
+
+
+def test_bridge_swaps_dedups_and_resyncs(tiny_model):
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+
+    model, params = tiny_model
+    run_id = "serve_live_bridge"
+    LocalBroker.destroy(_ns(run_id))
+    slots = ModelSlots(params, round_idx=0)
+    publisher = ServingPublisher(run_id=run_id, codec="int8")
+    bridge = FederatedServingBridge(slots, run_id=run_id)
+    publisher.run_async()
+    bridge.run_async()
+    try:
+        _kick(run_id, bridge)
+        publisher.publish(1, _round_tree(params, 1))
+        _wait(lambda: slots.live_round == 1)
+        assert slots.live_codec == "int8"
+
+        # a duplicate resend and an out-of-order older round are dropped
+        publisher.publish(3, _round_tree(params, 3))
+        _wait(lambda: slots.live_round == 3)
+        publisher.publish(2, _round_tree(params, 2))
+        _wait(lambda: slots.stale_drops >= 1)
+        assert slots.live_round == 3
+        assert bridge.lag == 0
+
+        # a corrupt swap payload must not wedge the endpoint: it keeps
+        # serving round 3 and re-requests the publisher's latest state —
+        # but only ONCE for that round (a deterministically-bad payload
+        # must not livelock hello → identical resend → same failure)
+        from fedml_tpu.core.distributed.message import Message
+        from fedml_tpu.serving.live import ServeMessage
+
+        resyncs = []
+        bridge.request_resync = lambda: resyncs.append(1)
+        bad = Message(ServeMessage.MSG_TYPE_P2S_SWAP, 0, 1)
+        bad.add_params(ServeMessage.ARG_MODEL_PARAMS, object())
+        bad.add_params(ServeMessage.ARG_ROUND, 7)
+        bridge._handle_swap(bad)
+        bridge._handle_swap(bad)
+        assert bridge.swap_errors == 2 and len(resyncs) == 1
+        assert slots.live_round == 3
+    finally:
+        publisher.finish()
+        bridge.finish()
+        LocalBroker.destroy(_ns(run_id))
+
+
+def test_bridge_late_join_resyncs_to_latest_round(tiny_model):
+    """An endpoint that (re)connects after rounds were published hellos
+    the publisher and lands on its latest round — a lost swap message
+    can't leave it wedged on a stale round."""
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+
+    model, params = tiny_model
+    run_id = "serve_live_latejoin"
+    LocalBroker.destroy(_ns(run_id))
+    publisher = ServingPublisher(run_id=run_id, codec="int8")
+    publisher.run_async()
+    try:
+        publisher.publish(5, _round_tree(params, 5))  # endpoint not up yet
+        slots = ModelSlots(params, round_idx=0)
+        bridge = FederatedServingBridge(slots, run_id=run_id)
+        bridge.run_async()
+        try:
+            _kick(run_id, bridge)  # → hello → publisher resends latest
+            _wait(lambda: slots.live_round == 5)
+            assert bridge.round_published == 5 and bridge.lag == 0
+        finally:
+            bridge.finish()
+    finally:
+        publisher.finish()
+        LocalBroker.destroy(_ns(run_id))
+
+
+def test_serving_plane_gets_its_own_comm_namespace():
+    """The publisher is rank 0 — sharing the federation's run_id would
+    collide with the real server's inbox/topics/port. The pair talks on
+    '<run_id>/serve' with a shifted port block, inheriting the caller's
+    transport settings."""
+    from fedml_tpu.serving.live import serve_namespace
+
+    a = type("A", (), {})()
+    a.run_id = "fed_run_7"
+    a.broker_host = "10.0.0.5"
+    a.broker_port = 1884
+    a.grpc_base_port = 9000
+
+    pub = ServingPublisher(args=a)
+    assert pub.args.run_id == serve_namespace("fed_run_7") != "fed_run_7"
+    assert pub.args.broker_host == "10.0.0.5"
+    assert pub.args.broker_port == 1884
+    assert pub.args.grpc_base_port == 9032
+    # endpoint side, args-less (tests/CLI): same namespace derivation
+    bridge = FederatedServingBridge(ModelSlots({"w": np.zeros(2)}),
+                                    run_id="fed_run_7")
+    try:
+        assert bridge.args.run_id == pub.args.run_id
+    finally:
+        from fedml_tpu.core.distributed.communication.local_comm import (
+            LocalBroker,
+        )
+
+        LocalBroker.destroy(serve_namespace("fed_run_7"))
+
+
+def test_tree_runner_root_publishes_each_round_to_endpoint():
+    """The hierarchy root's on_round hook feeds the publisher: every
+    closed global round lands in the endpoint slots, in order."""
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+    from fedml_tpu.hierarchy import TreeRunner, TreeTopology
+
+    tmpl = {"w": np.zeros((16, 8), np.float32),
+            "b": np.zeros((8,), np.float32)}
+    run_id = "serve_live_tree"
+    LocalBroker.destroy(_ns(run_id))
+    slots = ModelSlots(tmpl)  # static until the federation's first round
+    publisher = ServingPublisher(run_id=run_id, codec="int8")
+    bridge = FederatedServingBridge(slots, run_id=run_id)
+    publisher.run_async()
+    bridge.run_async()
+    try:
+        runner = TreeRunner(TreeTopology((1, 8)), template=tmpl,
+                            codec="int8", seed=0,
+                            on_round=publisher.publish)
+        runner.run(3)
+        _wait(lambda: slots.live_round == 2)
+        assert slots.swap_count == 3 and slots.live_codec == "int8"
+        # the served tree IS (a quantization of) the root's aggregate
+        want = jax.tree.leaves(runner.global_params)
+        got = jax.tree.leaves(slots.live_params)
+        for w, g in zip(want, got):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=0.05)
+    finally:
+        publisher.finish()
+        bridge.finish()
+        LocalBroker.destroy(_ns(run_id))
+
+
+# -- endpoint surface: /v1/models, model tag, overload shedding ------------
+
+def _post(url, obj, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_models_listing_and_response_tag_observe_swaps(tiny_model):
+    model, params = tiny_model
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=64,
+                                   initial_round=0)
+    runner = FedMLInferenceRunner(
+        LlamaPredictor(eng),
+        openai=OpenAIServing(eng, model_name="fedml-tpu")).start()
+    eng.model_slots.monitor = runner.monitor
+    base = f"http://127.0.0.1:{runner.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/v1/models", timeout=30) as r:
+            listing = json.loads(r.read())
+        entry = listing["data"][0]
+        assert entry["id"] == "fedml-tpu/round-0" and entry["round"] == 0
+
+        assert eng.model_slots.publish_payload(_round_tree(params, 2), 2)
+        with urllib.request.urlopen(f"{base}/v1/models", timeout=30) as r:
+            entry = json.loads(r.read())["data"][0]
+        assert entry["id"] == "fedml-tpu/round-2" and entry["round"] == 2
+
+        # completions name the round that actually served the request
+        _, body = _post(f"{base}/v1/completions",
+                        {"prompt": "hi", "max_tokens": 2})
+        assert body["model"] == "fedml-tpu/round-2"
+
+        snap = runner.monitor.snapshot()
+        assert snap["swaps"] == 1 and snap["round_current"] == 2
+    finally:
+        runner.stop()
+        eng.stop()
+
+
+def test_overload_sheds_429_with_retry_after():
+    class Slow(FedMLPredictor):
+        def predict(self, request):
+            time.sleep(0.5)
+            return {"ok": True}
+
+    monitor = EndpointMonitor("overload_test")
+    runner = FedMLInferenceRunner(Slow(), monitor=monitor, max_inflight=1,
+                                  queue_wait_s=0.02).start()
+    url = f"http://127.0.0.1:{runner.port}/predict"
+    statuses, retry_after = [], []
+    lock = threading.Lock()
+
+    def hit():
+        try:
+            status, _ = _post(url, {"x": 1})
+            with lock:
+                statuses.append(status)
+        except urllib.error.HTTPError as e:
+            with lock:
+                statuses.append(e.code)
+                retry_after.append(e.headers.get("Retry-After"))
+
+    try:
+        threads = [threading.Thread(target=hit) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        runner.stop()
+    # one admitted, the burst behind it shed fast with backpressure advice
+    assert statuses.count(200) >= 1
+    assert statuses.count(429) >= 1
+    assert all(v == "1" for v in retry_after)
+    assert monitor.snapshot()["rejected"] == statuses.count(429)
+
+
+# -- doctor + taxonomy lint + bench smoke ----------------------------------
+
+def _write_serving_metrics(run_dir, recs):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "telemetry.jsonl"), "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_doctor_serving_section_verdicts(tmp_path):
+    from fedml_tpu.telemetry.doctor import build_doctor, format_doctor
+
+    run_dir = str(tmp_path / "run_stale")
+    _write_serving_metrics(run_dir, [
+        {"name": "serving/round_current", "kind": "gauge", "value": 3},
+        {"name": "serving/round_published", "kind": "gauge", "value": 6},
+        {"name": "serving/swaps", "kind": "counter", "value": 3},
+        {"name": "serving/rejected", "kind": "counter", "value": 2},
+        {"name": "serving/slo_ms", "kind": "gauge", "value": 100.0},
+        {"name": "serving/request_ms", "kind": "histogram", "count": 50,
+         "sum": 9000.0, "max": 400.0, "p50": 150.0, "p95": 300.0,
+         "p99": 350.0},
+        {"name": "serving/swap_stall_ms", "kind": "histogram", "count": 3,
+         "sum": 30.0, "max": 20.0, "p50": 5.0, "p95": 20.0, "p99": 20.0},
+    ])
+    d = build_doctor(run_dir)
+    assert d["serving"]["round_current"] == 3
+    assert d["serving"]["round_published"] == 6
+    assert d["serving"]["swap_stall_max_ms"] == 20.0
+    v = "\n".join(d["verdict"])
+    assert "STALE round" in v and "3 behind" in v
+    assert "exceeds its SLO" in v
+    assert "shed 2 request(s)" in v
+    assert "serving" in format_doctor(d)
+
+    # a fresh endpoint within SLO raises no serving verdicts
+    healthy = str(tmp_path / "run_healthy")
+    _write_serving_metrics(healthy, [
+        {"name": "serving/round_current", "kind": "gauge", "value": 6},
+        {"name": "serving/round_published", "kind": "gauge", "value": 6},
+        {"name": "serving/swaps", "kind": "counter", "value": 6},
+        {"name": "serving/slo_ms", "kind": "gauge", "value": 100.0},
+        {"name": "serving/request_ms", "kind": "histogram", "count": 50,
+         "sum": 900.0, "max": 40.0, "p50": 15.0, "p95": 30.0, "p99": 35.0},
+    ])
+    d2 = build_doctor(healthy)
+    assert not any("SLO" in x or "STALE" in x or "shed" in x
+                   for x in d2["verdict"]), d2["verdict"]
+
+    # no endpoint in the run → explicit per-section degradation note
+    empty = str(tmp_path / "run_none")
+    _write_serving_metrics(empty, [
+        {"name": "round/total_ms", "kind": "histogram", "count": 1,
+         "sum": 1.0, "max": 1.0, "p50": 1.0, "p95": 1.0, "p99": 1.0}])
+    d3 = build_doctor(empty)
+    assert "serving" in d3["notes"]
+
+
+def test_span_lint_serve_rules():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_span_names", os.path.join(REPO, "tools",
+                                         "check_span_names.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    bad = [
+        ("x.py", 1, "span", "serve/stage"),             # fine
+        ("x.py", 2, "span", "serve/reload_weights"),    # unknown phase
+        ("x.py", 3, "counter", "serve/swaps"),          # span namespace
+        ("x.py", 4, "gauge", "serving/round_current"),  # fine
+        ("x.py", 5, "gauge", "serving/ep0/round"),      # ids ride labels
+        ("x.py", 6, "histogram", "serving/swap_stall_ms"),  # fine
+    ]
+    problems = lint.check(bad)
+    assert len(problems) == 3, problems
+
+
+def test_serve_bench_smoke_schema():
+    """Tier-1 wiring of the serve bench smoke: tiny model, 2 swaps, the
+    zero-drop and no-host-f32 gates hold."""
+    from tools.serve_bench import run_serve_bench
+
+    row = run_serve_bench(requests=10, swaps=2, concurrency=2, max_new=3,
+                          slots=2, codec="int8")
+    for key in ("qps", "p50_ms", "p99_ms", "baseline_p99_ms",
+                "p99_vs_baseline", "max_swap_stall_ms", "served_rounds",
+                "stage_wire_bytes", "f32_tree_nbytes"):
+        assert key in row, key
+    assert row["completed"], row
+    assert row["dropped"] == 0
+    assert row["swaps_applied"] == 2 and row["round_current"] == 2
+    assert row["ok_no_host_f32"]
+    assert row["stage_wire_bytes"] < 0.5 * row["f32_tree_nbytes"]

@@ -49,6 +49,11 @@ _RESILIENCE_SHAPE = re.compile(r"^resilience/[a-z0-9_]+$")
 # interpolated tier depth then one signal segment (node/client ids are
 # event fields, never name segments); counters or gauges only
 _TIER_SHAPE = re.compile(r"^tier/<v>/[a-z0-9_]+$")
+# live serving plane: serve/* spans are exactly the three swap phases
+# (staging, the flip, the publisher's encode+send); serving/* metrics are
+# one signal segment after the prefix — the endpoint id rides a label
+_SERVE_SPAN_SHAPE = re.compile(r"^serve/(?:stage|swap|publish)$")
+_SERVING_SHAPE = re.compile(r"^serving/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -112,6 +117,20 @@ def check(entries):
             problems.append(
                 f"{where}: {name!r} — mem/, health/, resilience/ and "
                 "tier/ are metric namespaces, not span names")
+        if kind == "span" and name.startswith("serve/"):
+            if not _SERVE_SPAN_SHAPE.match(name):
+                problems.append(
+                    f"{where}: span {name!r} must be serve/stage, "
+                    "serve/swap or serve/publish")
+        if kind != "span" and name.startswith("serve/"):
+            problems.append(
+                f"{where}: {kind} {name!r} — serve/ is the live-plane "
+                "span namespace; its metrics live under serving/")
+        if kind != "span" and name.startswith("serving/"):
+            if not _SERVING_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be serving/<signal> "
+                    "(one segment; the endpoint id rides a label)")
         if kind != "span" and name.startswith("mem/"):
             if kind != "gauge":
                 problems.append(
