@@ -130,6 +130,31 @@ def llm_shape(hbm_bytes: float):
     return cfg, 4, 128
 
 
+def xla_cost_flops(jitted, *args):
+    """(compiled_executable, flops) via XLA's own cost model.
+
+    AOT-lowers the jitted fn ONCE and reads ``cost_analysis()["flops"]``
+    off the executable — the compiled program's true FLOP count (DCE'd
+    frozen-weight grads and all), replacing the hand-computed analytic
+    constants wherever XLA reports it. The executable is returned so the
+    measurement chain runs the SAME program (no second compile).
+    Returns ``(None, None)`` where lowering/cost analysis is unavailable
+    (older jax, pathways backends) — callers fall back to the analytic
+    model and stamp ``mfu_source: "analytic"``.
+    """
+    try:
+        compiled = jitted.lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        if flops <= 0:
+            return compiled, None
+        return compiled, flops
+    except Exception:
+        return None, None
+
+
 def lora_flops_model(params, cfg, batch: int, seq: int):
     """(model FLOPs per LoRA optimizer step, total param count) — see module
     docstring for the FLOPs basis."""
@@ -293,6 +318,21 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--live" in sys.argv:
+        # live-telemetry overhead gate: the SAME in-proc federation run
+        # with streaming on vs off (rounds/s within tolerance), the
+        # micro-measured per-round streaming seam, and the steady-state
+        # telemetry wire bytes per node per round (bounded) — one JSON
+        # line (tools/live_bench.py; FEDML_LIVE_* env knobs)
+        from tools.live_bench import run_live_bench
+
+        row = run_live_bench()
+        print(json.dumps(row))
+        if not (row["completed"] and row["ok_overhead"] and row["ok_bytes"]
+                and row["ok_rounds"]):
+            raise SystemExit(1)
+        return
+
     if "--serve" in sys.argv:
         # live-serving SLO gate: sustained concurrent HTTP load through
         # the OpenAI endpoint across N federation hot swaps — qps,
@@ -364,20 +404,30 @@ def main() -> None:
 
     # --- A. single-step throughput: tokens/sec + MFU ----------------------
     # the train step donates (params, opt_state): iterations are chained by
-    # construction; the final loss readback forces the whole queue
+    # construction; the final loss readback forces the whole queue.
+    # FLOPs basis: XLA's own cost model on the compiled step where
+    # available (the AOT executable is reused for the chain — one
+    # compile), hand-computed LoRA model-flops otherwise.
+    step_compiled, step_xla_flops = xla_cost_flops(
+        trainer._train_step, trainer.params, trainer.opt_state,
+        x[None], y[None], m[None])
+    step_fn = step_compiled if step_compiled is not None else trainer._train_step
+
     def step_chain(n):
         t0 = time.perf_counter()
         p, o = trainer.params, trainer.opt_state
         loss = None
         for _ in range(n):
-            p, o, loss = trainer._train_step(p, o, x[None], y[None], m[None])
+            p, o, loss = step_fn(p, o, x[None], y[None], m[None])
         trainer.params, trainer.opt_state = p, o
         float(loss)
         return time.perf_counter() - t0
 
     sec_per_step = chain_time(step_chain, 2, 22, trials=3)
     tok_per_sec = batch * seq / sec_per_step
-    flops, n_params = lora_flops_model(trainer.params, cfg, batch, seq)
+    flops_analytic, n_params = lora_flops_model(trainer.params, cfg, batch, seq)
+    flops = step_xla_flops if step_xla_flops is not None else flops_analytic
+    mfu_source = "xla" if step_xla_flops is not None else "analytic"
     peak = PEAK_BF16.get(dev.device_kind)
     mfu = (flops / sec_per_step / peak) if peak else None
 
@@ -398,13 +448,24 @@ def main() -> None:
     ms_r = np.ones((n_clients, local_steps, batch), np.float32)
     wts = np.ones((n_clients,), np.float32)
 
+    # XLA cost model of the WHOLE fused round (client-switch + local
+    # steps + FedAvg): flops_per_round comes from the compiled program,
+    # not the analytic 4N approximation; the AOT executable runs the
+    # chain so the cost analysis costs no extra compile
+    round_compiled, round_xla_flops = xla_cost_flops(
+        fed_round, trainer.params, trainer.opt_state,
+        extract_lora(trainer.params), xs, ys_r, ms_r, wts)
+    round_fn = round_compiled if round_compiled is not None else fed_round
+
     def round_chain(n_rounds):
         t0 = time.perf_counter()
         p, o = trainer.params, trainer.opt_state
+        # fresh copy per chain: the donated global-lora buffers from the
+        # previous chain are dead
         g = jax.tree.map(jnp.copy, extract_lora(p))
         loss = None
         for _ in range(n_rounds):
-            p, o, g, loss = fed_round(p, o, g, xs, ys_r, ms_r, wts)
+            p, o, g, loss = round_fn(p, o, g, xs, ys_r, ms_r, wts)
         trainer.params, trainer.opt_state = p, o
         float(loss)  # readback forces the whole donated chain
         return time.perf_counter() - t0
@@ -437,7 +498,21 @@ def main() -> None:
         "llm_tokens_per_sec": round(tok_per_sec, 1),
         "llm_step_ms": round(sec_per_step * 1e3, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "mfu_basis": "LoRA model-flops (4N + 6N_lora + attn); frozen wgrads are DCE'd",
+        # FLOPs provenance: "xla" = lowered.compile().cost_analysis() on
+        # the compiled programs themselves; "analytic" = the hand model
+        # (4N + 6N_lora + attn; frozen wgrads DCE'd) where XLA's cost
+        # model is unavailable on this backend
+        "mfu_source": mfu_source,
+        "flops_per_step": round(flops, 1),
+        "flops_per_round": round(
+            round_xla_flops if round_xla_flops is not None
+            else flops_analytic * n_clients * local_steps, 1),
+        "flops_per_round_source": ("xla" if round_xla_flops is not None
+                                   else "analytic"),
+        "mfu_basis": (
+            "XLA cost_analysis() flops of the compiled train step"
+            if mfu_source == "xla" else
+            "LoRA model-flops (4N + 6N_lora + attn); frozen wgrads are DCE'd"),
         "round_shape": {"clients": n_clients, "local_steps": local_steps,
                         "round_tokens": round_tokens},
         "round_path": "fused on-device round: client-switch + local steps "

@@ -585,9 +585,12 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
 @click.option("--kill-round", default=1, show_default=True)
 @click.option("--revive-round", default=None, type=int,
               help="round the killed node comes back [default: +1]")
+@click.option("--metrics-port", default=None, type=int,
+              help="host a live /metrics + /healthz scrape endpoint and "
+                   "the online doctor for this tree run (0 = ephemeral)")
 def tree(clients: int, tiers: int, rounds: int, params: int, codec: str,
          seed: int, quorum: float, kill_tier, kill_node: int,
-         kill_round: int, revive_round) -> None:
+         kill_round: int, revive_round, metrics_port) -> None:
     """Run a seeded hierarchical (aggregation-tree) federation scenario.
 
     Simulates an N-tier tree in-process: virtual leaf clients upload
@@ -607,15 +610,27 @@ def tree(clients: int, tiers: int, rounds: int, params: int, codec: str,
     if kill_tier is not None:
         chaos.append(KillWindow(kill_tier, kill_node, kill_round,
                                 until=revive_round))
+    live = None
+    if metrics_port is not None:
+        from fedml_tpu.telemetry.live import LivePlane
+
+        live = LivePlane(job=f"tree_{seed}", node="tree_root",
+                         metrics_port=metrics_port)
+        click.echo(f"live telemetry: {live.url}/metrics "
+                   f"(watch: fedml_tpu telemetry watch {live.url})",
+                   err=True)
     runner = TreeRunner(
         TreeTopology.build(clients, tiers=tiers),
         template=default_template(params), codec=codec, seed=seed,
-        quorum=quorum, chaos=chaos)
+        quorum=quorum, chaos=chaos, live=live)
     try:
         out = runner.run(rounds)
     except RuntimeError as e:
         click.echo(json.dumps({"completed": False, "error": str(e)}))
         raise SystemExit(1)
+    finally:
+        if live is not None:
+            live.close()
     click.echo(json.dumps(out))
     if not out["completed"]:
         raise SystemExit(1)
@@ -647,9 +662,13 @@ def telemetry_report(run_dir: str, as_json: bool) -> None:
         click.echo(f"no spans or metrics recorded under {run_dir}")
         raise SystemExit(1)
     if as_json:
+        # stable machine-readable contract: ONE JSON object, sorted keys,
+        # schema-tagged — CI and the scheduler gate on this without
+        # scraping the human-format text
         stitched = report["stitched_spans"]
         report = {**report, "stitched_spans": len(stitched)}
-        click.echo(json.dumps(report, indent=1))
+        click.echo(json.dumps(report, indent=1, sort_keys=True,
+                              default=str))
     else:
         click.echo(format_report(report))
 
@@ -684,9 +703,34 @@ def telemetry_doctor(run_dir: str, as_json: bool,
         click.echo(triage["notes"]["run"])
         raise SystemExit(1)
     if as_json:
-        click.echo(json.dumps(triage, indent=1, default=str))
+        # stable machine-readable contract: ONE JSON object, sorted keys,
+        # schema-tagged, verdicts as a list — gate-able without text
+        # scraping (`jq .verdict`, `jq '.live.alerts'`)
+        click.echo(json.dumps(triage, indent=1, sort_keys=True,
+                              default=str))
     else:
         click.echo(format_doctor(triage))
+
+
+@telemetry.command("watch")
+@click.argument("target")
+@click.option("--interval", default=2.0, show_default=True,
+              help="refresh period in seconds")
+@click.option("--once", is_flag=True,
+              help="render a single frame and exit (CI smoke)")
+def telemetry_watch(target: str, interval: float, once: bool) -> None:
+    """Refreshing per-round/per-node terminal view of a LIVE run.
+
+    TARGET is a live scrape endpoint URL (``http://host:port`` — boot one
+    with ``live_telemetry: true`` + ``metrics_port`` on the federation, or
+    ``fedml_tpu serve``'s ``/metrics``-enabled runner), or a run dir for
+    the offline post-hoc rendering of the same view.
+    """
+    from fedml_tpu.telemetry.live import watch as live_watch
+
+    rc = live_watch(target, interval_s=interval, once=once)
+    if rc:
+        raise SystemExit(rc)
 
 
 @telemetry.command("prometheus")

@@ -68,6 +68,13 @@ class FedMLCommManager(Observer):
                                3 * self.resilience.heartbeat_interval_s))
         self._send_retry = self.resilience.retry_policy(key=f"rank{rank}")
         self._retry_on = transient_exceptions()
+        # live telemetry plane: when a MetricStreamer is attached, every
+        # outgoing message can carry one prepared metric frame (rate-
+        # limited by the streamer, so chatty transports don't amplify);
+        # inbound frames route to this process's LivePlane if one is
+        # bound. Both default off — the production hot path is two
+        # None-checks.
+        self.live_streamer = None
         # the authoritative round for windowed chaos faults: the client
         # FSM's own round_idx, or the server's args.round_idx
         self._chaos = chaos_from_args(
@@ -141,6 +148,18 @@ class FedMLCommManager(Observer):
                          self.rank, msg_type, msg_id)
             return
         self.liveness.note(msg_params.get_sender_id())
+        # live telemetry: a piggybacked metric frame merges into this
+        # process's collector (if one is bound) regardless of msg_type —
+        # duplicates of the SAME frame on a retried/duplicated message
+        # are absorbed by the collector's seq gate
+        frame = msg_params.get(Message.MSG_ARG_KEY_TELEMETRY)
+        if frame is not None:
+            try:
+                from fedml_tpu.telemetry.live import ingest_frame
+
+                ingest_frame(frame)
+            except Exception:  # observability must not break the round
+                logger.exception("telemetry frame ingest failed")
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.warning("rank %d: no handler for %s", self.rank, msg_type)
@@ -216,6 +235,19 @@ class FedMLCommManager(Observer):
                 reg.counter("comm/raw_bytes").inc(raw)
             except TypeError:
                 pass  # not a tree of arrays
+        # live telemetry: pop a prepared frame onto this message (rate-
+        # limited inside the streamer; the frame is cumulative, so the
+        # collector absorbs duplicate deliveries). BEFORE the chaos seam
+        # on purpose — injected drop/duplicate exercises frame recovery.
+        if (self.live_streamer is not None
+                and message.get(Message.MSG_ARG_KEY_TELEMETRY) is None):
+            try:
+                frame = self.live_streamer.pop_frame()
+                if frame is not None:
+                    message.add_params(Message.MSG_ARG_KEY_TELEMETRY, frame)
+                    reg.counter("live/frames_piggybacked").inc()
+            except Exception:  # observability must not break the send
+                logger.exception("telemetry frame piggyback failed")
         # idempotent-send header: stamped once per logical message (a
         # retried send reuses it, so the receiver's deduper catches the
         # case where the first attempt DID land)

@@ -41,6 +41,10 @@ class Message:
     # rejoin marker on a server->client resync after an eviction: the
     # client must reset per-identity compression state (EF residuals)
     MSG_ARG_KEY_REJOIN = "rejoin"
+    # live telemetry: one seq-numbered metric frame (JSON-safe dict, see
+    # telemetry/live/frames.py) piggybacked on an existing message — the
+    # collector side merges it; like health, never its own round-trip
+    MSG_ARG_KEY_TELEMETRY = "telemetry_frame"
 
     def __init__(self, type_: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type_)

@@ -49,6 +49,22 @@ class ClientMasterManager(FedMLCommManager):
         # partition heals); started once the connection is up
         self._heartbeat_thread = None
         self._finished = threading.Event()
+        # live telemetry: stream this process's registry to the server's
+        # collector, piggybacked on the status/upload messages we already
+        # send (the heartbeat doubles as the low-frequency carrier through
+        # long local epochs). Only when this client IS its own process —
+        # on the in-proc LOCAL transport all ranks share one registry, and
+        # the server's loopback streamer already covers it (a per-client
+        # streamer would multiply-count the shared instruments).
+        if (bool(getattr(args, "live_telemetry", False))
+                and str(backend).upper() != constants.COMM_BACKEND_LOCAL):
+            from fedml_tpu.telemetry.live import MetricStreamer
+
+            self.live_streamer = MetricStreamer(
+                f"rank{self.rank}",
+                job=str(getattr(args, "run_id", "0") or "0"),
+                interval_s=float(getattr(args, "live_interval_s", 1.0)),
+            ).start()
 
     def _heartbeat_fields(self) -> dict:
         """JSON-safe health scalars piggybacked on existing messages —
@@ -195,6 +211,14 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_finish(self, msg: Message) -> None:
         logger.debug("client %d finished", self.rank)
+        if self.live_streamer is not None:
+            # stream close: one last status message carries a FULL frame,
+            # so the collector's totals for this node end exact
+            try:
+                self.live_streamer.flush_final()
+                self.send_client_status(0)
+            except Exception:
+                logger.debug("final telemetry flush failed", exc_info=True)
         self.finish()
 
     def finish(self) -> None:
@@ -202,6 +226,8 @@ class ClientMasterManager(FedMLCommManager):
         # shutdown) must stop the heartbeat thread, or it keeps sending
         # into a dead transport for the rest of the process
         self._finished.set()
+        if self.live_streamer is not None:
+            self.live_streamer.stop()
         super().finish()
 
     # -- actions -----------------------------------------------------------

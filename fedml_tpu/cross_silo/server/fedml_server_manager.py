@@ -83,10 +83,20 @@ class FedMLServerManager(FedMLCommManager):
 
         # bind the run-dir sinks (spans/health/flight recorder) for
         # cross-silo runs the same way the simulation engines do
-        telemetry.configure_from_args(args)
+        tracer = telemetry.configure_from_args(args)
         self._health = ClientHealthTracker()
         self._devstats = DeviceStatsSampler()
         self._bcast_ts: Dict[int, float] = {}
+
+        # live telemetry plane (live_telemetry: true): this rank hosts the
+        # collector + online doctor + optional /metrics scrape endpoint
+        # (metrics_port), loops its own registry back per closed round,
+        # and — by virtue of being the LivePlane host — merges every
+        # frame clients piggyback on their uploads/heartbeats
+        from fedml_tpu.telemetry.live import LivePlane
+
+        self._live = LivePlane.from_args(args, node=f"rank{self.rank}",
+                                         run_dir=tracer.sink_dir)
 
         # round deadlines + quorum aggregation: with round_deadline_s
         # configured, a dead client can no longer hang a round — the
@@ -423,6 +433,15 @@ class FedMLServerManager(FedMLCommManager):
             global_params = self.aggregator.aggregate()
         self._health.finish_round(self.args.round_idx)
         self._devstats.sample("aggregate", self.args.round_idx)
+        if self._live is not None:
+            # per-round loopback: the fresh health/mem/resilience scores
+            # land on the scrape endpoint (and in front of the online
+            # doctor) the moment the round closes, not at process exit
+            try:
+                self._live.pump()
+            except Exception:  # observability must never break the round
+                logger.exception("live telemetry pump failed at round %d",
+                                 self.args.round_idx)
         self._notify_round_listeners(self.args.round_idx, global_params)
         with tracer.span(f"round/{self.args.round_idx}/eval"):
             metrics = self.aggregator.test_on_server_for_all_clients(
@@ -584,4 +603,8 @@ class FedMLServerManager(FedMLCommManager):
 
     def finish(self) -> None:
         self._deadline.cancel()
+        if self._live is not None:
+            # final full loopback frame: the collector's merged totals
+            # become exactly the post-hoc registry snapshot
+            self._live.close()
         super().finish()

@@ -104,7 +104,8 @@ class TreeRunner:
                  chaos: Optional[Sequence[KillWindow]] = None,
                  delta_fn: Optional[Callable] = None,
                  server_lr: float = 1.0,
-                 on_round: Optional[Callable[[int, Pytree], None]] = None):
+                 on_round: Optional[Callable[[int, Pytree], None]] = None,
+                 live: Optional[Any] = None):
         self.topology = topology
         self.codec = get_codec(codec)
         if self.codec is None:
@@ -128,6 +129,11 @@ class TreeRunner:
         # aggregate hot-swaps into a running endpoint. Guarded at call
         # time: a serving failure must not corrupt the federation.
         self.on_round = on_round
+        # live telemetry plane (optional LivePlane): the tree root loops
+        # its per-tier counters/health scores into the collector after
+        # every global round, so the /metrics endpoint and the online
+        # doctor track a 100k-client tree while it runs
+        self.live = live
         self._f32_tree_nbytes = sum(
             int(np.prod(sh, dtype=np.int64)) * 4 for _, sh in self.meta)
 
@@ -376,6 +382,12 @@ class TreeRunner:
                     self.on_round(r, self.global_params)
                 except Exception:  # serving must never corrupt training
                     logger.exception("round listener failed at round %d", r)
+            if self.live is not None:
+                try:
+                    self.live.pump()
+                except Exception:  # observability must never corrupt it
+                    logger.exception("live telemetry pump failed at "
+                                     "round %d", r)
             for d, b in self._tier_round_bytes.items():
                 peak_round_bytes[d] = max(peak_round_bytes.get(d, 0), b)
         wall = time.perf_counter() - t0

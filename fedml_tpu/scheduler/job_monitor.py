@@ -24,7 +24,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from fedml_tpu.core.mlops.status import RunStatus
 from fedml_tpu.deploy.cache import EndpointCache, EndpointStatus
@@ -78,11 +78,15 @@ class JobMonitor:
     def __init__(self, compute_store: Optional[ComputeStore] = None,
                  endpoint_cache: Optional[EndpointCache] = None,
                  interval_s: float = 5.0, probe_timeout_s: float = 2.0,
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None, live: Optional[Any] = None):
         self.compute_store = compute_store
         self.endpoint_cache = endpoint_cache
         self.interval_s = interval_s
         self.probe_timeout_s = probe_timeout_s
+        # live telemetry plane (optional LivePlane): each sweep loops the
+        # scheduler/* gauges into the collector, so a multi-tenant job
+        # plane's packing signals are scrapeable while jobs run
+        self.live = live
         # Pid liveness is only meaningful on the node that spawned the
         # run. With a shared store (NFS workdir, multi-node sqlite) a
         # monitor must never judge another node's rows: host A would mark
@@ -185,6 +189,11 @@ class JobMonitor:
                 for rep in (ep.get("replicas") or {}).values()
                 if rep.get("status") == EndpointStatus.OFFLINE)
             self._g_endpoints_offline.set(offline)
+        if self.live is not None:
+            try:
+                self.live.pump()
+            except Exception:  # pragma: no cover - observability only
+                logger.exception("job_monitor live pump failed")
         return result
 
     # -- loop ----------------------------------------------------------

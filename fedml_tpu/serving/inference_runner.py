@@ -78,10 +78,35 @@ class FedMLInferenceRunner:
 
             def do_GET(self):
                 path = self.path.rstrip("/")
-                if path in ("", "/ready", "/health"):
+                if path in ("", "/ready", "/health", "/healthz"):
                     self._send_json(
                         {"ready": bool(runner.predictor.ready()),
                          **runner.monitor.snapshot()})
+                elif path == "/metrics":
+                    # live scrape of this endpoint's own registry (the
+                    # serving/* instruments the monitor maintains), so
+                    # the endpoint is a first-class node of the live
+                    # telemetry plane without a collector in between
+                    from fedml_tpu.telemetry import get_registry
+
+                    body = get_registry().export_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; "
+                                     "version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/metrics.json":
+                    # what `fedml_tpu telemetry watch URL` fetches: the
+                    # endpoint's own registry in the collector-state shape
+                    # (single node, no frame accounting — there is no
+                    # collector in between)
+                    from fedml_tpu.telemetry import get_registry
+
+                    self._send_json({
+                        "job": "serving", "nodes": 1, "frames": 0,
+                        "seq_gaps": 0, "nodes_detail": {}, "alerts": [],
+                        "metrics": get_registry().snapshot()})
                 elif path == "/v1/models" and runner.openai is not None:
                     # clients observe hot swaps end-to-end: the listing
                     # names the live slot's federation round + codec

@@ -43,6 +43,7 @@ class ServeMessage:
     MSG_TYPE_CONNECTION_IS_READY = "MSG_TYPE_CONNECTION_IS_READY"
     MSG_TYPE_P2S_SWAP = "serve.p2s.swap"
     MSG_TYPE_S2P_HELLO = "serve.s2p.hello"
+    MSG_TYPE_S2P_TELEMETRY = "serve.s2p.telemetry"
     MSG_TYPE_P2S_FINISH = "serve.p2s.finish"
 
     ARG_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
@@ -115,6 +116,12 @@ class ServingPublisher(FedMLCommManager):
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             ServeMessage.MSG_TYPE_S2P_HELLO, self._handle_hello)
+        # the endpoint's dedicated metric frames: the frame itself is
+        # merged into this process's LivePlane by the comm receive seam
+        # before dispatch, so the handler has nothing left to do — it
+        # exists to keep the frame carrier off the no-handler warning path
+        self.register_message_receive_handler(
+            ServeMessage.MSG_TYPE_S2P_TELEMETRY, lambda m: None)
 
     def publish(self, round_idx: int, global_params: Pytree) -> None:
         """Encode once, remember as latest, send to the serving rank."""
@@ -174,6 +181,30 @@ class FederatedServingBridge(FedMLCommManager):
         from fedml_tpu.telemetry import get_registry
 
         self._g_published = get_registry().gauge("serving/round_published")
+        # live telemetry: the serving process streams its serving/*
+        # instruments back to the training-side collector. An endpoint has
+        # no per-round traffic to piggyback on (it SENDS only a boot-time
+        # hello plus one resync per failed swap), so piggybacking would
+        # freeze serving/round_current at the collector and trip a false
+        # stale_serving_round alert — this is the dedicated-carrier case:
+        # the streamer's off-thread loop delivers its own low-frequency
+        # frame messages (delta-filtered, so an idle endpoint sends
+        # nothing). Own-process only, like the cross-silo client: never on
+        # the shared-registry LOCAL path.
+        self._telemetry_streamer = None
+        if (bool(getattr(args, "live_telemetry", False))
+                and str(backend).upper() != constants.COMM_BACKEND_LOCAL):
+            from fedml_tpu.telemetry.live import MetricStreamer
+
+            self._telemetry_streamer = MetricStreamer(
+                # same falsy-run_id normalization as LivePlane.from_args:
+                # a "None"/"" job would fail the collector's job gate and
+                # silently drop every frame this endpoint sends
+                "serve",
+                job=str(getattr(args, "run_id", None) or run_id or "0"),
+                interval_s=float(getattr(args, "live_interval_s", 1.0)),
+                send_cb=self._send_telemetry_frame,
+            ).start()
 
     def run_async(self):
         """Start the receive loop AND announce ourselves: on distributed
@@ -201,6 +232,27 @@ class FederatedServingBridge(FedMLCommManager):
         """Ask the publisher for its latest round (startup / lag heal)."""
         self.send_message(Message(ServeMessage.MSG_TYPE_S2P_HELLO,
                                   self.get_sender_id(), 0))
+
+    def _send_telemetry_frame(self, frame: dict) -> None:
+        """Dedicated carrier for the streamer's off-thread loop: one small
+        message per emitted frame to the publisher, whose process hosts
+        the run's LivePlane (the comm receive seam merges the frame)."""
+        m = Message(ServeMessage.MSG_TYPE_S2P_TELEMETRY,
+                    self.get_sender_id(), 0)
+        m.add_params(Message.MSG_ARG_KEY_TELEMETRY, frame)
+        self.send_message(m)
+
+    def finish(self) -> None:
+        if self._telemetry_streamer is not None:
+            # stream close while the transport is still up: the final FULL
+            # frame makes the collector's totals for this node exact
+            streamer, self._telemetry_streamer = self._telemetry_streamer, None
+            try:
+                streamer.close()
+            except Exception:  # pragma: no cover - transport already down
+                logger.debug("final serving telemetry flush failed",
+                             exc_info=True)
+        super().finish()
 
     @property
     def lag(self) -> int:

@@ -64,6 +64,14 @@ from fedml_tpu.telemetry.health import (
     log_health_event,
     update_norm,
 )
+from fedml_tpu.telemetry.live import (  # noqa: E402 - after flight_recorder
+    LiveCollector,
+    LivePlane,
+    MetricStreamer,
+    MetricsScrapeServer,
+    OnlineDoctor,
+    reset_live_plane,
+)
 
 __all__ = [
     "BYTES_BUCKETS",
@@ -107,4 +115,10 @@ __all__ = [
     "ClientHealthTracker",
     "log_health_event",
     "update_norm",
+    "LiveCollector",
+    "LivePlane",
+    "MetricStreamer",
+    "MetricsScrapeServer",
+    "OnlineDoctor",
+    "reset_live_plane",
 ]

@@ -477,6 +477,41 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "tiers", "no data: no tier/* metrics or tier-tagged events "
             "(not a hierarchical-federation run)")
 
+    # -- live plane (online-doctor alerts + stream accounting) ------------
+    # doctor_alert records are appended to telemetry.jsonl BY the online
+    # doctor at the round a rule trips; surfacing them here proves the
+    # alert fired mid-run, not in this autopsy
+    alerts = [rec for rec in metric_records
+              if rec.get("kind") == "doctor_alert"]
+    latest_live: Dict[str, float] = {}
+    for rec in metric_records:
+        name = rec.get("name", "")
+        if name.startswith("live/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest_live[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    live_counters: Dict[str, float] = {}
+    for (name, _), val in latest_live.items():
+        key = name.split("/", 1)[1]
+        live_counters[key] = live_counters.get(key, 0.0) + val
+    live: Dict[str, Any] = {"alerts": alerts, "counters": live_counters}
+    if alerts:
+        first = alerts[0]
+        verdict.append(
+            f"online doctor fired {len(alerts)} alert(s) MID-RUN — first: "
+            f"[{first.get('rule')}] round {first.get('round')}: "
+            f"{first.get('verdict')}")
+    gaps = live_counters.get("seq_gaps", 0.0)
+    if gaps:
+        verdict.append(
+            f"live metric stream lost {gaps:.0f} frame(s) in flight "
+            "(accounted in live/seq_gaps; totals self-healed via "
+            "cumulative frames)")
+    if not alerts and not live_counters:
+        notes.setdefault(
+            "live", "no data: no live/* metrics or doctor_alert records "
+            "(run predates the live plane, or live_telemetry was off)")
+
     if not (fr_events or health_events or report["n_spans"]
             or report.get("n_metrics")):
         notes["run"] = f"no telemetry data of any kind under {run_dir}"
@@ -484,6 +519,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         verdict.append("no issues detected")
 
     return {
+        "schema": "fedml_tpu.telemetry.doctor/v1",
         "run_dir": run_dir,
         "notes": notes,
         "crash": crash,
@@ -497,6 +533,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "serving": serving,
         "connectivity": connectivity,
         "tiers": tiers,
+        "live": live,
         "verdict": verdict,
     }
 
@@ -633,6 +670,20 @@ def format_doctor(d: Dict) -> str:
                 + (f" (SLO {slo:.0f} ms)" if slo else ""))
     else:
         add(f"  {notes.get('serving', 'no data')}")
+
+    add("")
+    add("live plane (online doctor / metric stream):")
+    live = d.get("live") or {}
+    live_alerts = live.get("alerts") or []
+    live_counters = live.get("counters") or {}
+    if live_alerts or live_counters:
+        for name, v in sorted(live_counters.items()):
+            add(f"  live/{name:<38s}{v:>14.0f}")
+        for a in live_alerts[-8:]:
+            add(f"  alert [{a.get('rule')}] round {a.get('round')}: "
+                f"{a.get('verdict')}")
+    else:
+        add(f"  {notes.get('live', 'no data')}")
 
     add("")
     add("service health:")

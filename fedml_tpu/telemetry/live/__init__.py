@@ -1,0 +1,182 @@
+"""Live telemetry plane — stream metrics while the run is in flight.
+
+PR 1/4 made telemetry *post-hoc*: JSONL sinks that ``telemetry report``
+and ``doctor`` read after the process exits. This package makes it
+**live**: every node (cross-silo clients, hierarchy aggregators, the
+serving endpoint, the scheduler) periodically snapshots its metric
+registry off-thread and streams seq-numbered cumulative-delta frames to
+a central :class:`LiveCollector` — piggybacked on existing round traffic
+where it exists (``FedMLCommManager`` pops a prepared frame onto
+outgoing messages), a low-frequency dedicated frame otherwise. The
+collector merges frames into a node-/job-labeled aggregate registry with
+duplicate-frame idempotence and seq-gap accounting, serves it on a live
+``/metrics`` Prometheus scrape endpoint (+ ``/healthz``), and powers
+``fedml_tpu telemetry watch`` plus the :class:`OnlineDoctor` — the
+post-hoc triage rules evaluated mid-run, alerting at the round a
+condition trips instead of in the autopsy.
+
+Enable on a federation with ``live_telemetry: true`` (plus an optional
+``metrics_port``) in the train args; see ``docs/observability.md``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from fedml_tpu.telemetry.live.collector import LiveCollector
+from fedml_tpu.telemetry.live.frames import (
+    FRAME_VERSION,
+    MetricStreamer,
+    frame_nbytes,
+)
+from fedml_tpu.telemetry.live.online_doctor import OnlineDoctor
+from fedml_tpu.telemetry.live.scrape import MetricsScrapeServer
+from fedml_tpu.telemetry.live.watch import fetch_state, render_state, watch
+
+__all__ = [
+    "FRAME_VERSION",
+    "LiveCollector",
+    "LivePlane",
+    "MetricStreamer",
+    "MetricsScrapeServer",
+    "OnlineDoctor",
+    "current_live_plane",
+    "fetch_state",
+    "frame_nbytes",
+    "ingest_frame",
+    "render_state",
+    "reset_live_plane",
+    "watch",
+]
+
+_plane_lock = threading.Lock()
+_plane: Optional["LivePlane"] = None
+
+
+class LivePlane:
+    """The collector-side bundle one process hosts: loopback streamer for
+    its own registry, the collector, the online doctor, and (optionally)
+    the HTTP scrape endpoint. Construct via :meth:`from_args` on whatever
+    node aggregates the run (the cross-silo server, the tree root, a
+    scheduler) — remote frames arriving at ANY comm manager in this
+    process are routed here via :func:`ingest_frame`."""
+
+    def __init__(self, job: str, node: str = "rank0",
+                 run_dir: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1",
+                 interval_s: float = 1.0,
+                 doctor_kwargs: Optional[Dict[str, Any]] = None):
+        self.collector = LiveCollector(job=job)
+        self.doctor = OnlineDoctor(self.collector, run_dir=run_dir,
+                                   **(doctor_kwargs or {}))
+        self.streamer = MetricStreamer(node, job=job,
+                                       interval_s=interval_s).start()
+        self.scrape: Optional[MetricsScrapeServer] = None
+        if metrics_port is not None:
+            self.scrape = MetricsScrapeServer(
+                self.collector, host=metrics_host, port=int(metrics_port),
+                doctor=self.doctor).start()
+        self._closed = False
+        global _plane
+        with _plane_lock:
+            _plane = self
+
+    @classmethod
+    def from_args(cls, args: Any, node: str,
+                  run_dir: Optional[str] = None) -> Optional["LivePlane"]:
+        """None unless ``args.live_telemetry`` is truthy — the production
+        hot path stays a None-check."""
+        if not bool(getattr(args, "live_telemetry", False)):
+            return None
+        port = getattr(args, "metrics_port", None)
+        return cls(
+            job=str(getattr(args, "run_id", "0") or "0"),
+            node=node,
+            run_dir=run_dir,
+            metrics_port=int(port) if port is not None else None,
+            metrics_host=str(getattr(args, "metrics_host", "127.0.0.1")),
+            interval_s=float(getattr(args, "live_interval_s", 1.0)),
+            doctor_kwargs={
+                "straggler_threshold": float(
+                    getattr(args, "straggler_threshold", 2.0)),
+                "anomaly_threshold": float(
+                    getattr(args, "anomaly_threshold", 4.0)),
+            },
+        )
+
+    @property
+    def url(self) -> Optional[str]:
+        return self.scrape.url if self.scrape is not None else None
+
+    def pump(self) -> None:
+        """Loopback this process's own registry into the collector (the
+        server calls this once per closed round; rounds are derived from
+        the pumped health/rounds_scored metric, not passed in)."""
+        self.streamer.pump(self.collector, force=True)
+
+    def close(self, drain_s: float = 3.0) -> None:
+        """Final full loopback frame, then stop the plane's threads. The
+        scrape endpoint keeps serving until stop — callers that want the
+        endpoint to outlive the run simply don't close."""
+        if self._closed:
+            return
+        self._closed = True
+        # bounded drain: on distributed backends the server's FINISH is
+        # what makes each client flush its final FULL frame — those
+        # frames are still in flight when the training loop reaches
+        # close, and tearing down now would drop them (totals would
+        # never become exact). Wait for the stream to go quiet, bounded
+        # by drain_s; runs where only the loopback node ever streamed
+        # (in-proc LOCAL) have nothing in flight and skip the wait.
+        if drain_s > 0 and any(n != self.streamer.node
+                               for n in self.collector.nodes()):
+            deadline = time.time() + drain_s
+            last_count = self.collector.stats()["frames"]
+            last_change = time.time()
+            while time.time() < deadline:
+                time.sleep(0.05)
+                count = self.collector.stats()["frames"]
+                if count != last_count:
+                    last_count, last_change = count, time.time()
+                elif time.time() - last_change >= 0.25:
+                    break
+        final = self.streamer.close()
+        if final is not None:
+            self.collector.ingest(final)
+        if self.scrape is not None:
+            self.scrape.stop()
+        global _plane
+        with _plane_lock:
+            if _plane is self:
+                _plane = None
+
+
+def current_live_plane() -> Optional[LivePlane]:
+    with _plane_lock:
+        return _plane
+
+
+def ingest_frame(frame: Any) -> bool:
+    """Route a remote node's frame to this process's plane (no-op when no
+    plane is bound — the receiving manager need not know whether it is
+    the collector host)."""
+    plane = current_live_plane()
+    if plane is None:
+        return False
+    return plane.collector.ingest(frame)
+
+
+def reset_live_plane() -> None:
+    """Drop the process-global plane (test isolation)."""
+    global _plane
+    with _plane_lock:
+        plane, _plane = _plane, None
+    if plane is not None:
+        try:
+            if plane.scrape is not None:
+                plane.scrape.stop()
+            plane.streamer.stop()
+        except Exception:  # pragma: no cover - teardown best effort
+            pass

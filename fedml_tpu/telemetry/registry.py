@@ -173,6 +173,27 @@ class Histogram:
                                     self._counts)),
             }
 
+    def merge_delta(self, bucket_deltas: List[int], count_delta: int,
+                    sum_delta: float, observed_min: Optional[float] = None,
+                    observed_max: Optional[float] = None) -> None:
+        """Fold another histogram's *delta* into this one (live-telemetry
+        collector merge). ``bucket_deltas`` must align with ``bounds`` +1
+        for the +inf bucket; min/max are the REMOTE observed extremes, not
+        deltas, so they merge as min/max."""
+        if len(bucket_deltas) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name}: merge of {len(bucket_deltas)} "
+                f"buckets into {len(self._counts)}")
+        with self._lock:
+            for i, d in enumerate(bucket_deltas):
+                self._counts[i] += int(d)
+            self._count += int(count_delta)
+            self._sum += float(sum_delta)
+            if observed_min is not None:
+                self._min = min(self._min, float(observed_min))
+            if observed_max is not None:
+                self._max = max(self._max, float(observed_max))
+
 
 def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple:
     return tuple(sorted((labels or {}).items()))
@@ -241,11 +262,17 @@ class MetricsRegistry:
                 f.write(line + "\n")
         return path
 
-    def export_prometheus(self) -> str:
-        """Prometheus text exposition format, version 0.0.4."""
+    def export_prometheus(self, name_prefix: Optional[str] = None) -> str:
+        """Prometheus text exposition format, version 0.0.4.
+
+        ``name_prefix`` restricts the export to one metric namespace
+        (e.g. ``"live/"`` — the scrape endpoint appends the collector
+        plane's own health to the aggregated node metrics this way)."""
         out: List[str] = []
         seen_types = set()
         for m in self._items():
+            if name_prefix is not None and not m.name.startswith(name_prefix):
+                continue
             pname = m.name.replace("/", "_")
             if pname not in seen_types:
                 seen_types.add(pname)

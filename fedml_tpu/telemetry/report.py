@@ -283,6 +283,7 @@ def build_report(run_dir: str) -> Dict:
     stitched = [s for s in spans if s.get("remote_parent")]
 
     return {
+        "schema": "fedml_tpu.telemetry.report/v1",
         "run_dir": run_dir,
         "n_spans": len(spans),
         "n_metrics": len(metrics),

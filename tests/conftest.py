@@ -75,6 +75,7 @@ def _reset_singletons():
     from fedml_tpu import telemetry
     from fedml_tpu.telemetry.health import reset_health_log
 
+    telemetry.reset_live_plane()
     telemetry.reset_registry()
     telemetry.reset_tracer()
     telemetry.reset_flight_recorder()

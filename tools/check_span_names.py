@@ -54,6 +54,10 @@ _TIER_SHAPE = re.compile(r"^tier/<v>/[a-z0-9_]+$")
 # one signal segment after the prefix — the endpoint id rides a label
 _SERVE_SPAN_SHAPE = re.compile(r"^serve/(?:stage|swap|publish)$")
 _SERVING_SHAPE = re.compile(r"^serving/[a-z0-9_]+$")
+# live telemetry plane: live/* is the stream/collector meta-namespace
+# (frames, seq gaps, alerts, scrapes) — one signal segment; node/job/rule
+# dimensions ride labels. Metric-only: the plane never opens spans.
+_LIVE_SHAPE = re.compile(r"^live/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -113,10 +117,10 @@ def check(entries):
                     f"{where}: span {name!r} must be compress/encode "
                     "or compress/decode")
         if kind == "span" and name.startswith(
-                ("mem/", "health/", "resilience/", "tier/")):
+                ("mem/", "health/", "resilience/", "tier/", "live/")):
             problems.append(
-                f"{where}: {name!r} — mem/, health/, resilience/ and "
-                "tier/ are metric namespaces, not span names")
+                f"{where}: {name!r} — mem/, health/, resilience/, tier/ "
+                "and live/ are metric namespaces, not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 problems.append(
@@ -166,6 +170,11 @@ def check(entries):
                     f"{where}: {kind} {name!r} — tier/* signals are "
                     "occurrence counts (counter) or levels (gauge), not "
                     "histograms")
+        if kind != "span" and name.startswith("live/"):
+            if not _LIVE_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be live/<signal> "
+                    "(one segment; node/job/rule dimensions ride labels)")
         if kind != "span":
             prev = metric_kinds.get(name)
             if prev is not None and prev[0] != kind:

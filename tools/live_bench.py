@@ -1,0 +1,166 @@
+"""Live-telemetry overhead bench — proves streaming is (nearly) free.
+
+Runs the SAME in-proc cross-silo federation twice — live plane off, then
+on (collector + online doctor + /metrics endpoint + per-round loopback
+frames) — and reports:
+
+- ``rounds_per_s_off`` / ``rounds_per_s_on`` (best of ``trials`` each,
+  interleaved so host noise drifts cancel) and their ratio, gated at
+  ``tolerance`` (default 2%);
+- the micro-measured streaming seam: wall cost of one snapshot→frame→
+  ingest pump over the run's real populated registry, times pumps per
+  round, as a fraction of the measured round wall (``overhead_ratio``,
+  gated < ``tolerance``) — this is the deterministic gate; the end-to-end
+  rounds/s ratio is the honest-but-noisy one;
+- steady-state telemetry wire bytes per node per round (from the
+  ``live/frame_bytes`` histogram), gated under ``max_bytes_per_round``.
+
+Env knobs: ``FEDML_LIVE_ROUNDS`` / ``FEDML_LIVE_CLIENTS`` /
+``FEDML_LIVE_TRIALS`` / ``FEDML_LIVE_TOL`` / ``FEDML_LIVE_MAX_BYTES``.
+One JSON line via ``bench.py --live``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+def _run_once(seed: int, rounds: int, clients: int, live: bool,
+              run_id: str, log_dir: Optional[str] = None) -> float:
+    """One in-proc cross-silo run; returns wall seconds."""
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu import telemetry
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.cross_silo.run_inproc import run_cross_silo_inproc
+    from fedml_tpu.data import load_federated
+
+    cfg = {
+        "common_args": {"training_type": "cross_silo", "random_seed": seed,
+                        "run_id": run_id,
+                        **({"log_file_dir": log_dir} if log_dir else {})},
+        "data_args": {"dataset": "synthetic", "train_size": 60 * clients,
+                      "test_size": 60, "class_num": 4, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": clients,
+            "client_num_per_round": clients,
+            "comm_round": rounds, "epochs": 1, "batch_size": 32,
+            "learning_rate": 0.3,
+            **({"live_telemetry": True, "metrics_port": 0} if live else {}),
+        },
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    t0 = time.perf_counter()
+    result = run_cross_silo_inproc(args, ds, model, timeout=300)
+    wall = time.perf_counter() - t0
+    if result is None:
+        raise RuntimeError("federation run did not complete")
+    telemetry.reset_live_plane()
+    return wall
+
+
+def _frame_stats():
+    """(frames_emitted, frame_bytes_sum) from the process registry."""
+    from fedml_tpu.telemetry import get_registry
+
+    frames = bytes_sum = 0.0
+    for rec in get_registry().snapshot():
+        if rec["name"] == "live/frames_emitted":
+            frames += rec.get("value", 0.0)
+        elif rec["name"] == "live/frame_bytes":
+            bytes_sum += rec.get("sum", 0.0)
+    return frames, bytes_sum
+
+
+def _micro_pump_seconds(n: int = 50) -> float:
+    """Wall seconds of ONE snapshot→frame→ingest pump over the registry
+    this process just populated with a real run (deterministic seam
+    measurement — the counterpart of chaos_bench's send-seam gate)."""
+    from fedml_tpu.telemetry import get_registry
+    from fedml_tpu.telemetry.live import LiveCollector, MetricStreamer
+
+    reg = get_registry()
+    streamer = MetricStreamer("bench", job="live_bench", registry=reg,
+                              interval_s=3600.0)
+    collector = LiveCollector(job="live_bench")
+    tick = reg.counter("comm/messages_sent")  # something changes per pump
+    streamer.pump(collector, force=True)  # absorb the first full build
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tick.inc()
+        streamer.pump(collector, force=True)
+    return (time.perf_counter() - t0) / n
+
+
+def run_live_bench(rounds: Optional[int] = None,
+                   clients: Optional[int] = None,
+                   trials: Optional[int] = None,
+                   tolerance: Optional[float] = None,
+                   max_bytes_per_round: Optional[float] = None
+                   ) -> Dict[str, Any]:
+    rounds = int(rounds or os.environ.get("FEDML_LIVE_ROUNDS", 5))
+    clients = int(clients or os.environ.get("FEDML_LIVE_CLIENTS", 3))
+    trials = int(trials or os.environ.get("FEDML_LIVE_TRIALS", 3))
+    tolerance = float(tolerance or os.environ.get("FEDML_LIVE_TOL", 0.02))
+    max_bytes = float(max_bytes_per_round
+                      or os.environ.get("FEDML_LIVE_MAX_BYTES", 256 * 1024))
+
+    walls_off, walls_on = [], []
+    frames0, bytes0 = _frame_stats()
+    for t in range(trials):
+        # interleaved A/B so slow host-noise drift cancels out of the
+        # ratio (same methodology as serve_bench's swap windows)
+        walls_off.append(_run_once(t, rounds, clients, live=False,
+                                   run_id=f"livebench_off_{t}"))
+        walls_on.append(_run_once(t, rounds, clients, live=True,
+                                  run_id=f"livebench_on_{t}"))
+    frames1, bytes1 = _frame_stats()
+    wall_off = min(walls_off)
+    wall_on = min(walls_on)
+    rps_off = rounds / wall_off
+    rps_on = rounds / wall_on
+    ratio = rps_on / rps_off if rps_off else 0.0
+
+    # steady-state wire cost: every emitted frame, averaged over the live
+    # runs' (nodes × rounds). In-proc there is ONE streaming node (the
+    # server loopback); multiprocess deployments add one per rank.
+    n_frames = frames1 - frames0
+    frame_bytes = bytes1 - bytes0
+    bytes_per_node_per_round = (frame_bytes / (trials * rounds)
+                                if trials * rounds else 0.0)
+
+    pump_s = _micro_pump_seconds()
+    round_wall_s = wall_on / rounds
+    overhead_ratio = (pump_s / round_wall_s) if round_wall_s > 0 else 0.0
+
+    return {
+        "metric": "live_telemetry_overhead",
+        "rounds": rounds,
+        "clients": clients,
+        "trials": trials,
+        "rounds_per_s_off": round(rps_off, 3),
+        "rounds_per_s_on": round(rps_on, 3),
+        "on_off_ratio": round(ratio, 4),
+        "pump_ms": round(pump_s * 1e3, 3),
+        "overhead_ratio": round(overhead_ratio, 5),
+        "frames": int(n_frames),
+        "frame_bytes": int(frame_bytes),
+        "bytes_per_node_per_round": round(bytes_per_node_per_round, 1),
+        "tolerance": tolerance,
+        "max_bytes_per_round": max_bytes,
+        "ok_overhead": overhead_ratio <= tolerance,
+        "ok_bytes": bytes_per_node_per_round <= max_bytes,
+        "ok_rounds": ratio >= 1.0 - tolerance,
+        "completed": True,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_live_bench()))
