@@ -293,6 +293,19 @@ def main() -> None:
             print(json.dumps(row))
         return
 
+    if "--secagg" in sys.argv:
+        # secure-aggregation gates: masked wire bytes ≤ 1.2× plain int8
+        # on a resnet-sized delta, and a chaos-killed masked round
+        # closing via seed-reveal recovery at ≤ 1 extra round-trip per
+        # dropout — one JSON line (see tools/secagg_bench.py)
+        from tools.secagg_bench import run_secagg_bench
+
+        row = run_secagg_bench()
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--chaos" in sys.argv:
         # resilience micro-bench: seam overhead on the hot send path
         # (< 1% acceptance) + broker kill/restart recovery time — same

@@ -539,11 +539,14 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
 @click.option("--compression", default="", show_default=True,
               help="update codec (e.g. int8) — proves recovery paths "
                    "compose with the compressed transport")
+@click.option("--secagg", default="", show_default=True,
+              help="masked secure aggregation mode (int8) — chaos kills "
+                   "then exercise the seed-reveal mask recovery")
 @click.option("--round-deadline-s", default=30.0, show_default=True)
 @click.option("--round-quorum", default=2.0 / 3.0, show_default=True)
 def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
           revive_round, drop: float, duplicate: float, delay_ms: float,
-          compression: str, round_deadline_s: float,
+          compression: str, secagg: str, round_deadline_s: float,
           round_quorum: float) -> None:
     """Run a seeded chaos scenario against an in-proc federation.
 
@@ -559,7 +562,8 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
         seed=seed, rounds=rounds, clients=clients, kill_rank=kill_rank,
         kill_round=kill_round, revive_round=revive_round, drop=drop,
         duplicate=duplicate, delay_ms=delay_ms, compression=compression,
-        round_deadline_s=round_deadline_s, round_quorum=round_quorum)
+        secagg=secagg, round_deadline_s=round_deadline_s,
+        round_quorum=round_quorum)
     click.echo(json.dumps(out))
     if not out["completed"]:
         raise SystemExit(1)

@@ -41,6 +41,12 @@ def requires_full_trees() -> bool:
     trees — but model-poisoning attack injection, list-based defenses,
     central-DP clipping and FHE all operate on full client models, so
     when any of them is live the server decodes each update instead.
+
+    Norm-ONLY defenses (norm-difference clipping) are exempt: their
+    per-client norms read straight off the compressed blocks × scales
+    (the same path the health tracker uses) and the clip factor folds
+    into the fused aggregation weight — see
+    ``FedMLDefender.fused_clip_factors``.
     """
     from fedml_tpu.core.dp.fedml_differential_privacy import (
         FedMLDifferentialPrivacy,
@@ -50,10 +56,12 @@ def requires_full_trees() -> bool:
     from fedml_tpu.core.security.defender import FedMLDefender
 
     dp = FedMLDifferentialPrivacy.get_instance()
+    defender = FedMLDefender.get_instance()
     return (
         FedMLFHE.get_instance().is_fhe_enabled()
         or FedMLAttacker.get_instance().is_model_attack()
-        or FedMLDefender.get_instance().is_defense_enabled()
+        or (defender.is_defense_enabled()
+            and not defender.is_norm_only_defense())
         or (dp.is_dp_enabled() and dp.is_global_dp_enabled())
     )
 

@@ -44,6 +44,10 @@ import numpy as np
 Pytree = Any
 
 WIRE_VERSION = 1
+# masked wire nodes (secure aggregation) are version-2 codec nodes: same
+# array framing plus a validated "sa" metadata field. The codec lives in
+# fedml_tpu/privacy/secagg (loaded lazily — see get_codec).
+WIRE_VERSION_MASKED = 2
 
 # meta entry per original leaf: (dtype string, shape tuple)
 LeafMeta = Tuple[str, Tuple[int, ...]]
@@ -68,14 +72,17 @@ class CompressedTree:
     codec-positional list of arrays for that leaf (e.g. ``[q, scale]`` for
     int8). ``structure`` is the original container tree with each leaf
     replaced by its flat index, so decode can rebuild the exact shape.
+    ``sa`` is the masked-wire (v2) metadata dict — None on plain (v1)
+    trees.
     """
 
     __slots__ = ("codec", "version", "is_delta", "raw_nbytes", "meta",
-                 "structure", "arrays")
+                 "structure", "arrays", "sa")
 
     def __init__(self, codec: str, version: int, is_delta: bool,
                  raw_nbytes: int, meta: Tuple[LeafMeta, ...],
-                 structure: Pytree, arrays: List[List[Any]]):
+                 structure: Pytree, arrays: List[List[Any]],
+                 sa: Optional[dict] = None):
         self.codec = str(codec)
         self.version = int(version)
         self.is_delta = bool(is_delta)
@@ -84,17 +91,18 @@ class CompressedTree:
                           for dt, sh in meta)
         self.structure = structure
         self.arrays = arrays
+        self.sa = dict(sa) if sa is not None else None
 
     def tree_flatten(self):
         aux = (self.codec, self.version, self.is_delta, self.raw_nbytes,
-               self.meta, self.structure)
+               self.meta, self.structure, self.sa)
         return (self.arrays,), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        codec, version, is_delta, raw_nbytes, meta, structure = aux
+        codec, version, is_delta, raw_nbytes, meta, structure, sa = aux
         return cls(codec, version, is_delta, raw_nbytes, meta, structure,
-                   children[0])
+                   children[0], sa=sa)
 
     def __repr__(self) -> str:  # pragma: no cover
         return (f"CompressedTree(codec={self.codec}, v{self.version}, "
@@ -120,6 +128,10 @@ class Codec:
     # safe for FULL-model broadcast (not just deltas): sparsifying a whole
     # model would zero most of its weights, so top-k is delta/upload-only
     broadcast_safe: bool = True
+    # maskable codecs (secure aggregation) carry pairwise-masked blocks:
+    # individual trees never decode and the generic weighted sum refuses
+    # them — they resolve only through privacy.secagg.unmask_finalize
+    maskable: bool = False
 
     @property
     def spec(self) -> str:
@@ -321,6 +333,11 @@ def fused_weighted_sum(cts: Sequence[CompressedTree], weights) -> Pytree:
                 "cannot fuse heterogeneous compressed updates "
                 f"({ct.codec}/v{ct.version} vs {first.codec}/v{first.version})")
     codec = get_codec(first.codec)
+    if codec.maskable:
+        raise ValueError(
+            "masked (secure-aggregation) updates cannot ride the generic "
+            "weighted sum — per-client float weights would break exact "
+            "mask cancellation; use privacy.secagg.unmask_finalize")
     n_leaves = len(first.meta)
     if any(len(ct.arrays) != n_leaves for ct in cts):
         raise ValueError("compressed update leaf count mismatch")
@@ -450,9 +467,24 @@ _CODEC_CLASSES: Dict[str, type] = {
 
 _INSTANCES: Dict[Tuple, Codec] = {}
 
+_SECAGG_NAME = "secagg_int8"
+
+
+def _load_secagg_codec() -> type:
+    """Lazy registration of the maskable codec — privacy.secagg imports
+    this module, so the import runs on first use, not at import time."""
+    if _SECAGG_NAME not in _CODEC_CLASSES:
+        from fedml_tpu.privacy.secagg.codec import SecAggInt8Codec
+
+        _CODEC_CLASSES[SecAggInt8Codec.name] = SecAggInt8Codec
+    return _CODEC_CLASSES[_SECAGG_NAME]
+
 
 def available_codecs() -> Tuple[str, ...]:
-    return tuple(sorted(_CODEC_CLASSES))
+    # the masked codec is always a legal wire tag, loaded or not — a
+    # receiver must not reject a masked payload just because nothing in
+    # its process imported the privacy package yet
+    return tuple(sorted(set(_CODEC_CLASSES) | {_SECAGG_NAME}))
 
 
 def register_codec(cls: type) -> type:
@@ -474,6 +506,18 @@ def get_codec(name: str, args: Any = None) -> Optional[Codec]:
     if name in ("", "none", "off"):
         return None
     base, _, param = name.partition("@")
+    if base == _SECAGG_NAME:
+        cls = _load_secagg_codec()
+        if param:
+            clip, bound, mod_bits = cls.parse_param(param)
+        else:
+            # bare tag (wire validation, maskable checks): a default
+            # instance — every real round negotiates explicit params
+            clip, bound, mod_bits = 0.1, 42, 8
+        cache_key = (base, clip, bound, mod_bits)
+        if cache_key not in _INSTANCES:
+            _INSTANCES[cache_key] = cls(clip, bound, mod_bits)
+        return _INSTANCES[cache_key]
     if base not in _CODEC_CLASSES:
         raise ValueError(
             f"unknown compression codec {base!r}; "

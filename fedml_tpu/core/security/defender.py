@@ -40,6 +40,44 @@ class FedMLDefender:
     def is_defense_enabled(self) -> bool:
         return self.is_enabled
 
+    def is_norm_only_defense(self) -> bool:
+        """True when the active defense needs only per-client update
+        NORMS (norm-difference clipping). Norms are computable straight
+        off compressed blocks × scales (``telemetry.health.update_norm``)
+        and the clip factor folds into the aggregation weight, so these
+        defenses ride the dequant-fused path — no f32 fallback."""
+        return self.is_enabled and self.defense_type == "norm_diff_clipping"
+
+    def norm_clip_bound(self) -> float:
+        """The active norm bound (norm-only defenses)."""
+        return float(getattr(self.defender, "norm_bound", 0.0))
+
+    def fused_clip_factors(self, cts) -> Optional[List[float]]:
+        """Per-client clip factors for the dequant-fused aggregation
+        path: ``min(1, bound/‖d_i‖)`` with the delta norm read straight
+        off the compressed blocks × scales (``health.update_norm`` — the
+        PR 4 path, reused, not re-decoded). None when no norm-only
+        defense is active. The SINGLE definition for every fused caller
+        (cross-silo aggregator, sp simulation)."""
+        if not self.is_norm_only_defense():
+            return None
+        from fedml_tpu.telemetry.health import update_norm
+        from fedml_tpu.telemetry.registry import get_registry
+
+        bound = self.norm_clip_bound()
+        factors = []
+        for ct in cts:
+            norm = update_norm(ct)
+            if norm is None:  # pragma: no cover - delta cts always norm
+                logging.warning("norm-only defense could not norm a "
+                                "compressed update; leaving it unclipped")
+                factors.append(1.0)
+            else:
+                factors.append(min(1.0, bound / (norm + 1e-12)))
+        get_registry().counter("health/norm_clips_fused").inc(
+            sum(1 for f in factors if f < 1.0))
+        return factors
+
     def defend_before_aggregation(
         self,
         raw_client_grad_list: List[Tuple[int, Pytree]],

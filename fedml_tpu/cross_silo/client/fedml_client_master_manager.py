@@ -44,6 +44,14 @@ class ClientMasterManager(FedMLCommManager):
         self._error_feedback = None
         self._global_ref = None
         self._last_train_ms = None
+        # masked secure aggregation (secagg: int8): this client's X25519
+        # key rides every status message; each broadcast's secagg header
+        # opens the round's mask state; uploads leave the device already
+        # masked and the only thing this client ever reveals is the
+        # pair-seeds it shared with peers the server evicted
+        from fedml_tpu.privacy.secagg import SecAggClientSession
+
+        self._secagg = SecAggClientSession.from_args(rank, args)
         # resilience: optional periodic heartbeat (liveness signal that
         # survives long local epochs and drives rejoin detection after a
         # partition heals); started once the connection is up
@@ -107,6 +115,12 @@ class ClientMasterManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_REJOIN_SYNC, self.handle_message_rejoin_sync
         )
+        from fedml_tpu.privacy.secagg import SecAggMessage
+
+        self.register_message_receive_handler(
+            SecAggMessage.MSG_TYPE_S2C_SECAGG_RECOVER,
+            self.handle_message_secagg_recover,
+        )
 
     # -- handlers ----------------------------------------------------------
     def handle_message_connection_ready(self, msg: Message) -> None:
@@ -155,6 +169,19 @@ class ClientMasterManager(FedMLCommManager):
         if isinstance(global_params, CompressedTree):
             global_params = get_codec(global_params.codec).decode(
                 global_params)
+        if self._secagg is not None:
+            from fedml_tpu.privacy.secagg import SecAggMessage
+
+            header = msg.get(SecAggMessage.MSG_ARG_KEY_SECAGG)
+            if header is not None:
+                # the header is authoritative for the upload wire: the
+                # roster-derived codec params come from the server, and
+                # the MSG_ARG_KEY_COMPRESSION negotiation below applies
+                # to the broadcast only
+                self._secagg.begin_round(
+                    header, int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0)))
+            self._global_ref = global_params
+            return global_params
         negotiated = msg.get(Message.MSG_ARG_KEY_COMPRESSION)
         if negotiated is not None and not bool(
                 getattr(self.args, "secure_aggregation", False)):
@@ -179,13 +206,18 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
         new_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx + 1))
-        if new_round > self.round_idx + 1 and self._error_feedback is not None:
+        if new_round > self.round_idx + 1 and (
+                self._error_feedback is not None
+                or self._secagg is not None):
             # rounds were missed (dropout without a rejoin resync): the
             # EF residual belongs to a stale global reference — carrying
             # it forward would leak pre-gap quantization error
             logger.info("client %d skipped rounds %d..%d; resetting EF",
                         self.rank, self.round_idx + 1, new_round - 1)
-            self._error_feedback.reset()
+            if self._error_feedback is not None:
+                self._error_feedback.reset()
+            if self._secagg is not None:
+                self._secagg.reset_identity()
         global_params = self._receive_global_model(msg)
         data_silo_idx = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = new_round
@@ -205,6 +237,8 @@ class ClientMasterManager(FedMLCommManager):
                                      self.round_idx))
         if self._error_feedback is not None:
             self._error_feedback.reset()
+        if self._secagg is not None:
+            self._secagg.reset_identity()
         get_registry().counter("resilience/rejoin_syncs").inc()
         logger.info("client %d re-synced at round %d after rejoin",
                     self.rank, self.round_idx)
@@ -237,7 +271,34 @@ class ClientMasterManager(FedMLCommManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())
         msg.add_params(Message.MSG_ARG_KEY_HEALTH, self._heartbeat_fields())
+        if self._secagg is not None:
+            # key advertisement: 32 bytes on a message we already send
+            from fedml_tpu.privacy.secagg import SecAggMessage
+
+            msg.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG_PK,
+                           self._secagg.pk)
         self.send_message(msg)
+
+    def handle_message_secagg_recover(self, msg: Message) -> None:
+        """Dropout recovery: reveal the pair-seeds shared with the
+        evicted peers (and ONLY those — see SecAggClientSession guards;
+        a refused request is simply not answered, which the server's
+        bounded recovery deadline treats as this client's own dropout)."""
+        from fedml_tpu.privacy.secagg import SecAggMessage
+
+        if self._secagg is None:
+            return
+        seeds = self._secagg.reveal_for(
+            msg.get(SecAggMessage.MSG_ARG_KEY_SECAGG_EVICTED) or [],
+            msg.get(MyMessage.MSG_ARG_KEY_ROUND))
+        if seeds is None:
+            return
+        m = Message(SecAggMessage.MSG_TYPE_C2S_SECAGG_REVEAL,
+                    self.get_sender_id(), msg.get_sender_id())
+        m.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG_REVEAL, seeds)
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND,
+                     msg.get(MyMessage.MSG_ARG_KEY_ROUND))
+        self.send_message(m)
 
     def _encode_update(self, weights):
         """Delta-encode the trained model through the negotiated codec.
@@ -248,11 +309,20 @@ class ClientMasterManager(FedMLCommManager):
         jitted program on device, so the transport only ever pulls the
         compressed blocks off the accelerator.
         """
-        if self._upload_codec is None or self._global_ref is None:
-            return weights
         from fedml_tpu.compression import derive_key
         from fedml_tpu.compression.codecs import tree_delta
 
+        if self._secagg is not None:
+            if not self._secagg.active or self._global_ref is None:
+                raise ValueError(
+                    f"client {self.rank} has no open secagg round to "
+                    "encode into — refusing to upload an unmasked model")
+            delta = tree_delta(weights, self._global_ref)
+            return self._secagg.encode_update(
+                delta, derive_key(int(getattr(self.args, "random_seed", 0)),
+                                  self.round_idx, self.rank))
+        if self._upload_codec is None or self._global_ref is None:
+            return weights
         delta = tree_delta(weights, self._global_ref)
         key = derive_key(int(getattr(self.args, "random_seed", 0)),
                          self.round_idx, self.rank)

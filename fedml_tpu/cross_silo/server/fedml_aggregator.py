@@ -53,6 +53,10 @@ class FedMLAggregator:
         # records that decoded model here so deltas resolve against the
         # same base (None → the exact global)
         self._delta_base: Optional[Pytree] = None
+        # masked secure aggregation: when the server manager installs a
+        # SecAggServerSession, uploads are pairwise-masked trees that
+        # only resolve in aggregate (privacy/secagg)
+        self._secagg = None
         self.model_dict: Dict[int, Pytree] = {}
         self.sample_num_dict: Dict[int, int] = {}
         self.local_steps_dict: Dict[int, float] = {}
@@ -98,9 +102,21 @@ class FedMLAggregator:
             self.flag_client_model_uploaded_dict[i] = False
         return True
 
+    def set_secagg(self, session) -> None:
+        self._secagg = session
+
     def n_received(self) -> int:
         """Uploads staged for the current round (the quorum count)."""
         return len(self.model_dict)
+
+    def drop_client_upload(self, index: int) -> None:
+        """Remove one staged upload (secagg recovery: a survivor that
+        never revealed is evicted mid-close — its masked upload carries
+        unrecoverable masks and must not pollute the sum)."""
+        self.model_dict.pop(index, None)
+        self.sample_num_dict.pop(index, None)
+        self.local_steps_dict.pop(index, None)
+        self.flag_client_model_uploaded_dict[index] = False
 
     def close_round_quorum(self, expected: int) -> List[int]:
         """Close a round on quorum instead of all-received: reset the
@@ -138,6 +154,21 @@ class FedMLAggregator:
         from fedml_tpu.compression.codecs import tree_undelta
         from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
 
+        if self._secagg is not None:
+            # masked round: every upload must be a masked tree (the
+            # manager validated each at receive) and the only legal
+            # reduction is the unmask-in-aggregate program — per-client
+            # decode paths are structurally unreachable here
+            bad = [m for _, m in raw_list
+                   if not (isinstance(m, CompressedTree)
+                           and getattr(get_codec(m.codec), "maskable",
+                                       False))]
+            if bad:
+                raise ValueError(
+                    f"unmasked upload(s) reached a secagg aggregate: "
+                    f"{[type(m).__name__ for m in bad]}")
+            return raw_list, self._secagg.aggregate(
+                [m for _, m in raw_list], self.get_upload_base())
         if not any(isinstance(m, CompressedTree) for _, m in raw_list):
             return raw_list, None
         # deltas resolve against the broadcast as clients decoded it (the
@@ -146,8 +177,14 @@ class FedMLAggregator:
         if all(isinstance(m, CompressedTree) and m.is_delta
                for _, m in raw_list) and not (
                    requires_full_trees() or self._contrib.is_enabled()):
+            # norm-only defenses ride this path: clip factors read off
+            # the blocks × scales, folded into the fused weights
+            from fedml_tpu.core.security.defender import FedMLDefender
+
             return raw_list, FedMLAggOperator.agg_compressed(
-                self.args, raw_list, base)
+                self.args, raw_list, base,
+                clip_factors=FedMLDefender.get_instance()
+                .fused_clip_factors([m for _, m in raw_list]))
         decoded = []
         for n, m in raw_list:
             if isinstance(m, CompressedTree):

@@ -74,6 +74,18 @@ class FedMLServerManager(FedMLCommManager):
         if not bool(getattr(args, "secure_aggregation", False)):
             self._codec = get_codec(getattr(args, "compression", ""), args)
 
+        # masked secure aggregation (secagg: int8): uploads arrive as
+        # pairwise-masked int8-domain blocks that only ever decode in
+        # aggregate; quorum closes with missing clients trigger the
+        # seed-reveal recovery below instead of aggregating directly
+        from fedml_tpu.privacy.secagg import SecAggServerSession
+
+        self._secagg = SecAggServerSession.from_args(args, client_num)
+        self._completing = False
+        if self._secagg is not None:
+            self._check_secagg_compat()
+            self.aggregator.set_secagg(self._secagg)
+
         # run health: per-client latency EWMA + update-norm/loss z-scores
         # fed from the upload path, device memory sampled per aggregate —
         # surfaced as health/* and mem/* metrics and health.jsonl events
@@ -113,6 +125,9 @@ class FedMLServerManager(FedMLCommManager):
         self._deadline_expired = False
         self._deadline_extensions_used = 0
         self._deadline = RoundDeadline(self._on_round_deadline)
+        # secagg mask recovery rides the same deadline machinery: its
+        # bounded waves re-arm this timer, never the round's own
+        self._recovery_deadline = RoundDeadline(self._on_recovery_deadline)
 
         # live serving plane: listeners see every closed round's aggregate
         # (round_idx, global_params) — the serving publisher attaches here
@@ -137,6 +152,44 @@ class FedMLServerManager(FedMLCommManager):
     # -- lifecycle ---------------------------------------------------------
     def run(self) -> None:
         super().run()
+
+    def _check_secagg_compat(self) -> None:
+        """Masked rounds never expose individual models, so every trust
+        hook that operates on per-client plaintext is structurally
+        impossible — refuse at construction, not mid-round."""
+        from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+        from fedml_tpu.core.security.attacker import FedMLAttacker
+        from fedml_tpu.core.security.defender import FedMLDefender
+
+        conflicts = []
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            conflicts.append("FHE aggregation")
+        if FedMLAttacker.get_instance().is_model_attack():
+            conflicts.append("model-attack injection")
+        if FedMLDefender.get_instance().is_defense_enabled():
+            conflicts.append(
+                "list-based defenses (secagg_clip already bounds every "
+                "client update inside the masked encode)")
+        if self.aggregator._contrib.is_enabled():
+            conflicts.append("contribution assessment")
+        if self._codec is not None and not self._codec.broadcast_safe:
+            conflicts.append(
+                f"upload codec {self._codec.spec!r} (secagg owns the "
+                "upload wire; only broadcast-safe compression applies)")
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_dp_enabled() and dp.is_global_dp_enabled() and getattr(
+                getattr(dp.frame, "mechanism", None), "sigma", None) is None:
+            conflicts.append(
+                "non-gaussian central-DP mechanism (only gaussian has an "
+                "in-program noise path)")
+        if conflicts:
+            raise ValueError(
+                "secure aggregation (secagg: int8) cannot run with "
+                "per-client-plaintext features: " + "; ".join(conflicts))
 
     def _broadcast_payload(self, global_params):
         """The per-round broadcast payload: encoded ONCE, fanned out N×."""
@@ -169,10 +222,12 @@ class FedMLServerManager(FedMLCommManager):
 
         global_params = self.aggregator.get_global_model_params()
         payload = self._broadcast_payload(global_params)
+        sa_header = self._secagg_round_header()
         with self._round_lock:
             self._round_closed = False
             self._deadline_expired = False
             self._deadline_extensions_used = 0
+            self._completing = False
         # the open span's context rides each init message, so every
         # client's training span joins this round's server-side trace
         with telemetry.get_tracer().span(
@@ -190,6 +245,11 @@ class FedMLServerManager(FedMLCommManager):
                 if self._codec is not None:
                     msg.add_params(Message.MSG_ARG_KEY_COMPRESSION,
                                    self._codec.spec)
+                if sa_header is not None:
+                    from fedml_tpu.privacy.secagg import SecAggMessage
+
+                    msg.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG,
+                                   sa_header)
                 self._bcast_ts[client_id] = time.time()
                 self.send_message(msg)
         self._arm_round_deadline()
@@ -206,6 +266,21 @@ class FedMLServerManager(FedMLCommManager):
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self.handle_message_receive_model_from_client,
         )
+        from fedml_tpu.privacy.secagg import SecAggMessage
+
+        self.register_message_receive_handler(
+            SecAggMessage.MSG_TYPE_C2S_SECAGG_REVEAL,
+            self.handle_message_secagg_reveal,
+        )
+
+    def _secagg_round_header(self):
+        """Open a masked round (roster + pk directory + codec spec) —
+        rides the broadcast, costing zero extra round-trips."""
+        if self._secagg is None:
+            return None
+        return self._secagg.begin_round(
+            int(self.args.round_idx),
+            list(self.client_id_list_in_this_round))
 
     # -- handlers ----------------------------------------------------------
     def handle_message_connection_ready(self, msg: Message) -> None:
@@ -224,6 +299,18 @@ class FedMLServerManager(FedMLCommManager):
         hb = msg.get(Message.MSG_ARG_KEY_HEALTH)
         if isinstance(hb, dict):
             self._health.heartbeat(msg.get_sender_id(), hb)
+        if self._secagg is not None:
+            # key advertisement rides every status/heartbeat message
+            from fedml_tpu.privacy.secagg import SecAggMessage
+
+            pk = msg.get(SecAggMessage.MSG_ARG_KEY_SECAGG_PK)
+            if pk is not None:
+                try:
+                    self._secagg.note_pk(msg.get_sender_id(), pk)
+                except ValueError:
+                    logger.warning(
+                        "dropping malformed secagg key advertisement "
+                        "from client %s", msg.get_sender_id())
         # any sign of life from an evicted client is its reconnect
         if self.is_initialized and self.liveness.is_evicted(
                 msg.get_sender_id()):
@@ -281,6 +368,7 @@ class FedMLServerManager(FedMLCommManager):
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
+        invalid = None
         with self._round_lock:
             cohort = list(self.client_id_list_in_this_round or [])
             stale = (
@@ -292,12 +380,29 @@ class FedMLServerManager(FedMLCommManager):
             if stale:
                 pass  # logged below, outside the lock
             else:
-                self._observe_client_upload(sender, msg, model_params)
-                self.aggregator.add_local_trained_result(
-                    cohort.index(sender), model_params,
-                    local_sample_num, local_steps=msg.get("local_steps"),
-                )
-                missing = self._try_close_round(cohort)
+                if self._secagg is not None:
+                    try:
+                        self._secagg.validate_upload(sender, model_params)
+                    except ValueError as e:
+                        # a masked upload whose metadata lies is DROPPED
+                        # (the client effectively never uploaded this
+                        # round) — it can never reach the aggregate
+                        invalid = str(e)
+                if invalid is None:
+                    self._observe_client_upload(sender, msg, model_params)
+                    self.aggregator.add_local_trained_result(
+                        cohort.index(sender), model_params,
+                        local_sample_num, local_steps=msg.get("local_steps"),
+                    )
+                    missing = self._try_close_round(cohort)
+        if invalid is not None:
+            self._resilience_event(
+                "secagg_invalid_upload", client=sender,
+                round=self.args.round_idx, reason=invalid,
+                counter="secagg/invalid_uploads")
+            logger.warning("dropping invalid masked upload from client "
+                           "%s: %s", sender, invalid)
+            return
         if stale:
             # a quorum round already closed (or the sender was never in
             # this cohort): the upload is stale — logged, counted, never
@@ -414,8 +519,11 @@ class FedMLServerManager(FedMLCommManager):
         self.com_manager.stop_receive_message()
 
     def _finish_round(self, missing_clients: list) -> None:
-        """Aggregate the received cohort and advance the FSM — the shared
-        tail of the all-received and quorum paths."""
+        """Close path shared by all-received and quorum: evict the
+        missing, then either aggregate directly or — in a masked round
+        with dropouts — run seed-reveal recovery first (the aggregate
+        cannot close until the evicted clients' half-cancelled masks
+        are removed)."""
         from fedml_tpu import telemetry
 
         if missing_clients:
@@ -426,10 +534,169 @@ class FedMLServerManager(FedMLCommManager):
                     self._resilience_event(
                         "evicted", client=cid, round=self.args.round_idx,
                         counter="resilience/clients_evicted")
+        if (self._secagg is not None and missing_clients
+                and not self._secagg.recovery_complete()):
+            self._secagg_start_recovery(missing_clients)
+            return
+        self._complete_round()
+
+    # -- secagg dropout recovery -------------------------------------------
+    def _secagg_start_recovery(self, missing_clients: list) -> None:
+        """Ask every survivor for the pair-seeds it shared with the
+        evicted clients — ONE extra round-trip, riding the same comm
+        flow as the PR 5 probes. The round aggregates when the reveals
+        close (handle_message_secagg_reveal) or the bounded recovery
+        deadline expires."""
+        from fedml_tpu.resilience import quorum_size
+
+        cohort = list(self.client_id_list_in_this_round or [])
+        survivors = [c for c in cohort if c not in set(missing_clients)]
+        ask = self._secagg.begin_recovery(survivors, missing_clients)
+        need = max(2, quorum_size(len(cohort),
+                                  self.resilience.round_quorum))
+        if len(ask) < need:
+            self._abort_federation(
+                f"secagg round {self.args.round_idx} unrecoverable: "
+                f"{len(ask)} survivors < {need} (quorum floor; privacy "
+                "floor is 2 — a lone survivor's upload would unmask)")
+            return
+        self._resilience_event(
+            "secagg_recovery", round=self.args.round_idx,
+            evicted=list(self._secagg.evicted), survivors=ask,
+            wave=self._secagg.recovery_waves,
+            counter="resilience/quorum_recoveries")
+        self._send_recover_requests(ask)
+        self._recovery_deadline.arm(int(self.args.round_idx),
+                                    self._recovery_timeout_s())
+
+    def _recovery_timeout_s(self) -> float:
+        t = getattr(self.args, "secagg_recovery_timeout_s", None)
+        if t:
+            return float(t)
+        return self.resilience.round_deadline_s or 30.0
+
+    def _send_recover_requests(self, survivors: list) -> None:
+        from fedml_tpu.privacy.secagg import SecAggMessage
+
+        for s in survivors:
+            m = Message(SecAggMessage.MSG_TYPE_S2C_SECAGG_RECOVER,
+                        self.get_sender_id(), s)
+            m.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG_EVICTED,
+                         list(self._secagg.evicted))
+            m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(m)
+
+    def handle_message_secagg_reveal(self, msg: Message) -> None:
+        from fedml_tpu.privacy.secagg import SecAggMessage
+
+        sa = self._secagg
+        if sa is None:
+            return
+        sender = msg.get_sender_id()
+        complete, err = False, None
+        with self._round_lock:
+            if self._completing:
+                return
+            try:
+                complete = sa.note_reveal(
+                    sender, msg.get(SecAggMessage.MSG_ARG_KEY_SECAGG_REVEAL),
+                    msg.get(MyMessage.MSG_ARG_KEY_ROUND))
+            except (TypeError, ValueError) as e:
+                err = str(e)
+        if err is not None:
+            self._resilience_event(
+                "secagg_invalid_reveal", client=sender,
+                round=self.args.round_idx, reason=err,
+                counter="secagg/invalid_reveals")
+            logger.warning("dropping invalid secagg reveal from client "
+                           "%s: %s", sender, err)
+            return
+        if complete:
+            self._recovery_deadline.cancel()
+            # receive-thread path: failures must surface, not hang
+            try:
+                self._complete_round()
+            except BaseException as e:  # noqa: BLE001 - surface loudly
+                logger.exception("round advance failed after mask recovery")
+                self._abort_federation(
+                    f"round advance failed after mask recovery: {e!r}")
+
+    def _on_recovery_deadline(self, round_idx: int) -> None:
+        """A survivor never revealed: evict it too (dropping its upload
+        — a masked upload with unrecoverable masks is noise), extend
+        recovery to its pairs, bounded by secagg_recovery_rounds, then
+        abort loudly rather than hang or publish a mask-polluted
+        aggregate."""
+        sa = self._secagg
+        if sa is None:
+            return
+        with self._round_lock:
+            # every decision AND mutation happens under the round lock:
+            # a reveal completing concurrently on the receive thread
+            # either lands before this block (recovery_complete → we
+            # bail) or after it (the revealer is no longer a survivor —
+            # its late reveal is rejected, never half-applied). An
+            # unlocked evict/drop here could race _complete_round into
+            # aborting a healthy round.
+            if (self._completing or not sa.recovering
+                    or int(round_idx) != int(self.args.round_idx)
+                    or sa.recovery_complete()):
+                return
+            pending = sa.pending_reveals()
+            cohort = list(self.client_id_list_in_this_round or [])
+            exhausted = sa.recovery_waves >= sa.recovery_rounds
+            ask = []
+            if not exhausted:
+                for cid in pending:
+                    if self.liveness.evict(cid):
+                        self._resilience_event(
+                            "evicted", client=cid, round=round_idx,
+                            counter="resilience/clients_evicted")
+                    self.aggregator.drop_client_upload(cohort.index(cid))
+                ask = sa.begin_recovery(
+                    sa.survivors, set(sa.evicted) | set(pending))
+        if exhausted:
+            self._resilience_event(
+                "secagg_recovery_failed", round=round_idx,
+                pending=pending, waves=sa.recovery_waves,
+                counter="secagg/recovery_failures")
+            self._abort_federation(
+                f"secagg round {round_idx} mask recovery stuck: survivors "
+                f"{pending} never revealed after {sa.recovery_waves} "
+                "bounded waves")
+            return
+        from fedml_tpu.resilience import quorum_size
+
+        need = max(2, quorum_size(len(cohort),
+                                  self.resilience.round_quorum))
+        if len(ask) < need:
+            self._resilience_event(
+                "secagg_recovery_failed", round=round_idx,
+                pending=pending, waves=sa.recovery_waves,
+                counter="secagg/recovery_failures")
+            self._abort_federation(
+                f"secagg round {round_idx} below quorum during mask "
+                f"recovery: {len(ask)} survivors < {need}")
+            return
+        logger.warning(
+            "secagg recovery wave %d: survivors %s never revealed — "
+            "evicted, re-asking %s", sa.recovery_waves, pending, ask)
+        self._send_recover_requests(ask)
+        self._recovery_deadline.arm(int(round_idx),
+                                    self._recovery_timeout_s())
+
+    def _complete_round(self) -> None:
+        """Aggregate the received (and, under secagg, unmasked-in-
+        aggregate) cohort and advance the FSM."""
+        from fedml_tpu import telemetry
+
+        with self._round_lock:
+            if self._completing:
+                return
+            self._completing = True
         tracer = telemetry.get_tracer()
         with tracer.span(f"round/{self.args.round_idx}/aggregate",
-                         n_clients=len(self.client_id_list_in_this_round)
-                         - len(missing_clients)):
+                         n_clients=self.aggregator.n_received()):
             global_params = self.aggregator.aggregate()
         self._health.finish_round(self.args.round_idx)
         self._devstats.sample("aggregate", self.args.round_idx)
@@ -466,10 +733,12 @@ class FedMLServerManager(FedMLCommManager):
 
         self._select_round_clients()
         payload = self._broadcast_payload(global_params)
+        sa_header = self._secagg_round_header()
         with self._round_lock:
             self._round_closed = False
             self._deadline_expired = False
             self._deadline_extensions_used = 0
+            self._completing = False
         with tracer.span(f"round/{self.args.round_idx}/sync",
                          n_clients=len(self.client_id_list_in_this_round)):
             for client_id in self.client_id_list_in_this_round:
@@ -483,6 +752,11 @@ class FedMLServerManager(FedMLCommManager):
                 if self._codec is not None:
                     m.add_params(Message.MSG_ARG_KEY_COMPRESSION,
                                  self._codec.spec)
+                if sa_header is not None:
+                    from fedml_tpu.privacy.secagg import SecAggMessage
+
+                    m.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG,
+                                 sa_header)
                 self._bcast_ts[client_id] = time.time()
                 self.send_message(m)
         self._arm_round_deadline()
@@ -603,6 +877,7 @@ class FedMLServerManager(FedMLCommManager):
 
     def finish(self) -> None:
         self._deadline.cancel()
+        self._recovery_deadline.cancel()
         if self._live is not None:
             # final full loopback frame: the collector's merged totals
             # become exactly the post-hoc registry snapshot

@@ -105,7 +105,10 @@ class TreeRunner:
                  delta_fn: Optional[Callable] = None,
                  server_lr: float = 1.0,
                  on_round: Optional[Callable[[int, Pytree], None]] = None,
-                 live: Optional[Any] = None):
+                 live: Optional[Any] = None,
+                 secagg: bool = False,
+                 secagg_clip: float = 0.1,
+                 secagg_mod_bits: int = 8):
         self.topology = topology
         self.codec = get_codec(codec)
         if self.codec is None:
@@ -138,13 +141,30 @@ class TreeRunner:
             int(np.prod(sh, dtype=np.int64)) * 4 for _, sh in self.meta)
 
         L = topology.leaf_tier
-        # leaf cohorts (tier L), owned by the tier L-1 edges
+        # leaf cohorts (tier L), owned by the tier L-1 edges. Under
+        # per-edge-cohort SecAgg the cohort masks inside itself and the
+        # edge only ever sees (and re-encodes) the unmasked cohort SUM —
+        # no tier holds an individual leaf delta.
+        self.secagg = bool(secagg)
         self.cohorts: List[LeafCohort] = []
         for e in range(topology.levels[L - 1]):
             cids = topology.children(L - 1, e)
-            self.cohorts.append(LeafCohort(
-                L, e, cids, self.codec, self.meta, self.delta_fn,
-                self.seed, chunk=chunk, ef=ef))
+            if self.secagg:
+                from fedml_tpu.privacy.secagg.hierarchy import (
+                    SecAggLeafCohort,
+                )
+
+                if ef:
+                    raise ValueError(
+                        "secagg tree mode does not support per-client EF")
+                self.cohorts.append(SecAggLeafCohort(
+                    L, e, cids, self.codec, self.meta, self.delta_fn,
+                    self.seed, chunk=chunk, clip=float(secagg_clip),
+                    mod_bits=int(secagg_mod_bits)))
+            else:
+                self.cohorts.append(LeafCohort(
+                    L, e, cids, self.codec, self.meta, self.delta_fn,
+                    self.seed, chunk=chunk, ef=ef))
         # interior aggregators for tiers 0..L-2 (children are tier d+1
         # node indices; the tier L-1 edges' children are their cohorts,
         # handled vectorized above)
@@ -410,6 +430,7 @@ class TreeRunner:
             "levels": list(topo.levels),
             "rounds": int(rounds),
             "codec": self.codec.spec,
+            "secagg": self.secagg,
             "seed": self.seed,
             "quorum": self.quorum,
             "wall_s": wall,

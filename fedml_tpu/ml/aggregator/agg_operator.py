@@ -66,7 +66,8 @@ class FedMLAggOperator:
 
     @staticmethod
     def agg_compressed(
-        args: Any, raw_list: List[Tuple[int, Any]], global_params: Pytree
+        args: Any, raw_list: List[Tuple[int, Any]], global_params: Pytree,
+        clip_factors: Any = None,
     ) -> Pytree:
         """Dequant-fused aggregation of compressed client updates.
 
@@ -89,4 +90,14 @@ class FedMLAggOperator:
             raise ValueError(
                 "agg_compressed requires delta-encoded updates")
         weights = FedMLAggOperator._weights(args, raw_list)
+        if clip_factors is not None:
+            # norm-only defense on the fused path: clipping client i's
+            # delta to the norm bound is d_i · f_i with
+            # f_i = min(1, bound/‖d_i‖), and the weighted sum is linear,
+            # so the factor folds into the weight — deliberately NOT
+            # renormalized (clipping shrinks updates, it does not
+            # redistribute their mass)
+            import jax.numpy as jnp
+
+            weights = weights * jnp.asarray(clip_factors, jnp.float32)
         return tree_undelta(global_params, fused_weighted_sum(cts, weights))

@@ -1,0 +1,6 @@
+"""Privacy subsystems that ride the compressed wire.
+
+- :mod:`fedml_tpu.privacy.secagg` — dropout-robust masked secure
+  aggregation over the int8 block domain plus in-program central-DP
+  noise. See ``docs/privacy.md`` for the threat model and protocol.
+"""

@@ -1,0 +1,59 @@
+"""Dropout-robust secure aggregation over the int8 block domain.
+
+Quick tour::
+
+    # client
+    session = SecAggClientSession.from_args(rank, args)   # None when off
+    pk = session.pk                                       # rides STATUS msgs
+    session.begin_round(header, round_idx)                # from the broadcast
+    ct = session.encode_update(delta_tree, key)           # masked, one program
+    seeds = session.reveal_for(evicted, round_idx)        # dropout recovery
+
+    # server
+    session = SecAggServerSession.from_args(args, client_num)
+    header = session.begin_round(round_idx, cohort)       # rides the broadcast
+    session.validate_upload(sender, ct)
+    new_global = session.aggregate(cts, base)             # unmask + DP, fused
+
+Masks cancel exactly in integer arithmetic (mod ``2^k``), so SecAgg
+aggregates are bit-identical to the never-masked sum; the wire carries
+one mask-domain word per element (≈ plain int8 bytes). Protocol,
+guards and the threat model: ``docs/privacy.md``.
+"""
+from fedml_tpu.privacy.secagg.codec import (
+    SecAggInt8Codec,
+    WIRE_VERSION_MASKED,
+    last_finalize_trace,
+    masked_encode,
+    unmask_finalize,
+)
+from fedml_tpu.privacy.secagg.masking import (
+    client_bound,
+    mask_leaves,
+    net_mask_leaves,
+    pair_round_seed,
+    recovery_adjustment,
+)
+from fedml_tpu.privacy.secagg.protocol import (
+    SecAggClientSession,
+    SecAggMessage,
+    SecAggServerSession,
+    secagg_enabled,
+)
+
+__all__ = [
+    "SecAggClientSession",
+    "SecAggInt8Codec",
+    "SecAggMessage",
+    "SecAggServerSession",
+    "WIRE_VERSION_MASKED",
+    "client_bound",
+    "last_finalize_trace",
+    "mask_leaves",
+    "masked_encode",
+    "net_mask_leaves",
+    "pair_round_seed",
+    "recovery_adjustment",
+    "secagg_enabled",
+    "unmask_finalize",
+]

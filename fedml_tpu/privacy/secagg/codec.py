@@ -1,0 +1,311 @@
+"""The maskable int8-block codec + the fused unmask/finalize program.
+
+Client side (:func:`masked_encode`): ONE jitted program runs error
+feedback, clipping, shared-scale stochastic quantization and the mask
+add — what leaves the device is already masked, so no unmasked
+quantized update ever exists on the host, and the wire carries one
+mask-domain word per element (uint8 at ``mod_bits=8`` — same bytes as
+plain int8 blocks; the f32 per-leaf scales of plain int8 are replaced
+by one shared scalar in the codec spec, which is how SecAgg stays
+within the 1.2× wire gate).
+
+Server side (:func:`unmask_finalize`): ONE jitted program sums the
+masked words mod ``2^k`` (masks cancel inside the sum — this is the
+dequant-fused aggregation of PR 3 transplanted to the masked domain),
+subtracts the dropout-recovery adjustment, re-centers, scales to the
+cohort mean, applies it to the broadcast base, and — when central DP is
+live — adds the seeded Gaussian noise BEFORE anything is materialized:
+the plain (pre-noise) aggregate exists only as an XLA intermediate.
+``last_finalize_trace()`` exposes trace-time evidence of that for the
+acceptance tests.
+
+Shared-scale quantization: every cohort member quantizes with
+``scale = clip / bound`` where ``bound = client_bound(n)`` — per-client
+adaptive scales (plain int8) would multiply each mask by a different
+factor and break exact cancellation. The clip doubles as the norm bound
+defenses and DP accounting want; clip error is re-sent by error
+feedback like any other quantization error.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression.codecs import (
+    WIRE_VERSION_MASKED,
+    Codec,
+    CompressedTree,
+    _is_float_meta,
+    _tree_meta,
+)
+from fedml_tpu.privacy.secagg.masking import MOD_BITS_CHOICES
+
+Pytree = Any
+
+__all__ = [
+    "SecAggInt8Codec",
+    "WIRE_VERSION_MASKED",
+    "last_finalize_trace",
+    "masked_encode",
+    "unmask_finalize",
+]
+
+_UINT = {8: jnp.uint8, 16: jnp.uint16}
+
+# trace-time evidence for the "plain aggregate never hits the host
+# pre-noise" acceptance check: during tracing of the finalize program
+# we record whether the pre-noise mean was an abstract tracer (an XLA
+# intermediate) rather than a concrete host value
+_FINALIZE_TRACE = {"pre_noise_traced": None, "noised_in_program": None}
+
+
+def last_finalize_trace() -> dict:
+    return dict(_FINALIZE_TRACE)
+
+
+class SecAggInt8Codec(Codec):
+    """Masked int8-block codec — registered so the wire recognizes the
+    tag, but deliberately NOT a general-purpose codec:
+
+    - :meth:`encode`/:meth:`decode` of an individual tree raise
+      ``ValueError``: a masked update is meaningless (and decoding one
+      is exactly the privacy violation SecAgg exists to prevent) —
+      masked trees only ever resolve in aggregate via
+      :func:`unmask_finalize`;
+    - the generic ``fused_weighted_sum`` refuses maskable codecs for
+      the same reason (float weights would scale each client's masks
+      differently and silently corrupt the cancellation).
+    """
+
+    name = "secagg_int8"
+    lossless = False
+    broadcast_safe = False  # upload-only, like topk
+    maskable = True
+
+    def __init__(self, clip: float = 0.1, bound: int = 42,
+                 mod_bits: int = 8):
+        self.clip = float(clip)
+        self.bound = int(bound)
+        self.mod_bits = int(mod_bits)
+        if not self.clip > 0:
+            raise ValueError(f"secagg clip must be > 0, got {clip}")
+        if self.mod_bits not in MOD_BITS_CHOICES:
+            raise ValueError(
+                f"secagg mod_bits must be one of {MOD_BITS_CHOICES}, "
+                f"got {mod_bits}")
+        if not 1 <= self.bound <= (1 << (self.mod_bits - 1)) - 1:
+            raise ValueError(
+                f"secagg bound {bound} not representable mod "
+                f"2^{self.mod_bits}")
+
+    @property
+    def spec(self) -> str:
+        return (f"{self.name}@{self.clip:g}/{self.bound}/"
+                f"{self.mod_bits}")
+
+    @property
+    def scale(self) -> float:
+        return self.clip / float(self.bound)
+
+    @classmethod
+    def parse_param(cls, param: str) -> Tuple[float, int, int]:
+        """``clip/bound/mod_bits`` — the ``@``-suffix of the spec."""
+        parts = str(param).split("/")
+        if len(parts) != 3:
+            raise ValueError(
+                f"malformed secagg_int8 spec param {param!r} "
+                "(want clip/bound/mod_bits)")
+        try:
+            return float(parts[0]), int(parts[1]), int(parts[2])
+        except ValueError:
+            raise ValueError(
+                f"malformed secagg_int8 spec param {param!r}") from None
+
+    # -- privacy guards: individual masked trees never decode -------------
+    def encode(self, tree, key=None, is_delta: bool = False,
+               residual=None):
+        raise ValueError(
+            "secagg_int8 updates are masked: use "
+            "privacy.secagg.masked_encode (plain Codec.encode has no "
+            "mask input)")
+
+    def decode(self, ct: CompressedTree):
+        raise ValueError(
+            "refusing to decode an individual masked update — masked "
+            "trees only resolve in aggregate (privacy.secagg."
+            "unmask_finalize)")
+
+    def encode_leaf(self, x, key):  # pragma: no cover - guarded above
+        raise ValueError("secagg_int8 has no per-leaf encode")
+
+    def decode_leaf(self, parts, dt, shape):
+        raise ValueError(
+            "refusing to decode an individual masked leaf")
+
+    def weighted_sum_leaf(self, stacked, w, dt, shape):
+        raise ValueError(
+            "masked updates cannot ride the generic weighted sum — "
+            "per-client weights would break mask cancellation")
+
+
+def _check_float_meta(meta) -> None:
+    bad = [dt for dt, _ in meta if not _is_float_meta(dt)]
+    if bad:
+        raise ValueError(
+            "secure aggregation supports float-leaf trees only; "
+            f"non-float leaves ({', '.join(sorted(set(bad)))}) would ride "
+            "the wire unmasked")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _masked_encode_program(clip: float, bound: int, mod_bits: int, meta,
+                           leaves, res_leaves, mask_leaves, key):
+    """EF-compensate → clip → shared-scale stochastic quant → +mask,
+    one program. Returns (masked uint words per leaf, new residual)."""
+    scale = jnp.float32(clip / float(bound))
+    masked, new_res = [], []
+    for i, (x, r, m, (dt, sh)) in enumerate(
+            zip(leaves, res_leaves, mask_leaves, meta)):
+        comp = x.astype(jnp.float32) + r.astype(jnp.float32)
+        xc = jnp.clip(comp, -clip, clip)
+        u = jax.random.uniform(jax.random.fold_in(key, i), xc.shape)
+        q = jnp.clip(jnp.floor(xc / scale + u), -bound, bound)
+        q = q.astype(jnp.int32)
+        # uint cast of the int32 low bits IS the mod-2^k wrap
+        y = (q + m.astype(jnp.int32)) & ((1 << mod_bits) - 1)
+        masked.append(y.astype(_UINT[mod_bits]))
+        # residual: everything the server will not see for this client
+        # (clip error + quantization error), re-sent next round
+        new_res.append(comp - q.astype(jnp.float32) * scale)
+    return tuple(masked), tuple(new_res)
+
+
+def masked_encode(delta: Pytree, net_mask: Sequence[np.ndarray],
+                  codec: SecAggInt8Codec, key,
+                  residual: Optional[Pytree] = None,
+                  sa: Optional[dict] = None
+                  ) -> Tuple[CompressedTree, Pytree]:
+    """Encode one client's delta into a masked wire tree.
+
+    ``net_mask`` is the client's folded pairwise mask
+    (:func:`masking.net_mask_leaves`) over the SAME meta as ``delta``.
+    Returns ``(CompressedTree, new_residual)`` — the residual is the
+    caller's per-identity EF state (reset on rejoin, like every codec).
+    """
+    from fedml_tpu import telemetry
+
+    leaves, treedef = jax.tree.flatten(delta)
+    meta = _tree_meta(leaves)
+    _check_float_meta(meta)
+    if len(net_mask) != len(leaves):
+        raise ValueError(
+            f"net mask has {len(net_mask)} leaves for a {len(leaves)}-leaf "
+            "tree")
+    if residual is None:
+        res_leaves = tuple(jnp.zeros_like(x, jnp.float32) for x in leaves)
+    else:
+        res_leaves = tuple(jax.tree.leaves(residual))
+    import itertools
+
+    counter = itertools.count()
+    structure = jax.tree.unflatten(treedef, [next(counter) for _ in leaves])
+    raw_nbytes = sum(
+        int(np.prod(sh, dtype=np.int64)) * np.dtype("float32").itemsize
+        for _, sh in meta)
+    with telemetry.get_tracer().span("compress/encode", codec=codec.name,
+                                     n_leaves=len(leaves)):
+        masked, new_res = _masked_encode_program(
+            codec.clip, codec.bound, codec.mod_bits, meta,
+            tuple(leaves), res_leaves,
+            tuple(jnp.asarray(m) for m in net_mask), key)
+    ct = CompressedTree(codec.name, WIRE_VERSION_MASKED, True, raw_nbytes,
+                        meta, structure, [[y] for y in masked], sa=sa)
+    return ct, jax.tree.unflatten(treedef, new_res)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _unmask_program(clip: float, bound: int, mod_bits: int, meta,
+                    with_noise: bool, stacked, recovery, base_leaves,
+                    n_div, sigma, key_data):
+    """masked Σ → −recovery → re-center → mean → undelta → (+DP noise),
+    one program: the plain aggregate is an XLA temporary only."""
+    scale = jnp.float32(clip / float(bound))
+    half = 1 << (mod_bits - 1)
+    key = jax.random.wrap_key_data(key_data)
+    out = []
+    pre_noise_traced = True
+    for i, (ys, rec, base, (dt, sh)) in enumerate(
+            zip(stacked, recovery, base_leaves, meta)):
+        udt = _UINT[mod_bits]
+        s = jnp.sum(ys, axis=0, dtype=udt) - rec.astype(udt)
+        c = s.astype(jnp.int32)
+        c = c - ((c >= half).astype(jnp.int32) << mod_bits)
+        mean = c.astype(jnp.float32) * scale / n_div
+        agg = base.astype(jnp.float32) + mean
+        pre_noise_traced = pre_noise_traced and isinstance(
+            agg, jax.core.Tracer)
+        if with_noise:
+            agg = agg + sigma * jax.random.normal(
+                jax.random.fold_in(key, i), agg.shape, jnp.float32)
+        out.append(agg.astype(base.dtype))
+    _FINALIZE_TRACE["pre_noise_traced"] = bool(pre_noise_traced)
+    _FINALIZE_TRACE["noised_in_program"] = bool(with_noise)
+    return tuple(out)
+
+
+def unmask_finalize(cts: Sequence[CompressedTree], base: Pytree,
+                    codec: SecAggInt8Codec,
+                    recovery: Optional[Sequence[np.ndarray]] = None,
+                    dp_sigma: float = 0.0,
+                    dp_key_data: Optional[np.ndarray] = None) -> Pytree:
+    """Fuse the survivors' masked trees into the new global model.
+
+    ``recovery`` is the dropout adjustment
+    (:func:`masking.recovery_adjustment`), ``dp_sigma`` > 0 adds seeded
+    Gaussian noise to the aggregate inside the same program. Raises
+    ``ValueError`` on heterogeneous or non-masked inputs.
+    """
+    from fedml_tpu import telemetry
+
+    if not cts:
+        raise ValueError("empty masked update list")
+    first = cts[0]
+    for ct in cts:
+        if (ct.codec != SecAggInt8Codec.name
+                or ct.version != WIRE_VERSION_MASKED
+                or ct.meta != first.meta or not ct.is_delta):
+            raise ValueError(
+                "unmask_finalize needs homogeneous masked delta trees "
+                f"(got {ct.codec}/v{ct.version})")
+    base_leaves = jax.tree.leaves(base)
+    if len(base_leaves) != len(first.meta):
+        raise ValueError("broadcast base does not match the masked trees")
+    try:
+        stacked = tuple(
+            jnp.stack([np.asarray(ct.arrays[j][0]) for ct in cts])
+            for j in range(len(first.meta)))
+    except (ValueError, TypeError) as e:
+        raise ValueError(
+            f"masked block shapes differ across clients: {e}") from None
+    if recovery is None:
+        rec = tuple(jnp.zeros(sh, _UINT[codec.mod_bits])
+                    for _, sh in first.meta)
+    else:
+        if len(recovery) != len(first.meta):
+            raise ValueError("recovery adjustment leaf count mismatch")
+        rec = tuple(jnp.asarray(r) for r in recovery)
+    with_noise = float(dp_sigma) > 0.0
+    if dp_key_data is None:
+        dp_key_data = np.asarray(jax.random.key_data(jax.random.key(0)))
+    with telemetry.get_tracer().span("compress/decode", codec=codec.name,
+                                     n_leaves=len(first.meta)):
+        flat = _unmask_program(
+            codec.clip, codec.bound, codec.mod_bits, first.meta,
+            with_noise, stacked, rec, tuple(base_leaves),
+            jnp.float32(len(cts)), jnp.float32(dp_sigma),
+            jnp.asarray(dp_key_data))
+    return jax.tree.unflatten(jax.tree.structure(base), list(flat))

@@ -1,0 +1,187 @@
+"""Per-edge-cohort SecAgg for the in-process aggregation tree.
+
+In a hierarchical federation the EDGE tier is the curious party: it
+buffers its cohort's uploads, so without masking it sees every leaf
+client's individual delta. :class:`SecAggLeafCohort` drops into the
+:class:`~fedml_tpu.hierarchy.edge.LeafCohort` slot of a
+:class:`~fedml_tpu.hierarchy.runner.TreeRunner` and masks INSIDE the
+cohort: each virtual client quantizes with the cohort-shared scale and
+adds its pairwise masks in the same chunk program, the edge sums masked
+words mod ``2^k``, and only the cohort SUM is ever unmasked — the edge
+re-encodes that mean for its uplink, so no tier (edge or above) ever
+holds an individual leaf delta. Chaos kills are recovered exactly like
+the cross-silo path: the surviving pairs' seeds reproduce the evicted
+clients' half-cancelled masks, subtracted from the cohort sum.
+
+Pair seeds are derived deterministically from the tree seed (both
+"endpoints" of a virtual pair live in this process — there is nothing
+to key-exchange), so two same-seed runs are digest-identical; the
+cross-silo protocol (real key agreement, reveal messages) lives in
+:mod:`fedml_tpu.privacy.secagg.protocol`.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression.codecs import derive_key_data_batch
+from fedml_tpu.hierarchy.edge import LeafCohort
+from fedml_tpu.privacy.secagg import masking
+
+__all__ = ["SecAggLeafCohort"]
+
+_UINT = {8: jnp.uint8, 16: jnp.uint16}
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _secagg_leaf_chunk_program(meta, delta_fn, clip: float, bound: int,
+                               mod_bits: int, key_data, alive, masks):
+    """generate → clip → shared-scale quant → +mask → masked SUM, one
+    program. ``alive`` zeroes dead/padded slots (their mask half never
+    "arrived"); per-client deltas and quantized words are XLA
+    temporaries only — the program's output is the masked cohort sum."""
+    scale = jnp.float32(clip / float(bound))
+    wrap = (1 << mod_bits) - 1
+    udt = _UINT[mod_bits]
+
+    def per_client(kd, m_leaves):
+        key = jax.random.wrap_key_data(kd)
+        leaves = tuple(delta_fn(jax.random.fold_in(key, 1)))
+        enc_key = jax.random.fold_in(key, 2)
+        ys = []
+        for i, (x, m) in enumerate(zip(leaves, m_leaves)):
+            xc = jnp.clip(x.astype(jnp.float32), -clip, clip)
+            u = jax.random.uniform(jax.random.fold_in(enc_key, i), xc.shape)
+            q = jnp.clip(jnp.floor(xc / scale + u), -bound, bound)
+            ys.append(((q.astype(jnp.int32) + m.astype(jnp.int32)) & wrap)
+                      .astype(udt))
+        return tuple(ys)
+
+    ys = jax.vmap(per_client)(key_data, masks)
+    a = alive.astype(udt)
+    return tuple(
+        jnp.sum(y * a.reshape((-1,) + (1,) * (y.ndim - 1)), axis=0,
+                dtype=udt)
+        for y in ys)
+
+
+class SecAggLeafCohort(LeafCohort):
+    """A leaf cohort whose edge only ever sees the masked sum.
+
+    Same reduce contract as :class:`LeafCohort` (unnormalized f32 sum
+    leaves + total weight), but per-client contributions are pairwise-
+    masked in the cohort-shared int domain. Weights must be uniform
+    (masked sums are unweighted by construction) and EF is unsupported
+    in this mode (the masked path has no per-client decode to feed it).
+    """
+
+    def __init__(self, tier: int, edge_id: int, client_ids, codec, meta,
+                 delta_fn, seed: int, chunk: int = 2048,
+                 clip: float = 0.1, mod_bits: int = 8, **kw):
+        if kw.pop("ef", False):
+            raise ValueError(
+                "secagg leaf cohorts do not support per-client error "
+                "feedback (there is no per-client decode to feed it)")
+        if kw.pop("weights", None) is not None:
+            raise ValueError(
+                "secagg leaf cohorts are uniform-weight by construction")
+        super().__init__(tier, edge_id, client_ids, codec, meta, delta_fn,
+                         seed, chunk=chunk, ef=False, **kw)
+        self.clip = float(clip)
+        self.mod_bits = int(mod_bits)
+        # the shared quant bound is sized for the FULL roster: the mask
+        # domain must absorb the worst-case cohort sum, and a constant
+        # bound keeps one compiled program across kill/rejoin rounds
+        self.bound = masking.client_bound(len(self.client_ids),
+                                          self.mod_bits)
+        self._pair_secret_cache = {}
+
+    # -- deterministic in-process pair seeds --------------------------------
+    def _pair_secret(self, i: int, j: int) -> int:
+        lo, hi = (int(i), int(j)) if i < j else (int(j), int(i))
+        ck = (lo, hi)
+        if ck not in self._pair_secret_cache:
+            h = hashlib.blake2b(
+                b"fedml_tpu/secagg/hier%d/%d/%d/%d" % (
+                    self.seed, self.edge_id, lo, hi),
+                digest_size=16)
+            self._pair_secret_cache[ck] = int.from_bytes(h.digest(),
+                                                         "little")
+        return self._pair_secret_cache[ck]
+
+    def _seeds_for(self, i: int, others, round_idx: int):
+        return {int(j): masking.pair_round_seed(self._pair_secret(i, j),
+                                                round_idx)
+                for j in others if int(j) != int(i)}
+
+    def reduce(self, round_idx: int, alive_local: np.ndarray) -> Tuple[
+            Optional[list], float, int]:
+        from fedml_tpu.telemetry import get_registry
+
+        live = np.asarray(alive_local, bool) & ~self.evicted_mask
+        expected = np.nonzero(~self.evicted_mask)[0]
+        n_recv = int(live.sum())
+        if n_recv == 0:
+            return None, 0.0, 0
+        # every EXPECTED client derived masks over the full expected
+        # roster this round; dead-but-expected clients are the recovery
+        # set (their uploads never arrived, their pair halves dangle)
+        dead_expected = [int(i) for i in expected if not live[i]]
+        live_idx = np.nonzero(live)[0]
+        udt = {8: np.uint8, 16: np.uint16}[self.mod_bits]
+        total = None
+        n = len(live_idx)
+        for start in range(0, n, self.chunk):
+            idx = live_idx[start:start + self.chunk]
+            # pad every chunk to the bucketed size: kills change inputs
+            # (alive mask + zero masks), never program shapes
+            pad = self.chunk - len(idx)
+            # masks for the chunk's clients, host-side (numpy, wrapping)
+            chunk_masks = []
+            for i in idx:
+                seeds = self._seeds_for(int(i), expected, round_idx)
+                chunk_masks.append(masking.net_mask_leaves(
+                    int(i), seeds, self.meta, self.mod_bits))
+            for _ in range(pad):
+                chunk_masks.append([np.zeros(sh, udt)
+                                    for _, sh in self.meta])
+            cids = np.concatenate([self.client_ids[idx],
+                                   np.zeros(pad, np.int64)])
+            kd = derive_key_data_batch(self.seed, round_idx, cids)
+            alive_chunk = np.concatenate([np.ones(len(idx), np.uint8),
+                                          np.zeros(pad, np.uint8)])
+            masks_stacked = tuple(
+                jnp.asarray(np.stack([m[li] for m in chunk_masks]))
+                for li in range(len(self.meta)))
+            summed = _secagg_leaf_chunk_program(
+                self.meta, self.delta_fn, self.clip, self.bound,
+                self.mod_bits, jnp.asarray(kd), jnp.asarray(alive_chunk),
+                masks_stacked)
+            summed = [np.asarray(s) for s in summed]
+            if total is None:
+                total = summed
+            else:
+                total = [a + b for a, b in zip(total, summed)]  # uint wrap
+        # dropout recovery: reproduce the live↔dead halves and strip them
+        if dead_expected:
+            pairs = [(int(i), j, self._seeds_for(int(i), [j], round_idx)[j])
+                     for i in live_idx for j in dead_expected]
+            rec = masking.recovery_adjustment(pairs, self.meta,
+                                              self.mod_bits)
+            total = [a - r for a, r in zip(total, rec)]
+            get_registry().counter("secagg/hier_recoveries").inc()
+        get_registry().counter("secagg/hier_cohort_rounds").inc()
+        # re-center mod 2^k and scale: the cohort's unnormalized f32 sum
+        half = 1 << (self.mod_bits - 1)
+        scale = self.clip / float(self.bound)
+        sum_leaves = []
+        for s in total:
+            c = s.astype(np.int32)
+            c = c - ((c >= half).astype(np.int32) << self.mod_bits)
+            sum_leaves.append(jnp.asarray(c.astype(np.float32) * scale))
+        return sum_leaves, float(n_recv), n_recv

@@ -1,0 +1,105 @@
+"""X25519 key agreement for pair-seed derivation — dependency-gated.
+
+``core/mpc/secagg`` uses the ``cryptography`` package for X25519; this
+module prefers that implementation when it is importable and otherwise
+falls back to a pure-Python RFC 7748 Montgomery ladder (exact same
+curve, clamping and output encoding, so mixed deployments agree on the
+shared secret byte-for-byte). The fallback is ~1ms per exchange — key
+agreement runs once per (client, peer) pair per process, never per
+round, so this is nowhere near a hot path.
+
+Security note: the pure-Python ladder is not constant-time. The secrets
+it protects are per-run mask seeds for an honest-but-curious-server
+model (docs/privacy.md), not long-lived identity keys; install
+``cryptography`` to get the constant-time implementation.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Tuple
+
+__all__ = ["kx_agree", "kx_keygen"]
+
+_P = 2 ** 255 - 19
+_A24 = 121665
+_BASE_U = 9
+
+
+def _decode_scalar(k: bytes) -> int:
+    b = bytearray(k)
+    b[0] &= 248
+    b[31] &= 127
+    b[31] |= 64
+    return int.from_bytes(b, "little")
+
+
+def _x25519(k_bytes: bytes, u_bytes: bytes) -> bytes:
+    """RFC 7748 §5 scalar multiplication on curve25519."""
+    k = _decode_scalar(k_bytes)
+    x1 = int.from_bytes(u_bytes, "little") & ((1 << 255) - 1)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in reversed(range(255)):
+        kt = (k >> t) & 1
+        swap ^= kt
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = z3 * z3 % _P
+        z3 = z3 * x1 % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + _A24 * e) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    return (x2 * pow(z2, _P - 2, _P) % _P).to_bytes(32, "little")
+
+
+def _have_cryptography() -> bool:
+    try:
+        import cryptography.hazmat.primitives.asymmetric.x25519  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def kx_keygen() -> Tuple[bytes, bytes]:
+    """(private scalar bytes, 32-byte public key) from OS entropy."""
+    if _have_cryptography():
+        from fedml_tpu.core.mpc.secagg import kx_keygen as _kg
+
+        sk_obj, pk = _kg()
+        return sk_obj.private_bytes_raw(), pk
+    sk = os.urandom(32)
+    return sk, _x25519(sk, _BASE_U.to_bytes(32, "little"))
+
+
+def kx_agree(sk: bytes, their_pk: bytes) -> int:
+    """Shared secret → 128-bit PRF seed (SHA-256 of the raw exchange —
+    identical derivation to ``core/mpc/secagg.kx_agree``)."""
+    if _have_cryptography():
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+        )
+
+        from fedml_tpu.core.mpc.secagg import kx_agree as _ka
+
+        return _ka(X25519PrivateKey.from_private_bytes(bytes(sk)),
+                   bytes(their_pk))
+    secret = _x25519(bytes(sk), bytes(their_pk))
+    return int.from_bytes(hashlib.sha256(secret).digest()[:16], "little")

@@ -1,0 +1,517 @@
+"""SecAgg v2 session state machines — the protocol minus the transport.
+
+The cross-silo managers own the message flow; these sessions own the
+per-round mask state, the reveal bookkeeping and every privacy guard:
+
+- **key advertisement** rides the existing client→server STATUS
+  messages (one X25519 public key per client process, 32 bytes);
+- the **round header** rides the existing broadcast (roster + pk
+  directory + the shared codec spec) — no extra round-trips on the
+  happy path;
+- **dropout recovery** rides the quorum-close path: when a round closes
+  with missing clients the server asks each survivor for the pair-seeds
+  it shared with the evicted peers (ONE extra round-trip per recovery
+  wave), never anything that could unmask a received upload.
+
+Client-side guards (the client is the last line of defense against a
+lying server):
+
+- reveals cover pair-seeds with EVICTED peers only — a client never
+  reveals anything that unmasks its own upload ("its own self-mask"),
+  and refuses requests that name itself as evicted;
+- the cumulative evicted set per round is bounded by what the quorum
+  could legitimately lose (``roster − quorum``): a server claiming more
+  dropouts than the round could survive is refused;
+- one reveal per (round, peer), ever — recovery waves may extend the
+  evicted set but can never re-target a peer under a different story.
+
+Threat model (full write-up in ``docs/privacy.md``): honest-but-curious
+server, honest clients. Each received upload stays masked by at least
+one pair shared with another survivor, so the recovery floor is two
+survivors; a malicious server that fabricates evictions for clients
+whose uploads it RECEIVED is outside this model (that is what the
+Bonawitz double-mask + Shamir construction in ``cross_silo/secagg``
+defends against, at 8 bytes/element and two extra protocol legs).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fedml_tpu.cross_silo.message_define import MyMessage
+from fedml_tpu.privacy.secagg import masking
+from fedml_tpu.privacy.secagg.codec import (
+    SecAggInt8Codec,
+    masked_encode,
+    unmask_finalize,
+)
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+__all__ = [
+    "SecAggClientSession",
+    "SecAggMessage",
+    "SecAggServerSession",
+    "secagg_enabled",
+]
+
+
+class SecAggMessage(MyMessage):
+    """Protocol extensions riding the standard cross-silo flows."""
+
+    # server → survivors: the round closed on quorum; reveal the pair
+    # seeds you shared with the evicted peers
+    MSG_TYPE_S2C_SECAGG_RECOVER = "MSG_TYPE_S2C_SECAGG_RECOVER"
+    # survivor → server: {evicted_rank: per-round pair seed}
+    MSG_TYPE_C2S_SECAGG_REVEAL = "MSG_TYPE_C2S_SECAGG_REVEAL"
+
+    MSG_ARG_KEY_SECAGG = "secagg"            # round header on broadcasts
+    MSG_ARG_KEY_SECAGG_PK = "secagg_pk"      # key advert on status msgs
+    MSG_ARG_KEY_SECAGG_EVICTED = "secagg_evicted"
+    MSG_ARG_KEY_SECAGG_REVEAL = "secagg_reveal"
+
+
+def secagg_enabled(args: Any) -> bool:
+    """``secagg: int8`` (the only masked domain so far) turns it on."""
+    mode = str(getattr(args, "secagg", "") or "").lower()
+    if mode in ("", "0", "false", "none", "off"):
+        return False
+    if mode not in ("int8", "1", "true"):
+        raise ValueError(
+            f"unknown secagg mode {mode!r} (supported: int8)")
+    return True
+
+
+def _counter(name: str, **labels):
+    from fedml_tpu.telemetry import get_registry
+
+    return get_registry().counter(name, labels=labels or None)
+
+
+def _secagg_event(event: str, **fields) -> None:
+    """Land one protocol event everywhere the doctor looks."""
+    from fedml_tpu.telemetry import flight_recorder
+    from fedml_tpu.telemetry.health import log_health_event
+
+    try:
+        log_health_event({"kind": "secagg_event", "event": event, **fields})
+    except Exception:  # pragma: no cover - observability must not kill
+        logger.exception("secagg event logging failed")
+    flight_recorder.record("secagg_event", event=event, **fields)
+
+
+def record_phase(phase: str, round_idx: int, **fields) -> None:
+    """Flight-recorder phase marker. ``individual_plaintext`` is the
+    acceptance invariant: no phase of a SecAgg round ever materializes
+    an individual client's unmasked delta on the server."""
+    from fedml_tpu.telemetry import flight_recorder
+
+    flight_recorder.record("secagg_phase", phase=phase, round=int(round_idx),
+                           masked=True, individual_plaintext=False, **fields)
+
+
+def _validate_pk(pk: Any) -> bytes:
+    if not isinstance(pk, (bytes, bytearray)) or len(pk) != 32:
+        raise ValueError(
+            f"secagg public key must be 32 bytes, got "
+            f"{type(pk).__name__}[{len(pk) if hasattr(pk, '__len__') else '?'}]")
+    return bytes(pk)
+
+
+def _codec_from_spec(spec: str) -> SecAggInt8Codec:
+    from fedml_tpu.compression import get_codec
+
+    codec = get_codec(spec)
+    if not isinstance(codec, SecAggInt8Codec):
+        raise ValueError(f"not a secagg codec spec: {spec!r}")
+    return codec
+
+
+class SecAggClientSession:
+    """One client's masking state across the run (keys persist; mask and
+    reveal state is per round)."""
+
+    def __init__(self, rank: int, args: Any):
+        from fedml_tpu.privacy.secagg.keys import kx_agree, kx_keygen
+        from fedml_tpu.resilience import ResilienceConfig
+
+        self.rank = int(rank)
+        self._kx_agree = kx_agree
+        self.sk, self.pk = kx_keygen()
+        self._secret_cache: Dict[Tuple[int, bytes], int] = {}
+        self.quorum_frac = ResilienceConfig(args).round_quorum
+        # round state
+        self.round_idx: Optional[int] = None
+        self.roster: List[int] = []
+        self.codec: Optional[SecAggInt8Codec] = None
+        self._peer_seeds: Dict[int, int] = {}
+        self._residual: Optional[Pytree] = None
+        self._revealed: Dict[int, set] = {}  # round -> peers revealed
+
+    @classmethod
+    def from_args(cls, rank: int, args: Any) -> Optional["SecAggClientSession"]:
+        return cls(rank, args) if secagg_enabled(args) else None
+
+    # -- round setup --------------------------------------------------------
+    def begin_round(self, header: Any, round_idx: int) -> None:
+        """Apply the broadcast's secagg header. Malformed headers raise
+        ``ValueError`` — a client never trains against a roster it could
+        not parse."""
+        if not isinstance(header, dict):
+            raise ValueError("malformed secagg header (not a dict)")
+        try:
+            roster = [int(c) for c in header["roster"]]
+            pks = {int(c): _validate_pk(pk)
+                   for c, pk in dict(header["pks"]).items()}
+            spec = str(header["spec"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed secagg header: {e}") from None
+        if self.rank not in roster:
+            raise ValueError(
+                f"secagg header roster {roster} does not include this "
+                f"client (rank {self.rank})")
+        if len(set(roster)) != len(roster):
+            raise ValueError("secagg header roster has duplicates")
+        codec = _codec_from_spec(spec)
+        if codec.bound != masking.client_bound(len(roster), codec.mod_bits):
+            raise ValueError(
+                f"secagg spec bound {codec.bound} does not match a "
+                f"{len(roster)}-client roster")
+        self.round_idx = int(round_idx)
+        self.roster = roster
+        self.codec = codec
+        self._peer_seeds = {}
+        for j in roster:
+            if j == self.rank:
+                continue
+            if j not in pks:
+                raise ValueError(f"secagg header missing pk for peer {j}")
+            ck = (j, pks[j])
+            if ck not in self._secret_cache:
+                self._secret_cache[ck] = self._kx_agree(self.sk, pks[j])
+            self._peer_seeds[j] = masking.pair_round_seed(
+                self._secret_cache[ck], self.round_idx)
+        # prune reveal bookkeeping for long runs
+        for r in [r for r in self._revealed if r < self.round_idx - 4]:
+            del self._revealed[r]
+
+    @property
+    def active(self) -> bool:
+        return self.codec is not None
+
+    # -- upload path ---------------------------------------------------------
+    def encode_update(self, delta: Pytree, key):
+        """Mask + encode one round's delta (EF residual lives here)."""
+        net_mask = masking.net_mask_leaves(
+            self.rank, self._peer_seeds,
+            _meta_of(delta), self.codec.mod_bits)
+        sa = {"round": int(self.round_idx), "rank": self.rank,
+              "roster": list(self.roster)}
+        ct, self._residual = masked_encode(
+            delta, net_mask, self.codec, key,
+            residual=self._residual, sa=sa)
+        _counter("secagg/masked_uploads").inc()
+        return ct
+
+    def reset_identity(self) -> None:
+        """Rejoin / round-gap: drop the EF residual — pre-gap
+        quantization error must not leak into the new identity."""
+        self._residual = None
+
+    # -- dropout recovery -----------------------------------------------------
+    def reveal_for(self, evicted: Sequence[Any],
+                   round_idx: Any) -> Optional[Dict[int, int]]:
+        """Pair seeds shared with ``evicted``, or None when the request
+        fails a privacy guard (refusals are counted and logged — an
+        honest server only sees them when it is lying)."""
+        refuse = _counter("secagg/reveal_refusals")
+        try:
+            evicted = sorted({int(e) for e in evicted})
+            round_idx = int(round_idx)
+        except (TypeError, ValueError):
+            refuse.inc()
+            logger.error("secagg: refusing malformed reveal request")
+            return None
+        if round_idx != self.round_idx or not self.roster:
+            refuse.inc()
+            logger.error(
+                "secagg: refusing reveal for round %s (client is at %s)",
+                round_idx, self.round_idx)
+            return None
+        if self.rank in evicted:
+            # revealing "for ourselves" would hand over half of our own
+            # mask while the server may well hold our upload
+            refuse.inc()
+            logger.error("secagg: refusing reveal request naming THIS "
+                         "client as evicted")
+            return None
+        if not set(evicted) <= set(self.roster):
+            refuse.inc()
+            logger.error("secagg: refusing reveal for peers outside the "
+                         "round roster")
+            return None
+        from fedml_tpu.resilience import quorum_size
+
+        already = self._revealed.setdefault(self.round_idx, set())
+        # the bound is the TIGHTER of the quorum (a round that lost more
+        # could never have closed) and the 2-survivor privacy floor (at
+        # one survivor, revealing every pair seed would unmask this
+        # client's own upload — even a legally-low quorum never excuses
+        # that)
+        max_evictable = len(self.roster) - max(2, quorum_size(
+            len(self.roster), self.quorum_frac))
+        if len(already | set(evicted)) > max_evictable:
+            refuse.inc()
+            logger.error(
+                "secagg: refusing reveal — %d claimed dropouts exceed the "
+                "quorum/privacy-compatible maximum %d",
+                len(already | set(evicted)), max_evictable)
+            return None
+        out = {j: self._peer_seeds[j] for j in evicted
+               if j in self._peer_seeds}
+        already.update(out)
+        _counter("secagg/seeds_revealed").inc(len(out))
+        return out
+
+
+def _meta_of(tree: Pytree):
+    from fedml_tpu.compression.codecs import _tree_meta
+    import jax
+
+    return _tree_meta(jax.tree.leaves(tree))
+
+
+class SecAggServerSession:
+    """Server-side roster/reveal bookkeeping + the unmask aggregation.
+
+    The server never holds mask seeds of its own: it learns exactly the
+    revealed (survivor, evicted) pair seeds, applies them to the masked
+    SUM, and materializes only the (optionally DP-noised) aggregate.
+    """
+
+    def __init__(self, args: Any, client_num: int):
+        self.client_num = int(client_num)
+        self.clip = float(getattr(args, "secagg_clip", 0.1))
+        self.mod_bits = int(getattr(args, "secagg_mod_bits", 8))
+        self.recovery_rounds = int(getattr(
+            args, "secagg_recovery_rounds",
+            getattr(args, "round_deadline_extensions", 3)))
+        self.pks: Dict[int, bytes] = {}
+        self._lock = threading.Lock()
+        # round state
+        self.round_idx: Optional[int] = None
+        self.roster: List[int] = []
+        self.codec: Optional[SecAggInt8Codec] = None
+        # recovery state
+        self.recovering = False
+        self.survivors: List[int] = []
+        self.evicted: List[int] = []
+        self.reveals: Dict[int, Dict[int, int]] = {}
+        self.recovery_waves = 0
+
+    @classmethod
+    def from_args(cls, args: Any,
+                  client_num: int) -> Optional["SecAggServerSession"]:
+        return cls(args, client_num) if secagg_enabled(args) else None
+
+    # -- key advertisement ----------------------------------------------------
+    def note_pk(self, client_id: int, pk: Any) -> None:
+        """Store a client's advertised key. A changed key is a restarted
+        client — replace it (its next roster uses the new key)."""
+        self.pks[int(client_id)] = _validate_pk(pk)
+
+    # -- round lifecycle --------------------------------------------------------
+    def begin_round(self, round_idx: int, cohort: Sequence[int]) -> dict:
+        """Open a masked round; returns the broadcast header."""
+        from fedml_tpu.compression import get_codec
+
+        cohort = [int(c) for c in cohort]
+        missing = [c for c in cohort if c not in self.pks]
+        if missing:
+            raise RuntimeError(
+                f"secagg round {round_idx} cannot open: no key "
+                f"advertisement from clients {missing}")
+        bound = masking.client_bound(len(cohort), self.mod_bits)
+        spec = (f"{SecAggInt8Codec.name}@{self.clip:g}/{bound}/"
+                f"{self.mod_bits}")
+        with self._lock:
+            self.round_idx = int(round_idx)
+            self.roster = cohort
+            self.codec = get_codec(spec)
+            self.recovering = False
+            self.survivors = []
+            self.evicted = []
+            self.reveals = {}
+            self.recovery_waves = 0
+        _counter("secagg/rounds").inc()
+        record_phase("collect", round_idx, roster=cohort)
+        return {"v": 1, "spec": spec, "roster": cohort,
+                "pks": {int(c): self.pks[c] for c in cohort},
+                "round": int(round_idx)}
+
+    def validate_upload(self, sender: int, ct: Any) -> None:
+        """Reject masked uploads whose metadata lies — wrong codec,
+        foreign round, spoofed rank, roster mismatch. ``ValueError``
+        only (the caller drops + counts, never aggregates)."""
+        from fedml_tpu.compression import CompressedTree
+
+        if not isinstance(ct, CompressedTree) or (
+                ct.codec != SecAggInt8Codec.name):
+            raise ValueError(
+                f"secagg round expected a masked upload, got "
+                f"{type(ct).__name__}")
+        sa = ct.sa
+        if not isinstance(sa, dict):
+            raise ValueError("masked upload missing its sa header")
+        try:
+            rank = int(sa["rank"])
+            rnd = int(sa["round"])
+            roster = [int(c) for c in sa["roster"]]
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed masked upload header: {e}") from None
+        if rank != int(sender):
+            raise ValueError(
+                f"masked upload claims rank {rank} but came from {sender}")
+        if rnd != self.round_idx or roster != self.roster:
+            raise ValueError(
+                f"masked upload for round {rnd}/roster {roster} does not "
+                f"match the open round {self.round_idx}/{self.roster}")
+
+    # -- dropout recovery -------------------------------------------------------
+    def begin_recovery(self, survivors: Sequence[int],
+                       evicted: Sequence[int]) -> List[int]:
+        """Start (or extend) recovery; returns the survivors to ask."""
+        with self._lock:
+            if not self.recovering:
+                self.recovering = True
+                self.survivors = [int(s) for s in survivors]
+                self.evicted = sorted(int(e) for e in evicted)
+                self.reveals = {}
+            else:
+                newly = sorted(set(int(e) for e in evicted)
+                               - set(self.evicted))
+                self.evicted = sorted(set(self.evicted)
+                                      | set(int(e) for e in evicted))
+                self.survivors = [s for s in self.survivors
+                                  if s not in self.evicted]
+                for s in list(self.reveals):
+                    if s in self.evicted:
+                        del self.reveals[s]
+                logger.warning("secagg recovery extended to evicted=%s "
+                               "(+%s)", self.evicted, newly)
+            self.recovery_waves += 1
+            _counter("secagg/recoveries").inc()
+            record_phase("recover", self.round_idx or -1,
+                         wave=self.recovery_waves, evicted=self.evicted,
+                         survivors=list(self.survivors))
+            _secagg_event("recovery_started", round=self.round_idx,
+                          wave=self.recovery_waves,
+                          evicted=list(self.evicted))
+            return list(self.survivors)
+
+    def note_reveal(self, sender: int, payload: Any,
+                    round_idx: Any) -> bool:
+        """Record one survivor's reveal; True once recovery is complete.
+        Malformed payloads raise ``ValueError`` (counted by the caller,
+        the sender is then treated as not having revealed)."""
+        sender = int(sender)
+        with self._lock:
+            if not self.recovering or int(round_idx) != self.round_idx:
+                raise ValueError(
+                    f"unexpected secagg reveal for round {round_idx} "
+                    f"(recovering={self.recovering} at {self.round_idx})")
+            if sender not in self.survivors:
+                raise ValueError(
+                    f"secagg reveal from non-survivor {sender}")
+            if not isinstance(payload, dict):
+                raise ValueError("secagg reveal payload must be a dict")
+            try:
+                seeds = {int(j): int(s) for j, s in payload.items()}
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "secagg reveal payload must map int→int") from None
+            if not set(seeds) <= set(self.evicted):
+                raise ValueError(
+                    f"secagg reveal covers non-evicted peers "
+                    f"{sorted(set(seeds) - set(self.evicted))}")
+            self.reveals.setdefault(sender, {}).update(seeds)
+            return self._complete_locked()
+
+    def _complete_locked(self) -> bool:
+        need = set(self.evicted)
+        return all(need <= set(self.reveals.get(s, {}))
+                   for s in self.survivors)
+
+    def recovery_complete(self) -> bool:
+        with self._lock:
+            return self.recovering and self._complete_locked()
+
+    def pending_reveals(self) -> List[int]:
+        with self._lock:
+            need = set(self.evicted)
+            return [s for s in self.survivors
+                    if not need <= set(self.reveals.get(s, {}))]
+
+    def recovery_adjustment(self, meta) -> Optional[List[np.ndarray]]:
+        with self._lock:
+            if not self.evicted:
+                return None
+            pairs = [(s, j, self.reveals[s][j])
+                     for s in self.survivors for j in self.evicted]
+        return masking.recovery_adjustment(pairs, meta, self.mod_bits)
+
+    # -- the unmask aggregation ---------------------------------------------------
+    def aggregate(self, cts: Sequence[Any], base: Pytree) -> Pytree:
+        """Unmask the survivors' sum into the new global model (+ DP).
+
+        ``cts`` are the received masked trees (any order — ``sa.rank``
+        orders them canonically). The per-client trees stay masked; the
+        only decoded value is the aggregate, noised in-program when
+        central DP is enabled.
+        """
+        ordered = sorted(cts, key=lambda ct: int(ct.sa["rank"]))
+        ranks = [int(ct.sa["rank"]) for ct in ordered]
+        with self._lock:
+            survivors = (list(self.survivors) if self.recovering
+                         else list(self.roster))
+        if ranks != sorted(survivors):
+            raise ValueError(
+                f"masked uploads {ranks} do not match the survivor set "
+                f"{sorted(survivors)}")
+        recovery = self.recovery_adjustment(ordered[0].meta)
+        dp_sigma, dp_key = self._dp_noise_params()
+        out = unmask_finalize(ordered, base, self.codec,
+                              recovery=recovery, dp_sigma=dp_sigma,
+                              dp_key_data=dp_key)
+        record_phase("unmask", self.round_idx or -1,
+                     survivors=ranks, recovered=len(self.evicted),
+                     dp_noised=dp_sigma > 0)
+        if self.evicted:
+            _secagg_event("recovery_closed", round=self.round_idx,
+                          evicted=list(self.evicted),
+                          seeds=sum(len(v) for v in self.reveals.values()))
+        return out
+
+    def _dp_noise_params(self) -> Tuple[float, Optional[np.ndarray]]:
+        """Central-DP noise drawn INSIDE the unmask program: σ from the
+        configured gaussian mechanism, key from the accounted counter
+        chain (one release per round, like ``add_global_noise``)."""
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if not (dp.is_dp_enabled() and dp.is_global_dp_enabled()):
+            return 0.0, None
+        sigma = getattr(getattr(dp.frame, "mechanism", None), "sigma", None)
+        if sigma is None:
+            raise ValueError(
+                "secagg in-program central DP supports the gaussian "
+                "mechanism only (laplace has no in-program path)")
+        _counter("secagg/dp_noise_rounds").inc()
+        return float(sigma), dp.take_key_data(1)[0]

@@ -186,6 +186,8 @@ def run_chaos_scenario(
     duplicate: float = 0.0,
     delay_ms: float = 0.0,
     compression: str = "",
+    secagg: str = "",
+    secagg_clip: float = 0.2,
     round_deadline_s: float = 30.0,
     round_quorum: float = 2.0 / 3.0,
     timeout: float = 300.0,
@@ -227,6 +229,8 @@ def run_chaos_scenario(
             "round_quorum": round_quorum,
             "chaos": chaos, "chaos_seed": seed,
             **({"compression": compression} if compression else {}),
+            **({"secagg": secagg, "secagg_clip": secagg_clip}
+               if secagg else {}),
         },
     }
     args = fedml_tpu.init(load_arguments_from_dict(cfg))
@@ -241,10 +245,15 @@ def run_chaos_scenario(
                 total += float(rec.get("value", rec.get("count", 0)) or 0)
         return total
 
-    before = {n: grab(n) for n in (
+    counter_names = [
         "resilience/quorum_rounds", "resilience/clients_evicted",
         "resilience/clients_rejoined", "resilience/stale_uploads",
-        "resilience/duplicates_dropped", "resilience/chaos_injections")}
+        "resilience/duplicates_dropped", "resilience/chaos_injections"]
+    if secagg:
+        counter_names += ["secagg/rounds", "secagg/recoveries",
+                          "secagg/seeds_revealed",
+                          "secagg/recovery_failures"]
+    before = {n: grab(n) for n in counter_names}
     t0 = time.time()
     result = run_cross_silo_inproc(args, ds, model, timeout=timeout)
     wall_s = time.time() - t0

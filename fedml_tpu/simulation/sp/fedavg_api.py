@@ -193,8 +193,14 @@ class FedAvgAPI:
             self._health.observe(cid, round_idx, update_norm=update_norm(ct))
             enc.append((n_k, ct))
         if not (requires_full_trees() or self._contrib.is_enabled()):
+            # norm-only defenses ride the fused path: clip factors from
+            # blocks × scales (no decode), folded into the weights
+            from fedml_tpu.core.security.defender import FedMLDefender
+
             return w_locals, FedMLAggOperator.agg_compressed(
-                self.args, enc, self.global_params)
+                self.args, enc, self.global_params,
+                clip_factors=FedMLDefender.get_instance()
+                .fused_clip_factors([ct for _, ct in enc]))
         decoded = [
             (n, tree_undelta(self.global_params, self._codec.decode(ct)))
             for n, ct in enc

@@ -477,6 +477,54 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "tiers", "no data: no tier/* metrics or tier-tagged events "
             "(not a hierarchical-federation run)")
 
+    # -- secure aggregation (secagg/* counters + secagg_event records) ----
+    latest_sa: Dict[Any, float] = {}
+    for rec in metric_records:
+        name = rec.get("name", "")
+        if name.startswith("secagg/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest_sa[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    sa_counters: Dict[str, float] = {}
+    for (name, _), val in latest_sa.items():
+        key = name.split("/", 1)[1]
+        sa_counters[key] = sa_counters.get(key, 0.0) + val
+    sa_events = [e for e in health_events if e.get("kind") == "secagg_event"]
+    secagg: Dict[str, Any] = {"counters": sa_counters,
+                              "events": sa_events[-16:]}
+    for e in sa_events:
+        # mask-recovery verdicts: each closed recovery is a round that
+        # would have been LOST (or privacy-broken) without the protocol
+        if e.get("event") == "recovery_closed":
+            verdict.append(
+                f"secagg round {e.get('round')} closed via mask recovery: "
+                f"evicted {e.get('evicted')}, {e.get('seeds', 0):.0f} "
+                "pair-seed(s) revealed — aggregate stayed masked per "
+                "client and bit-stable")
+    if sa_counters.get("recovery_failures"):
+        verdict.append(
+            f"{sa_counters['recovery_failures']:.0f} secagg mask "
+            "recovery(ies) FAILED — the federation aborted rather than "
+            "publish a mask-polluted aggregate (check survivor liveness "
+            "/ secagg_recovery_rounds)")
+    if sa_counters.get("reveal_refusals"):
+        verdict.append(
+            f"clients refused {sa_counters['reveal_refusals']:.0f} "
+            "seed-reveal request(s) — the server asked for more than the "
+            "quorum-compatible dropout set (misconfiguration, or a "
+            "privacy probe)")
+    if sa_counters.get("invalid_uploads") or sa_counters.get(
+            "invalid_reveals"):
+        verdict.append(
+            f"secagg dropped {sa_counters.get('invalid_uploads', 0):.0f} "
+            f"malformed masked upload(s) and "
+            f"{sa_counters.get('invalid_reveals', 0):.0f} malformed "
+            "reveal(s) — a peer is corrupt or hostile")
+    if not sa_counters and not sa_events:
+        notes.setdefault(
+            "secagg", "no data: no secagg/* metrics or secagg_event "
+            "records (secure aggregation was off)")
+
     # -- live plane (online-doctor alerts + stream accounting) ------------
     # doctor_alert records are appended to telemetry.jsonl BY the online
     # doctor at the round a rule trips; surfacing them here proves the
@@ -533,6 +581,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "serving": serving,
         "connectivity": connectivity,
         "tiers": tiers,
+        "secagg": secagg,
         "live": live,
         "verdict": verdict,
     }
@@ -652,6 +701,20 @@ def format_doctor(d: Dict) -> str:
                 if k not in ("kind", "ts") and not isinstance(v, dict)))
     else:
         add(f"  {notes.get('tiers', 'no data')}")
+
+    add("")
+    add("secure aggregation (masked rounds / dropout recovery):")
+    sa = d.get("secagg") or {}
+    sa_counters = sa.get("counters") or {}
+    if sa_counters or sa.get("events"):
+        for name, v in sorted(sa_counters.items()):
+            add(f"  secagg/{name:<36s}{v:>14.0f}")
+        for e in (sa.get("events") or [])[-6:]:
+            add("  event: " + " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("kind", "ts") and not isinstance(v, dict)))
+    else:
+        add(f"  {notes.get('secagg', 'no data')}")
 
     add("")
     add("serving (live endpoint freshness / SLO):")

@@ -139,6 +139,11 @@ def update_norm(update: Any, base: Any = None) -> Optional[float]:
             codec = get_codec(update.codec)
             if codec is None:
                 return None
+            if getattr(codec, "maskable", False):
+                # a masked (secure-aggregation) update is exactly the
+                # thing the server must NOT be able to introspect — no
+                # norm, by design, not by limitation
+                return None
             if not update.is_delta:
                 tree = codec.decode(update)
                 return math.sqrt(float(_tree_sq(tree, base)))

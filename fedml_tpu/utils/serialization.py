@@ -91,7 +91,7 @@ def _encode(obj: Any, blobs: List[Any]) -> Any:
         blobs.append(b"RAW0" + bytes(obj))
         return {_BYTES: len(blobs) - 1}
     if isinstance(obj, CompressedTree):
-        return {
+        node = {
             _CODEC: obj.codec,
             "v": obj.version,
             "delta": obj.is_delta,
@@ -100,6 +100,11 @@ def _encode(obj: Any, blobs: List[Any]) -> Any:
             "structure": _encode(obj.structure, blobs),
             "state": _encode(obj.arrays, blobs),
         }
+        if obj.sa is not None:
+            # masked wire node (v2): the sa field carries the mask-domain
+            # metadata the receiving aggregator validates before fusing
+            node["sa"] = _encode(obj.sa, blobs)
+        return node
     if isinstance(obj, (np.ndarray, jax.Array, np.generic)):
         # already-host arrays skip the device_get + asarray double hop
         arr = obj if isinstance(obj, np.ndarray) else np.asarray(
@@ -196,15 +201,37 @@ def _decode_codec(node: dict, blobs: List[memoryview]) -> Any:
     ``ValueError`` — a hostile peer must not be able to smuggle bytes
     past the registry by inventing a tag.
     """
-    from fedml_tpu.compression.codecs import WIRE_VERSION, CompressedTree
-    from fedml_tpu.compression.codecs import available_codecs
+    from fedml_tpu.compression.codecs import (
+        WIRE_VERSION,
+        WIRE_VERSION_MASKED,
+        CompressedTree,
+        available_codecs,
+    )
 
     codec = node.get(_CODEC)
     if not isinstance(codec, str) or codec not in available_codecs():
         raise ValueError(f"unknown compression codec tag {codec!r}")
     version = node.get("v")
-    if version != WIRE_VERSION:
+    if version not in (WIRE_VERSION, WIRE_VERSION_MASKED):
         raise ValueError(f"unsupported compression wire version {version!r}")
+    sa = None
+    if version == WIRE_VERSION_MASKED:
+        # masked wire nodes REQUIRE a maskable codec and a well-formed
+        # sa dict; v1 nodes must not smuggle one — every direction
+        # rejects, same contract as unknown tags (a hostile peer gets
+        # ValueError, never a guess). The maskable check stops a plain
+        # codec from masquerading as the masked wire.
+        from fedml_tpu.compression.codecs import get_codec
+
+        if not getattr(get_codec(codec), "maskable", False):
+            raise ValueError(
+                f"codec {codec!r} is not maskable; v2 wire nodes carry "
+                "masked payloads only")
+        sa = _decode(node.get("sa"), blobs)
+        if not isinstance(sa, dict):
+            raise ValueError("masked (v2) payload missing its sa field")
+    elif "sa" in node:
+        raise ValueError("v1 compressed payload carries a masked sa field")
     meta = node.get("meta")
     arrays = _decode(node.get("state"), blobs)
     structure = _decode(node.get("structure"), blobs)
@@ -216,6 +243,7 @@ def _decode_codec(node: dict, blobs: List[memoryview]) -> Any:
         return CompressedTree(
             codec, int(version), bool(node.get("delta", False)),
             int(node.get("raw_nbytes", 0)), meta_t, structure, arrays,
+            sa=sa,
         )
     except (TypeError, ValueError) as e:
         raise ValueError(f"malformed compressed payload: {e}") from None

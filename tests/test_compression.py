@@ -173,10 +173,18 @@ def test_unknown_codec_tag_rejected():
 
 
 def test_unknown_wire_version_rejected():
+    # version 2 is the MASKED wire (needs an sa field — rejection of its
+    # malformed shapes is covered by tests/test_secagg.py); anything
+    # beyond is unknown and must be refused by version alone
     rng = np.random.default_rng(4)
     ct = get_codec("int8").encode(DTYPE_TREES["f32"](rng))
-    ct.version = WIRE_VERSION + 1
+    ct.version = WIRE_VERSION + 2
     with pytest.raises(ValueError, match="version"):
+        safe_loads(safe_dumps(ct))
+    # the masked version is reserved for maskable codecs: a plain codec
+    # cannot masquerade as the masked wire
+    ct.version = WIRE_VERSION + 1
+    with pytest.raises(ValueError, match="maskable"):
         safe_loads(safe_dumps(ct))
 
 

@@ -1,0 +1,621 @@
+"""Secure aggregation on the compressed wire (privacy/secagg):
+
+masking units (exact cancellation, recovery adjustment, bounds), the
+maskable codec (encode/unmask bit-exactness vs the unmasked quantized
+reference, decode guards), wire-v2 fuzz (hostile sa fields, truncated
+masked payloads, malformed reveals → ValueError), protocol guards
+(reveal refusals), the chaos acceptance runs (mid-round kill closes via
+mask recovery, bit-identical same-seed replays, flight recorder shows
+no individually-unmasked phase), in-program central DP, the per-edge-
+cohort tree mode, doctor triage and the bench gates."""
+import copy
+import json
+import os
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.compression import derive_key, get_codec
+from fedml_tpu.compression.codecs import _tree_meta
+from fedml_tpu.privacy import secagg
+from fedml_tpu.privacy.secagg import masking
+from fedml_tpu.utils.serialization import safe_dumps, safe_loads
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TEMPLATE = {"w": np.zeros((8, 4), np.float32), "b": np.zeros((4,), np.float32)}
+META = _tree_meta(jax.tree.leaves(TEMPLATE))
+
+
+def _pair_seeds(n, round_idx, salt=0):
+    """Symmetric per-pair seeds for ranks 1..n (test stand-in for DH)."""
+    secrets = {(i, j): (i * 1009 + j * 7919 + salt * 104729)
+               for i in range(1, n + 1) for j in range(i + 1, n + 1)}
+
+    def seeds_for(i):
+        return {j: masking.pair_round_seed(
+            secrets[(min(i, j), max(i, j))], round_idx)
+            for j in range(1, n + 1) if j != i}
+
+    return seeds_for
+
+
+def _deltas(n, scale=0.02, seed=0):
+    rng = np.random.default_rng(seed)
+    return [jax.tree.map(
+        lambda x: np.asarray(rng.normal(0, scale, x.shape), np.float32),
+        TEMPLATE) for _ in range(n)]
+
+
+def _reference_quant(deltas, codec, round_idx=0):
+    """The unmasked quantized sum each client's program must produce."""
+    qs = []
+    for i, d in enumerate(deltas, start=1):
+        key = derive_key(0, round_idx, i)
+        qi = []
+        for li, x in enumerate(jax.tree.leaves(d)):
+            u = jax.random.uniform(jax.random.fold_in(key, li), x.shape)
+            q = jax.numpy.clip(
+                jax.numpy.floor(
+                    jax.numpy.clip(x, -codec.clip, codec.clip)
+                    / codec.scale + u),
+                -codec.bound, codec.bound)
+            qi.append(np.asarray(q, np.int32))
+        qs.append(qi)
+    return qs
+
+
+def _encode_all(deltas, codec, round_idx=0, salt=0):
+    n = len(deltas)
+    seeds_for = _pair_seeds(n, round_idx, salt)
+    cts = []
+    for i, d in enumerate(deltas, start=1):
+        nm = masking.net_mask_leaves(i, seeds_for(i), META, codec.mod_bits)
+        ct, _ = secagg.masked_encode(
+            d, nm, codec, derive_key(0, round_idx, i),
+            sa={"round": round_idx, "rank": i,
+                "roster": list(range(1, n + 1))})
+        cts.append(ct)
+    return cts, seeds_for
+
+
+# -- masking / codec units --------------------------------------------------
+def test_client_bound_and_mod_bits():
+    assert masking.client_bound(1) == 127
+    assert masking.client_bound(4) == 31
+    assert masking.client_bound(127) == 1
+    with pytest.raises(ValueError):
+        masking.client_bound(128)  # no representable bound mod 2^8
+    assert masking.client_bound(128, 16) == 255
+    with pytest.raises(ValueError):
+        masking.client_bound(4, 12)  # unsupported modulus
+
+
+def test_net_masks_cancel_exactly():
+    """Σ_i net_mask_i ≡ 0 mod 2^k over any full roster — the invariant
+    the whole subsystem rests on."""
+    for mod_bits in (8, 16):
+        seeds_for = _pair_seeds(5, round_idx=3)
+        acc = None
+        for i in range(1, 6):
+            m = masking.net_mask_leaves(i, seeds_for(i), META, mod_bits)
+            acc = m if acc is None else [a + b for a, b in zip(acc, m)]
+        for leaf in acc:
+            assert not leaf.any(), "pairwise masks must cancel exactly"
+
+
+def test_masked_aggregate_matches_unmasked_reference():
+    """unmask_finalize(masked uploads) == base + mean(quantized deltas),
+    BIT-exact — masking is invisible to the aggregate."""
+    n = 4
+    codec = get_codec(f"secagg_int8@0.1/{masking.client_bound(n)}/8")
+    deltas = _deltas(n)
+    base = jax.tree.map(
+        lambda x: np.asarray(
+            np.random.default_rng(9).normal(size=x.shape), np.float32),
+        TEMPLATE)
+    cts, _ = _encode_all(deltas, codec)
+    agg = secagg.unmask_finalize(cts, base, codec)
+    qs = _reference_quant(deltas, codec)
+    for li, b in enumerate(jax.tree.leaves(base)):
+        ref = (np.asarray(b, np.float32)
+               + sum(q[li] for q in qs).astype(np.float32)
+               * codec.scale / n)
+        np.testing.assert_array_equal(np.asarray(jax.tree.leaves(agg)[li]),
+                                      ref)
+
+
+def test_dropout_recovery_is_bit_exact():
+    """Evict one client: survivors' reveals reproduce the dangling mask
+    halves and the recovered aggregate equals the survivors-only
+    unmasked reference to the bit."""
+    n = 4
+    codec = get_codec(f"secagg_int8@0.1/{masking.client_bound(n)}/8")
+    deltas = _deltas(n)
+    base = jax.tree.map(lambda x: np.zeros(x.shape, np.float32), TEMPLATE)
+    cts, seeds_for = _encode_all(deltas, codec)
+    survivors = [1, 2, 4]
+    pairs = [(s, 3, seeds_for(s)[3]) for s in survivors]
+    rec = masking.recovery_adjustment(pairs, META, codec.mod_bits)
+    agg = secagg.unmask_finalize([cts[s - 1] for s in survivors], base,
+                                 codec, recovery=rec)
+    qs = _reference_quant(deltas, codec)
+    for li in range(len(META)):
+        ref = (sum(qs[s - 1][li] for s in survivors).astype(np.float32)
+               * codec.scale / len(survivors))
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(agg)[li]), ref)
+
+
+def test_masked_tree_decode_guards():
+    """No code path decodes an individual masked tree: the codec
+    refuses, the generic fused sum refuses, and the health norm is None
+    by design."""
+    from fedml_tpu.compression import fused_weighted_sum
+    from fedml_tpu.telemetry.health import update_norm
+
+    n = 3
+    codec = get_codec(f"secagg_int8@0.1/{masking.client_bound(n)}/8")
+    cts, _ = _encode_all(_deltas(n), codec)
+    with pytest.raises(ValueError, match="refusing to decode"):
+        codec.decode(cts[0])
+    with pytest.raises(ValueError, match="mask cancellation"):
+        fused_weighted_sum(cts, np.ones(n, np.float32) / n)
+    assert update_norm(cts[0]) is None
+    with pytest.raises(ValueError, match="mask input"):
+        codec.encode(TEMPLATE)
+    with pytest.raises(ValueError, match="float-leaf"):
+        secagg.masked_encode({"n": np.zeros(3, np.int32)},
+                             [np.zeros(3, np.uint8)], codec,
+                             derive_key(0, 0, 1))
+
+
+def test_non_float_and_mismatched_specs_raise():
+    with pytest.raises(ValueError, match="clip"):
+        get_codec("secagg_int8@0/31/8")
+    with pytest.raises(ValueError, match="malformed"):
+        get_codec("secagg_int8@0.1/31")
+    with pytest.raises(ValueError, match="not representable"):
+        get_codec("secagg_int8@0.1/200/8")
+
+
+# -- wire v2 ----------------------------------------------------------------
+def test_masked_wire_node_roundtrips_with_sa():
+    n = 3
+    codec = get_codec(f"secagg_int8@0.1/{masking.client_bound(n)}/8")
+    cts, _ = _encode_all(_deltas(n), codec)
+    ct2 = safe_loads(safe_dumps(cts[0]))
+    assert ct2.version == secagg.WIRE_VERSION_MASKED
+    assert ct2.sa == cts[0].sa
+    assert ct2.codec == "secagg_int8"
+    for a, b in zip(ct2.arrays, cts[0].arrays):
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+
+def test_masked_wire_fuzz_hostile_and_truncated():
+    """Satellite: every malformed masked payload → ValueError, never a
+    wrong aggregate. Extends the PR 3 fuzz smoke with the v2 node."""
+    n = 3
+    codec = get_codec(f"secagg_int8@0.1/{masking.client_bound(n)}/8")
+    cts, _ = _encode_all(_deltas(n), codec)
+    wire = safe_dumps({"m": cts[0]})
+    # truncations at every stride must never escape ValueError
+    for cut in list(range(0, 12)) + list(range(12, len(wire) - 1, 83)):
+        try:
+            safe_loads(wire[:cut])
+        except ValueError:
+            pass
+    # hostile skeletons around the v2 sa field
+    hostile = [
+        # v2 without sa
+        {"skeleton": {"__codec__": "secagg_int8", "v": 2, "meta": [],
+                      "structure": [], "state": []}, "arrays": []},
+        # v1 smuggling an sa field
+        {"skeleton": {"__codec__": "int8", "v": 1, "meta": [],
+                      "structure": [], "state": [], "sa": {"rank": 1}},
+         "arrays": []},
+        # plain codec masquerading as the masked wire
+        {"skeleton": {"__codec__": "int8", "v": 2, "meta": [],
+                      "structure": [], "state": [], "sa": {"rank": 1}},
+         "arrays": []},
+        # sa of the wrong type
+        {"skeleton": {"__codec__": "secagg_int8", "v": 2, "meta": [],
+                      "structure": [], "state": [], "sa": [1, 2]},
+         "arrays": []},
+        # unsupported masked version
+        {"skeleton": {"__codec__": "secagg_int8", "v": 3, "meta": [],
+                      "structure": [], "state": [], "sa": {}},
+         "arrays": []},
+    ]
+    for skel in hostile:
+        header = json.dumps(skel).encode()
+        payload = struct.pack("<I", len(header)) + header + b"\x00" * 32
+        with pytest.raises(ValueError):
+            safe_loads(payload)
+
+
+def test_server_session_rejects_hostile_uploads_and_reveals():
+    """Protocol-level fuzz: spoofed ranks, foreign rounds, non-survivor
+    reveals, seeds for non-evicted peers — all ValueError."""
+    args = load_arguments_from_dict(
+        {"train_args": {"secagg": "int8", "round_quorum": 0.5}},
+        training_type="cross_silo")
+    sess = secagg.SecAggServerSession(args, client_num=3)
+    for cid in (1, 2, 3):
+        sess.note_pk(cid, bytes(32))
+    with pytest.raises(ValueError):
+        sess.note_pk(1, b"short")
+    sess.begin_round(0, [1, 2, 3])
+    codec = get_codec(sess.codec.spec)
+    cts, _ = _encode_all(_deltas(3, seed=1), codec)
+    sess.validate_upload(1, cts[0])
+    with pytest.raises(ValueError, match="claims rank"):
+        sess.validate_upload(2, cts[0])  # spoofed sender
+    with pytest.raises(ValueError, match="masked upload"):
+        sess.validate_upload(1, {"w": np.zeros(3)})
+    bad_round = copy.copy(cts[0])
+    bad_round.sa = dict(cts[0].sa, round=7)
+    with pytest.raises(ValueError, match="does not match"):
+        sess.validate_upload(1, bad_round)
+    # recovery reveals
+    sess.begin_recovery([1, 2], [3])
+    with pytest.raises(ValueError, match="non-survivor"):
+        sess.note_reveal(3, {3: 1}, 0)
+    with pytest.raises(ValueError, match="non-evicted"):
+        sess.note_reveal(1, {2: 1}, 0)
+    with pytest.raises(ValueError, match="int"):
+        sess.note_reveal(1, {"x": "y"}, 0)
+    with pytest.raises(ValueError, match="dict"):
+        sess.note_reveal(1, [1, 2], 0)
+    with pytest.raises(ValueError, match="unexpected"):
+        sess.note_reveal(1, {3: 1}, 4)
+    assert not sess.note_reveal(1, {3: 11}, 0)
+    assert sess.note_reveal(2, {3: 22}, 0)  # complete
+    assert sess.recovery_complete()
+
+
+def test_client_session_reveal_guards():
+    """The client refuses reveal requests a lying server would need:
+    naming itself, peers outside the roster, foreign rounds, or more
+    dropouts than the quorum could have survived."""
+    from fedml_tpu.telemetry import get_registry
+
+    args = load_arguments_from_dict(
+        {"train_args": {"secagg": "int8", "round_deadline_s": 10.0,
+                        "round_quorum": 0.5}},
+        training_type="cross_silo")
+    sessions = {r: secagg.SecAggClientSession(r, args) for r in (1, 2, 3, 4)}
+    pks = {r: s.pk for r, s in sessions.items()}
+    header = {"v": 1, "spec": f"secagg_int8@0.1/{masking.client_bound(4)}/8",
+              "roster": [1, 2, 3, 4], "pks": pks, "round": 2}
+    s1 = sessions[1]
+    s1.begin_round(header, 2)
+    before = get_registry().counter("secagg/reveal_refusals").value
+    assert s1.reveal_for([1], 2) is None          # names the client itself
+    assert s1.reveal_for([9], 2) is None          # outside the roster
+    assert s1.reveal_for([3], 5) is None          # foreign round
+    assert s1.reveal_for([2, 3, 4], 2) is None    # > roster − quorum
+    assert s1.reveal_for("junk", 2) is None       # malformed
+    assert (get_registry().counter("secagg/reveal_refusals").value
+            - before) == 5
+    ok = s1.reveal_for([3], 2)
+    assert set(ok) == {3}
+    # both endpoints derive the same pair seed (the recovery invariant)
+    sessions[3].begin_round(header, 2)
+    assert ok[3] == sessions[3]._peer_seeds[1]
+    # malformed headers are rejected loudly
+    with pytest.raises(ValueError):
+        s1.begin_round({"roster": [1]}, 2)
+    with pytest.raises(ValueError):
+        s1.begin_round(
+            dict(header, spec="secagg_int8@0.1/99/8"), 2)  # wrong bound
+
+
+# -- cross-silo acceptance runs ---------------------------------------------
+def _secagg_cfg(run_id, seed=7, rounds=5, clients=3, extra=None):
+    return {
+        "common_args": {"training_type": "cross_silo", "random_seed": seed,
+                        "run_id": run_id},
+        "data_args": {"dataset": "synthetic", "train_size": 60 * clients,
+                      "test_size": 60, "class_num": 4, "feature_dim": 12},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": clients,
+                       "client_num_per_round": clients,
+                       "comm_round": rounds, "epochs": 1, "batch_size": 32,
+                       "learning_rate": 0.3, "secagg": "int8",
+                       "secagg_clip": 0.2, **(extra or {})},
+    }
+
+
+def _run_federation(cfg, timeout=240.0):
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.message_define import MyMessage
+    from fedml_tpu.cross_silo.run_inproc import run_managers_to_completion
+    from fedml_tpu.cross_silo.server.server import Server
+    from fedml_tpu.data import load_federated
+
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    run_id = str(args.run_id)
+    LocalBroker.destroy(run_id)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    server = Server(args, None, ds, model)
+    clients = []
+    for rank in range(1, int(args.client_num_per_round) + 1):
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        clients.append(Client(cargs, None, ds, model))
+    managers = [server.manager] + [c.manager for c in clients]
+    result = run_managers_to_completion(
+        managers, run_id, MyMessage.MSG_TYPE_CONNECTION_IS_READY,
+        timeout=timeout)
+    final = jax.tree.map(
+        np.asarray, server.manager.aggregator.get_global_model_params())
+    return result, server.manager, final
+
+
+def _counter(name):
+    from fedml_tpu.telemetry import get_registry
+
+    return get_registry().counter(name).value
+
+
+def test_secagg_chaos_acceptance_bit_identical(tmp_path):
+    """THE acceptance run: 5-round int8+SecAgg with a seeded mid-round
+    kill — the quorum round closes via mask recovery, two same-seed
+    runs end BIT-identical, and the flight recorder shows no phase
+    where an individual client's unmasked delta was materialized."""
+    from fedml_tpu.telemetry import flight_recorder
+
+    chaos = {"round_deadline_s": 30.0, "round_quorum": 2.0 / 3.0,
+             "round_deadline_multiplier": 1.5,
+             "round_deadline_grace_s": 0.3,
+             "chaos": {"kill": {"rank": 2, "round": 2, "revive_round": 3}},
+             "chaos_seed": 7, "log_file_dir": str(tmp_path)}
+    names = ["resilience/quorum_rounds", "secagg/rounds",
+             "secagg/recoveries", "secagg/seeds_revealed",
+             "secagg/masked_uploads", "secagg/recovery_failures"]
+    before = {n: _counter(n) for n in names}
+    r1, mgr, f1 = _run_federation(_secagg_cfg("sa_acc_1", extra=chaos))
+    delta = {n: _counter(n) - before[n] for n in names}
+    assert r1 is not None and r1["test_acc"] > 0.4, r1
+    assert delta["resilience/quorum_rounds"] == 1, delta
+    assert delta["secagg/rounds"] == 5, delta
+    assert delta["secagg/recoveries"] == 1, delta
+    # 2 survivors × 1 evicted peer — and nothing else — was revealed
+    assert delta["secagg/seeds_revealed"] == 2, delta
+    assert delta["secagg/recovery_failures"] == 0, delta
+    assert mgr.liveness.evicted() == []  # the killed client rejoined
+
+    # the server-side flight recorder: every secagg phase is masked,
+    # none ever materialized an individual plaintext; the kill round
+    # went collect → recover → unmask
+    phases = [e for e in flight_recorder.get_flight_recorder().snapshot()
+              if e.get("kind") == "secagg_phase"]
+    assert phases, "secagg phases must land in the flight recorder"
+    assert all(e.get("masked") is True for e in phases)
+    assert all(e.get("individual_plaintext") is False for e in phases)
+    assert any(e.get("phase") == "recover" and e.get("round") == 2
+               for e in phases)
+    assert any(e.get("phase") == "unmask" and e.get("recovered") == 1
+               for e in phases)
+
+    # doctor triage (flushed BEFORE run 2 retargets the sink dir): the
+    # secagg section surfaces the recovery verdict
+    from fedml_tpu import telemetry
+    from fedml_tpu.telemetry.doctor import build_doctor, format_doctor
+
+    telemetry.flush_run()
+    d = build_doctor(os.path.join(str(tmp_path), "run_sa_acc_1"))
+    assert d["secagg"]["counters"].get("recoveries", 0) >= 1
+    assert d["secagg"]["counters"].get("seeds_revealed", 0) >= 2
+    assert any("mask recovery" in v for v in d["verdict"]), d["verdict"]
+    assert "secure aggregation" in format_doctor(d)
+
+    r2, _, f2 = _run_federation(_secagg_cfg("sa_acc_2", extra=chaos))
+    leaves1, treedef1 = jax.tree.flatten(f1)
+    leaves2, treedef2 = jax.tree.flatten(f2)
+    assert treedef1 == treedef2
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_array_equal(a, b)
+    assert r2["test_acc"] == r1["test_acc"]
+
+
+def test_secagg_kill_during_seed_exchange_two_dropouts():
+    """Satellite: the kill window opens ON the round's mask-seed
+    exchange (the broadcast carrying roster+pks never reaches the
+    victims), with TWO of four clients dead — the round still closes
+    via a multi-evicted recovery and same-seed runs stay bit-identical."""
+    extra = {"round_deadline_s": 30.0, "round_quorum": 0.5,
+             "round_deadline_multiplier": 1.5,
+             "round_deadline_grace_s": 0.3,
+             # partition (not kill) so two ranks drop the same window:
+             # the broadcast → seed derivation → upload of round 1 is
+             # exactly what the window swallows for ranks 2 and 3
+             "chaos": {"partition": {"ranks": [2, 3], "round": 1,
+                                     "heal_round": 2}},
+             "chaos_seed": 11}
+    names = ["secagg/recoveries", "secagg/seeds_revealed"]
+    before = {n: _counter(n) for n in names}
+    r1, _, f1 = _run_federation(
+        _secagg_cfg("sa_seedkill_1", seed=11, rounds=4, clients=4,
+                    extra=extra))
+    delta = {n: _counter(n) - before[n] for n in names}
+    assert r1 is not None
+    assert delta["secagg/recoveries"] == 1, delta
+    # 2 survivors × 2 evicted peers
+    assert delta["secagg/seeds_revealed"] == 4, delta
+    r2, _, f2 = _run_federation(
+        _secagg_cfg("sa_seedkill_2", seed=11, rounds=4, clients=4,
+                    extra=extra))
+    for a, b in zip(jax.tree.leaves(f1), jax.tree.leaves(f2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_secagg_central_dp_noise_in_program():
+    """Central DP under SecAgg: noise lands INSIDE the unmask program
+    (trace-time proof), the aggregate differs from the no-DP run, and
+    the accountant charges one release per round."""
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy,
+    )
+
+    dp_cfg = {"enable_dp": True, "dp_solution_type": "CDP",
+              "mechanism_type": "gaussian", "epsilon": 50.0,
+              "delta": 1e-5, "sensitivity": 0.01, "max_epsilon": 1e9}
+    FedMLDifferentialPrivacy.reset()
+    try:
+        r_dp, _, f_dp = _run_federation(
+            _secagg_cfg("sa_dp", rounds=2, extra=dp_cfg))
+        assert r_dp is not None
+        dp = FedMLDifferentialPrivacy.get_instance()
+        assert dp.epsilon_spent() > 0.0
+        trace = secagg.last_finalize_trace()
+        assert trace["noised_in_program"] is True
+        assert trace["pre_noise_traced"] is True, (
+            "the pre-noise aggregate must be an XLA temporary, never a "
+            "host value")
+        assert _counter("secagg/dp_noise_rounds") >= 2
+    finally:
+        FedMLDifferentialPrivacy.reset()
+    r_plain, _, f_plain = _run_federation(
+        _secagg_cfg("sa_dp_off", rounds=2))
+    diff = sum(
+        float(np.abs(a - b).sum())
+        for a, b in zip(jax.tree.leaves(f_dp), jax.tree.leaves(f_plain)))
+    assert diff > 0.0, "DP noise must actually perturb the aggregate"
+    # (no trace assertion for the plain run: with_noise is a STATIC jit
+    # arg, so the noise-free program is served from cache without
+    # retracing and the trace probe legitimately keeps its last value)
+
+
+def test_secagg_refuses_plaintext_features():
+    """Per-client-plaintext trust hooks cannot run under SecAgg — the
+    server refuses at construction, not mid-round."""
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    cfg = _secagg_cfg("sa_conflict", extra={
+        "enable_defense": True, "defense_type": "norm_diff_clipping",
+        "norm_bound": 5.0})
+    with pytest.raises(ValueError, match="secure aggregation"):
+        try:
+            _run_federation(cfg, timeout=30.0)
+        finally:
+            FedMLDefender.reset()
+
+
+# -- norm-only defense off the f32 fallback ---------------------------------
+def test_norm_only_defense_rides_fused_path():
+    """Satellite: norm clipping no longer forces the full-tree decode —
+    factors from blocks×scales fold into the fused weights, equal to
+    decode-clip-average to fp tolerance of the same quantized blocks."""
+    from types import SimpleNamespace
+
+    from fedml_tpu.compression import requires_full_trees
+    from fedml_tpu.core.security.defender import FedMLDefender
+    from fedml_tpu.ml.aggregator.agg_operator import FedMLAggOperator
+    from fedml_tpu.telemetry.health import update_norm
+
+    FedMLDefender.reset()
+    try:
+        FedMLDefender.get_instance().init(SimpleNamespace(
+            enable_defense=True, defense_type="norm_diff_clipping",
+            norm_bound=0.5))
+        assert not requires_full_trees()
+        codec = get_codec("int8")
+        deltas = _deltas(3, seed=5)
+        # blow one client up so it actually clips
+        deltas[1] = jax.tree.map(lambda x: x * 50.0, deltas[1])
+        cts = [codec.encode(d, key=derive_key(0, 0, c), is_delta=True)
+               for c, d in enumerate(deltas)]
+        raw = [(10, ct) for ct in cts]
+        bound = 0.5
+        factors = [min(1.0, bound / (update_norm(ct) + 1e-12))
+                   for _, ct in raw]
+        assert factors[1] < 1.0 and factors[0] == 1.0
+        base = jax.tree.map(lambda x: np.zeros(x.shape, np.float32),
+                            TEMPLATE)
+        args = SimpleNamespace(federated_optimizer="FedAvg")
+        agg = FedMLAggOperator.agg_compressed(args, raw, base,
+                                              clip_factors=factors)
+        for li, leaf in enumerate(jax.tree.leaves(agg)):
+            ref = sum(
+                np.asarray(jax.tree.leaves(codec.decode(ct))[li],
+                           np.float32) * f / 3.0
+                for ct, f in zip(cts, factors))
+            np.testing.assert_allclose(np.asarray(leaf), ref, rtol=1e-5,
+                                       atol=1e-7)
+    finally:
+        FedMLDefender.reset()
+
+
+# -- hierarchy: per-edge-cohort secagg --------------------------------------
+def test_tree_secagg_digest_identical_with_chaos():
+    """Per-edge-cohort SecAgg in the aggregation tree: chaos kills at
+    the leaf tier recover via the cohort's mask adjustment, and two
+    same-seed runs end digest-identical."""
+    from fedml_tpu.hierarchy.runner import (
+        KillWindow,
+        TreeRunner,
+        default_template,
+    )
+    from fedml_tpu.hierarchy.tree import TreeTopology
+
+    topo = TreeTopology([1, 2, 24])
+    chaos = [KillWindow(2, 5, 1)]
+
+    def run():
+        return TreeRunner(topo, template=default_template(128),
+                          codec="int8", seed=3, quorum=0.5, chunk=16,
+                          chaos=chaos, secagg=True).run(3)
+
+    before = _counter("secagg/hier_recoveries")
+    s1 = run()
+    assert s1["secagg"] is True
+    assert _counter("secagg/hier_recoveries") - before >= 1
+    s2 = run()
+    assert s1["final_digest"] == s2["final_digest"]
+    # secagg mode refuses the configurations it cannot keep private
+    with pytest.raises(ValueError, match="EF"):
+        TreeRunner(topo, codec="int8", secagg=True, ef=True)
+
+
+# -- bench + lint -----------------------------------------------------------
+def test_secagg_bench_smoke():
+    """Tier-1 smoke of the bench gates: wire ≤ 1.2× int8, recovery ≤ 1
+    round-trip per dropout, bit-stable closure."""
+    from tools.secagg_bench import run_secagg_bench
+
+    row = run_secagg_bench(n_params=20_000, cohort=4, rounds=4, seed=7)
+    assert row["gate_wire_ok"], row
+    assert row["wire_ratio_vs_int8"] <= 1.2, row
+    assert row["gate_recovery_ok"], row
+    assert row["ok"], row
+
+
+def test_span_lint_secagg_rules():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_span_names",
+        os.path.join(REPO, "tools", "check_span_names.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    bad = [
+        ("x.py", 1, "counter", "secagg/rounds"),            # fine
+        ("x.py", 2, "counter", "secagg/client/2/reveals"),  # labels!
+        ("x.py", 3, "gauge", "secagg/recoveries"),          # counters only
+        ("x.py", 4, "histogram", "secagg/reveal_ms"),       # counters only
+        ("x.py", 5, "span", "secagg/unmask"),               # namespace
+    ]
+    problems = lint.check(bad)
+    assert len(problems) == 4, problems

@@ -58,6 +58,10 @@ _SERVING_SHAPE = re.compile(r"^serving/[a-z0-9_]+$")
 # (frames, seq gaps, alerts, scrapes) — one signal segment; node/job/rule
 # dimensions ride labels. Metric-only: the plane never opens spans.
 _LIVE_SHAPE = re.compile(r"^live/[a-z0-9_]+$")
+# secure aggregation: secagg/* is metric-only (the masked encode/decode
+# phases ride the existing compress/* spans); one signal segment, and
+# counters only — every secagg signal is a protocol occurrence count
+_SECAGG_SHAPE = re.compile(r"^secagg/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -117,10 +121,11 @@ def check(entries):
                     f"{where}: span {name!r} must be compress/encode "
                     "or compress/decode")
         if kind == "span" and name.startswith(
-                ("mem/", "health/", "resilience/", "tier/", "live/")):
+                ("mem/", "health/", "resilience/", "tier/", "live/",
+                 "secagg/")):
             problems.append(
-                f"{where}: {name!r} — mem/, health/, resilience/, tier/ "
-                "and live/ are metric namespaces, not span names")
+                f"{where}: {name!r} — mem/, health/, resilience/, tier/, "
+                "live/ and secagg/ are metric namespaces, not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 problems.append(
@@ -175,6 +180,16 @@ def check(entries):
                 problems.append(
                     f"{where}: {kind} {name!r} must be live/<signal> "
                     "(one segment; node/job/rule dimensions ride labels)")
+        if kind != "span" and name.startswith("secagg/"):
+            if not _SECAGG_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be secagg/<signal> "
+                    "(one segment; rounds/clients/tiers ride event "
+                    "fields)")
+            elif kind != "counter":
+                problems.append(
+                    f"{where}: {kind} {name!r} — secagg/* signals are "
+                    "protocol occurrence counts; counters only")
         if kind != "span":
             prev = metric_kinds.get(name)
             if prev is not None and prev[0] != kind:

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Secure-aggregation bench: wire cost + dropout-recovery message cost.
+
+Two claims the SecAgg subsystem makes, measured and gated:
+
+1. **Wire** — a masked upload rides the int8 block domain (one
+   mask-domain word per element instead of int8 block + per-leaf f32
+   scale), so SecAgg wire bytes must stay within **1.2×** of plain int8
+   for the same tree. The 4–10× f32 penalty the old
+   documented-disabled path paid is the number this gate retires.
+2. **Recovery** — a seeded chaos kill during a masked round must close
+   via seed-reveal recovery at **≤ 1 extra message round-trip per
+   dropout** (one recover-request/reveal wave), and the run must end
+   bit-stably (`completed`).
+
+Prints ONE JSON line (same contract as the other ``tools/*_bench.py``;
+also reachable as ``python bench.py --secagg``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+WIRE_GATE = 1.2
+
+
+def bench_wire(n_params: int = 1_000_000, cohort: int = 4) -> dict:
+    """Masked vs plain-int8 wire bytes for one resnet-sized delta."""
+    import numpy as np
+
+    from fedml_tpu.compression import derive_key, get_codec
+    from fedml_tpu.compression.codecs import _tree_meta
+    from fedml_tpu.privacy import secagg
+    from fedml_tpu.privacy.secagg import masking
+    from fedml_tpu.utils.serialization import safe_dumps
+    from tools.wire_bench import make_resnet_sized_tree
+
+    import jax
+
+    tree = make_resnet_sized_tree(n_params)
+    delta = jax.tree.map(
+        lambda x: (0.01 * np.random.default_rng(0).standard_normal(
+            x.shape)).astype(np.float32), tree)
+    int8_bytes = len(safe_dumps(get_codec("int8").encode(
+        delta, key=derive_key(0, 0, 1), is_delta=True)))
+    bound = masking.client_bound(cohort)
+    codec = get_codec(f"secagg_int8@0.1/{bound}/8")
+    meta = _tree_meta(jax.tree.leaves(delta))
+    peers = {j: masking.pair_round_seed(j * 7919 + 13, 0)
+             for j in range(2, cohort + 1)}
+    net_mask = masking.net_mask_leaves(1, peers, meta)
+    ct, _ = secagg.masked_encode(
+        delta, net_mask, codec, derive_key(0, 0, 1),
+        sa={"round": 0, "rank": 1, "roster": list(range(1, cohort + 1))})
+    sa_bytes = len(safe_dumps(ct))
+    ratio = sa_bytes / float(int8_bytes)
+    return {
+        "params": int(n_params),
+        "cohort": int(cohort),
+        "int8_wire_bytes": int(int8_bytes),
+        "secagg_wire_bytes": int(sa_bytes),
+        "wire_ratio_vs_int8": round(ratio, 4),
+        "gate_wire_ok": bool(ratio <= WIRE_GATE),
+    }
+
+
+def bench_recovery(seed: int = 7, rounds: int = 5, clients: int = 3) -> dict:
+    """Chaos-killed masked round: recovery waves per dropout + closure."""
+    from fedml_tpu.resilience import run_chaos_scenario
+
+    out = run_chaos_scenario(
+        seed=seed, rounds=rounds, clients=clients,
+        kill_rank=2, kill_round=2, revive_round=3,
+        secagg="int8", round_deadline_s=30.0, round_quorum=2.0 / 3.0,
+    )
+    c = out["counters"]
+    dropouts = max(1.0, c.get("clients_evicted", 0.0))
+    waves = c.get("recoveries", 0.0)
+    # one recovery wave = one extra round-trip (recover request out,
+    # reveals back); the gate is ≤ 1 per dropout
+    rt_per_dropout = waves / dropouts
+    return {
+        "completed": bool(out["completed"]),
+        "dropouts": dropouts,
+        "recovery_waves": waves,
+        "seeds_revealed": c.get("seeds_revealed", 0.0),
+        "recovery_failures": c.get("recovery_failures", 0.0),
+        "round_trips_per_dropout": rt_per_dropout,
+        "gate_recovery_ok": bool(
+            out["completed"] and waves >= 1 and rt_per_dropout <= 1.0
+            and not c.get("recovery_failures", 0.0)),
+    }
+
+
+def run_secagg_bench(n_params: int = 1_000_000, cohort: int = 4,
+                     rounds: int = 5, seed: int = 7) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    wire = bench_wire(n_params, cohort)
+    rec = bench_recovery(seed=seed, rounds=rounds)
+    return {
+        "bench": "secagg",
+        **wire,
+        **rec,
+        "wire_gate": WIRE_GATE,
+        "ok": bool(wire["gate_wire_ok"] and rec["gate_recovery_ok"]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--params", type=int, default=1_000_000)
+    ap.add_argument("--cohort", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    row = run_secagg_bench(args.params, args.cohort, args.rounds, args.seed)
+    print(json.dumps(row))
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
