@@ -38,15 +38,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# chip peak bf16 FLOP/s by device kind (public spec sheets)
-PEAK_BF16 = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-    "TPU v6e": 918e12,
-}
+# chip peak bf16 FLOP/s by device kind — owned by the profiling layer now
+# (telemetry/profiling/roofline.py) so bench, report, doctor and the live
+# watch all read ONE table; re-exported here for external callers
+from fedml_tpu.telemetry.profiling.roofline import PEAK_BF16  # noqa: E402
 
 
 def chain_time(run_chain, n_short: int, n_long: int, trials: int = 2) -> float:
@@ -130,29 +125,25 @@ def llm_shape(hbm_bytes: float):
     return cfg, 4, 128
 
 
-def xla_cost_flops(jitted, *args):
-    """(compiled_executable, flops) via XLA's own cost model.
+def catalog_flops(name: str):
+    """XLA-cost FLOPs of a cataloged program, or None.
 
-    AOT-lowers the jitted fn ONCE and reads ``cost_analysis()["flops"]``
-    off the executable — the compiled program's true FLOP count (DCE'd
-    frozen-weight grads and all), replacing the hand-computed analytic
-    constants wherever XLA reports it. The executable is returned so the
-    measurement chain runs the SAME program (no second compile).
-    Returns ``(None, None)`` where lowering/cost analysis is unavailable
-    (older jax, pathways backends) — callers fall back to the analytic
-    model and stamp ``mfu_source: "analytic"``.
+    The per-program ``cost_analysis()`` extraction that used to live here
+    as a private ``xla_cost_flops`` helper moved into the program catalog
+    (``telemetry/profiling/catalog.py``): the hot-path programs register
+    there at first compile, the AOT executable is reused for the
+    measurement chain (no second compile), and every consumer — this
+    bench, ``telemetry report``, the doctor, ``tools/bench_compare`` —
+    reads the SAME record. None where cost analysis was unavailable on
+    this backend; callers fall back to the analytic model and stamp
+    ``mfu_source: "analytic"``.
     """
-    try:
-        compiled = jitted.lower(*args).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0] if cost else {}
-        flops = float(cost.get("flops", 0.0))
-        if flops <= 0:
-            return compiled, None
-        return compiled, flops
-    except Exception:
-        return None, None
+    from fedml_tpu.telemetry.profiling import get_catalog
+
+    for rec in get_catalog().records():
+        if rec.name == name and rec.flops > 0:
+            return rec.flops
+    return None
 
 
 def lora_flops_model(params, cfg, batch: int, seq: int):
@@ -359,6 +350,19 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--profile" in sys.argv:
+        # attribution-overhead gate: the SAME run with the program
+        # catalog on vs off (interleaved trials) plus the deterministic
+        # per-call wrapper seam — always-on profiling must cost < 1%
+        # rounds/s (tools/profile_bench.py; FEDML_PROFILE_* env knobs)
+        from tools.profile_bench import run_profile_bench
+
+        row = run_profile_bench()
+        print(json.dumps(row))
+        if not (row["completed"] and row["ok_overhead"] and row["ok_rounds"]):
+            raise SystemExit(1)
+        return
+
     if "--stage" in sys.argv:
         # staging-path micro-bench (pipelined round engine): staged
         # bytes/s, vectorized assembly ms, prefetch overlap ratio —
@@ -418,13 +422,11 @@ def main() -> None:
     # --- A. single-step throughput: tokens/sec + MFU ----------------------
     # the train step donates (params, opt_state): iterations are chained by
     # construction; the final loss readback forces the whole queue.
-    # FLOPs basis: XLA's own cost model on the compiled step where
-    # available (the AOT executable is reused for the chain — one
-    # compile), hand-computed LoRA model-flops otherwise.
-    step_compiled, step_xla_flops = xla_cost_flops(
-        trainer._train_step, trainer.params, trainer.opt_state,
-        x[None], y[None], m[None])
-    step_fn = step_compiled if step_compiled is not None else trainer._train_step
+    # FLOPs basis: XLA's own cost model via the program catalog — the
+    # wrapped step AOT-compiles ONCE at its first (throwaway) call and
+    # every later call runs that same executable, so the chain pays no
+    # second compile and the catalog record carries the analysis.
+    step_fn = trainer._train_step  # cataloged as "llm/train_step"
 
     def step_chain(n):
         t0 = time.perf_counter()
@@ -437,6 +439,7 @@ def main() -> None:
         return time.perf_counter() - t0
 
     sec_per_step = chain_time(step_chain, 2, 22, trials=3)
+    step_xla_flops = catalog_flops("llm/train_step")
     tok_per_sec = batch * seq / sec_per_step
     flops_analytic, n_params = lora_flops_model(trainer.params, cfg, batch, seq)
     flops = step_xla_flops if step_xla_flops is not None else flops_analytic
@@ -462,13 +465,11 @@ def main() -> None:
     wts = np.ones((n_clients,), np.float32)
 
     # XLA cost model of the WHOLE fused round (client-switch + local
-    # steps + FedAvg): flops_per_round comes from the compiled program,
-    # not the analytic 4N approximation; the AOT executable runs the
-    # chain so the cost analysis costs no extra compile
-    round_compiled, round_xla_flops = xla_cost_flops(
-        fed_round, trainer.params, trainer.opt_state,
-        extract_lora(trainer.params), xs, ys_r, ms_r, wts)
-    round_fn = round_compiled if round_compiled is not None else fed_round
+    # steps + FedAvg): flops_per_round comes from the catalog record of
+    # the compiled program ("llm/fused_round"), not the analytic 4N
+    # approximation; the catalog's AOT executable runs the chain so the
+    # cost analysis costs no extra compile
+    round_fn = fed_round
 
     def round_chain(n_rounds):
         t0 = time.perf_counter()
@@ -484,8 +485,34 @@ def main() -> None:
         return time.perf_counter() - t0
 
     round_sec = chain_time(round_chain, 1, 5, trials=3)
+    round_xla_flops = catalog_flops("llm/fused_round")
     rounds_per_sec_per_chip = 1.0 / round_sec / n_chips
     round_tokens = n_clients * local_steps * batch * seq
+
+    # --trace-rounds r1,r2: capture a deep device trace of N extra fused
+    # rounds AFTER the measurement (tracing inside the timed chain would
+    # perturb it) through the budgeted TraceController
+    from fedml_tpu.telemetry.profiling import parse_rounds
+
+    trace_rounds = []
+    for i, a in enumerate(sys.argv):
+        if a == "--trace-rounds" and i + 1 < len(sys.argv):
+            trace_rounds = parse_rounds(sys.argv[i + 1])
+    if trace_rounds:
+        from fedml_tpu.telemetry.profiling import get_trace_controller
+
+        tc = get_trace_controller()
+        tc.arm_rounds(trace_rounds,
+                      trace_dir=os.environ.get("FEDML_TRACE_DIR",
+                                               ".fedml_logs/bench_traces"))
+        g = jax.tree.map(jnp.copy, extract_lora(trainer.params))
+        p, o = trainer.params, trainer.opt_state
+        for r in trace_rounds:
+            tc.on_round_start(r)
+            p, o, g, loss = round_fn(p, o, g, xs, ys_r, ms_r, wts)
+            float(loss)  # drain before stop_trace so the trace sees it
+            tc.on_round_end(r)
+        trainer.params, trainer.opt_state = p, o
 
     # --- C. reference engine measured on same work -------------------------
     ref_tps, baseline_kind = bench_reference_torch(cfg)
@@ -534,6 +561,13 @@ def main() -> None:
         "baseline_kind": baseline_kind,
         "timing": "chained-dependency, long-minus-short readback (tunnel-safe)",
     }
+    # per-program catalog summary (name → flops/bytes/peak-HBM/compile):
+    # tools/bench_compare.py diffs these across BENCH files so an MFU or
+    # HBM regression is attributed to a PROGRAM, not just whole-run
+    # rounds/s
+    from fedml_tpu.telemetry.profiling import get_catalog
+
+    extra["programs"] = get_catalog().programs_summary()
     if flash:
         extra.update(flash)
 

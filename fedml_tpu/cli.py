@@ -437,9 +437,13 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
               help="transport the ServingPublisher speaks")
 @click.option("--broker", default="127.0.0.1:1883", show_default=True,
               help="host:port of the federation broker (BROKER backend)")
+@click.option("--trace-rounds", default="", show_default=True,
+              help="comma-separated federation round indices whose hot-"
+                   "swap windows to deep-trace (with --live)")
 def serve(model_size: str, host: str, port: int, batch_slots: int,
           max_len: int, lora_rank: int, quantize, hf_checkpoint,
-          checkpoint, live_run_id, live_backend: str, broker: str) -> None:
+          checkpoint, live_run_id, live_backend: str, broker: str,
+          trace_rounds: str) -> None:
     """Boot a continuous-batching LLM inference endpoint (blocking)."""
     import jax
     import jax.numpy as jnp
@@ -495,6 +499,15 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
         openai=OpenAIServing(engine, model_name=model_size),
     )
     engine.model_slots.monitor = runner.monitor
+    from fedml_tpu.telemetry.profiling import (
+        get_trace_controller,
+        parse_rounds,
+    )
+
+    armed = parse_rounds(trace_rounds)
+    if armed:
+        get_trace_controller().arm_rounds(armed)
+        click.echo(f"deep trace armed for swap round(s) {armed}")
     if live_run_id:
         from fedml_tpu.serving.live import FederatedServingBridge
 
@@ -592,9 +605,13 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
 @click.option("--metrics-port", default=None, type=int,
               help="host a live /metrics + /healthz scrape endpoint and "
                    "the online doctor for this tree run (0 = ephemeral)")
+@click.option("--trace-rounds", default="", show_default=True,
+              help="comma-separated round indices to capture a deep "
+                   "device trace of (budgeted TraceController)")
 def tree(clients: int, tiers: int, rounds: int, params: int, codec: str,
          seed: int, quorum: float, kill_tier, kill_node: int,
-         kill_round: int, revive_round, metrics_port) -> None:
+         kill_round: int, revive_round, metrics_port,
+         trace_rounds: str) -> None:
     """Run a seeded hierarchical (aggregation-tree) federation scenario.
 
     Simulates an N-tier tree in-process: virtual leaf clients upload
@@ -614,6 +631,14 @@ def tree(clients: int, tiers: int, rounds: int, params: int, codec: str,
     if kill_tier is not None:
         chaos.append(KillWindow(kill_tier, kill_node, kill_round,
                                 until=revive_round))
+    from fedml_tpu.telemetry.profiling import (
+        get_trace_controller,
+        parse_rounds,
+    )
+
+    armed = parse_rounds(trace_rounds)
+    if armed:
+        get_trace_controller().arm_rounds(armed)
     live = None
     if metrics_port is not None:
         from fedml_tpu.telemetry.live import LivePlane
@@ -743,6 +768,37 @@ def telemetry_prometheus() -> None:
     from fedml_tpu.telemetry import get_registry
 
     click.echo(get_registry().export_prometheus())
+
+
+@telemetry.command("profile",
+                   context_settings={"ignore_unknown_options": True})
+@click.option("--rounds", "trace_rounds", default="0", show_default=True,
+              help="comma-separated round indices to deep-trace")
+@click.option("--trace-dir", default=".fedml_logs/traces",
+              show_default=True)
+@click.argument("cmd", nargs=-1, type=click.UNPROCESSED, required=True)
+def telemetry_profile(trace_rounds: str, trace_dir: str, cmd) -> None:
+    """Run CMD with deep device-trace capture armed.
+
+    The explicit arm of the budgeted TraceController: CMD (e.g.
+    ``python bench.py`` or ``python -m fedml_tpu.cli tree ...``) runs
+    with ``FEDML_TRACE_ROUNDS``/``FEDML_TRACE_DIR`` set, and every
+    engine's round loop captures a ``jax.profiler`` trace of exactly the
+    armed rounds into TRACE_DIR (TensorBoard-loadable), landing a
+    ``profile_capture`` marker in the run's flight recorder and
+    telemetry.jsonl.
+    """
+    import os
+    import subprocess
+
+    env = {**os.environ, "FEDML_TRACE_ROUNDS": trace_rounds,
+           "FEDML_TRACE_DIR": trace_dir}
+    rc = subprocess.call(list(cmd), env=env)
+    if os.path.isdir(trace_dir):
+        for name in sorted(os.listdir(trace_dir)):
+            click.echo(f"trace: {os.path.join(trace_dir, name)}", err=True)
+    if rc:
+        raise SystemExit(rc)
 
 
 @cli.group()

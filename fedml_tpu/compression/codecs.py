@@ -249,11 +249,21 @@ def _tree_meta(leaves) -> Tuple[LeafMeta, ...]:
     return tuple(out)
 
 
-# Whole-tree programs, jitted once per (codec instance, meta, structure).
-# Codec instances are cached by get_codec, so jit's weakref cache holds.
+# Whole-tree programs, jitted once per (codec instance, meta, structure)
+# and registered in the program catalog (telemetry.profiling) so their
+# XLA flops/bytes/HBM feed the attribution layer. Codec instances are
+# cached by get_codec, so jit's weakref cache holds; distinct trees are
+# legitimate variants (multi_shape), not treedef churn.
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _encode_program(codec: Codec, meta, leaves, key):
     return tuple(tuple(p) for p in codec._encode_leaves(leaves, meta, key))
+
+
+_encode_program = _wrap_jit("compress/encode", _encode_program,
+                            static_argnums=(0, 1), multi_shape=True)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -270,9 +280,17 @@ def _ef_encode_program(codec: Codec, meta, leaves, res_leaves, key):
     return tuple(tuple(p) for p in enc), new_res
 
 
+_ef_encode_program = _wrap_jit("compress/ef_encode", _ef_encode_program,
+                               static_argnums=(0, 1), multi_shape=True)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _decode_program(codec: Codec, meta, arrays):
     return tuple(codec._decode_leaves(arrays, meta))
+
+
+_decode_program = _wrap_jit("compress/decode", _decode_program,
+                            static_argnums=(0, 1), multi_shape=True)
 
 
 def _raw_weighted_sum(leaf_stacked, w):
@@ -290,6 +308,11 @@ def _fused_weighted_sum_program(codec: Codec, meta, stacked, w):
         if _is_float_meta(dt) else _raw_weighted_sum(parts[0], w)
         for parts, (dt, sh) in zip(stacked, meta)
     )
+
+
+_fused_weighted_sum_program = _wrap_jit(
+    "compress/fused_weighted_sum", _fused_weighted_sum_program,
+    static_argnums=(0, 1), multi_shape=True)
 
 
 def tree_delta(new: Pytree, ref: Pytree) -> Pytree:

@@ -80,15 +80,21 @@ class MLOpsProfilerEvent:
     def flush(self) -> Optional[str]:
         return self._tracer.flush()
 
-    # jax profiler passthrough for deep TPU traces
-    def start_trace(self):
-        if self._jax_trace_dir:
-            import jax
+    # deep-trace facade: the old direct jax.profiler passthrough (wired
+    # to nothing, fighting any other trace owner for the profiler
+    # singleton) is retired — manual traces now go through the ONE
+    # budgeted TraceController the profile CLI and the online doctor's
+    # auto-captures also use
+    def start_trace(self) -> bool:
+        if not self._jax_trace_dir:
+            return False
+        from fedml_tpu.telemetry.profiling import get_trace_controller
 
-            jax.profiler.start_trace(self._jax_trace_dir)
+        return get_trace_controller().start_manual(self._jax_trace_dir)
 
     def stop_trace(self):
-        if self._jax_trace_dir:
-            import jax
+        if not self._jax_trace_dir:
+            return None
+        from fedml_tpu.telemetry.profiling import get_trace_controller
 
-            jax.profiler.stop_trace()
+        return get_trace_controller().stop_manual()

@@ -220,6 +220,15 @@ class FedMLServerManager(FedMLCommManager):
     def send_init_msg(self) -> None:
         from fedml_tpu import telemetry
 
+        # the first round opens HERE, not in _complete_round — without
+        # this hook round 0 (the resumed start round) could never be
+        # deep-traced on the cross-silo path
+        try:
+            from fedml_tpu.telemetry.profiling import get_trace_controller
+
+            get_trace_controller().on_round_start(self.args.round_idx)
+        except Exception:  # profiling must never break the round
+            logger.exception("trace controller start hook failed")
         global_params = self.aggregator.get_global_model_params()
         payload = self._broadcast_payload(global_params)
         sa_header = self._secagg_round_header()
@@ -709,6 +718,20 @@ class FedMLServerManager(FedMLCommManager):
             except Exception:  # observability must never break the round
                 logger.exception("live telemetry pump failed at round %d",
                                  self.args.round_idx)
+        # deep-trace round boundary: close the capture that bracketed the
+        # round that just aggregated, then — if the online doctor's pump
+        # above just requested one — start a bounded capture covering the
+        # NEXT round on this (the implicated, in-proc) node
+        try:
+            from fedml_tpu.telemetry.profiling import get_trace_controller
+
+            tc = get_trace_controller()
+            tc.on_round_end(self.args.round_idx)
+            if self.args.round_idx + 1 < self.round_num:
+                tc.on_round_start(self.args.round_idx + 1)
+        except Exception:  # profiling must never break the round
+            logger.exception("trace controller round hook failed at "
+                             "round %d", self.args.round_idx)
         self._notify_round_listeners(self.args.round_idx, global_params)
         with tracer.span(f"round/{self.args.round_idx}/eval"):
             metrics = self.aggregator.test_on_server_for_all_clients(
@@ -882,4 +905,19 @@ class FedMLServerManager(FedMLCommManager):
             # final full loopback frame: the collector's merged totals
             # become exactly the post-hoc registry snapshot
             self._live.close()
+        try:
+            from fedml_tpu import telemetry
+            from fedml_tpu.telemetry.profiling import (
+                get_catalog,
+                get_trace_controller,
+            )
+
+            get_trace_controller().finish()  # never leave a trace recording
+            tracer = telemetry.get_tracer()
+            if tracer.sink_dir is not None:
+                # land programs.jsonl for cross-silo runs without relying
+                # on the caller to flush_run() (sp/mesh do it in train())
+                get_catalog().flush_jsonl(tracer.sink_dir)
+        except Exception:  # observability must never break shutdown
+            logger.exception("program-catalog flush failed at finish")
         super().finish()

@@ -232,6 +232,15 @@ def _leaf_chunk_program(codec: Codec, meta, delta_fn: DeltaFn, ef: bool,
     return summed, new_res
 
 
+# cataloged: the hierarchy tier's hot program — one variant per
+# power-of-2 chunk bucket is the design, not treedef churn
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
+
+_leaf_chunk_program = _wrap_jit(
+    "hierarchy/leaf_chunk", _leaf_chunk_program,
+    static_argnums=(0, 1, 2, 3), multi_shape=True)
+
+
 class LeafCohort:
     """One edge's virtual leaf clients, reduced in fixed-size chunks.
 

@@ -370,8 +370,56 @@ class TreeRunner:
             reg.gauge(f"tier/{d}/nodes").set(topo.levels[d])
         self._tier_peak_buffer: Dict[int, int] = {}
         peak_round_bytes: Dict[int, int] = {}
+        from fedml_tpu.telemetry.profiling import get_trace_controller
+
         t0 = time.perf_counter()
+        try:
+            self._run_rounds(rounds, reg, L, peak_round_bytes)
+        finally:
+            # a quorum abort mid-round must not leave a trace recording
+            get_trace_controller().finish()
+        wall = time.perf_counter() - t0
+        for d, v in self._tier_peak_buffer.items():
+            reg.gauge(f"tier/{d}/peak_buffer_bytes").set(v)
+
+        digest = hashlib.blake2b(digest_size=16)
+        for x in self.global_leaves:
+            digest.update(np.ascontiguousarray(x).tobytes())
+        per_tier = {}
+        for d in range(L + 1):
+            per_tier[str(d)] = {
+                "nodes": topo.levels[d],
+                "peak_round_upload_bytes": peak_round_bytes.get(d, 0),
+                "peak_buffer_bytes": self._tier_peak_buffer.get(d, 0),
+            }
+        self.stats = {
+            "clients": topo.n_clients,
+            "tiers": topo.n_tiers,
+            "levels": list(topo.levels),
+            "rounds": int(rounds),
+            "codec": self.codec.spec,
+            "secagg": self.secagg,
+            "seed": self.seed,
+            "quorum": self.quorum,
+            "wall_s": wall,
+            "rounds_per_s": (rounds / wall) if wall > 0 else 0.0,
+            "per_client_wire_bytes": self.per_client_wire_nbytes,
+            "f32_tree_nbytes": self._f32_tree_nbytes,
+            "per_tier": per_tier,
+            "final_digest": digest.hexdigest(),
+            "completed": True,
+        }
+        return self.stats
+
+    def _run_rounds(self, rounds: int, reg, L: int,
+                    peak_round_bytes: Dict[int, int]) -> None:
+        from fedml_tpu.telemetry.profiling import get_trace_controller
+
+        topo = self.topology
         for r in range(int(rounds)):
+            # deep-trace seam: --trace-rounds or a doctor-requested
+            # capture brackets exactly one tree round
+            get_trace_controller().on_round_start(r)
             self._tier_round_bytes: Dict[int, int] = {}
             self._root_close = None
             partials = self._leaf_round(r, reg)
@@ -410,38 +458,7 @@ class TreeRunner:
                                      "round %d", r)
             for d, b in self._tier_round_bytes.items():
                 peak_round_bytes[d] = max(peak_round_bytes.get(d, 0), b)
-        wall = time.perf_counter() - t0
-        for d, v in self._tier_peak_buffer.items():
-            reg.gauge(f"tier/{d}/peak_buffer_bytes").set(v)
-
-        digest = hashlib.blake2b(digest_size=16)
-        for x in self.global_leaves:
-            digest.update(np.ascontiguousarray(x).tobytes())
-        per_tier = {}
-        for d in range(L + 1):
-            per_tier[str(d)] = {
-                "nodes": topo.levels[d],
-                "peak_round_upload_bytes": peak_round_bytes.get(d, 0),
-                "peak_buffer_bytes": self._tier_peak_buffer.get(d, 0),
-            }
-        self.stats = {
-            "clients": topo.n_clients,
-            "tiers": topo.n_tiers,
-            "levels": list(topo.levels),
-            "rounds": int(rounds),
-            "codec": self.codec.spec,
-            "secagg": self.secagg,
-            "seed": self.seed,
-            "quorum": self.quorum,
-            "wall_s": wall,
-            "rounds_per_s": (rounds / wall) if wall > 0 else 0.0,
-            "per_client_wire_bytes": self.per_client_wire_nbytes,
-            "f32_tree_nbytes": self._f32_tree_nbytes,
-            "per_tier": per_tier,
-            "final_digest": digest.hexdigest(),
-            "completed": True,
-        }
-        return self.stats
+            get_trace_controller().on_round_end(r)
 
     @property
     def global_params(self) -> Pytree:

@@ -85,8 +85,15 @@ def build_local_trainer(
     args: Any,
     loss_builder: Callable = softmax_ce_loss,
 ) -> Callable:
-    """Compile the full local-training program for one client shape."""
-    return jax.jit(build_local_fn(apply_fn, args, loss_builder))
+    """Compile the full local-training program for one client shape.
+
+    Registered in the program catalog as ``sp/local_train`` — the sp
+    backend's hot-path program — so its XLA flops/bytes/peak-HBM and
+    recompile count feed the attribution layer."""
+    from fedml_tpu.telemetry.profiling import wrap_jit
+
+    return wrap_jit("sp/local_train",
+                    jax.jit(build_local_fn(apply_fn, args, loss_builder)))
 
 
 def build_local_fn(
@@ -269,7 +276,10 @@ def init_local_state(params: Pytree, args: Any) -> LocalState:
 
 
 def build_evaluator(apply_fn: Callable) -> Callable:
-    """Compiled full-batch evaluation: returns (loss_sum, correct, count)."""
+    """Compiled full-batch evaluation: returns (loss_sum, correct, count).
+
+    Cataloged as ``sp/evaluate`` (multi-shape: each test-set shape is a
+    legitimate variant, not treedef churn)."""
 
     @jax.jit
     def evaluate(params, x, y):
@@ -284,4 +294,6 @@ def build_evaluator(apply_fn: Callable) -> Callable:
             pred_ok = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
         return jnp.sum(ce), jnp.sum(pred_ok), jnp.asarray(y.shape[0], jnp.float32)
 
-    return evaluate
+    from fedml_tpu.telemetry.profiling import wrap_jit
+
+    return wrap_jit("sp/evaluate", evaluate, multi_shape=True)

@@ -161,6 +161,9 @@ def _check_float_meta(meta) -> None:
             "the wire unmasked")
 
 
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _masked_encode_program(clip: float, bound: int, mod_bits: int, meta,
                            leaves, res_leaves, mask_leaves, key):
@@ -182,6 +185,11 @@ def _masked_encode_program(clip: float, bound: int, mod_bits: int, meta,
         # (clip error + quantization error), re-sent next round
         new_res.append(comp - q.astype(jnp.float32) * scale)
     return tuple(masked), tuple(new_res)
+
+
+_masked_encode_program = _wrap_jit(
+    "secagg/masked_encode", _masked_encode_program,
+    static_argnums=(0, 1, 2, 3), multi_shape=True)
 
 
 def masked_encode(delta: Pytree, net_mask: Sequence[np.ndarray],
@@ -255,6 +263,11 @@ def _unmask_program(clip: float, bound: int, mod_bits: int, meta,
     _FINALIZE_TRACE["pre_noise_traced"] = bool(pre_noise_traced)
     _FINALIZE_TRACE["noised_in_program"] = bool(with_noise)
     return tuple(out)
+
+
+_unmask_program = _wrap_jit(
+    "secagg/unmask_finalize", _unmask_program,
+    static_argnums=(0, 1, 2, 3, 4), multi_shape=True)
 
 
 def unmask_finalize(cts: Sequence[CompressedTree], base: Pytree,

@@ -70,6 +70,13 @@ def _secagg_leaf_chunk_program(meta, delta_fn, clip: float, bound: int,
         for y in ys)
 
 
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
+
+_secagg_leaf_chunk_program = _wrap_jit(
+    "secagg/leaf_chunk", _secagg_leaf_chunk_program,
+    static_argnums=(0, 1, 2, 3, 4), multi_shape=True)
+
+
 class SecAggLeafCohort(LeafCohort):
     """A leaf cohort whose edge only ever sees the masked sum.
 

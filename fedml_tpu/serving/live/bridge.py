@@ -269,6 +269,22 @@ class FederatedServingBridge(FedMLCommManager):
         if self.round_published is None or round_idx > self.round_published:
             self.round_published = round_idx
             self._g_published.set(float(round_idx))
+        # serve --trace-rounds seam: an armed round captures its swap
+        # window (staging + decode + flip) through the one TraceController
+        from fedml_tpu.telemetry.profiling import get_trace_controller
+
+        tc = get_trace_controller()
+        tracing = tc.on_round_start(round_idx)
+        try:
+            swapped = self._apply_swap(round_idx, payload, spec)
+        finally:
+            if tracing:
+                tc.on_round_end(round_idx)
+        if swapped:
+            logger.info("endpoint hot-swapped to round %d%s", round_idx,
+                        f" ({spec})" if spec else "")
+
+    def _apply_swap(self, round_idx: int, payload, spec) -> bool:
         try:
             swapped = self.slots.publish_payload(payload, round_idx, spec)
         except Exception:
@@ -288,10 +304,8 @@ class FederatedServingBridge(FedMLCommManager):
                 self._failed_rounds = {
                     r for r in self._failed_rounds if r > round_idx - 128}
                 self.request_resync()
-            return
-        if swapped:
-            logger.info("endpoint hot-swapped to round %d%s", round_idx,
-                        f" ({spec})" if spec else "")
+            return False
+        return swapped
 
 
 def attach_round_publisher(server_manager: Any,

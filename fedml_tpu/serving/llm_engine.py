@@ -215,9 +215,20 @@ class ContinuousBatchingEngine:
             return caches, logits, jnp.argmax(logits, axis=-1).astype(
                 jnp.int32)
 
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
-        self._decode_group = jax.jit(decode_group_fn, donate_argnums=(1,))
+        # cataloged (telemetry.profiling): the serving hot programs —
+        # decode_group compiles one variant per group size by design
+        # (warm_swap_paths pre-builds them all), hence multi_shape
+        from fedml_tpu.telemetry.profiling import wrap_jit
+
+        self._prefill = wrap_jit(
+            "serve/prefill", jax.jit(prefill_fn, donate_argnums=(1,)),
+            multi_shape=True)
+        self._decode = wrap_jit(
+            "serve/decode", jax.jit(decode_fn, donate_argnums=(1,)))
+        self._decode_group = wrap_jit(
+            "serve/decode_group",
+            jax.jit(decode_group_fn, donate_argnums=(1,)),
+            multi_shape=True)
 
     @property
     def params(self) -> Pytree:

@@ -258,7 +258,12 @@ class MeshFedAvgAPI:
                       P("clients"), P("clients"), P(), P("clients")),
             out_specs=(out_model_spec, P(), P()),
         )
-        self._round_fn = jax.jit(shard)
+        # cataloged as the mesh backend's ONE hot program: the whole round
+        # (N clients' local SGD + wire-sim + FedAvg psum) — the program
+        # the multichip plan sizes its sharding against
+        from fedml_tpu.telemetry.profiling import wrap_jit
+
+        self._round_fn = wrap_jit("mesh/fused_round", jax.jit(shard))
         self._local_state = init_local_state(self.global_params, args)
         self.test_history: List[dict] = []
 
@@ -452,6 +457,15 @@ class MeshFedAvgAPI:
 
     # -- round loop -------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:
+        from fedml_tpu.telemetry.profiling import get_trace_controller
+
+        get_trace_controller().on_round_start(round_idx)
+        try:
+            return self._train_one_round(round_idx)
+        finally:
+            get_trace_controller().on_round_end(round_idx)
+
+    def _train_one_round(self, round_idx: int) -> dict:
         from fedml_tpu.core.alg_frame.params import Context
 
         self.event.log_event_started("stage", round_idx)

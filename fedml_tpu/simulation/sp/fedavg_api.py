@@ -209,6 +209,17 @@ class FedAvgAPI:
 
     # -- round ------------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:
+        # deep-trace seam: an armed capture (explicit --trace-rounds or an
+        # online-doctor alert requesting one) brackets exactly this round
+        from fedml_tpu.telemetry.profiling import get_trace_controller
+
+        get_trace_controller().on_round_start(round_idx)
+        try:
+            return self._train_one_round(round_idx)
+        finally:
+            get_trace_controller().on_round_end(round_idx)
+
+    def _train_one_round(self, round_idx: int) -> dict:
         with self.tracer.span(f"round/{round_idx}/sample"):
             client_ids = self._client_sampling(round_idx)
         ctx = Context()

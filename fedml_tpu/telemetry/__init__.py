@@ -72,6 +72,15 @@ from fedml_tpu.telemetry.live import (  # noqa: E402 - after flight_recorder
     OnlineDoctor,
     reset_live_plane,
 )
+from fedml_tpu.telemetry.profiling import (  # noqa: E402 - after spans
+    ProgramCatalog,
+    TraceController,
+    get_catalog,
+    get_trace_controller,
+    reset_catalog,
+    reset_trace_controller,
+    wrap_jit,
+)
 
 __all__ = [
     "BYTES_BUCKETS",
@@ -121,4 +130,11 @@ __all__ = [
     "MetricsScrapeServer",
     "OnlineDoctor",
     "reset_live_plane",
+    "ProgramCatalog",
+    "TraceController",
+    "get_catalog",
+    "get_trace_controller",
+    "reset_catalog",
+    "reset_trace_controller",
+    "wrap_jit",
 ]

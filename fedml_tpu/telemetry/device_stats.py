@@ -178,6 +178,12 @@ class DeviceStatsSampler:
 
         log_health_event(event)
         flight_recorder.record(**event)
+        # phase samples double as the profile/* refresh tick: the program
+        # catalog's live MFU/roofline gauges update on the same cadence
+        # the mem/* gauges do, so the live plane streams both together
+        from fedml_tpu.telemetry.profiling import pump_profile_gauges
+
+        pump_profile_gauges()
         return snap
 
 

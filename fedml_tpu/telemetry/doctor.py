@@ -55,7 +55,8 @@ def _fmt_bytes(b: float) -> str:
 def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
                  anomaly_threshold: float = 4.0,
                  mem_growth_threshold: float = 1.5,
-                 min_rounds: int = 3) -> Dict:
+                 min_rounds: int = 3,
+                 recompile_threshold: int = 3) -> Dict:
     notes: Dict[str, str] = {}
     verdict: List[str] = []
 
@@ -525,6 +526,77 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "secagg", "no data: no secagg/* metrics or secagg_event "
             "records (secure aggregation was off)")
 
+    # -- performance attribution (program catalog + roofline) -------------
+    # three verdicts the multichip plan and perf triage read directly:
+    # the top peak-HBM consumer (ROADMAP item 1's direct input), treedef
+    # churn (a program recompiling N times), and a phase whose achieved
+    # bandwidth collapsed against its own per-round history
+    attribution = report.get("attribution") or {}
+    profile: Dict[str, Any] = {}
+    if attribution.get("programs"):
+        top = attribution.get("top_hbm_program")
+        mem_limit = 0.0
+        for key, v in (report.get("mem_gauges") or {}).items():
+            if key.split("{")[0] == "mem/bytes_limit":
+                mem_limit = max(mem_limit, float(v or 0.0))
+        profile = {
+            "programs": attribution["programs"],
+            "top_hbm_program": top,
+            "device_kind": attribution.get("device_kind"),
+            "hbm_limit_bytes": mem_limit or None,
+            "captures": [rec for rec in metric_records
+                         if rec.get("kind") == "profile_capture"],
+        }
+        if top:
+            headroom = ""
+            if mem_limit > 0:
+                headroom = (f"; {_fmt_bytes(mem_limit - top['peak_hbm_bytes'])}"
+                            " HBM headroom left on this device")
+            verdict.append(
+                f"top HBM-headroom consumer: program {top['name']!r} holds "
+                f"{_fmt_bytes(top['peak_hbm_bytes'])} live at peak "
+                f"({top.get('roofline_class') or 'class unknown'})"
+                + headroom + " — the program multichip sharding must split")
+        for prog in attribution["programs"]:
+            if prog.get("multi_shape"):
+                continue  # per-shape variants are that program's design
+            if prog.get("recompiles", 0) >= recompile_threshold:
+                verdict.append(
+                    f"program {prog['name']!r} recompiled "
+                    f"{prog['recompiles']} time(s) — input treedef/shape "
+                    "churn; pin the input signature or mark the site "
+                    "multi_shape")
+        # bandwidth collapse vs own history: an attributed phase whose
+        # last-round wall blew past its own median moved the same bytes
+        # at a fraction of the bandwidth
+        attr_phases = {p["phase"]: p for p in attribution.get("phases") or []
+                       if p.get("bytes_accessed") and p.get("wall_ms")}
+        for phase, p in sorted(attr_phases.items()):
+            walls = [(r["round"], r["phases"].get(phase))
+                     for r in report.get("rounds") or []]
+            walls = [(n, w) for n, w in walls if w]
+            if len(walls) < 4:
+                continue
+            med = _median([w for _, w in walls[:-1]])
+            last_round, last_wall = walls[-1]
+            if med > 0 and last_wall > 2.0 * med:
+                per_round_bytes = p["bytes_accessed"] / len(walls)
+                verdict.append(
+                    f"phase {phase!r} bandwidth collapsed at round "
+                    f"{last_round}: {per_round_bytes / (last_wall / 1e3) / 1e9:.2f}"
+                    f" GB/s vs {per_round_bytes / (med / 1e3) / 1e9:.2f} GB/s "
+                    "over its own history — host interference or device "
+                    "contention on that round")
+        for cap in profile["captures"]:
+            verdict.append(
+                f"deep trace captured at round {cap.get('round')} "
+                f"(trigger: {cap.get('rule')}) -> {cap.get('trace_dir')}")
+    else:
+        notes.setdefault(
+            "profile",
+            "no data: programs.jsonl missing (run predates the program "
+            "catalog, or profiling was disabled)")
+
     # -- live plane (online-doctor alerts + stream accounting) ------------
     # doctor_alert records are appended to telemetry.jsonl BY the online
     # doctor at the round a rule trips; surfacing them here proves the
@@ -582,6 +654,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "connectivity": connectivity,
         "tiers": tiers,
         "secagg": secagg,
+        "profile": profile,
         "live": live,
         "verdict": verdict,
     }
@@ -733,6 +806,29 @@ def format_doctor(d: Dict) -> str:
                 + (f" (SLO {slo:.0f} ms)" if slo else ""))
     else:
         add(f"  {notes.get('serving', 'no data')}")
+
+    add("")
+    add("performance attribution (program catalog / roofline):")
+    profile = d.get("profile") or {}
+    if profile.get("programs"):
+        top = profile.get("top_hbm_program")
+        if top:
+            add(f"  top HBM consumer: {top['name']} "
+                f"({_fmt_bytes(top['peak_hbm_bytes'])} live at peak, "
+                f"{top.get('roofline_class') or 'class unknown'})")
+        for p in profile["programs"][:8]:
+            ai = p.get("arithmetic_intensity")
+            add(f"  {p['name']:<30s} calls {p['calls']:>6d}  "
+                f"AI {'-' if ai is None else format(ai, '.1f'):>7s}  "
+                f"{p.get('roofline_class') or '-':<14s} "
+                f"peak {_fmt_bytes(p['peak_hbm_bytes'])}  "
+                f"recompiles {p['recompiles']}")
+        for cap in profile.get("captures", [])[-4:]:
+            add(f"  capture: round {cap.get('round')} "
+                f"[{cap.get('rule')}] {cap.get('trace_dir')} "
+                f"({cap.get('trace_bytes', 0)} B)")
+    else:
+        add(f"  {notes.get('profile', 'no data')}")
 
     add("")
     add("live plane (online doctor / metric stream):")

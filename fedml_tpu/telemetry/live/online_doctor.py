@@ -102,6 +102,19 @@ class OnlineDoctor:
         get_registry().counter("live/alerts", labels={"rule": rule}).inc()
         flight_recorder.record("doctor_alert", rule=rule, node=node,
                                round=round_idx, verdict=verdict)
+        # alert-triggered deep trace: straggler / memory-slope / serving-
+        # stall alerts request ONE bounded capture for the next round on
+        # the implicated (in-process) node — the TraceController dedupes
+        # per rule per run and enforces the count/byte budget, so a
+        # second alert on the same rule never re-captures
+        from fedml_tpu.telemetry.profiling import (
+            AUTO_CAPTURE_RULES,
+            get_trace_controller,
+        )
+
+        if rule in AUTO_CAPTURE_RULES:
+            get_trace_controller().request_capture(
+                rule=rule, reason=verdict, node=node, round_idx=round_idx)
         run_dir = self.run_dir
         if run_dir is None:
             from fedml_tpu.telemetry.spans import get_tracer

@@ -86,7 +86,8 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
         row = by_node.setdefault(node, {
             "node": node, "round": None, "clients": None,
             "straggler": None, "straggler_client": None,
-            "mem_bytes": None, "wire_bytes": 0.0, "serving_round": None})
+            "mem_bytes": None, "wire_bytes": 0.0, "serving_round": None,
+            "mfu": None, "hbm_bound": None})
         name = rec.get("name", "")
         val = float(rec.get("value", rec.get("count", 0)) or 0)
         if name == "health/rounds_scored" and val:
@@ -103,12 +104,18 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
             row["wire_bytes"] += val
         elif name == "serving/round_current":
             row["serving_round"] = int(val)
+        elif name == "profile/mfu":
+            # streamed by the program catalog's gauge pump: achieved
+            # FLOP/s over the device peak, refreshed each phase sample
+            row["mfu"] = val
+        elif name == "profile/hbm_bound":
+            row["hbm_bound"] = bool(val)
     detail = state.get("nodes_detail") or {}
     for node, d in detail.items():
         row = by_node.setdefault(node, {
             "node": node, "round": None, "clients": None, "straggler": None,
             "straggler_client": None, "mem_bytes": None, "wire_bytes": 0.0,
-            "serving_round": None})
+            "serving_round": None, "mfu": None, "hbm_bound": None})
         row["seq"] = d.get("seq")
         row["seq_gaps"] = d.get("seq_gaps", 0)
     return [by_node[n] for n in sorted(by_node)]
@@ -127,18 +134,25 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
     add(head)
     add("")
     add(f"  {'node':<14s}{'round':>6s}{'clients':>8s}{'straggler':>12s}"
-        f"{'mem':>10s}{'wire':>10s}{'serving':>8s}{'gaps':>6s}")
+        f"{'mem':>10s}{'wire':>10s}{'mfu':>7s}{'roofline':>10s}"
+        f"{'serving':>8s}{'gaps':>6s}")
     for row in _node_rows(state):
         strag = ("-" if row.get("straggler") is None else
                  f"{row['straggler']:.1f}x"
                  + (f"@{row['straggler_client']}"
                     if row.get("straggler_client") else ""))
+        mfu = ("-" if row.get("mfu") is None
+               else f"{row['mfu']:.2f}")
+        roofline = ("-" if row.get("hbm_bound") is None
+                    else ("HBM" if row["hbm_bound"] else "compute"))
         add(f"  {row['node']:<14s}"
             f"{row['round'] if row['round'] is not None else '-':>6}"
             f"{row['clients'] if row['clients'] is not None else '-':>8}"
             f"{strag:>12s}"
             f"{_fmt_bytes(row.get('mem_bytes')):>10s}"
             f"{_fmt_bytes(row.get('wire_bytes')):>10s}"
+            f"{mfu:>7s}"
+            f"{roofline:>10s}"
             f"{row['serving_round'] if row['serving_round'] is not None else '-':>8}"
             f"{row.get('seq_gaps', 0):>6}")
     alerts = state.get("alerts") or []

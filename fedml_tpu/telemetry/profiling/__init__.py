@@ -1,0 +1,68 @@
+"""Performance attribution layer — program catalog, roofline, deep traces.
+
+Three parts (see ``docs/performance.md`` §attribution):
+
+- :mod:`.catalog` — a process-wide registry where every hot-path jitted
+  program registers under a stable name at first compile, recording XLA
+  ``cost_analysis()`` flops/bytes, ``memory_analysis()`` HBM footprint,
+  compile wall time, input treedef, and recompile count; persisted as
+  ``programs.jsonl`` per run and streamed as ``profile/*`` instruments;
+- :mod:`.roofline` — device peak tables + arithmetic-intensity
+  classification (compute- vs HBM-bound) and the report's per-phase
+  attribution join (achieved FLOP/s, bytes/s, per-round MFU
+  decomposition);
+- :mod:`.trace` — the budgeted :class:`TraceController` wrapping
+  ``jax.profiler`` with explicit, manual, and alert-triggered capture
+  arms (one trace owner per process).
+"""
+from fedml_tpu.telemetry.profiling.catalog import (
+    CatalogedProgram,
+    ProgramCatalog,
+    ProgramRecord,
+    get_catalog,
+    pump_profile_gauges,
+    reset_catalog,
+    wrap_jit,
+)
+from fedml_tpu.telemetry.profiling.roofline import (
+    DEFAULT_RIDGE,
+    PEAK_BF16,
+    PEAK_FLOPS,
+    PEAK_HBM_BW,
+    arithmetic_intensity,
+    build_attribution,
+    classify,
+    device_peaks,
+    ridge_point,
+)
+from fedml_tpu.telemetry.profiling.trace import (
+    AUTO_CAPTURE_RULES,
+    TraceController,
+    get_trace_controller,
+    parse_rounds,
+    reset_trace_controller,
+)
+
+__all__ = [
+    "AUTO_CAPTURE_RULES",
+    "CatalogedProgram",
+    "DEFAULT_RIDGE",
+    "PEAK_BF16",
+    "PEAK_FLOPS",
+    "PEAK_HBM_BW",
+    "ProgramCatalog",
+    "ProgramRecord",
+    "TraceController",
+    "arithmetic_intensity",
+    "build_attribution",
+    "classify",
+    "device_peaks",
+    "get_catalog",
+    "get_trace_controller",
+    "parse_rounds",
+    "pump_profile_gauges",
+    "reset_catalog",
+    "reset_trace_controller",
+    "ridge_point",
+    "wrap_jit",
+]

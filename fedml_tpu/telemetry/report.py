@@ -74,6 +74,13 @@ def load_metrics(run_dir: str) -> List[Dict]:
     return _load_jsonl(os.path.join(run_dir, "telemetry.jsonl"))
 
 
+def load_programs(run_dir: str) -> List[Dict]:
+    """``programs.jsonl`` — the per-run program-catalog snapshot (one
+    line per named XLA program; the file is rewritten whole at flush, so
+    every line is current)."""
+    return _load_jsonl(os.path.join(run_dir, "programs.jsonl"))
+
+
 def build_report(run_dir: str) -> Dict:
     spans = load_spans(run_dir)
     metrics = load_metrics(run_dir)
@@ -279,6 +286,27 @@ def build_report(run_dir: str) -> Dict:
             else:
                 services[key] = rec.get("value", 0.0)
 
+    # -- performance attribution (program catalog × phase walls) ----------
+    # programs.jsonl names every hot-path compiled program with its XLA
+    # cost/memory analysis; joining against the measured phase walls
+    # yields achieved FLOP/s + bytes/s per phase, a roofline class per
+    # program, and the per-round MFU decomposition (same "xla"
+    # provenance as bench.py's whole-run number)
+    programs = load_programs(run_dir)
+    attribution: Dict = {}
+    if programs:
+        from fedml_tpu.telemetry.profiling.roofline import build_attribution
+
+        attribution = build_attribution(
+            phases=phase_rows, rounds=round_rows, programs=programs,
+            device_kind=next((p.get("device_kind") for p in programs
+                              if p.get("device_kind")), None))
+    else:
+        notes.setdefault(
+            "attribution",
+            "no data: programs.jsonl missing (run predates the program "
+            "catalog, or profiling was disabled via FEDML_PROFILE=0)")
+
     # -- stitched (cross-process) spans ----------------------------------
     stitched = [s for s in spans if s.get("remote_parent")]
 
@@ -299,6 +327,7 @@ def build_report(run_dir: str) -> Dict:
         "client_health": client_health,
         "mem_gauges": mem_gauges,
         "services": services,
+        "attribution": attribution,
         "stitched_spans": stitched,
     }
 
@@ -388,6 +417,56 @@ def format_report(report: Dict) -> str:
                 add(f"  {p['phase']:<24s} count {p['count']:>5d}  "
                     f"p50 {p['p50_ms']:.1f} ms  p95 {p['p95_ms']:.1f} ms  "
                     f"total {p['total_ms']:.1f} ms")
+    attr = report.get("attribution") or {}
+    if attr.get("programs"):
+        add("")
+        ridge = attr.get("ridge_flops_per_byte")
+        dev = attr.get("device_kind") or "unknown device"
+        add(f"performance attribution ({dev}, roofline ridge "
+            f"{ridge:.1f} flop/byte):")
+        add(f"  {'program':<30s}{'calls':>7s}{'GFLOP':>9s}{'MB acc':>9s}"
+            f"{'AI':>8s}{'class':>15s}{'peakHBM':>10s}{'recomp':>7s}")
+        for p in attr["programs"][:16]:
+            ai = p.get("arithmetic_intensity")
+            ai_s = "-" if ai is None else f"{ai:.1f}"
+            add(f"  {p['name']:<30s}{p['calls']:>7d}"
+                f"{p['flops'] / 1e9:>9.3f}"
+                f"{p['bytes_accessed'] / 1e6:>9.2f}"
+                f"{ai_s:>8s}"
+                f"{p.get('roofline_class') or '-':>15s}"
+                f"{p['peak_hbm_bytes'] / 1e6:>9.1f}M"
+                f"{p['recompiles']:>7d}")
+        phase_attr = [p for p in attr.get("phases") or []
+                      if p.get("wall_ms")]
+        if phase_attr:
+            add("  per-phase achieved rates:")
+            for p in phase_attr:
+                rate = p.get("achieved_flops_per_s")
+                bw = p.get("achieved_bytes_per_s")
+                mfu = p.get("mfu")
+                line = (f"    {p['phase']:<40s}"
+                        f"{(rate or 0) / 1e9:>9.2f} GFLOP/s"
+                        f"{(bw or 0) / 1e9:>9.3f} GB/s"
+                        f"  {p.get('roofline_class') or '-'}")
+                if mfu is not None:
+                    line += f"  mfu {mfu:.3f}"
+                add(line)
+        overall = attr.get("overall") or {}
+        if overall.get("achieved_flops_per_s"):
+            line = (f"  whole-run: {overall['achieved_flops_per_s'] / 1e9:.2f}"
+                    f" GFLOP/s over {overall['round_wall_ms']:.0f} ms of "
+                    f"round wall (provenance: {overall.get('provenance')})")
+            if overall.get("mfu") is not None:
+                line += f", MFU {overall['mfu']:.4f}"
+            add(line)
+        top = attr.get("top_hbm_program")
+        if top:
+            add(f"  top peak-HBM consumer: {top['name']} "
+                f"({top['peak_hbm_bytes'] / 1e6:.1f} MB live at peak, "
+                f"{top.get('roofline_class') or 'class unknown'})")
+    elif "attribution" in notes:
+        add("")
+        add(f"performance attribution: {notes['attribution']}")
     if report["stitched_spans"]:
         add("")
         add(f"cross-process stitched spans: {len(report['stitched_spans'])}")

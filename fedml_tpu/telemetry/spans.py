@@ -345,22 +345,32 @@ def configure(run_dir: str, service: str = "") -> Tracer:
 
 def configure_from_args(args: Any) -> Tracer:
     """Derive the sink dir from run args — same layout core/mlops uses:
-    ``<log_file_dir>/run_<run_id>/``."""
+    ``<log_file_dir>/run_<run_id>/``. Also applies the run's deep-trace
+    budget knobs (``trace_max_captures`` / ``trace_byte_budget`` /
+    ``trace_rounds``) to the process TraceController."""
     run_id = str(getattr(args, "run_id", "0") or "0")
     base = str(getattr(args, "log_file_dir", "") or ".fedml_logs")
-    return configure(os.path.join(base, f"run_{run_id}"))
+    tracer = configure(os.path.join(base, f"run_{run_id}"))
+    from fedml_tpu.telemetry.profiling import trace as _trace
+
+    _trace.configure_from_args(args)
+    return tracer
 
 
 def flush_run() -> Optional[str]:
-    """Land the global tracer's spans AND a registry snapshot in the run
-    dir (no-op for an unconfigured, memory-only tracer). The one call a
-    training loop needs at the end of ``train()``."""
+    """Land the global tracer's spans, a registry snapshot, AND the
+    program-catalog snapshot (``programs.jsonl``) in the run dir (no-op
+    for an unconfigured, memory-only tracer). The one call a training
+    loop needs at the end of ``train()``."""
     from fedml_tpu.telemetry.registry import get_registry as _reg
 
     tracer = get_tracer()
     tracer.flush()
     if tracer.sink_dir is None:
         return None
+    from fedml_tpu.telemetry.profiling import get_catalog
+
+    get_catalog().flush_jsonl(tracer.sink_dir)
     return _reg().flush_jsonl(tracer.sink_dir)
 
 

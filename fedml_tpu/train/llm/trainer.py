@@ -273,12 +273,16 @@ class LLMTrainer:
         # inputs are [accum, B, ...]: the *batch* dim rides (dp, fsdp)
         micro_spec = NamedSharding(self.mesh, P(None, ("dp", "fsdp")))
         self._micro_spec = micro_spec
-        self._train_step = jax.jit(
+        # cataloged: the LLM hot step — bench.py reads its XLA-cost FLOPs
+        # (mfu_source "xla") straight off the catalog record
+        from fedml_tpu.telemetry.profiling import wrap_jit
+
+        self._train_step = wrap_jit("llm/train_step", jax.jit(
             train_step,
             in_shardings=(self.shardings, None, micro_spec, micro_spec, micro_spec),
             out_shardings=(self.shardings, None, replicated(self.mesh)),
             donate_argnums=(0, 1),
-        )
+        ))
 
         eval_loss_fn = self._eval_loss_fn
 
@@ -288,10 +292,10 @@ class LLMTrainer:
 
         eval_spec = batch_sharding(self.mesh)
         self._eval_spec = eval_spec
-        self._eval_step = jax.jit(
+        self._eval_step = wrap_jit("llm/eval_step", jax.jit(
             eval_step,
             in_shardings=(self.shardings, eval_spec, eval_spec, eval_spec),
-        )
+        ), multi_shape=True)
         # built once: a fresh lambda per exchange_state() call would miss
         # the jit cache and recompile the all-gather every round
         self._gather = jax.jit(lambda t: t,
@@ -461,13 +465,15 @@ class LLMTrainer:
         lora_shardings = extract_lora(self.shardings)
         data_spec = NamedSharding(self.mesh, P(None, None, ("dp", "fsdp")))
         rep = replicated(self.mesh)
-        return jax.jit(
+        from fedml_tpu.telemetry.profiling import wrap_jit
+
+        return wrap_jit("llm/fused_round", jax.jit(
             fed_round,
             in_shardings=(self.shardings, None, lora_shardings,
                           data_spec, data_spec, data_spec, rep),
             out_shardings=(self.shardings, None, lora_shardings, rep),
             donate_argnums=(0, 1, 2),
-        )
+        ), multi_shape=True)
 
     # -- checkpointing (orbax) -------------------------------------------
     def save_checkpoint(self, ckpt_dir: str, round_idx: int):

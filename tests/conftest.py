@@ -79,4 +79,9 @@ def _reset_singletons():
     telemetry.reset_registry()
     telemetry.reset_tracer()
     telemetry.reset_flight_recorder()
+    # profiling globals: fresh program-catalog accounting (compiled
+    # variants survive — recompiling per test would be the regression)
+    # and a fresh trace controller so captures never leak across tests
+    telemetry.reset_catalog()
+    telemetry.reset_trace_controller()
     reset_health_log()

@@ -19,7 +19,7 @@ import json
 import os
 import re
 import sys
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["load_headline", "run_compare", "main"]
 
@@ -32,15 +32,16 @@ def _natural_key(path: str):
             for p in re.split(r"(\d+)", name)]
 
 
-def load_headline(path: str) -> Optional[Tuple[str, float]]:
-    """(metric, value) from a BENCH file, or None if unrecognizable."""
+def _load_record(path: str) -> Optional[Dict]:
+    """The full emitted bench record from a BENCH file (raw or
+    harness-wrapped), or None if unrecognizable."""
     try:
         with open(path) as f:
             obj = json.load(f)
     except (OSError, ValueError):
         return None
     if isinstance(obj, dict) and "metric" in obj and "value" in obj:
-        return str(obj["metric"]), float(obj["value"])
+        return obj
     # harness-wrapped shape: the emitted line is the LAST parseable JSON
     # object in the captured tail
     tail = obj.get("tail") if isinstance(obj, dict) else None
@@ -54,8 +55,57 @@ def load_headline(path: str) -> Optional[Tuple[str, float]]:
             except ValueError:
                 continue
             if isinstance(rec, dict) and "metric" in rec and "value" in rec:
-                return str(rec["metric"]), float(rec["value"])
+                return rec
     return None
+
+
+def load_headline(path: str) -> Optional[Tuple[str, float]]:
+    """(metric, value) from a BENCH file, or None if unrecognizable."""
+    rec = _load_record(path)
+    if rec is None:
+        return None
+    return str(rec["metric"]), float(rec["value"])
+
+
+def compare_programs(prev_rec: Optional[Dict], new_rec: Optional[Dict],
+                     threshold: float) -> List[Dict]:
+    """Per-program regressions between two bench records' program
+    catalogs (``extra.programs``: name → flops/bytes/peak-HBM).
+
+    Flags, per program present in BOTH records: peak-HBM growth past the
+    threshold (the multichip headroom eroding), program FLOPs growth past
+    the threshold (the compiled program itself got more expensive — an
+    MFU regression at fixed wall), and new recompiles (treedef churn
+    landing where there was none). Whole-run MFU is diffed by the caller
+    off ``extra.mfu``."""
+    out: List[Dict] = []
+    prev_p = ((prev_rec or {}).get("extra") or {}).get("programs") or {}
+    new_p = ((new_rec or {}).get("extra") or {}).get("programs") or {}
+    for name in sorted(set(prev_p) & set(new_p)):
+        a, b = prev_p[name], new_p[name]
+        for field, label in (("peak_hbm_bytes", "peak HBM"),
+                             ("flops", "flops")):
+            pa = float(a.get(field) or 0.0)
+            pb = float(b.get(field) or 0.0)
+            if pa > 0 and pb > pa * (1.0 + threshold):
+                out.append({
+                    "program": name, "field": field,
+                    "prev": pa, "new": pb,
+                    "delta_pct": round((pb - pa) / pa * 100.0, 2),
+                    "note": f"{label} grew {((pb - pa) / pa) * 100:.1f}%",
+                })
+        ra = int(a.get("recompiles") or 0)
+        rb = int(b.get("recompiles") or 0)
+        # multi_shape programs (serve/decode_group's per-group-size
+        # variants, eval over several test shapes) legitimately grow
+        # variants — same exemption the doctor's churn verdict applies
+        if rb > ra and not (a.get("multi_shape") or b.get("multi_shape")):
+            out.append({
+                "program": name, "field": "recompiles",
+                "prev": ra, "new": rb, "delta_pct": None,
+                "note": f"recompiles {ra} -> {rb} (treedef churn)",
+            })
+    return out
 
 
 def run_compare(bench_dir: str = ".", threshold: float = 0.10,
@@ -83,8 +133,25 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                 "prev_file": prev_path, "new_file": new_path}
     delta = ((new_value - prev_value) / prev_value if prev_value
              else 0.0)
+    # per-program attribution diff: regressions named by PROGRAM, not
+    # just whole-run rounds/s (the program catalog rides extra.programs)
+    prev_rec = _load_record(prev_path)
+    new_rec = _load_record(new_path)
+    program_regressions = compare_programs(prev_rec, new_rec, threshold)
+    mfu_prev = ((prev_rec or {}).get("extra") or {}).get("mfu")
+    mfu_new = ((new_rec or {}).get("extra") or {}).get("mfu")
+    mfu_delta = None
+    if mfu_prev and mfu_new is not None:
+        mfu_delta = (float(mfu_new) - float(mfu_prev)) / float(mfu_prev)
+        if mfu_delta < -threshold:
+            program_regressions.append({
+                "program": "<whole-run>", "field": "mfu",
+                "prev": mfu_prev, "new": mfu_new,
+                "delta_pct": round(mfu_delta * 100.0, 2),
+                "note": f"whole-run MFU dropped {-mfu_delta * 100:.1f}%",
+            })
     return {
-        "ok": delta >= -threshold,
+        "ok": delta >= -threshold and not program_regressions,
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -92,6 +159,9 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
         "new_value": new_value,
         "delta_pct": round(delta * 100.0, 2),
         "threshold_pct": round(threshold * 100.0, 2),
+        "mfu_delta_pct": (round(mfu_delta * 100.0, 2)
+                          if mfu_delta is not None else None),
+        "program_regressions": program_regressions,
     }
 
 

@@ -62,6 +62,12 @@ _LIVE_SHAPE = re.compile(r"^live/[a-z0-9_]+$")
 # phases ride the existing compress/* spans); one signal segment, and
 # counters only — every secagg signal is a protocol occurrence count
 _SECAGG_SHAPE = re.compile(r"^secagg/[a-z0-9_]+$")
+# performance attribution: profile/* is the program-catalog namespace —
+# metric-only (catalog programs are NOT spans; their names live in the
+# `program` label), one signal segment, counter/gauge only (flops/bytes/
+# HBM readings are levels, capture/recompile signals are counts — a
+# histogram here would violate the bounded-frame live-plane contract)
+_PROFILE_SHAPE = re.compile(r"^profile/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -122,10 +128,11 @@ def check(entries):
                     "or compress/decode")
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
-                 "secagg/")):
+                 "secagg/", "profile/")):
             problems.append(
                 f"{where}: {name!r} — mem/, health/, resilience/, tier/, "
-                "live/ and secagg/ are metric namespaces, not span names")
+                "live/, secagg/ and profile/ are metric namespaces, not "
+                "span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 problems.append(
@@ -180,6 +187,17 @@ def check(entries):
                 problems.append(
                     f"{where}: {kind} {name!r} must be live/<signal> "
                     "(one segment; node/job/rule dimensions ride labels)")
+        if kind != "span" and name.startswith("profile/"):
+            if not _PROFILE_SHAPE.match(name):
+                problems.append(
+                    f"{where}: {kind} {name!r} must be profile/<signal> "
+                    "(one segment; program names and capture triggers "
+                    "ride labels)")
+            elif kind == "histogram":
+                problems.append(
+                    f"{where}: {kind} {name!r} — profile/* signals are "
+                    "levels (gauge) or occurrence counts (counter), not "
+                    "histograms")
         if kind != "span" and name.startswith("secagg/"):
             if not _SECAGG_SHAPE.match(name):
                 problems.append(
