@@ -1,0 +1,34 @@
+"""graftcheck — semantic static analysis for the repo's own invariants.
+
+PRs 2-10 established conventions that no generic linter can check:
+hot-path programs are pure and jitted once, donated buffers are never
+re-read, host syncs happen only at sanctioned round boundaries, state
+shared across timer/heartbeat/comm-handler/HTTP threads is lock-guarded,
+and every wire message type has a receiver.  ``fedml_tpu.analysis`` is
+the AST-based framework that machine-checks them: a shared file/scope/
+call-graph core (:mod:`fedml_tpu.analysis.core`) plus one module per
+invariant under :mod:`fedml_tpu.analysis.passes`.
+
+Entry points:
+
+* ``tools/graftcheck.py`` / ``fedml_tpu analyze`` — the CLI
+  (:func:`fedml_tpu.analysis.runner.main`);
+* :func:`run_analysis` — the library API used by tests;
+* ``tools/check_span_names.py`` and ``tools/lint.py`` remain as thin
+  shims over the migrated ``span-names`` and ``lint`` passes.
+
+Suppression: a line comment ``# graft: allow(<pass-id>): <why>`` waives
+one line (the justification is mandatory), and ``analysis_baseline.txt``
+at the repo root waives verified-benign pre-existing findings.  See
+``docs/static_analysis.md``.
+"""
+from __future__ import annotations
+
+from fedml_tpu.analysis.core import Finding, Repo  # noqa: F401
+from fedml_tpu.analysis.runner import (  # noqa: F401
+    ALL_PASSES,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = ["ALL_PASSES", "Finding", "Repo", "load_baseline", "run_analysis"]

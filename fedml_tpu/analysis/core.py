@@ -1,0 +1,227 @@
+"""Shared analysis core: repo model, parsed files, scopes, call graphs.
+
+Every pass operates on a :class:`Repo` — the set of first-party python
+files parsed exactly once (source text, line table, AST, parent links,
+``# graft: allow`` waivers).  The helpers here are deliberately
+heuristic: they resolve what an AST can resolve (same-module calls,
+``from fedml_tpu.x import y`` imports, ``self.method()`` within a class)
+and stay silent where python's dynamism wins.  Passes are tuned so that
+what they *do* report is worth a human's time; `analysis_baseline.txt`
+absorbs the verified-benign remainder.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# one line-waiver syntax for every pass: the justification after the
+# colon is mandatory (enforced by the runner, not the regex)
+ALLOW_RE = re.compile(
+    r"#\s*graft:\s*allow\(\s*([a-z0-9_\-, ]+?)\s*\)(?::\s*(\S.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer finding.  ``key`` deliberately excludes the line
+    number so baseline entries survive unrelated edits above them."""
+
+    pass_id: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_id}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class SourceFile:
+    """One parsed file: source, lines, AST (lazy), parent links (lazy),
+    and the ``# graft: allow(...)`` waivers found on its lines."""
+
+    def __init__(self, abspath: str, rel: str, src: str):
+        self.path = abspath
+        self.rel = rel.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self._tree: Optional[ast.Module] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        self._parsed = False
+        # line -> (pass ids, justification-or-None)
+        self.allows: Dict[int, Tuple[Set[str], Optional[str]]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = ALLOW_RE.search(line)
+            if m:
+                ids = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                self.allows[i] = (ids, m.group(2))
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.src, filename=self.path)
+            except SyntaxError as e:  # reported by the lint pass as E999
+                self.syntax_error = e
+        return self._tree
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(node):
+                        self._parents[child] = node
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        p = self.parents.get(node)
+        while p is not None:
+            yield p
+            p = self.parents.get(p)
+
+    def allowed(self, pass_id: str, line: int) -> bool:
+        """True when ``line`` carries ``# graft: allow(<pass_id>)``, or a
+        contiguous comment block directly above it does (the waiver plus
+        its justification may wrap over several comment lines)."""
+        entry = self.allows.get(line)
+        if entry is not None and pass_id in entry[0]:
+            return True
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            entry = self.allows.get(ln)
+            if entry is not None and pass_id in entry[0]:
+                return True
+            # stacked single-pass waivers compose: keep scanning the
+            # comment block past allows for other passes
+            ln -= 1
+        return False
+
+
+class Repo:
+    """All first-party python files, parsed once and shared by every
+    pass.  ``roots`` mirrors the historical lint roots; domain passes
+    narrow to :meth:`package_files`."""
+
+    DEFAULT_ROOTS: Sequence[str] = (
+        "fedml_tpu", "tools", "examples", "bench.py", "__graft_entry__.py")
+
+    def __init__(self, root: str, roots: Sequence[str] = DEFAULT_ROOTS):
+        self.root = os.path.abspath(root)
+        self.files: List[SourceFile] = []
+        self.by_rel: Dict[str, SourceFile] = {}
+        for entry in roots:
+            target = os.path.join(self.root, entry)
+            if entry.endswith(".py"):
+                if os.path.isfile(target):
+                    self._add(target)
+                continue
+            for base, dirs, names in os.walk(target):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for fn in sorted(names):
+                    if fn.endswith(".py"):
+                        self._add(os.path.join(base, fn))
+        self.files.sort(key=lambda f: f.rel)
+
+    def _add(self, abspath: str) -> None:
+        rel = os.path.relpath(abspath, self.root)
+        if rel in self.by_rel:
+            return
+        with open(abspath, encoding="utf-8") as f:
+            src = f.read()
+        sf = SourceFile(abspath, rel, src)
+        self.files.append(sf)
+        self.by_rel[sf.rel] = sf
+
+    def package_files(self) -> List[SourceFile]:
+        return [f for f in self.files if f.rel.startswith("fedml_tpu/")]
+
+    def module(self, dotted_name: str) -> Optional[SourceFile]:
+        """Resolve ``fedml_tpu.compression.codecs`` to its SourceFile."""
+        rel = dotted_name.replace(".", "/")
+        return (self.by_rel.get(rel + ".py")
+                or self.by_rel.get(rel + "/__init__.py"))
+
+
+# ---- AST helpers shared by the passes -------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain (``jax.random.normal``) or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def enclosing_function(file: SourceFile, node: ast.AST):
+    for anc in file.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def import_map(file: SourceFile) -> Dict[str, Tuple[str, Optional[str]]]:
+    """name -> (module, original_name_or_None).  ``from a.b import c as d``
+    maps ``d -> ("a.b", "c")``; ``import a.b as ab`` maps
+    ``ab -> ("a.b", None)``."""
+    out: Dict[str, Tuple[str, Optional[str]]] = {}
+    tree = file.tree
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (a.name, None)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = (node.module, a.name)
+    return out
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def in_lock_block(file: SourceFile, node: ast.AST) -> bool:
+    """True when ``node`` sits under a ``with <something lock-ish>:``.
+
+    Lock-ish = any context expression whose source mentions lock/mutex/
+    cond — matches the repo convention (``self._lock``, ``_catalog_lock``,
+    ``self._cv``)."""
+    for anc in file.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                src = ast.unparse(item.context_expr).lower()
+                if "lock" in src or "mutex" in src or "_cv" in src \
+                        or "cond" in src:
+                    return True
+    return False
+
+
+def stmt_of(file: SourceFile, node: ast.AST) -> ast.AST:
+    """The nearest enclosing statement (``node`` itself when it is one)."""
+    if isinstance(node, ast.stmt):
+        return node
+    for anc in file.ancestors(node):
+        if isinstance(anc, ast.stmt):
+            return anc
+    return node

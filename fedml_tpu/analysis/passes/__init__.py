@@ -1,0 +1,7 @@
+"""Analysis passes — one module per invariant.
+
+Every pass exposes ``PASS_ID`` and ``run(repo) -> list[Finding]``.  The
+registry lives in :mod:`fedml_tpu.analysis.runner` so that adding a pass
+is: write the module, add it to ``ALL_PASSES``, document it in
+``docs/static_analysis.md``.
+"""

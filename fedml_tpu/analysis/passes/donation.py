@@ -1,0 +1,201 @@
+"""donation — a buffer donated to a jitted program must not be re-read.
+
+We collect every binding assigned from a jit application carrying
+``donate_argnums`` (module-level ``_prog = wrap_jit(.., jax.jit(f,
+donate_argnums=(1,)))`` or instance attributes ``self._step = jax.jit(f,
+donate_argnums=(0, 1))``), remembering the donated positions.  At every
+call of such a binding we take the argument expression at each donated
+position and, within the same function scope, flag any *read* of that
+expression after the call — unless the same statement rebinds it (the
+canonical ``params = step(params, ...)`` / ``self.a, self.b =
+self._step(self.a, self.b)`` donation pattern), or a later statement
+rebinds it before the first read.  Calls inside loops additionally treat
+any read of an un-rebound donated expression in the loop body as a
+finding: the next iteration would hand XLA a deleted buffer.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from fedml_tpu.analysis.core import (
+    Finding,
+    Repo,
+    SourceFile,
+    call_name,
+    dotted,
+    enclosing_function,
+    stmt_of,
+)
+
+PASS_ID = "donation"
+
+
+def _donate_argnums(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.add(e.value)
+    return out
+
+
+def _find_jit_with_donation(expr: ast.AST) -> Optional[Set[int]]:
+    """If ``expr`` is (or wraps) a ``jax.jit(..., donate_argnums=...)``
+    call, return the donated positions."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = call_name(expr)
+    if name in ("jax.jit", "jit"):
+        nums = _donate_argnums(expr)
+        return nums or None
+    # wrap_jit("name", jax.jit(fn, donate_argnums=...), ...)
+    if name is not None and name.split(".")[-1] in ("wrap_jit", "_wrap_jit"):
+        for arg in expr.args:
+            nums = _find_jit_with_donation(arg)
+            if nums:
+                return nums
+    return None
+
+
+def _collect_donating_bindings(file: SourceFile) -> Dict[str, Set[int]]:
+    """binding source text (``_prog`` / ``self._step``) -> donated
+    argnums, from assignments in this file."""
+    out: Dict[str, Set[int]] = {}
+    tree = file.tree
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            nums = _find_jit_with_donation(node.value)
+            if nums:
+                target = dotted(node.targets[0])
+                if target is not None:
+                    out[target] = nums
+    return out
+
+
+def _store_targets(stmt: ast.AST) -> Set[str]:
+    """Textual forms (``x``, ``self.params``) stored by ``stmt``."""
+    out: Set[str] = set()
+    targets: Sequence[ast.AST] = ()
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = (stmt.target,)
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                d = dotted(node)
+                if d is not None:
+                    out.add(d)
+    return out
+
+
+def _reads_of(node: ast.AST, expr_text: str) -> List[ast.AST]:
+    """Load-context occurrences of ``expr_text`` under ``node``."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(n, "ctx", None), ast.Load) \
+                and dotted(n) == expr_text:
+            # skip the bases of longer attribute chains: ``x.shape`` reads
+            # metadata of a donated ``x`` — still a read, keep it
+            out.append(n)
+    return out
+
+
+def _check_call(file: SourceFile, call: ast.Call, donated: Set[int],
+                binding: str, findings: List[Finding]) -> None:
+    fn = enclosing_function(file, call)
+    if fn is None:
+        return
+    call_stmt = stmt_of(file, call)
+    if not isinstance(call_stmt, ast.stmt):
+        return
+
+    for pos in sorted(donated):
+        if pos >= len(call.args):
+            continue
+        arg = call.args[pos]
+        expr_text = dotted(arg)
+        if expr_text is None:
+            continue  # expression arg (fresh temporary) — nothing to re-read
+        # rebound by the very statement that makes the call?
+        if expr_text in _store_targets(call_stmt):
+            continue
+        # loop-carried donation without rebinding: every iteration after
+        # the first passes a deleted buffer
+        loop = None
+        for anc in file.ancestors(call):
+            if isinstance(anc, (ast.For, ast.While)):
+                loop = anc
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        scope = loop if loop is not None else fn
+        offending: Optional[ast.AST] = None
+        if loop is not None:
+            # loop-carried: a rebinding anywhere in the loop body (other
+            # than the call statement itself) restores the name each
+            # iteration; without one, any read in the loop is a hazard
+            rebound_in_loop = any(
+                isinstance(stmt, ast.stmt) and stmt is not call_stmt
+                and expr_text in _store_targets(stmt)
+                for stmt in ast.walk(loop))
+            if rebound_in_loop:
+                continue
+            # no rebinding at all: iteration 2 re-passes the deleted
+            # buffer at this very call site
+            offending = arg
+        for n in _reads_of(scope, expr_text):
+            if n is arg or (n.lineno == arg.lineno
+                            and n.col_offset == arg.col_offset):
+                continue
+            if loop is None:
+                if n.lineno <= call.lineno:
+                    continue  # straight-line scope: only later reads count
+                # a rebinding between the call and the read clears it
+                rebound = False
+                for stmt in ast.walk(scope):
+                    if isinstance(stmt, ast.stmt) and stmt is not call_stmt \
+                            and call.lineno < getattr(stmt, "lineno", 0) \
+                            <= n.lineno \
+                            and expr_text in _store_targets(stmt):
+                        rebound = True
+                        break
+                if rebound:
+                    continue
+            offending = n
+            break
+        if offending is not None:
+            where = "in the enclosing loop" if loop is not None else \
+                "after the donating call"
+            findings.append(Finding(
+                PASS_ID, file.rel, offending.lineno,
+                f"read of '{expr_text}' {where} — it was donated to "
+                f"'{binding}' (argnum {pos}) and its buffer is deleted"))
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for file in repo.package_files():
+        bindings = _collect_donating_bindings(file)
+        if not bindings:
+            continue
+        tree = file.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = dotted(node.func)
+            if target is None or target not in bindings:
+                continue
+            _check_call(file, node, bindings[target], target, findings)
+    return findings

@@ -1,0 +1,175 @@
+"""host-sync — round loops sync to host only at sanctioned boundaries.
+
+PR 2 removed the per-round barrier: rounds chain on device, and the
+host reads back (``float(loss)``, ``device_get``, ``block_until_ready``)
+only under the eval/checkpoint/host-agg/FedNova guards.  This pass scans
+the round-loop modules for implicit device->host transfers and flags any
+that sit outside a sanctioned region.
+
+Sanctioned = an ancestor ``if``/ternary whose condition mentions one of
+the sync-gate names (``eval_round``, ``_sync_each_round``, ``_host_agg``,
+``fednova``, ``should_save``, ...), or an enclosing function that IS a
+sync site by role (eval/test/checkpoint/save/finish/close/report).  A
+deliberate sync anywhere else takes a ``# graft: allow(host-sync): why``.
+
+Sync constructs recognized: ``.item()``, ``jax.device_get``,
+``[jax.]block_until_ready``, ``np.asarray``/``np.array`` on non-literal
+arguments, and ``float()``/``int()`` applied to a name bound from a
+call of a jitted-program binding in the same function.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from fedml_tpu.analysis.core import (
+    Finding,
+    Repo,
+    SourceFile,
+    call_name,
+    dotted,
+    enclosing_function,
+    names_in,
+)
+
+PASS_ID = "host-sync"
+
+ROUND_LOOP_PATTERNS = (
+    re.compile(r"^fedml_tpu/simulation/sp/[^/]+\.py$"),
+    re.compile(r"^fedml_tpu/simulation/parallel/mesh_simulator\.py$"),
+    re.compile(r"^fedml_tpu/hierarchy/runner\.py$"),
+    re.compile(r"^fedml_tpu/cross_silo/server/[^/]+\.py$"),
+    re.compile(r"^fedml_tpu/cross_silo/client/[^/]+\.py$"),
+)
+
+# names whose presence in a guarding condition marks the branch as a
+# sanctioned sync region (the PR 2 gates plus their later siblings)
+_GUARD_HINTS = ("eval", "sync", "host_agg", "fednova", "checkpoint",
+                "should_save", "ckpt", "rejoin", "finish", "final")
+# functions that ARE sanctioned sync sites by role
+_FUNC_HINTS = re.compile(
+    r"(eval|test|checkpoint|save|finish|close|report|metric|summary|"
+    r"ckpt|aggregate_host|digest)", re.I)
+
+# a jitted-program binding: assignments from jax.jit/wrap_jit give the
+# names whose call results are device arrays (see donation pass); the
+# conservative name shapes below catch the repo's conventions without
+# needing whole-program type inference
+_PROGRAM_BINDING = re.compile(
+    r"(^|\.)_?(round_fn|train_step|eval_step|step|program|fused|"
+    r"local_train|evaluate)\w*$")
+
+
+def _is_round_loop_file(rel: str) -> bool:
+    return any(p.match(rel) for p in ROUND_LOOP_PATTERNS)
+
+
+def _sanctioned(file: SourceFile, node: ast.AST) -> bool:
+    for anc in file.ancestors(node):
+        if isinstance(anc, (ast.If, ast.While)):
+            if any(h in ast.unparse(anc.test).lower() for h in _GUARD_HINTS):
+                return True
+        elif isinstance(anc, ast.IfExp):
+            if any(h in ast.unparse(anc.test).lower() for h in _GUARD_HINTS):
+                return True
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _FUNC_HINTS.search(anc.name):
+                return True
+    return False
+
+
+def _device_names(file: SourceFile, fn: ast.AST) -> Set[str]:
+    """Names in ``fn`` bound (possibly via tuple unpack) from a call of
+    a jitted-program binding — their values live on device."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        target_name = dotted(value.func)
+        if target_name is None or not _PROGRAM_BINDING.search(target_name):
+            continue
+        for t in node.targets:
+            # bare names and tuple unpacks only — an Attribute target's
+            # base ('self') is not itself a device value
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        out.add(e.id)
+    return out
+
+
+def _literal_arg(arg: ast.AST) -> bool:
+    return isinstance(arg, (ast.List, ast.Tuple, ast.Dict, ast.Set,
+                            ast.Constant, ast.ListComp, ast.GeneratorExp))
+
+
+def _check_file(file: SourceFile, findings: List[Finding]) -> None:
+    tree = file.tree
+    if tree is None:
+        return
+
+    def flag(node: ast.AST, desc: str) -> None:
+        if _sanctioned(file, node):
+            return
+        findings.append(Finding(
+            PASS_ID, file.rel, node.lineno,
+            f"unsanctioned device->host sync: {desc} (round loops sync "
+            "only at eval/checkpoint/host-agg boundaries)"))
+
+    device_cache = {}
+
+    def device_names_for(node: ast.AST) -> Set[str]:
+        fn = enclosing_function(file, node)
+        if fn is None:
+            return set()
+        if id(fn) not in device_cache:
+            device_cache[id(fn)] = _device_names(file, fn)
+        return device_cache[id(fn)]
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            # `expr().item()` chains: the base is an expression but the
+            # trailing sync method still transfers
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    flag(node, ".item() on an expression")
+                elif node.func.attr == "block_until_ready":
+                    flag(node, ".block_until_ready() on an expression")
+            continue
+        parts = name.split(".")
+        if parts[-1] == "item" and not node.args:
+            flag(node, f"{name}()")
+        elif name in ("jax.device_get", "device_get"):
+            flag(node, f"{name}(...)")
+        elif parts[-1] == "block_until_ready":
+            flag(node, f"{name}()")
+        elif name in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array", "onp.asarray", "onp.array"):
+            if node.args and not _literal_arg(node.args[0]):
+                # host materialization of an already-host value is fine;
+                # flag only when the argument mentions a device binding
+                touched = names_in(node.args[0]) & device_names_for(node)
+                if touched:
+                    flag(node, f"{name}({sorted(touched)[0]}...)")
+        elif name in ("float", "int") and node.args:
+            touched = names_in(node.args[0]) & device_names_for(node)
+            if touched:
+                flag(node, f"{name}() on device value "
+                           f"'{sorted(touched)[0]}'")
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for file in repo.package_files():
+        if _is_round_loop_file(file.rel):
+            _check_file(file, findings)
+    return findings

@@ -1,0 +1,334 @@
+"""jit-purity — bodies reachable from jit/wrap_jit sites must stay pure.
+
+A "jit root" is any function handed to ``jax.jit`` (decorator, partial
+decorator, direct call, or as the program inside ``telemetry.wrap_jit``).
+From each root we walk the bare-name call graph (same module, plus one
+``from fedml_tpu.x import y`` hop) and flag, anywhere in a reachable
+body:
+
+* host APIs — ``time.*``, ``logging.*`` / ``logger.*`` calls,
+  ``print``/``open``/``input``, module-level RNG (``random.*``,
+  ``np.random.*`` — randomness must come from threaded PRNG keys);
+* sync forcers — ``.item()``, ``.block_until_ready()``,
+  ``jax.device_get``, ``np.asarray``/``np.array``, and
+  ``float()``/``int()``/``bool()`` applied to a non-static parameter of
+  the root.
+
+Trace-time-only host work is still a finding: the convention these
+programs live by is that a jitted body re-traces bit-identically, and a
+host call inside one is either dead weight re-run per compile or a
+silent impurity.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.core import (
+    Finding,
+    Repo,
+    SourceFile,
+    call_name,
+    dotted,
+    import_map,
+    names_in,
+)
+
+PASS_ID = "jit-purity"
+
+_HOST_MODULES = ("time", "logging", "socket", "requests", "subprocess")
+_LOGGER_NAMES = {"logger", "log", "_logger", "_log"}
+_LOGGER_METHODS = {"debug", "info", "warning", "error", "exception",
+                   "critical", "log"}
+_MAX_DEPTH = 6
+
+
+def _resolve_base(file: SourceFile, name: str,
+                  imports: Dict[str, Tuple[str, Optional[str]]]) -> str:
+    """Map an imported alias back to the real module path for matching
+    (``onp.random.rand`` -> ``numpy.random.rand``)."""
+    head, _, rest = name.partition(".")
+    entry = imports.get(head)
+    if entry is None:
+        return name
+    module, orig = entry
+    real = module if orig is None else f"{module}.{orig}"
+    return f"{real}.{rest}" if rest else real
+
+
+def _static_argnums(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.add(e.value)
+    return out
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        out.add(e.value)
+    return out
+
+
+class _Root:
+    """One jit root: the function node, where it was registered, and
+    which of its parameters are static (python-level, sync-free)."""
+
+    def __init__(self, file: SourceFile, node: ast.AST, site: str,
+                 static_nums: Set[int], static_names: Set[str]):
+        self.file = file
+        self.node = node  # FunctionDef | Lambda
+        self.site = site
+        params: List[str] = []
+        args = node.args
+        for a in list(args.posonlyargs) + list(args.args):
+            params.append(a.arg)
+        traced = [p for i, p in enumerate(params)
+                  if i not in static_nums and p not in static_names]
+        self.traced_params: Set[str] = set(traced)
+        self.name = getattr(node, "name", "<lambda>")
+
+
+def _jit_call_target(call: ast.Call) -> Optional[ast.Call]:
+    """Return the call node when ``call`` IS a jit application —
+    ``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    name = call_name(call)
+    if name in ("jax.jit", "jit"):
+        return call
+    if name in ("functools.partial", "partial") and call.args:
+        inner = call.args[0]
+        if isinstance(inner, (ast.Name, ast.Attribute)) \
+                and dotted(inner) in ("jax.jit", "jit"):
+            return call
+    return None
+
+
+class _Ctx:
+    """Per-run memo of each file's function index and import map — the
+    Repo parses once; this keeps the passes from re-walking trees once
+    per (root, body) pair."""
+
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self._defs: Dict[str, Dict[str, List[ast.AST]]] = {}
+        self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+
+    def defs(self, file: SourceFile) -> Dict[str, List[ast.AST]]:
+        if file.rel not in self._defs:
+            index: Dict[str, List[ast.AST]] = {}
+            if file.tree is not None:
+                for n in ast.walk(file.tree):
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        index.setdefault(n.name, []).append(n)
+            self._defs[file.rel] = index
+        return self._defs[file.rel]
+
+    def imports(self, file: SourceFile):
+        if file.rel not in self._imports:
+            self._imports[file.rel] = import_map(file)
+        return self._imports[file.rel]
+
+
+def _collect_roots(ctx: _Ctx, file: SourceFile) -> List[_Root]:
+    tree = file.tree
+    if tree is None:
+        return []
+    roots: List[_Root] = []
+    defs = ctx.defs(file)
+
+    def resolve(name: str) -> Optional[ast.AST]:
+        cands = defs.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def add(fn_expr: ast.AST, site: str, jit_call: Optional[ast.Call],
+            skip_if_decorated: bool = False):
+        nums = _static_argnums(jit_call) if jit_call is not None else set()
+        names = _static_argnames(jit_call) if jit_call is not None else set()
+        if isinstance(fn_expr, ast.Lambda):
+            roots.append(_Root(file, fn_expr, site, nums, names))
+        elif isinstance(fn_expr, ast.Name):
+            target = resolve(fn_expr.id)
+            if target is None:
+                return
+            # a def already jitted by decorator registers via the
+            # decorator path WITH its static argnums — re-adding it from
+            # the wrap_jit site would lose them
+            if skip_if_decorated and any(
+                    (isinstance(d, (ast.Name, ast.Attribute))
+                     and dotted(d) in ("jax.jit", "jit"))
+                    or (isinstance(d, ast.Call)
+                        and _jit_call_target(d) is not None)
+                    for d in getattr(target, "decorator_list", [])):
+                return
+            roots.append(_Root(file, target, site, nums, names))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, (ast.Name, ast.Attribute)):
+                    if dotted(dec) in ("jax.jit", "jit"):
+                        roots.append(_Root(
+                            file, node, f"@jit {file.rel}", set(), set()))
+                elif isinstance(dec, ast.Call):
+                    jc = _jit_call_target(dec)
+                    if jc is not None:
+                        roots.append(_Root(
+                            file, node, f"@jit {file.rel}",
+                            _static_argnums(jc), _static_argnames(jc)))
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("jax.jit", "jit") and node.args:
+                add(node.args[0], f"jax.jit {file.rel}", node)
+            elif name is not None and name.split(".")[-1] in (
+                    "wrap_jit", "_wrap_jit") and len(node.args) >= 2:
+                inner = node.args[1]
+                if isinstance(inner, ast.Call):
+                    continue  # jax.jit(...) inner call handled above
+                # wrap_jit's own static_argnums kwarg mirrors the jit's
+                add(inner, f"wrap_jit {file.rel}", node,
+                    skip_if_decorated=True)
+    return roots
+
+
+def _reachable(ctx: _Ctx, root: _Root):
+    """Yield ``(file, body_node, depth)`` for the root body and every
+    function reachable from it by resolvable bare-name calls."""
+    seen: Set[Tuple[str, int]] = set()
+    queue: List[Tuple[SourceFile, ast.AST, int]] = [(root.file, root.node, 0)]
+    while queue:
+        file, node, depth = queue.pop()
+        key = (file.rel, node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield file, node, depth
+        if depth >= _MAX_DEPTH:
+            continue
+        imports = ctx.imports(file)
+        defs = ctx.defs(file)
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            if not isinstance(call.func, ast.Name):
+                continue
+            fname = call.func.id
+            cands = defs.get(fname, [])
+            if len(cands) == 1:
+                queue.append((file, cands[0], depth + 1))
+                continue
+            entry = imports.get(fname)
+            if entry is not None and entry[1] is not None \
+                    and entry[0].startswith("fedml_tpu"):
+                target_file = ctx.repo.module(entry[0])
+                if target_file is not None and target_file.tree is not None:
+                    for n in target_file.tree.body:
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                                and n.name == entry[1]:
+                            queue.append((target_file, n, depth + 1))
+
+
+def _check_body(ctx: _Ctx, root: _Root, file: SourceFile, body: ast.AST,
+                is_root_body: bool, findings: List[Finding]) -> None:
+    imports = ctx.imports(file)
+    prog = root.name
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            PASS_ID, file.rel, node.lineno,
+            f"jitted program '{prog}': {what}"))
+
+    # nested defs and lambdas are traced as part of the program (loss
+    # closures under jax.grad etc.) — walk everything under the body
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            # attribute-of-call (`x.sum().item()`): the chain base is an
+            # expression, but the trailing sync methods still apply
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    flag(node, ".item() forces a device->host sync")
+                elif node.func.attr == "block_until_ready":
+                    flag(node, ".block_until_ready() forces a host sync")
+            continue
+        real = _resolve_base(file, name, imports)
+        head = real.split(".")[0]
+        last = real.split(".")[-1]
+        if real.startswith("jax."):
+            if real == "jax.device_get":
+                flag(node, "jax.device_get forces a device->host sync")
+            elif real == "jax.block_until_ready":
+                flag(node, "jax.block_until_ready forces a host sync")
+            continue
+        if head in _HOST_MODULES:
+            flag(node, f"{real}() is a host API call")
+            continue
+        if real == "random" or real.startswith("random."):
+            flag(node, f"{real}() draws from module-level RNG "
+                       "(use threaded jax.random keys)")
+            continue
+        if real.startswith("numpy.random."):
+            flag(node, f"{name}() draws from module-level numpy RNG "
+                       "(use threaded jax.random keys)")
+            continue
+        if real in ("numpy.asarray", "numpy.array"):
+            flag(node, f"{name}() materializes a host array "
+                       "(forces a sync on traced values)")
+            continue
+        if name in ("print", "input"):
+            flag(node, f"{name}() is host I/O")
+            continue
+        if name == "open":
+            flag(node, "open() is host I/O")
+            continue
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] in _LOGGER_NAMES \
+                and parts[1] in _LOGGER_METHODS:
+            flag(node, f"{name}() logs from a jit-pure body")
+            continue
+        if parts[-1] == "item" and not node.args and not node.keywords:
+            flag(node, f"{name}() forces a device->host sync")
+            continue
+        if parts[-1] == "block_until_ready":
+            flag(node, f"{name}() forces a host sync")
+            continue
+        if name in ("float", "int", "bool") and is_root_body and node.args:
+            touched = names_in(node.args[0]) & root.traced_params
+            if touched:
+                flag(node, f"{name}() on traced value "
+                           f"'{sorted(touched)[0]}' forces a host sync")
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    ctx = _Ctx(repo)
+    for file in repo.package_files():
+        for root in _collect_roots(ctx, file):
+            for body_file, body, depth in _reachable(ctx, root):
+                _check_body(ctx, root, body_file, body, depth == 0,
+                            findings)
+    # duplicate roots (e.g. wrap_jit(name, jax.jit(fn))) and shared
+    # helpers produce identical findings — dedup on full identity
+    out, seen = [], set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.message)):
+        k = (f.path, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

@@ -1,0 +1,157 @@
+"""lint — the in-tree ruff stand-in, as an analysis pass.
+
+``tools/lint.py`` migrated onto the shared core (the tool is now a shim
+over this module; ``check_file``/``main`` keep their signatures, output
+and exit codes).  Checks are unchanged:
+
+  F401  unused module-level import (skipped in __init__.py re-exports)
+  E722  bare except
+  B006  mutable default argument
+  W291  trailing whitespace
+  E501  line longer than 100 chars
+  T201  print() in library code (CLI/tools/tests exempt)
+
+``# noqa`` on the offending line suppresses any check (kept for
+compatibility; new waivers should prefer ``# graft: allow(lint): why``).
+``run(repo)`` reuses the repo's already-parsed ASTs instead of re-reading
+every file.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from fedml_tpu.analysis.core import Finding, Repo
+
+PASS_ID = "lint"
+
+MAX_LINE = 100
+LIB_DIRS = ("fedml_tpu",)
+PRINT_EXEMPT = ("cli.py", "env_collect.py")
+
+
+def iter_py(root):
+    for base, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+        for fn in files:
+            if fn.endswith(".py"):
+                yield os.path.join(base, fn)
+
+
+def imported_names(node):
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            yield (a.asname or a.name.split(".")[0]), node.lineno
+    elif isinstance(node, ast.ImportFrom):
+        for a in node.names:
+            if a.name != "*":
+                yield (a.asname or a.name), node.lineno
+
+
+def _check_source(path: str, src: str, tree: Optional[ast.Module],
+                  syntax_error: Optional[SyntaxError]
+                  ) -> List[Tuple[int, str]]:
+    problems: List[Tuple[int, str]] = []
+    lines = src.splitlines()
+    noqa = {i + 1 for i, l in enumerate(lines) if "# noqa" in l}
+
+    for i, line in enumerate(lines, 1):
+        if i in noqa:
+            continue
+        if line.rstrip("\n") != line.rstrip():
+            problems.append((i, "W291 trailing whitespace"))
+        if len(line) > MAX_LINE:
+            problems.append((i, f"E501 line too long ({len(line)})"))
+
+    if tree is None:
+        if syntax_error is not None:
+            problems.append((syntax_error.lineno or 0,
+                             f"E999 syntax error: {syntax_error.msg}"))
+        return problems
+
+    # F401: module-level imports never referenced
+    if os.path.basename(path) != "__init__.py":
+        imports = {}
+        for node in tree.body:
+            if (isinstance(node, ast.ImportFrom)
+                    and node.module == "__future__"):
+                continue
+            for name, lineno in imported_names(node):
+                imports[name] = lineno
+        used = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                n = node
+                while isinstance(n, ast.Attribute):
+                    n = n.value
+                if isinstance(n, ast.Name):
+                    used.add(n.id)
+        # names in __all__ / docstring-style re-export count as used
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                used.add(node.value)
+        for name, lineno in imports.items():
+            if name not in used and lineno not in noqa:
+                problems.append((lineno, f"F401 unused import '{name}'"))
+
+    in_lib = any(path.startswith(d + os.sep) or f"/{d}/" in path
+                 for d in LIB_DIRS)
+    for node in ast.walk(tree):
+        lineno = getattr(node, "lineno", 0)
+        if lineno in noqa:
+            continue
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append((lineno, "E722 bare except"))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        (default.lineno, "B006 mutable default argument"))
+        if (in_lib and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and os.path.basename(path) not in PRINT_EXEMPT):
+            problems.append((lineno, "T201 print() in library code"))
+    return problems
+
+
+def check_file(path):
+    """Historical API: lint one file from disk."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree: Optional[ast.Module] = ast.parse(src, filename=path)
+        err: Optional[SyntaxError] = None
+    except SyntaxError as e:
+        tree, err = None, e
+    return _check_source(path, src, tree, err)
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for file in repo.files:
+        for lineno, msg in _check_source(
+                file.rel, file.src, file.tree, file.syntax_error):
+            findings.append(Finding(PASS_ID, file.rel, lineno, msg))
+    return findings
+
+
+def main():
+    roots = sys.argv[1:] or ["fedml_tpu", "tools", "examples", "bench.py",
+                             "__graft_entry__.py"]
+    total = 0
+    for root in roots:
+        paths = [root] if root.endswith(".py") else list(iter_py(root))
+        for path in sorted(paths):
+            for lineno, msg in check_file(path):
+                print(f"{path}:{lineno}: {msg}")  # noqa: T201 (CLI output)
+                total += 1
+    if total:
+        print(f"\n{total} problem(s)")  # noqa: T201 (CLI output)
+        return 1
+    print("lint clean")  # noqa: T201 (CLI output)
+    return 0

@@ -1,0 +1,160 @@
+"""message-contract — every sent message type has a registered receiver.
+
+The PR 7 namespace-isolation bug class: a manager sends
+``Message(MyMessage.MSG_TYPE_X, ...)`` but no peer ever calls
+``register_message_receive_handler(MSG_TYPE_X, ...)`` (or vice versa),
+and the message silently rots in an inbox.  We resolve the message-type
+expression at every ``Message(...)`` construction and every handler
+registration down to its string constant (class attributes and
+module-level constants, across ``from x import y``), then flag:
+
+* a type value that is sent somewhere but handled nowhere;
+* a type value with a handler that nothing ever sends.
+
+Expressions that do not resolve to a constant (computed types) are
+ignored — dynamic protocols own their contracts.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from fedml_tpu.analysis.core import (
+    Finding,
+    Repo,
+    SourceFile,
+    call_name,
+    import_map,
+)
+
+PASS_ID = "message-contract"
+
+
+def _string_consts(repo: Repo):
+    """class_consts[class_name][attr] = value; module_consts[rel][name]
+    = value (module-level string assignments)."""
+    class_consts: Dict[str, Dict[str, str]] = {}
+    module_consts: Dict[str, Dict[str, str]] = {}
+    for file in repo.package_files():
+        tree = file.tree
+        if tree is None:
+            continue
+        mod: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                mod[node.targets[0].id] = node.value.value
+        module_consts[file.rel] = mod
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = class_consts.setdefault(node.name, {})
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and isinstance(stmt.value, ast.Constant) \
+                        and isinstance(stmt.value.value, str):
+                    attrs[stmt.targets[0].id] = stmt.value.value
+    return class_consts, module_consts
+
+
+class _Resolver:
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.class_consts, self.module_consts = _string_consts(repo)
+        self._aliases: Dict[str, Dict[str, str]] = {}
+        self._imports: Dict[str, Dict] = {}
+
+    def _import_map(self, file: SourceFile) -> Dict:
+        if file.rel not in self._imports:
+            self._imports[file.rel] = import_map(file)
+        return self._imports[file.rel]
+
+    def _alias_map(self, file: SourceFile) -> Dict[str, str]:
+        """``M = InfMessage`` style local aliases, plus import renames."""
+        cached = self._aliases.get(file.rel)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        tree = file.tree
+        if tree is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Name):
+                    out[node.targets[0].id] = node.value.id
+        for name, (_, orig) in self._import_map(file).items():
+            if orig is not None and orig != name:
+                out[name] = orig
+        self._aliases[file.rel] = out
+        return out
+
+    def resolve(self, file: SourceFile, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            cls = expr.value.id
+            aliases = self._alias_map(file)
+            for _ in range(3):  # follow M = InfMessage chains
+                if cls in self.class_consts:
+                    break
+                nxt = aliases.get(cls)
+                if nxt is None or nxt == cls:
+                    break
+                cls = nxt
+            return self.class_consts.get(cls, {}).get(expr.attr)
+        if isinstance(expr, ast.Name):
+            local = self.module_consts.get(file.rel, {})
+            if expr.id in local:
+                return local[expr.id]
+            entry = self._import_map(file).get(expr.id)
+            if entry is not None and entry[1] is not None:
+                target = self.repo.module(entry[0])
+                if target is not None:
+                    return self.module_consts.get(
+                        target.rel, {}).get(entry[1])
+        return None
+
+
+def run(repo: Repo) -> List[Finding]:
+    resolver = _Resolver(repo)
+    # value -> first (path, line) seen, per direction
+    sent: Dict[str, Tuple[str, int]] = {}
+    handled: Dict[str, Tuple[str, int]] = {}
+    for file in repo.package_files():
+        tree = file.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            last = name.split(".")[-1]
+            if last == "Message":
+                value = resolver.resolve(file, node.args[0])
+                if value is not None:
+                    sent.setdefault(value, (file.rel, node.lineno))
+            elif last == "register_message_receive_handler" \
+                    and len(node.args) >= 2:
+                value = resolver.resolve(file, node.args[0])
+                if value is not None:
+                    handled.setdefault(value, (file.rel, node.lineno))
+    findings: List[Finding] = []
+    for value in sorted(set(sent) - set(handled)):
+        path, line = sent[value]
+        findings.append(Finding(
+            PASS_ID, path, line,
+            f"message type '{value}' is sent here but no peer registers "
+            "a receive handler for it"))
+    for value in sorted(set(handled) - set(sent)):
+        path, line = handled[value]
+        findings.append(Finding(
+            PASS_ID, path, line,
+            f"receive handler registered for '{value}' but nothing in "
+            "the repo sends that message type"))
+    return findings

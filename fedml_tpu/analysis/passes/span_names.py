@@ -1,0 +1,240 @@
+"""span-names — the telemetry taxonomy lint, as an analysis pass.
+
+This is ``tools/check_span_names.py`` migrated onto the shared core:
+the scanning/normalization/shape rules are byte-identical (the tool is
+now a shim over this module — ``collect``/``check``/``normalize`` keep
+their signatures and output so the existing tier-1 wiring and
+``tests/test_telemetry.py`` run unmodified), and ``run(repo)`` adapts
+the same checks to :class:`~fedml_tpu.analysis.core.Repo` findings,
+reusing the already-loaded sources.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Tuple
+
+from fedml_tpu.analysis.core import Finding, Repo
+
+PASS_ID = "span-names"
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+ROOTS = ("fedml_tpu",)
+
+_SPAN_CALL = re.compile(
+    r"\.(?:span|begin)\(\s*(?:\n\s*)?(f?)\"([^\"]+)\"")
+_METRIC_CALL = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*(?:\n\s*)?(f?)\"([^\"]+)\"")
+_SEGMENT = re.compile(r"^(?:[a-z0-9_]+|<[a-z_]+>)$")
+_ROUND_SHAPE = re.compile(
+    r"^round/<v>(?:/client/<v>)?/[a-z0-9_]+$")
+# compression spans are exactly the two codec phases — anything else
+# under compress/ is taxonomy drift
+_COMPRESS_SHAPE = re.compile(r"^compress/(?:encode|decode)$")
+# run-health namespaces: one segment after the prefix, per-entity
+# dimensions (client id, phase) ride LABELS, never the name — and memory
+# readings are instantaneous by definition, so mem/* must be gauges
+_MEM_SHAPE = re.compile(r"^mem/[a-z0-9_]+$")
+_HEALTH_SHAPE = re.compile(r"^health/[a-z0-9_]+$")
+# resilience namespace: same one-segment rule (client ids, chaos actions
+# and backends are labels); counters or gauges only — retry/reconnect/
+# quorum signals are occurrence counts, not latency distributions
+_RESILIENCE_SHAPE = re.compile(r"^resilience/[a-z0-9_]+$")
+# hierarchical-federation namespace: tier/<depth>/<signal> — exactly one
+# interpolated tier depth then one signal segment (node/client ids are
+# event fields, never name segments); counters or gauges only
+_TIER_SHAPE = re.compile(r"^tier/<v>/[a-z0-9_]+$")
+# live serving plane: serve/* spans are exactly the three swap phases
+# (staging, the flip, the publisher's encode+send); serving/* metrics are
+# one signal segment after the prefix — the endpoint id rides a label
+_SERVE_SPAN_SHAPE = re.compile(r"^serve/(?:stage|swap|publish)$")
+_SERVING_SHAPE = re.compile(r"^serving/[a-z0-9_]+$")
+# live telemetry plane: live/* is the stream/collector meta-namespace
+# (frames, seq gaps, alerts, scrapes) — one signal segment; node/job/rule
+# dimensions ride labels. Metric-only: the plane never opens spans.
+_LIVE_SHAPE = re.compile(r"^live/[a-z0-9_]+$")
+# secure aggregation: secagg/* is metric-only (the masked encode/decode
+# phases ride the existing compress/* spans); one signal segment, and
+# counters only — every secagg signal is a protocol occurrence count
+_SECAGG_SHAPE = re.compile(r"^secagg/[a-z0-9_]+$")
+# performance attribution: profile/* is the program-catalog namespace —
+# metric-only (catalog programs are NOT spans; their names live in the
+# `program` label), one signal segment, counter/gauge only (flops/bytes/
+# HBM readings are levels, capture/recompile signals are counts — a
+# histogram here would violate the bounded-frame live-plane contract)
+_PROFILE_SHAPE = re.compile(r"^profile/[a-z0-9_]+$")
+
+
+def normalize(literal: str, is_fstring: bool) -> str:
+    if is_fstring:
+        literal = re.sub(r"\{[^}]*\}", "<v>", literal)
+    # literal numeric ids (docstring examples, fixed round 0 spans) are the
+    # runtime shape of an interpolated id — same placeholder
+    return re.sub(r"(?<=/)\d+(?=/|$)", "<v>", literal)
+
+
+def _scan(path: str, src: str, out: list) -> None:
+    for m in _SPAN_CALL.finditer(src):
+        lineno = src[: m.start()].count("\n") + 1
+        out.append((path, lineno, "span",
+                    normalize(m.group(2), bool(m.group(1)))))
+    for m in _METRIC_CALL.finditer(src):
+        lineno = src[: m.start()].count("\n") + 1
+        out.append((path, lineno, m.group(1),
+                    normalize(m.group(3), bool(m.group(2)))))
+
+
+def iter_py():
+    for root in ROOTS:
+        for base, dirs, files in os.walk(os.path.join(REPO, root)):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+            for fn in files:
+                if fn.endswith(".py"):
+                    yield os.path.join(base, fn)
+
+
+def collect():
+    """[(path, lineno, kind, name)] for every instrumented literal."""
+    out = []
+    for path in sorted(iter_py()):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        _scan(path, src, out)
+    return out
+
+
+def _check_structured(entries) -> List[Tuple[str, int, str]]:
+    """[(relpath, lineno, message)] — the rule engine behind check()."""
+    problems: List[Tuple[str, int, str]] = []
+    metric_kinds = {}
+    for path, lineno, kind, name in entries:
+        rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+        where = f"{rel}:{lineno}"
+
+        def bad(msg: str, rel=rel, lineno=lineno) -> None:
+            problems.append((rel, lineno, msg))
+
+        segments = name.split("/")
+        if not all(_SEGMENT.match(s) for s in segments):
+            bad(f"{kind} name {name!r} violates the taxonomy "
+                "(lowercase [a-z0-9_] segments joined by '/')")
+            continue
+        if kind == "span" and name.startswith("round/"):
+            if not _ROUND_SHAPE.match(name):
+                bad(f"span {name!r} must follow "
+                    "round/<n>[/client/<id>]/<phase>")
+        if kind == "span" and name.startswith("compress/"):
+            if not _COMPRESS_SHAPE.match(name):
+                bad(f"span {name!r} must be compress/encode "
+                    "or compress/decode")
+        if kind == "span" and name.startswith(
+                ("mem/", "health/", "resilience/", "tier/", "live/",
+                 "secagg/", "profile/")):
+            bad(f"{name!r} — mem/, health/, resilience/, tier/, "
+                "live/, secagg/ and profile/ are metric namespaces, not "
+                "span names")
+        if kind == "span" and name.startswith("serve/"):
+            if not _SERVE_SPAN_SHAPE.match(name):
+                bad(f"span {name!r} must be serve/stage, "
+                    "serve/swap or serve/publish")
+        if kind != "span" and name.startswith("serve/"):
+            bad(f"{kind} {name!r} — serve/ is the live-plane "
+                "span namespace; its metrics live under serving/")
+        if kind != "span" and name.startswith("serving/"):
+            if not _SERVING_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be serving/<signal> "
+                    "(one segment; the endpoint id rides a label)")
+        if kind != "span" and name.startswith("mem/"):
+            if kind != "gauge":
+                bad(f"{kind} {name!r} — mem/* readings are "
+                    "instantaneous and must be gauges")
+            elif not _MEM_SHAPE.match(name):
+                bad(f"gauge {name!r} must be mem/<reading> "
+                    "(one segment; device/phase go in labels)")
+        if kind != "span" and name.startswith("health/"):
+            if not _HEALTH_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be health/<signal> "
+                    "(one segment; client ids go in labels)")
+        if kind != "span" and name.startswith("resilience/"):
+            if not _RESILIENCE_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be resilience/<signal> "
+                    "(one segment; clients/actions/backends go in labels)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — resilience/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
+                    "histograms")
+        if kind != "span" and name.startswith("tier/"):
+            if not _TIER_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be tier/<depth>/"
+                    "<signal> (one depth segment, one signal segment; "
+                    "node/client ids ride event fields)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — tier/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
+                    "histograms")
+        if kind != "span" and name.startswith("live/"):
+            if not _LIVE_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be live/<signal> "
+                    "(one segment; node/job/rule dimensions ride labels)")
+        if kind != "span" and name.startswith("profile/"):
+            if not _PROFILE_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be profile/<signal> "
+                    "(one segment; program names and capture triggers "
+                    "ride labels)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — profile/* signals are "
+                    "levels (gauge) or occurrence counts (counter), not "
+                    "histograms")
+        if kind != "span" and name.startswith("secagg/"):
+            if not _SECAGG_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be secagg/<signal> "
+                    "(one segment; rounds/clients/tiers ride event "
+                    "fields)")
+            elif kind != "counter":
+                bad(f"{kind} {name!r} — secagg/* signals are "
+                    "protocol occurrence counts; counters only")
+        if kind != "span":
+            prev = metric_kinds.get(name)
+            if prev is not None and prev[0] != kind:
+                bad(f"metric {name!r} registered as {kind} but "
+                    f"already a {prev[0]} at {prev[1]}")
+            else:
+                metric_kinds.setdefault(name, (kind, where))
+    return problems
+
+
+def check(entries):
+    """Historical API: problem strings, ``path:line: message``."""
+    return [f"{rel}:{lineno}: {msg}"
+            for rel, lineno, msg in _check_structured(entries)]
+
+
+_DUP_REF = re.compile(r"(registered as \w+ but already a \w+ at .+):\d+$")
+
+
+def run(repo: Repo) -> List[Finding]:
+    # feed repo-relative paths (file.rel) so findings carry the same
+    # paths the runner's allow/baseline/--changed plumbing keys on,
+    # whatever --root the analysis runs against
+    entries: list = []
+    for file in repo.package_files():
+        _scan(file.rel, file.src, entries)
+    # the duplicate-kind message embeds the first registration's
+    # `path:line` (kept byte-identical in the shim's check()); baseline
+    # keys are line-number-free by contract, so the Finding variant
+    # drops the line
+    return [Finding(PASS_ID, rel, lineno, _DUP_REF.sub(r"\1", msg))
+            for rel, lineno, msg in _check_structured(entries)]
+
+
+def main() -> int:
+    entries = collect()
+    problems = check(entries)
+    for p in problems:
+        print(p)  # noqa: T201 (CLI output)
+    if problems:
+        print(f"\n{len(problems)} problem(s)")  # noqa: T201 (CLI output)
+        return 1
+    print(f"span-name lint clean ({len(entries)} instrumented names)")  # noqa: T201 (CLI output)
+    return 0

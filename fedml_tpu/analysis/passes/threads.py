@@ -1,0 +1,243 @@
+"""thread-safety — state shared across thread entrypoints is lock-guarded.
+
+Per class we build the set of *thread entrypoints*:
+
+* methods handed to ``threading.Thread(target=...)`` / ``Timer``;
+* comm handlers registered via ``register_message_receive_handler``;
+* HTTP ``do_*`` methods;
+* ``run`` on ``threading.Thread`` subclasses;
+
+plus the pseudo-entrypoint ``<caller>`` for everything reachable from
+the owning thread.  Intra-class reachability follows ``self.method()``
+calls.  A ``self.*`` attribute written (outside ``__init__``) from two
+or more distinct entrypoints, with at least one of those accesses not
+under a ``with <lock>:`` block, is a finding — that is exactly the
+timer-vs-handler races PRs 4-8 kept fixing by hand.
+
+A helper whose every intra-class call site sits inside a lock block is
+treated as lock-held (the ``with self._lock: self._flush()`` pattern).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.core import (
+    Finding,
+    Repo,
+    SourceFile,
+    call_name,
+    dotted,
+    in_lock_block,
+)
+
+PASS_ID = "thread-safety"
+
+_DO_METHOD = re.compile(r"^do_[A-Z]+$")
+_MAIN = "<caller>"
+# attributes that are themselves synchronization/thread handles: writing
+# the handle from two entrypoints is the lifecycle pattern (start/stop),
+# not a data race the lock discipline covers
+_HANDLE_ATTR = re.compile(r"(lock|thread|timer|_cv|cond|event|stop|"
+                          r"shutdown|closed|running|finished|done)", re.I)
+
+
+def _methods(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _thread_entrypoints(cls: ast.ClassDef,
+                        methods: Dict[str, ast.AST]) -> Dict[str, str]:
+    """entrypoint method name -> how it becomes a thread entrypoint."""
+    out: Dict[str, str] = {}
+    for name in methods:
+        if _DO_METHOD.match(name):
+            out[name] = "HTTP handler"
+    bases = " ".join(filter(None, (dotted(b) for b in cls.bases)))
+    if "Thread" in bases and "run" in methods:
+        out["run"] = "Thread.run"
+    for m in methods.values():
+        for node in ast.walk(m):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            last = name.split(".")[-1]
+            target: Optional[ast.AST] = None
+            if last in ("Thread", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+                if target is None and last == "Timer" and len(node.args) >= 2:
+                    target = node.args[1]
+                how = f"threading.{last} target"
+            elif last == "register_message_receive_handler" \
+                    and len(node.args) >= 2:
+                target = node.args[1]
+                how = "comm handler"
+            else:
+                continue
+            attr = _self_attr(target) if target is not None else None
+            if attr is not None and attr in methods:
+                out.setdefault(attr, how)
+    return out
+
+
+def _calls_of_self(m: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(m):
+        if isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _reachable_from(entry: str, call_edges: Dict[str, Set[str]]) -> Set[str]:
+    seen: Set[str] = set()
+    stack = [entry]
+    while stack:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(call_edges.get(cur, ()))
+    return seen
+
+
+def _lock_held_methods(file: SourceFile, cls: ast.ClassDef,
+                       methods: Dict[str, ast.AST]) -> Set[str]:
+    """Methods whose every intra-class call site is under a lock."""
+    sites: Dict[str, List[bool]] = {}
+    for m in methods.values():
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr in methods:
+                    sites.setdefault(attr, []).append(
+                        in_lock_block(file, node))
+    return {name for name, guards in sites.items()
+            if guards and all(guards)}
+
+
+def _check_class(file: SourceFile, cls: ast.ClassDef,
+                 findings: List[Finding]) -> None:
+    # BaseHTTPRequestHandler subclasses are instantiated per request —
+    # their self.* is never shared between threads (shared state lives
+    # on self.server or in closures, which other classes own)
+    bases = " ".join(filter(None, (dotted(b) for b in cls.bases)))
+    if "RequestHandler" in bases:
+        return
+    methods = _methods(cls)
+    entries = _thread_entrypoints(cls, methods)
+    if not entries:
+        return
+    call_edges = {name: _calls_of_self(m) & set(methods)
+                  for name, m in methods.items()}
+    lock_held = _lock_held_methods(file, cls, methods)
+
+    # comm handlers all run on the one receive-loop thread — they are a
+    # single logical entrypoint, concurrent with timers/HTTP/<caller>
+    # but serialized with each other
+    label = {entry: ("<comm>" if how == "comm handler" else entry)
+             for entry, how in entries.items()}
+    how_of = {label[e]: how for e, how in entries.items()}
+
+    # attribute each method to the entrypoints that reach it
+    attribution: Dict[str, Set[str]] = {name: set() for name in methods}
+    for entry in entries:
+        for m in _reachable_from(entry, call_edges):
+            attribution[m].add(label[entry])
+    thread_reached = {m for m, owners in attribution.items() if owners}
+    for name in methods:
+        if name.startswith("__"):
+            continue
+        how = entries.get(name)
+        if how is None:
+            # public methods are always callable from the owning thread;
+            # private helpers only when not exclusively thread-internal
+            if not name.startswith("_") or name not in thread_reached:
+                attribution[name].add(_MAIN)
+        elif not name.startswith("_") and how.startswith("threading."):
+            # a PUBLIC Thread/Timer target is dual-role: thread body AND
+            # plain API surface (the flush()-as-target pattern).  Comm
+            # handlers, do_* and Thread.run are framework-invoked only —
+            # public by convention, never called by the owning thread.
+            attribution[name].add(_MAIN)
+    # <caller>-attributed methods propagate through their call chains
+    main_reach: Set[str] = set()
+    for name, owners in list(attribution.items()):
+        if _MAIN in owners:
+            main_reach |= _reachable_from(name, call_edges)
+    for m in main_reach:
+        if m in attribution:
+            attribution[m].add(_MAIN)
+
+    # accesses[attr] = list of (entrypoint, is_write, guarded, node)
+    accesses: Dict[str, List[Tuple[str, bool, bool, ast.AST]]] = {}
+    for name, m in methods.items():
+        if name in ("__init__", "__del__", "__enter__", "__exit__"):
+            continue
+        owners = attribution.get(name) or set()
+        if not owners:
+            continue
+        body_guarded = name in lock_held
+        for node in ast.walk(m):
+            attr = _self_attr(node)
+            if attr is None or attr in methods:
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            guarded = body_guarded or in_lock_block(file, node)
+            for owner in owners:
+                accesses.setdefault(attr, []).append(
+                    (owner, is_write, guarded, node))
+
+    for attr in sorted(accesses):
+        if _HANDLE_ATTR.search(attr):
+            continue
+        recs = accesses[attr]
+        writers = {owner for owner, is_write, _, _ in recs if is_write}
+        if len(writers) < 2:
+            continue
+        if not (writers - {_MAIN}):
+            continue
+        # unguarded READS are legal (the idiom is: mutate under the
+        # lock, read a just-written snapshot on the owning thread) —
+        # the race class PRs 4-8 kept fixing is the unguarded WRITE
+        unguarded = [(owner, node)
+                     for owner, is_write, guarded, node in recs
+                     if is_write and not guarded]
+        if not unguarded:
+            continue
+        unguarded.sort(key=lambda r: r[1].lineno)
+        owner, node = unguarded[0]
+        names = ", ".join(sorted(
+            e if e == _MAIN else f"{e} ({how_of.get(e, '?')})"
+            for e in writers))
+        findings.append(Finding(
+            PASS_ID, file.rel, node.lineno,
+            f"{cls.name}.self.{attr} is written from multiple thread "
+            f"entrypoints [{names}] with an unguarded write in "
+            f"'{owner}' — guard the writes with the instance lock"))
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for file in repo.package_files():
+        tree = file.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class(file, node, findings)
+    return findings

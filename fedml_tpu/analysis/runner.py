@@ -1,0 +1,264 @@
+"""graftcheck runner — pass registry, suppression, baseline, CLI.
+
+Finding flow: every pass reports raw findings; the runner then drops
+
+1. line waivers — ``# graft: allow(<pass-id>): <why>`` on the finding
+   line (or a standalone comment directly above).  An allow *without*
+   the justification is itself a finding (pass id ``suppression``);
+2. baseline entries — ``analysis_baseline.txt`` lines of the form
+   ``pass-id|path|message :: justification`` matching the finding's
+   key (line numbers excluded, so unrelated edits don't invalidate it).
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 infrastructure error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+from fedml_tpu.analysis import core
+from fedml_tpu.analysis.core import Finding, Repo
+from fedml_tpu.analysis.passes import (
+    donation,
+    host_sync,
+    jit_purity,
+    lint,
+    messages,
+    span_names,
+    threads,
+)
+
+ALL_PASSES = {
+    jit_purity.PASS_ID: jit_purity,
+    donation.PASS_ID: donation,
+    host_sync.PASS_ID: host_sync,
+    threads.PASS_ID: threads,
+    messages.PASS_ID: messages,
+    span_names.PASS_ID: span_names,
+    lint.PASS_ID: lint,
+}
+
+BASELINE_NAME = "analysis_baseline.txt"
+SUPPRESSION_PASS = "suppression"
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """key -> justification.  Every entry must carry one."""
+    out: Dict[str, str] = {}
+    if not os.path.isfile(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            entry, sep, why = line.partition(" :: ")
+            if not sep or not why.strip():
+                raise BaselineError(
+                    f"{path}:{i}: baseline entry needs a justification "
+                    "('pass-id|path|message :: why')")
+            if entry.count("|") < 2:
+                raise BaselineError(
+                    f"{path}:{i}: malformed baseline key "
+                    "(expected 'pass-id|path|message')")
+            out[entry.strip()] = why.strip()
+    return out
+
+
+def _allow_findings(repo: Repo) -> List[Finding]:
+    """Allow-comments missing their mandatory justification."""
+    out: List[Finding] = []
+    for file in repo.files:
+        for line, (ids, why) in sorted(file.allows.items()):
+            if why is None or not why.strip():
+                out.append(Finding(
+                    SUPPRESSION_PASS, file.rel, line,
+                    f"graft: allow({', '.join(sorted(ids))}) requires a "
+                    "justification — '# graft: allow(<pass-id>): <why>'"))
+    return out
+
+
+class AnalysisResult:
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []       # unsuppressed
+        self.suppressed_inline: List[Finding] = []
+        self.suppressed_baseline: List[Finding] = []
+        self.stale_baseline: List[str] = []
+        self.counts: Dict[str, int] = {}
+        self.files = 0
+        self.elapsed_s = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "schema": "graftcheck/v1",
+            "ok": self.ok,
+            "files": self.files,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "counts": {k: v for k, v in sorted(self.counts.items())},
+            "suppressed": {
+                "inline": len(self.suppressed_inline),
+                "baseline": len(self.suppressed_baseline),
+            },
+            "stale_baseline": len(self.stale_baseline),
+            "findings": [
+                {"pass": f.pass_id, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in self.findings],
+        }, sort_keys=True)
+
+
+def run_analysis(root: str,
+                 passes: Optional[Sequence[str]] = None,
+                 baseline_path: Optional[str] = None,
+                 changed_only: Optional[Set[str]] = None,
+                 repo: Optional[Repo] = None) -> AnalysisResult:
+    t0 = time.monotonic()
+    result = AnalysisResult()
+    repo = repo if repo is not None else Repo(root)
+    result.files = len(repo.files)
+    ids = list(passes) if passes else list(ALL_PASSES)
+    for pid in ids:
+        if pid not in ALL_PASSES:
+            raise ValueError(f"unknown pass {pid!r} "
+                             f"(have: {', '.join(sorted(ALL_PASSES))})")
+
+    if baseline_path is None:
+        baseline_path = os.path.join(repo.root, BASELINE_NAME)
+    baseline = load_baseline(baseline_path)
+    matched: Set[str] = set()
+
+    raw: List[Finding] = []
+    for pid in ids:
+        found = ALL_PASSES[pid].run(repo)
+        result.counts[pid] = 0
+        raw.extend(found)
+    raw.extend(_allow_findings(repo))
+    result.counts.setdefault(SUPPRESSION_PASS, 0)
+
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.pass_id,
+                                        f.message)):
+        file = repo.by_rel.get(f.path)
+        if file is not None and f.pass_id != SUPPRESSION_PASS \
+                and file.allowed(f.pass_id, f.line):
+            result.suppressed_inline.append(f)
+            continue
+        if f.key in baseline:
+            matched.add(f.key)
+            result.suppressed_baseline.append(f)
+            continue
+        if changed_only is not None and f.path not in changed_only:
+            continue
+        result.findings.append(f)
+        result.counts[f.pass_id] = result.counts.get(f.pass_id, 0) + 1
+
+    # a --passes subset run can only judge entries of the passes that
+    # actually executed — anything else would tell the developer to
+    # delete entries a full run still needs
+    ran = set(ids) | {SUPPRESSION_PASS}
+    result.stale_baseline = sorted(
+        key for key in set(baseline) - matched
+        if key.split("|", 1)[0] in ran)
+    result.elapsed_s = time.monotonic() - t0
+    return result
+
+
+def _changed_files(root: str, base: str) -> Set[str]:
+    out: Set[str] = set()
+    for args in (["git", "diff", "--name-only", base, "--"],
+                 ["git", "diff", "--name-only", "--cached", "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(args, cwd=root, capture_output=True,
+                              text=True, check=False)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
+def _default_root() -> str:
+    # core.py lives at <root>/fedml_tpu/analysis/core.py
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(core.__file__))))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="semantic static analysis for fedml_tpu's invariants")
+    ap.add_argument("--root", default=_default_root(),
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--changed", metavar="BASE", default=None,
+                    help="only report findings in files changed vs the "
+                         "given git ref (analysis still runs repo-wide)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one machine-readable JSON line on stdout")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="print baseline lines for the current findings "
+                         "(fill in the ':: justification' before use)")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for pid, module in ALL_PASSES.items():
+            doc = (module.__doc__ or "").strip().splitlines()[0]
+            print(f"{pid:17s} {doc}")  # noqa: T201 (CLI output)
+        return 0
+
+    passes = ([p.strip() for p in args.passes.split(",") if p.strip()]
+              if args.passes else None)
+    changed: Optional[Set[str]] = None
+    try:
+        if args.changed is not None:
+            changed = _changed_files(args.root, args.changed)
+        result = run_analysis(args.root, passes=passes,
+                              baseline_path=args.baseline,
+                              changed_only=changed)
+    except (ValueError, RuntimeError) as e:
+        print(f"graftcheck: error: {e}", file=sys.stderr)  # noqa: T201 (CLI output)
+        return 2
+
+    if args.write_baseline:
+        for f in result.findings:
+            print(f"{f.key} :: TODO justify")  # noqa: T201 (CLI output)
+        return 0 if result.ok else 1
+
+    if args.as_json:
+        print(result.to_json())  # noqa: T201 (CLI output)
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())  # noqa: T201 (CLI output)
+    for key in result.stale_baseline:
+        print(f"graftcheck: note: stale baseline entry (fixed? remove "  # noqa: T201 (CLI output)
+              f"it): {key}")
+    n_sup = len(result.suppressed_inline) + len(result.suppressed_baseline)
+    scope = (f" in {len(changed)} changed file(s)"
+             if changed is not None else "")
+    if result.findings:
+        print(f"\ngraftcheck: {len(result.findings)} finding(s){scope} "  # noqa: T201 (CLI output)
+              f"({n_sup} suppressed) across {result.files} files "
+              f"in {result.elapsed_s:.1f}s")
+        return 1
+    print(f"graftcheck clean{scope}: {result.files} files, "  # noqa: T201 (CLI output)
+          f"{len(ALL_PASSES) if passes is None else len(passes)} passes, "
+          f"{n_sup} suppressed finding(s), {result.elapsed_s:.1f}s")
+    return 0

@@ -801,6 +801,49 @@ def telemetry_profile(trace_rounds: str, trace_dir: str, cmd) -> None:
         raise SystemExit(rc)
 
 
+@cli.command()
+@click.option("--passes", default=None,
+              help="comma-separated pass ids (default: all)")
+@click.option("--changed", metavar="BASE", default=None,
+              help="only report findings in files changed vs a git ref")
+@click.option("--baseline", default=None,
+              help="baseline file (default: <repo>/analysis_baseline.txt)")
+@click.option("--root", default=None,
+              help="repo root (default: auto-detected)")
+@click.option("--json", "as_json", is_flag=True,
+              help="one machine-readable JSON line")
+@click.option("--write-baseline", is_flag=True,
+              help="print baseline-formatted lines for current findings")
+@click.option("--list-passes", is_flag=True)
+def analyze(passes, changed, baseline, root, as_json, write_baseline,
+            list_passes) -> None:
+    """Run graftcheck — the repo's semantic static analysis.
+
+    Seven passes machine-check the invariants PRs 2-10 established:
+    jit-purity, donation safety, host-sync discipline, thread-safety,
+    message contracts, the span-name taxonomy and the in-tree lint.
+    Same engine as ``tools/graftcheck.py``; see docs/static_analysis.md.
+    """
+    from fedml_tpu.analysis.runner import main as graftcheck_main
+
+    argv = []
+    if passes:
+        argv += ["--passes", passes]
+    if changed:
+        argv += ["--changed", changed]
+    if baseline:
+        argv += ["--baseline", baseline]
+    if root:
+        argv += ["--root", root]
+    if as_json:
+        argv += ["--json"]
+    if write_baseline:
+        argv += ["--write-baseline"]
+    if list_passes:
+        argv += ["--list-passes"]
+    raise SystemExit(graftcheck_main(argv))
+
+
 @cli.group()
 def storage() -> None:
     """Manage stored artifacts (reference: `fedml storage`,

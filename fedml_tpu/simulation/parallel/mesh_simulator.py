@@ -611,6 +611,9 @@ class MeshFedAvgAPI:
         try:
             for round_idx in range(self._start_round, int(self.args.comm_round)):
                 self.train_one_round(round_idx)
+            # graft: allow(host-sync): the final barrier — rounds chain on
+            # device all run long; the run's wall clock is only honest if
+            # the last round's work has actually retired
             jax.block_until_ready(self.global_params)
         finally:
             self._pipeline.close()

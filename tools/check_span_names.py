@@ -1,235 +1,45 @@
 #!/usr/bin/env python
 """Span/metric name lint — keeps the telemetry taxonomy from drifting.
 
-Scans ``fedml_tpu/`` for instrumented literals:
+Shim: the rules moved to ``fedml_tpu.analysis.passes.span_names`` (the
+``span-names`` pass of ``tools/graftcheck.py``).  This entrypoint keeps
+the historical CLI, exit codes, output and module API
+(``collect``/``check``/``normalize``) so the existing tier-1 wiring and
+``tests/test_telemetry.py`` run unmodified.
 
-  tracer.span("...") / tracer.begin("...")
-  registry.counter("...") / .gauge("...") / .histogram("...")
-
-and fails on
-
-- names violating the taxonomy: ``/``-separated lowercase ``[a-z0-9_]``
-  segments (f-string ``{expr}`` placeholders normalize to ``<v>``);
-- ``round/...`` span names that do not follow
-  ``round/<n>[/client/<id>]/<phase>``;
-- the same metric name registered with two different instrument kinds
-  (the registry raises at runtime; this catches it statically).
-
-Run from CI via ``tests/test_telemetry.py`` — no extra infrastructure.
+Like ``tools/lint.py``, the import bypasses ``fedml_tpu/__init__.py``
+so the lint stays usable when the package import chain is broken.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
+import types
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ROOTS = ("fedml_tpu",)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-_SPAN_CALL = re.compile(
-    r"\.(?:span|begin)\(\s*(?:\n\s*)?(f?)\"([^\"]+)\"")
-_METRIC_CALL = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*(?:\n\s*)?(f?)\"([^\"]+)\"")
-_SEGMENT = re.compile(r"^(?:[a-z0-9_]+|<[a-z_]+>)$")
-_ROUND_SHAPE = re.compile(
-    r"^round/<v>(?:/client/<v>)?/[a-z0-9_]+$")
-# compression spans are exactly the two codec phases — anything else
-# under compress/ is taxonomy drift
-_COMPRESS_SHAPE = re.compile(r"^compress/(?:encode|decode)$")
-# run-health namespaces: one segment after the prefix, per-entity
-# dimensions (client id, phase) ride LABELS, never the name — and memory
-# readings are instantaneous by definition, so mem/* must be gauges
-_MEM_SHAPE = re.compile(r"^mem/[a-z0-9_]+$")
-_HEALTH_SHAPE = re.compile(r"^health/[a-z0-9_]+$")
-# resilience namespace: same one-segment rule (client ids, chaos actions
-# and backends are labels); counters or gauges only — retry/reconnect/
-# quorum signals are occurrence counts, not latency distributions
-_RESILIENCE_SHAPE = re.compile(r"^resilience/[a-z0-9_]+$")
-# hierarchical-federation namespace: tier/<depth>/<signal> — exactly one
-# interpolated tier depth then one signal segment (node/client ids are
-# event fields, never name segments); counters or gauges only
-_TIER_SHAPE = re.compile(r"^tier/<v>/[a-z0-9_]+$")
-# live serving plane: serve/* spans are exactly the three swap phases
-# (staging, the flip, the publisher's encode+send); serving/* metrics are
-# one signal segment after the prefix — the endpoint id rides a label
-_SERVE_SPAN_SHAPE = re.compile(r"^serve/(?:stage|swap|publish)$")
-_SERVING_SHAPE = re.compile(r"^serving/[a-z0-9_]+$")
-# live telemetry plane: live/* is the stream/collector meta-namespace
-# (frames, seq gaps, alerts, scrapes) — one signal segment; node/job/rule
-# dimensions ride labels. Metric-only: the plane never opens spans.
-_LIVE_SHAPE = re.compile(r"^live/[a-z0-9_]+$")
-# secure aggregation: secagg/* is metric-only (the masked encode/decode
-# phases ride the existing compress/* spans); one signal segment, and
-# counters only — every secagg signal is a protocol occurrence count
-_SECAGG_SHAPE = re.compile(r"^secagg/[a-z0-9_]+$")
-# performance attribution: profile/* is the program-catalog namespace —
-# metric-only (catalog programs are NOT spans; their names live in the
-# `program` label), one signal segment, counter/gauge only (flops/bytes/
-# HBM readings are levels, capture/recompile signals are counts — a
-# histogram here would violate the bounded-frame live-plane contract)
-_PROFILE_SHAPE = re.compile(r"^profile/[a-z0-9_]+$")
+_stubbed = False
+if "fedml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("fedml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO, "fedml_tpu")]
+    sys.modules["fedml_tpu"] = _pkg
+    _stubbed = True
 
+from fedml_tpu.analysis.passes.span_names import (  # noqa: E402,F401
+    REPO,
+    ROOTS,
+    check,
+    collect,
+    iter_py,
+    main,
+    normalize,
+)
 
-def normalize(literal: str, is_fstring: bool) -> str:
-    if is_fstring:
-        literal = re.sub(r"\{[^}]*\}", "<v>", literal)
-    # literal numeric ids (docstring examples, fixed round 0 spans) are the
-    # runtime shape of an interpolated id — same placeholder
-    return re.sub(r"(?<=/)\d+(?=/|$)", "<v>", literal)
-
-
-def iter_py():
-    for root in ROOTS:
-        for base, dirs, files in os.walk(os.path.join(REPO, root)):
-            dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
-            for fn in files:
-                if fn.endswith(".py"):
-                    yield os.path.join(base, fn)
-
-
-def collect():
-    """[(path, lineno, kind, name)] for every instrumented literal."""
-    out = []
-    for path in sorted(iter_py()):
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        for m in _SPAN_CALL.finditer(src):
-            lineno = src[: m.start()].count("\n") + 1
-            out.append((path, lineno, "span",
-                        normalize(m.group(2), bool(m.group(1)))))
-        for m in _METRIC_CALL.finditer(src):
-            lineno = src[: m.start()].count("\n") + 1
-            out.append((path, lineno, m.group(1),
-                        normalize(m.group(3), bool(m.group(2)))))
-    return out
-
-
-def check(entries):
-    problems = []
-    metric_kinds = {}
-    for path, lineno, kind, name in entries:
-        rel = os.path.relpath(path, REPO)
-        where = f"{rel}:{lineno}"
-        segments = name.split("/")
-        if not all(_SEGMENT.match(s) for s in segments):
-            problems.append(
-                f"{where}: {kind} name {name!r} violates the taxonomy "
-                "(lowercase [a-z0-9_] segments joined by '/')")
-            continue
-        if kind == "span" and name.startswith("round/"):
-            if not _ROUND_SHAPE.match(name):
-                problems.append(
-                    f"{where}: span {name!r} must follow "
-                    "round/<n>[/client/<id>]/<phase>")
-        if kind == "span" and name.startswith("compress/"):
-            if not _COMPRESS_SHAPE.match(name):
-                problems.append(
-                    f"{where}: span {name!r} must be compress/encode "
-                    "or compress/decode")
-        if kind == "span" and name.startswith(
-                ("mem/", "health/", "resilience/", "tier/", "live/",
-                 "secagg/", "profile/")):
-            problems.append(
-                f"{where}: {name!r} — mem/, health/, resilience/, tier/, "
-                "live/, secagg/ and profile/ are metric namespaces, not "
-                "span names")
-        if kind == "span" and name.startswith("serve/"):
-            if not _SERVE_SPAN_SHAPE.match(name):
-                problems.append(
-                    f"{where}: span {name!r} must be serve/stage, "
-                    "serve/swap or serve/publish")
-        if kind != "span" and name.startswith("serve/"):
-            problems.append(
-                f"{where}: {kind} {name!r} — serve/ is the live-plane "
-                "span namespace; its metrics live under serving/")
-        if kind != "span" and name.startswith("serving/"):
-            if not _SERVING_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be serving/<signal> "
-                    "(one segment; the endpoint id rides a label)")
-        if kind != "span" and name.startswith("mem/"):
-            if kind != "gauge":
-                problems.append(
-                    f"{where}: {kind} {name!r} — mem/* readings are "
-                    "instantaneous and must be gauges")
-            elif not _MEM_SHAPE.match(name):
-                problems.append(
-                    f"{where}: gauge {name!r} must be mem/<reading> "
-                    "(one segment; device/phase go in labels)")
-        if kind != "span" and name.startswith("health/"):
-            if not _HEALTH_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be health/<signal> "
-                    "(one segment; client ids go in labels)")
-        if kind != "span" and name.startswith("resilience/"):
-            if not _RESILIENCE_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be resilience/<signal> "
-                    "(one segment; clients/actions/backends go in labels)")
-            elif kind == "histogram":
-                problems.append(
-                    f"{where}: {kind} {name!r} — resilience/* signals are "
-                    "occurrence counts (counter) or levels (gauge), not "
-                    "histograms")
-        if kind != "span" and name.startswith("tier/"):
-            if not _TIER_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be tier/<depth>/"
-                    "<signal> (one depth segment, one signal segment; "
-                    "node/client ids ride event fields)")
-            elif kind == "histogram":
-                problems.append(
-                    f"{where}: {kind} {name!r} — tier/* signals are "
-                    "occurrence counts (counter) or levels (gauge), not "
-                    "histograms")
-        if kind != "span" and name.startswith("live/"):
-            if not _LIVE_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be live/<signal> "
-                    "(one segment; node/job/rule dimensions ride labels)")
-        if kind != "span" and name.startswith("profile/"):
-            if not _PROFILE_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be profile/<signal> "
-                    "(one segment; program names and capture triggers "
-                    "ride labels)")
-            elif kind == "histogram":
-                problems.append(
-                    f"{where}: {kind} {name!r} — profile/* signals are "
-                    "levels (gauge) or occurrence counts (counter), not "
-                    "histograms")
-        if kind != "span" and name.startswith("secagg/"):
-            if not _SECAGG_SHAPE.match(name):
-                problems.append(
-                    f"{where}: {kind} {name!r} must be secagg/<signal> "
-                    "(one segment; rounds/clients/tiers ride event "
-                    "fields)")
-            elif kind != "counter":
-                problems.append(
-                    f"{where}: {kind} {name!r} — secagg/* signals are "
-                    "protocol occurrence counts; counters only")
-        if kind != "span":
-            prev = metric_kinds.get(name)
-            if prev is not None and prev[0] != kind:
-                problems.append(
-                    f"{where}: metric {name!r} registered as {kind} but "
-                    f"already a {prev[0]} at {prev[1]}")
-            else:
-                metric_kinds.setdefault(name, (kind, where))
-    return problems
-
-
-def main() -> int:
-    entries = collect()
-    problems = check(entries)
-    for p in problems:
-        print(p)
-    if problems:
-        print(f"\n{len(problems)} problem(s)")
-        return 1
-    print(f"span-name lint clean ({len(entries)} instrumented names)")
-    return 0
-
+if _stubbed:
+    for _name in [m for m in sys.modules
+                  if m == "fedml_tpu" or m.startswith("fedml_tpu.")]:
+        del sys.modules[_name]
 
 if __name__ == "__main__":
     sys.exit(main())

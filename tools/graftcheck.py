@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""graftcheck — semantic static analysis for the repo's own invariants.
+
+Thin CLI over :mod:`fedml_tpu.analysis` (also reachable as
+``fedml_tpu analyze``).  Runs the seven passes (jit-purity, donation,
+host-sync, thread-safety, message-contract, span-names, lint) over the
+repo and fails on any unsuppressed finding.
+
+  python tools/graftcheck.py                 # repo-wide, exit 1 on findings
+  python tools/graftcheck.py --changed main  # only findings in touched files
+  python tools/graftcheck.py --json          # one JSON line (bench-style)
+  python tools/graftcheck.py --list-passes
+
+Suppression: ``# graft: allow(<pass-id>): <why>`` on the line, or a
+``pass-id|path|message :: why`` entry in ``analysis_baseline.txt``.
+See ``docs/static_analysis.md``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.analysis.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """In-tree linter — the offline stand-in for ruff.
 
-The reference enforces quality with pylint + CodeQL workflows
-(``.github/workflows/pylint.yml``); this environment ships no linter and
-no package installs, so CI runs this ast-based checker (and `ruff check`
-when available — see .github/workflows/ci.yml). Checks:
+Shim: the checks moved to ``fedml_tpu.analysis.passes.lint`` (the
+``lint`` pass of ``tools/graftcheck.py``).  This entrypoint keeps the
+historical CLI, exit codes, output and module API (``check_file`` /
+``iter_py`` / ``main``) so CI (`.github/workflows/ci.yml`) and local
+habits keep working.  Checks, unchanged:
 
   F401  unused module-level import (skipped in __init__.py re-exports)
   E722  bare except
@@ -14,121 +15,45 @@ when available — see .github/workflows/ci.yml). Checks:
   T201  print() in library code (CLI/tools/tests exempt)
 
 `# noqa` on the offending line suppresses any check.
+
+The analysis package is stdlib-only, and the import below deliberately
+bypasses ``fedml_tpu/__init__.py``: the linter must keep reporting E999
+even when the package import chain itself is the thing that's broken.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
+import types
 
-MAX_LINE = 100
-LIB_DIRS = ("fedml_tpu",)
-PRINT_EXEMPT = ("cli.py", "env_collect.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
+# import the subpackage WITHOUT executing fedml_tpu/__init__.py (which
+# pulls numpy/arguments/runner): register a bare namespace stub, import
+# what we need (analysis modules are stdlib-only), then drop the stub so
+# a later real `import fedml_tpu` in this process is unaffected
+_stubbed = False
+if "fedml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("fedml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO, "fedml_tpu")]
+    sys.modules["fedml_tpu"] = _pkg
+    _stubbed = True
 
-def iter_py(root):
-    for base, dirs, files in os.walk(root):
-        dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
-        for fn in files:
-            if fn.endswith(".py"):
-                yield os.path.join(base, fn)
+from fedml_tpu.analysis.passes.lint import (  # noqa: E402,F401
+    LIB_DIRS,
+    MAX_LINE,
+    PRINT_EXEMPT,
+    check_file,
+    imported_names,
+    iter_py,
+    main,
+)
 
-
-def imported_names(node):
-    if isinstance(node, ast.Import):
-        for a in node.names:
-            yield (a.asname or a.name.split(".")[0]), node.lineno
-    elif isinstance(node, ast.ImportFrom):
-        for a in node.names:
-            if a.name != "*":
-                yield (a.asname or a.name), node.lineno
-
-
-def check_file(path):
-    problems = []
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    lines = src.splitlines()
-    noqa = {i + 1 for i, l in enumerate(lines) if "# noqa" in l}
-
-    for i, line in enumerate(lines, 1):
-        if i in noqa:
-            continue
-        if line.rstrip("\n") != line.rstrip():
-            problems.append((i, "W291 trailing whitespace"))
-        if len(line) > MAX_LINE:
-            problems.append((i, f"E501 line too long ({len(line)})"))
-
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        problems.append((e.lineno or 0, f"E999 syntax error: {e.msg}"))
-        return problems
-
-    # F401: module-level imports never referenced
-    if os.path.basename(path) != "__init__.py":
-        imports = {}
-        for node in tree.body:
-            if (isinstance(node, ast.ImportFrom)
-                    and node.module == "__future__"):
-                continue
-            for name, lineno in imported_names(node):
-                imports[name] = lineno
-        used = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Name):
-                used.add(node.id)
-            elif isinstance(node, ast.Attribute):
-                n = node
-                while isinstance(n, ast.Attribute):
-                    n = n.value
-                if isinstance(n, ast.Name):
-                    used.add(n.id)
-        # names in __all__ / docstring-style re-export count as used
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                used.add(node.value)
-        for name, lineno in imports.items():
-            if name not in used and lineno not in noqa:
-                problems.append((lineno, f"F401 unused import '{name}'"))
-
-    in_lib = any(path.startswith(d + os.sep) or f"/{d}/" in path
-                 for d in LIB_DIRS)
-    for node in ast.walk(tree):
-        lineno = getattr(node, "lineno", 0)
-        if lineno in noqa:
-            continue
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append((lineno, "E722 bare except"))
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in node.args.defaults + node.args.kw_defaults:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    problems.append(
-                        (default.lineno, "B006 mutable default argument"))
-        if (in_lib and isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-                and os.path.basename(path) not in PRINT_EXEMPT):
-            problems.append((lineno, "T201 print() in library code"))
-    return problems
-
-
-def main():
-    roots = sys.argv[1:] or ["fedml_tpu", "tools", "examples", "bench.py",
-                             "__graft_entry__.py"]
-    total = 0
-    for root in roots:
-        paths = [root] if root.endswith(".py") else list(iter_py(root))
-        for path in sorted(paths):
-            for lineno, msg in check_file(path):
-                print(f"{path}:{lineno}: {msg}")
-                total += 1
-    if total:
-        print(f"\n{total} problem(s)")
-        return 1
-    print("lint clean")
-    return 0
-
+if _stubbed:
+    for _name in [m for m in sys.modules
+                  if m == "fedml_tpu" or m.startswith("fedml_tpu.")]:
+        del sys.modules[_name]
 
 if __name__ == "__main__":
     sys.exit(main())
