@@ -68,6 +68,9 @@ class SysStatsSampler:
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
         self.samples = 0
+        # sample_once() is public API and the poll-thread body: the
+        # sample counter is shared state, so the increment takes a lock
+        self._lock = threading.Lock()
 
     def sample_once(self) -> Dict:
         entry = {
@@ -76,7 +79,8 @@ class SysStatsSampler:
             "devices": sample_device_stats(),
         }
         self._metrics.log(entry)
-        self.samples += 1
+        with self._lock:
+            self.samples += 1
         return entry
 
     def start(self) -> "SysStatsSampler":
