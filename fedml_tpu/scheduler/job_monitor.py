@@ -95,6 +95,10 @@ class JobMonitor:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.sweeps = 0
+        # sweep_once() is public API and the loop-thread body: serialize
+        # whole sweeps so a caller-driven sweep racing the timer can't
+        # double-probe endpoints or lose a `sweeps` increment
+        self._sweep_lock = threading.Lock()
         # job/endpoint health rides the telemetry registry (not private
         # attrs), so `telemetry report` and the Prometheus exposition see
         # the scheduler plane without polling this object
@@ -170,6 +174,10 @@ class JobMonitor:
         return flips
 
     def sweep_once(self) -> Dict:
+        with self._sweep_lock:
+            return self._sweep_once_locked()
+
+    def _sweep_once_locked(self) -> Dict:
         result = {"runs_fixed": self.sweep_runs(),
                   "endpoint_flips": self.sweep_endpoints()}
         self.sweeps += 1
