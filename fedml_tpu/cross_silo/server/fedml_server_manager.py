@@ -339,7 +339,8 @@ class FedMLServerManager(FedMLCommManager):
                 metrics = self.aggregator.test_on_server_for_all_clients(
                     self.args.round_idx - 1
                 )
-                self.result = {"rounds": self.round_num, **metrics}
+                with self._round_lock:
+                    self.result = {"rounds": self.round_num, **metrics}
                 self._send_finish()
                 self.finish()
                 return
@@ -359,18 +360,24 @@ class FedMLServerManager(FedMLCommManager):
                     "every client is evicted; federation cannot make "
                     "progress (check round_deadline_s / network health)")
             self._probe_evicted(sorted(evicted))
-        self.client_id_list_in_this_round = self.aggregator.client_selection(
+        cohort = self.aggregator.client_selection(
             self.args.round_idx, client_ids,
             min(int(self.args.client_num_per_round), len(client_ids))
         )
         silo_indexes = self.aggregator.data_silo_selection(
             self.args.round_idx,
             int(self.args.client_num_in_total),
-            len(self.client_id_list_in_this_round),
+            len(cohort),
         )
-        self.data_silo_index_of_client = dict(
-            zip(self.client_id_list_in_this_round, silo_indexes)
-        )
+        # the comm thread snapshots the cohort under the round lock
+        # (stale-upload / deadline / reveal paths) while THIS write can
+        # run on the timer thread (deadline → _finish_round →
+        # _complete_round) — publish both fields atomically under it
+        with self._round_lock:
+            self.client_id_list_in_this_round = cohort
+            self.data_silo_index_of_client = dict(
+                zip(cohort, silo_indexes)
+            )
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
@@ -524,7 +531,11 @@ class FedMLServerManager(FedMLCommManager):
         err = RuntimeError(reason)
         flight_recorder.get_flight_recorder().dump(reason="federation_abort",
                                                    exc=err)
-        self.handler_error = err
+        # aborts fire from the comm thread (handler failure) or either
+        # deadline timer; every _abort_federation call site runs with
+        # the round lock RELEASED, so taking it here cannot deadlock
+        with self._round_lock:
+            self.handler_error = err
         self.com_manager.stop_receive_message()
 
     def _finish_round(self, missing_clients: list) -> None:
@@ -749,7 +760,11 @@ class FedMLServerManager(FedMLCommManager):
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
-            self.result = {"rounds": self.round_num, **metrics}
+            # the final result can land from the comm thread (all
+            # uploads in) or the timer thread (quorum close) — same
+            # lock the status handler's writer takes
+            with self._round_lock:
+                self.result = {"rounds": self.round_num, **metrics}
             self._send_finish()
             self.finish()
             return
