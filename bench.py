@@ -309,6 +309,20 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--recover" in sys.argv:
+        # crash-anywhere durability gates: journal seam < 2% of a durable
+        # round, kill-the-server MTTR within budget, every journaled
+        # upload salvaged (none retrained), identity-codec final params
+        # bit-identical to an uninterrupted run — one JSON line
+        # (tools/recover_bench.py; FEDML_RECOVER_* env knobs)
+        from tools.recover_bench import run_recover_bench
+
+        row = run_recover_bench()
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--tree" in sys.argv:
         # hierarchical-federation bench: a seeded 3-tier 100k-client
         # aggregation tree on this machine — rounds/s, peak wire bytes

@@ -41,6 +41,12 @@ _HEALTH_SHAPE = re.compile(r"^health/[a-z0-9_]+$")
 # and backends are labels); counters or gauges only — retry/reconnect/
 # quorum signals are occurrence counts, not latency distributions
 _RESILIENCE_SHAPE = re.compile(r"^resilience/[a-z0-9_]+$")
+# crash-anywhere durability: the journal/restart signals are append and
+# replay occurrence counts — COUNTERS only. A gauge here would let a
+# restart silently zero the evidence the doctor's recovery section
+# reads, and a histogram breaks the bounded live-frame contract.
+_DURABILITY_SHAPE = re.compile(
+    r"^resilience/(?:journal_[a-z0-9_]+|restarts|checkpoints_pruned)$")
 # hierarchical-federation namespace: tier/<depth>/<signal> — exactly one
 # interpolated tier depth then one signal segment (node/client ids are
 # event fields, never name segments); counters or gauges only
@@ -164,6 +170,10 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
                 bad(f"{kind} {name!r} — resilience/* signals are "
                     "occurrence counts (counter) or levels (gauge), not "
                     "histograms")
+            elif _DURABILITY_SHAPE.match(name) and kind != "counter":
+                bad(f"{kind} {name!r} — durability journal/restart "
+                    "signals are append/replay occurrence counts; "
+                    "counters only")
         if kind != "span" and name.startswith("tier/"):
             if not _TIER_SHAPE.match(name):
                 bad(f"{kind} {name!r} must be tier/<depth>/"

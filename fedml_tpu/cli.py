@@ -557,10 +557,19 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
                    "then exercise the seed-reveal mask recovery")
 @click.option("--round-deadline-s", default=30.0, show_default=True)
 @click.option("--round-quorum", default=2.0 / 3.0, show_default=True)
+@click.option("--kill-server", is_flag=True, default=False,
+              help="SIGKILL the SERVER mid-round (at --kill-round, after "
+                   "--after-uploads journaled uploads) and supervise an "
+                   "auto-restart with resume — the write-ahead round "
+                   "journal salvages every received upload; runs as real "
+                   "OS processes over the broker transport")
+@click.option("--after-uploads", default=1, show_default=True,
+              help="with --kill-server: uploads journaled before the kill")
 def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
           revive_round, drop: float, duplicate: float, delay_ms: float,
           compression: str, secagg: str, round_deadline_s: float,
-          round_quorum: float) -> None:
+          round_quorum: float, kill_server: bool,
+          after_uploads: int) -> None:
     """Run a seeded chaos scenario against an in-proc federation.
 
     Injects deterministic faults (message drop/duplicate/delay, client
@@ -568,7 +577,28 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
     federation through the resilience layer: round deadlines + quorum
     aggregation, dropout/eviction, rejoin resync. Prints ONE JSON line —
     the same scenario with the same --seed reproduces bit-identically.
+
+    --kill-server flips the target: instead of a client, the server
+    process itself is SIGKILLed mid-round and supervised back to life,
+    re-entering the round from its write-ahead journal (MTTR, salvaged
+    uploads and the final-params digest land in the JSON line).
     """
+    if kill_server:
+        if secagg:
+            raise click.UsageError(
+                "--kill-server with secagg is a round-boundary abort by "
+                "design (masks die with the session); run it without "
+                "--secagg to measure mid-round salvage")
+        from fedml_tpu.resilience.durability import run_recover_scenario
+
+        out = run_recover_scenario(
+            seed=seed, rounds=rounds, clients=clients,
+            kill_round=kill_round, after_uploads=after_uploads,
+            compression=compression or "identity")
+        click.echo(json.dumps(out))
+        if not out["completed"]:
+            raise SystemExit(1)
+        return
     from fedml_tpu.resilience import run_chaos_scenario
 
     out = run_chaos_scenario(

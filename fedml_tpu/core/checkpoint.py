@@ -84,12 +84,75 @@ class RoundCheckpointer:
     def restore_latest(
         self, template: Dict[str, Any]
     ) -> Optional[Tuple[int, Dict[str, Any]]]:
-        r = self.latest_round()
-        if r is None:
-            return None
-        state = self.restore(r, template)
-        logger.info("resumed round checkpoint %d from %s", r, self.dir)
-        return r, state
+        """Restore the newest *restorable* round.
+
+        A crash mid-save is a normal event for a preemptible server: it
+        leaves orphaned orbax tmp dirs (the atomic-rename staging area)
+        and, on non-atomic filesystems, a half-written ``round_<n>``.
+        Both are pruned here — tmp dirs unconditionally, a corrupt
+        latest round after its restore fails — and the walk falls back
+        to the next-newest round instead of raising on the wreckage.
+        """
+        self._prune_orphaned_tmp()
+        rounds = sorted(self.saved_rounds(), reverse=True)
+        failed_round: Optional[int] = None
+        for i, r in enumerate(rounds):
+            try:
+                state = self.restore(r, template)
+            except Exception as e:  # orbax raises backend-specific types
+                if i > 0:
+                    # saves are sequential, so a crash corrupts at most
+                    # the NEWEST round — a second unrestorable round is a
+                    # template/config mismatch, not crash damage
+                    raise
+                failed_round = r
+                logger.warning(
+                    "round checkpoint %d is unrestorable (%s: %s) — "
+                    "falling back to the previous round", r,
+                    type(e).__name__, e)
+                continue
+            if failed_round is not None:
+                # prune the newest round only AFTER an older one restored
+                # against the same template: that proves the template is
+                # fine and the newest save is genuinely half-written. A
+                # template/config mismatch (every round fails) must never
+                # destroy a good checkpoint.
+                from fedml_tpu import telemetry
+
+                telemetry.get_registry().counter(
+                    "resilience/checkpoints_pruned").inc()
+                logger.warning(
+                    "pruning half-written round checkpoint %d (round %d "
+                    "restored cleanly against the same template)",
+                    failed_round, r)
+                shutil.rmtree(
+                    os.path.join(self.dir, f"round_{failed_round}"),
+                    ignore_errors=True)
+            logger.info("resumed round checkpoint %d from %s", r, self.dir)
+            return r, state
+        if failed_round is not None:
+            # the ONLY checkpoint failed: crash damage and template
+            # mismatch are indistinguishable here — keep the directory
+            # for forensics and let the caller start fresh, loudly
+            logger.error(
+                "no restorable round checkpoint under %s (round %d kept "
+                "on disk unrestorable — half-written first save, or a "
+                "changed model template)", self.dir, failed_round)
+        return None
+
+    def _prune_orphaned_tmp(self) -> None:
+        """Remove orbax atomic-rename staging dirs a crash left behind
+        (``*.orbax-checkpoint-tmp-*`` and the older ``<name>.tmp.*``
+        layouts) — they are never restorable and their presence breaks a
+        later save of the same round on some orbax versions."""
+        if not os.path.isdir(self.dir):
+            return
+        for name in os.listdir(self.dir):
+            if "orbax-checkpoint-tmp" in name or ".tmp" in name:
+                path = os.path.join(self.dir, name)
+                logger.warning("pruning orphaned checkpoint tmp dir %s "
+                               "(crash mid-save)", path)
+                shutil.rmtree(path, ignore_errors=True)
 
 
 def pack_round_state(

@@ -103,6 +103,101 @@ class AsyncFedMLServerManager(FedMLCommManager):
             self._buffer = FedBuffBuffer(
                 self.buffer_size, staleness_exponent=self.staleness_exp)
 
+        # crash-anywhere durability (durability: true): in FedBuff mode
+        # the round journal makes the K buffered contributions durable —
+        # a restarted async server refills the buffer from the journal
+        # and resumes at the checkpointed model version, so buffered-
+        # but-unflushed uploads are never lost to a server kill. In
+        # instant-apply mode there is no buffer to journal; durability
+        # instead checkpoints EVERY applied version (each update is in
+        # the model the moment it applies — the version checkpoint IS
+        # the durable state, at one orbax save per update). The state
+        # lock serializes the version/applied/flushes bookkeeping
+        # between the replay path and the comm thread's apply/flush
+        # paths.
+        import threading
+
+        self._state_lock = threading.Lock()
+        from fedml_tpu.core.checkpoint import (
+            apply_round_state,
+            engine_checkpointer,
+            pack_round_state,
+        )
+        from fedml_tpu.resilience.durability import journal_from_args
+
+        self._ckpt = engine_checkpointer(args)
+        self._journal = (journal_from_args(args, name="async_buffer")
+                         if self._buffer is not None else None)
+        self._instant_durable = (self._buffer is None
+                                 and bool(getattr(args, "durability",
+                                                  False)))
+        if self._instant_durable and self._ckpt is None:
+            raise ValueError(
+                "durability: true on the instant-apply async server "
+                "needs checkpoint_dir — every applied version is made "
+                "durable as a round checkpoint")
+        if self._ckpt is not None and bool(getattr(args, "resume", False)):
+            template = pack_round_state(
+                self.aggregator.get_global_model_params(),
+                self.aggregator.server_opt, 0)
+            restored = self._ckpt.restore_latest(template)
+            if restored is not None:
+                _, state = restored
+                self.aggregator.set_global_model_params(
+                    state["global_params"])
+                self.version = apply_round_state(
+                    state, self.aggregator.server_opt)
+        if self._journal is not None and bool(getattr(args, "resume",
+                                                      False)):
+            self._replay_buffer_journal()
+
+    def _replay_buffer_journal(self) -> None:
+        """Refill the FedBuff buffer from the journal after a restart.
+
+        Three crash windows, disambiguated by the durable ``buffer_flush``
+        marker vs the checkpointed version: no marker → the uploads were
+        buffered but never flushed (refill and wait); marker version
+        ahead of the checkpoint → the flush happened but its checkpoint
+        didn't land (refill and re-flush NOW — the flush is
+        deterministic); marker version at/behind the checkpoint → the
+        flush is already committed (discard the stale records)."""
+        from fedml_tpu import telemetry
+
+        records = self._journal.records()
+        uploads = [r for r in records if r.get("kind") == "upload_received"]
+        marker = next((r for r in reversed(records)
+                       if r.get("kind") == "buffer_flush"), None)
+        if not records:
+            return
+        reg = telemetry.get_registry()
+        reg.counter("resilience/restarts").inc()
+        reg.counter("resilience/journal_replays").inc()
+        if marker is not None and int(marker.get("version", 0)) <= self.version:
+            logger.info("async journal: flush v%s already checkpointed; "
+                        "dropping %d stale record(s)",
+                        marker.get("version"), len(records))
+            with self._state_lock:
+                self.applied = max(self.applied,
+                                   int(marker.get("applied", 0)))
+            self._journal.reset()
+            return
+        for u in uploads:
+            self._buffer.add(int(u["sender"]), int(u["base_version"]),
+                             float(u.get("n_samples") or 1.0),
+                             u.get("payload"))
+            with self._state_lock:
+                self.applied = max(self.applied,
+                                   int(u.get("applied", 0)))
+        reg.counter("resilience/journal_salvaged").inc(len(uploads))
+        logger.warning(
+            "restart: async journal refilled the FedBuff buffer with %d "
+            "salvaged contribution(s) at version %d", len(uploads),
+            self.version)
+        if marker is not None and len(self._buffer):
+            # the flush happened pre-crash but its checkpoint never
+            # landed: redo it (deterministic given the same entries)
+            self._flush_buffer()
+
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
@@ -157,7 +252,14 @@ class AsyncFedMLServerManager(FedMLCommManager):
             mixed = jax.tree.map(lambda g, c: (1.0 - a) * g + a * c,
                                  x, w_client)
         self.aggregator.set_global_model_params(mixed)
-        self.version += 1
+        with self._state_lock:
+            self.version += 1
+        if self._instant_durable:
+            from fedml_tpu.core.checkpoint import pack_round_state
+
+            # instant-apply durability: the applied version IS the state
+            self._ckpt.save(self.version, pack_round_state(
+                mixed, self.aggregator.server_opt, self.version))
 
     def _flush_buffer(self) -> None:
         """Apply the FedBuff buffer as one fused staleness-weighted step."""
@@ -172,11 +274,26 @@ class AsyncFedMLServerManager(FedMLCommManager):
                                         jax.numpy.floating) else n,
                 x, new_global)
         self.aggregator.set_global_model_params(new_global)
-        self.version += 1
-        self.flushes += 1
+        with self._state_lock:
+            self.version += 1
+            self.flushes += 1
         flight_recorder.record("fedbuff_flush", round=self.version,
                                flushed=stats["flushed"],
                                mean_staleness=stats["mean_staleness"])
+        if self._journal is not None:
+            # durable commit sequence: flush marker -> checkpoint ->
+            # journal reset. A crash between any two steps replays
+            # without losing or double-applying a contribution (see
+            # _replay_buffer_journal for the case analysis).
+            self._journal.append("buffer_flush", version=int(self.version),
+                                 applied=int(self.applied),
+                                 flushed=int(stats["flushed"]))
+            if self._ckpt is not None:
+                from fedml_tpu.core.checkpoint import pack_round_state
+
+                self._ckpt.save(self.version, pack_round_state(
+                    new_global, self.aggregator.server_opt, self.version))
+            self._journal.reset()
 
     def handle_client_update(self, msg: Message) -> None:
         if self.finishing:
@@ -218,11 +335,20 @@ class AsyncFedMLServerManager(FedMLCommManager):
             float(staleness))
         flight_recorder.record("async_update", round=self.version,
                                sender=sender, staleness=staleness)
-        self.applied += 1
+        with self._state_lock:
+            self.applied += 1
         self.staleness_seen.append(staleness)
         self.senders_seen.append(sender)
 
         if self._buffer is not None:
+            if self._journal is not None:
+                # durable BEFORE buffered: a restart refills the buffer
+                # from exactly these records (wire-sized, not f32-sized)
+                self._journal.append(
+                    "upload_received", sender=int(sender),
+                    base_version=int(base_version),
+                    n_samples=float(n_samples),
+                    applied=int(self.applied), payload=w_client)
             self._buffer.add(sender, base_version, n_samples, w_client)
             telemetry.get_registry().gauge(
                 "health/async_buffer_fill").set(len(self._buffer))

@@ -129,6 +129,61 @@ class FedMLServerManager(FedMLCommManager):
         # bounded waves re-arm this timer, never the round's own
         self._recovery_deadline = RoundDeadline(self._on_recovery_deadline)
 
+        # crash-anywhere durability (durability: true): a write-ahead
+        # round journal colocated with the checkpoints records every
+        # round-state transition — round open, each upload AS WIRE BYTES,
+        # quorum close, aggregate commit — so a SIGKILLed server replays
+        # it at restart and re-enters the interrupted round MID-FLIGHT
+        # instead of discarding every upload already received
+        from fedml_tpu.resilience import ServerKillWindow
+        from fedml_tpu.resilience.durability import (
+            journal_from_args,
+            salvage_round,
+        )
+
+        self._journal = journal_from_args(args)
+        self._kill_window = ServerKillWindow.from_args(args)
+        if self._kill_window is not None and self._journal is None:
+            # a kill-server chaos spec without the journal would lose
+            # every received upload unrecoverably — refuse the
+            # misconfiguration instead of honoring it
+            raise ValueError(
+                "chaos kill_server needs durability: true — the kill "
+                "window fires after uploads are journaled, and recovery "
+                "replays that journal")
+        with self._round_lock:
+            self._salvaged = None
+        if self._journal is not None and bool(getattr(args, "resume", False)):
+            records = self._journal.records()
+            if records:
+                telemetry.get_registry().counter(
+                    "resilience/restarts").inc()
+                sal = salvage_round(records, int(self.args.round_idx))
+                if sal is not None and sal.secagg:
+                    # masked rounds are journaled NON-resumable: pairwise
+                    # masks died with the session, so the salvaged masked
+                    # uploads can never unmask — abort cleanly to the
+                    # last round boundary, loudly
+                    telemetry.get_registry().counter(
+                        "secagg/resume_aborts").inc()
+                    from fedml_tpu.telemetry.health import log_health_event
+
+                    log_health_event({
+                        "kind": "secagg_event", "event": "resume_aborted",
+                        "round": sal.round_idx,
+                        "uploads_dropped": len(sal.uploads)})
+                    logger.error(
+                        "secagg round %d cannot resume mid-round after a "
+                        "restart (masks are irrecoverable without the "
+                        "session): dropping %d journaled masked upload(s) "
+                        "and restarting the round from the checkpoint "
+                        "boundary", sal.round_idx, len(sal.uploads))
+                    sal = None
+                if sal is None:
+                    self._journal.reset()  # stale records: ckpt covers them
+                with self._round_lock:
+                    self._salvaged = sal
+
         # live serving plane: listeners see every closed round's aggregate
         # (round_idx, global_params) — the serving publisher attaches here
         # (serving/live/bridge.py). Guarded at call time: a serving-plane
@@ -217,6 +272,33 @@ class FedMLServerManager(FedMLCommManager):
             self.aggregator.set_delta_base(None)
         return ct
 
+    def _send_round_config(self, client_ids, payload, sa_header,
+                           init: bool) -> None:
+        """The ONE per-client round-config send loop: the fresh-round
+        INIT broadcast, the next-round SYNC, and the salvage
+        re-broadcast all build the same message contract here — a new
+        header added in one place reaches all three paths."""
+        for client_id in client_ids:
+            if init:
+                msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
+                              self.get_sender_id(), client_id)
+            else:
+                msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              self.get_sender_id(), client_id)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           self.data_silo_index_of_client[client_id])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            if self._codec is not None:
+                msg.add_params(Message.MSG_ARG_KEY_COMPRESSION,
+                               self._codec.spec)
+            if sa_header is not None:
+                from fedml_tpu.privacy.secagg import SecAggMessage
+
+                msg.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG, sa_header)
+            self._bcast_ts[client_id] = time.time()
+            self.send_message(msg)
+
     def send_init_msg(self) -> None:
         from fedml_tpu import telemetry
 
@@ -237,30 +319,15 @@ class FedMLServerManager(FedMLCommManager):
             self._deadline_expired = False
             self._deadline_extensions_used = 0
             self._completing = False
+        self._journal_round_open()
         # the open span's context rides each init message, so every
         # client's training span joins this round's server-side trace
         with telemetry.get_tracer().span(
             f"round/{self.args.round_idx}/sync",
             n_clients=len(self.client_id_list_in_this_round),
         ):
-            for client_id in self.client_id_list_in_this_round:
-                silo_idx = self.data_silo_index_of_client[client_id]
-                msg = Message(
-                    MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), client_id
-                )
-                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
-                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
-                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-                if self._codec is not None:
-                    msg.add_params(Message.MSG_ARG_KEY_COMPRESSION,
-                                   self._codec.spec)
-                if sa_header is not None:
-                    from fedml_tpu.privacy.secagg import SecAggMessage
-
-                    msg.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG,
-                                   sa_header)
-                self._bcast_ts[client_id] = time.time()
-                self.send_message(msg)
+            self._send_round_config(self.client_id_list_in_this_round,
+                                    payload, sa_header, init=True)
         self._arm_round_deadline()
         mlops.log({"event": "server.init_sent", "round": 0})
 
@@ -344,6 +411,11 @@ class FedMLServerManager(FedMLCommManager):
                 self._send_finish()
                 self.finish()
                 return
+            with self._round_lock:
+                salvaged = self._salvaged is not None
+            if salvaged:
+                self._resume_salvaged_round()
+                return
             self._select_round_clients()
             self.send_init_msg()
 
@@ -406,11 +478,30 @@ class FedMLServerManager(FedMLCommManager):
                         invalid = str(e)
                 if invalid is None:
                     self._observe_client_upload(sender, msg, model_params)
+                    if self._journal is not None:
+                        # the upload is durable BEFORE it is applied: a
+                        # crash at any later instant replays it, and the
+                        # journaled bytes are the wire form (compressed
+                        # blocks, not decoded f32 trees)
+                        self._journal.append(
+                            "upload_received",
+                            round=int(self.args.round_idx),
+                            client=int(sender),
+                            msg_id=msg.get(Message.MSG_ARG_KEY_MSG_ID),
+                            n_samples=int(local_sample_num or 1),
+                            local_steps=msg.get("local_steps"),
+                            payload=model_params)
                     self.aggregator.add_local_trained_result(
                         cohort.index(sender), model_params,
                         local_sample_num, local_steps=msg.get("local_steps"),
                     )
                     missing = self._try_close_round(cohort)
+        if self._kill_window is not None and not stale and invalid is None:
+            # chaos seam: the seeded kill-the-server window fires AFTER
+            # the upload is journaled — the recovery tests assert exactly
+            # this upload is salvaged, never retrained
+            self._kill_window.maybe_kill(int(self.args.round_idx),
+                                         self.aggregator.n_received())
         if invalid is not None:
             self._resilience_event(
                 "secagg_invalid_upload", client=sender,
@@ -456,6 +547,15 @@ class FedMLServerManager(FedMLCommManager):
         missing_idx = self.aggregator.close_round_quorum(expected)
         self._round_closed = True
         self._deadline.cancel()
+        if self._journal is not None:
+            # a replay of a closed-but-uncommitted round re-closes on
+            # exactly this missing set instead of re-waiting the deadline
+            # durable=False: a lost close marker just re-enters the
+            # round with its (durable) uploads and re-closes — the next
+            # durable append syncs it anyway
+            self._journal.append("quorum_close", durable=False,
+                                 round=int(self.args.round_idx),
+                                 missing=[int(i) for i in missing_idx])
         return [cohort[i] for i in missing_idx]
 
     def _on_round_deadline(self, round_idx: int) -> None:
@@ -752,11 +852,19 @@ class FedMLServerManager(FedMLCommManager):
         if self._ckpt is not None:
             from fedml_tpu.core.checkpoint import pack_round_state, should_save
 
-            if should_save(self.args, self.args.round_idx):
+            # the journal resets at every committed round, so a commit
+            # must always be checkpoint-backed: durability forces a
+            # per-round boundary regardless of checkpoint_frequency
+            if self._journal is not None or should_save(
+                    self.args, self.args.round_idx):
                 self._ckpt.save(self.args.round_idx, pack_round_state(
                     global_params, self.aggregator.server_opt,
                     self.args.round_idx + 1,
                 ))
+        if self._journal is not None:
+            self._journal.append("aggregate_committed", durable=False,
+                                 round=int(self.args.round_idx))
+            self._journal.reset()
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
@@ -777,27 +885,98 @@ class FedMLServerManager(FedMLCommManager):
             self._deadline_expired = False
             self._deadline_extensions_used = 0
             self._completing = False
+        self._journal_round_open()
         with tracer.span(f"round/{self.args.round_idx}/sync",
                          n_clients=len(self.client_id_list_in_this_round)):
-            for client_id in self.client_id_list_in_this_round:
-                silo_idx = self.data_silo_index_of_client[client_id]
-                m = Message(
-                    MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.get_sender_id(), client_id
-                )
-                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
-                m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
-                m.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-                if self._codec is not None:
-                    m.add_params(Message.MSG_ARG_KEY_COMPRESSION,
-                                 self._codec.spec)
-                if sa_header is not None:
-                    from fedml_tpu.privacy.secagg import SecAggMessage
-
-                    m.add_params(SecAggMessage.MSG_ARG_KEY_SECAGG,
-                                 sa_header)
-                self._bcast_ts[client_id] = time.time()
-                self.send_message(m)
+            self._send_round_config(self.client_id_list_in_this_round,
+                                    payload, sa_header, init=False)
         self._arm_round_deadline()
+
+    # -- durability: write-ahead journal + mid-round replay ----------------
+    def _journal_round_open(self) -> None:
+        """Make the round's identity durable before any broadcast leaves:
+        a crash at any later instant replays into THIS round with THIS
+        cohort, not a re-selection."""
+        if self._journal is None:
+            return
+        with self._round_lock:
+            cohort = list(self.client_id_list_in_this_round or [])
+            silo = dict(self.data_silo_index_of_client or {})
+        self._journal.append(
+            "round_open", round=int(self.args.round_idx),
+            cohort=[int(c) for c in cohort],
+            silo_index={int(k): int(v) for k, v in silo.items()},
+            seed=int(getattr(self.args, "random_seed", 0)),
+            codec=self._codec.spec if self._codec is not None else None,
+            secagg=self._secagg is not None)
+
+    def _resume_salvaged_round(self) -> None:
+        """Re-enter the journaled mid-flight round after a restart.
+
+        Salvaged uploads rehydrate straight into the aggregator — those
+        clients never retrain, and any resend of the same logical message
+        drops on the primed msg-id dedup. Only clients whose uploads died
+        with the old process get the round's broadcast again (they retrain
+        the SAME seeded round, so identity-codec runs stay bit-identical).
+        A round that had already quorum-closed re-closes on the journaled
+        missing set immediately.
+        """
+        from fedml_tpu import telemetry
+
+        with self._round_lock:
+            sal = self._salvaged
+            self._salvaged = None
+        if sal is None:  # pragma: no cover - guarded by the caller
+            return
+        cohort = list(sal.cohort)
+        with self._round_lock:
+            self.client_id_list_in_this_round = cohort
+            self.data_silo_index_of_client = dict(sal.silo_index)
+            self._round_closed = False
+            # a pre-crash quorum close replays as an expired deadline:
+            # _try_close_round below closes on the salvaged quorum
+            self._deadline_expired = sal.closed
+            self._deadline_extensions_used = 0
+            self._completing = False
+        # re-derive the broadcast (same params, same seeded encode key)
+        # so the delta base matches what the clients decoded pre-crash
+        payload = self._broadcast_payload(
+            self.aggregator.get_global_model_params())
+        for u in sal.uploads:
+            mid = u.get("msg_id")
+            if mid:
+                self._deduper.seen(mid)
+            self.aggregator.add_local_trained_result(
+                cohort.index(int(u["client"])), u.get("payload"),
+                int(u.get("n_samples") or 1),
+                local_steps=u.get("local_steps"))
+        reg = telemetry.get_registry()
+        reg.counter("resilience/journal_replays").inc()
+        reg.counter("resilience/journal_salvaged").inc(len(sal.uploads))
+        self._resilience_event(
+            "journal_replayed", round=sal.round_idx,
+            salvaged=sorted(sal.uploaded_clients),
+            closed=sal.closed)
+        logger.warning(
+            "restart: journal replay re-entered round %d mid-flight with "
+            "%d/%d salvaged upload(s)%s", sal.round_idx, len(sal.uploads),
+            len(cohort), " (round already quorum-closed)"
+            if sal.closed else "")
+        uploaded = set(sal.uploaded_clients)
+        to_broadcast = [c for c in cohort if c not in uploaded]
+        if not sal.closed and to_broadcast:
+            sa_header = self._secagg_round_header()
+            with telemetry.get_tracer().span(
+                f"round/{self.args.round_idx}/sync",
+                n_clients=len(to_broadcast),
+            ):
+                self._send_round_config(to_broadcast, payload, sa_header,
+                                        init=True)
+            self._arm_round_deadline()
+        with self._round_lock:
+            missing = self._try_close_round(cohort)
+        if missing is not None:
+            self._finish_round(missing)
 
     # -- resilience helpers ------------------------------------------------
     def _probe_evicted(self, client_ids: list) -> None:
@@ -916,6 +1095,8 @@ class FedMLServerManager(FedMLCommManager):
     def finish(self) -> None:
         self._deadline.cancel()
         self._recovery_deadline.cancel()
+        if self._journal is not None:
+            self._journal.close()
         if self._live is not None:
             # final full loopback frame: the collector's merged totals
             # become exactly the post-hoc registry snapshot

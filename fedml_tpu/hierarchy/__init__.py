@@ -32,11 +32,17 @@ from fedml_tpu.hierarchy.partial_sum import (
     flat_reference,
     reduce_cohort,
 )
-from fedml_tpu.hierarchy.runner import KillWindow, TreeRunner, default_template
+from fedml_tpu.hierarchy.runner import (
+    EdgeKillWindow,
+    KillWindow,
+    TreeRunner,
+    default_template,
+)
 from fedml_tpu.hierarchy.tree import TreeTopology
 
 __all__ = [
     "EdgeAggregator",
+    "EdgeKillWindow",
     "FedBuffBuffer",
     "KillWindow",
     "LeafCohort",

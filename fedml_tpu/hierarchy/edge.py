@@ -72,6 +72,46 @@ class EdgeAggregator:
         self._on_expire: Optional[Callable[[int], None]] = None
         self._buffered_nbytes = 0  # running sum: offer is O(1), not O(C)
         self.peak_buffered_nbytes = 0
+        self._journal = None  # crash durability, opt-in via bind_journal
+
+    # -- crash durability --------------------------------------------------
+    def bind_journal(self, journal) -> None:
+        """Opt this edge into the write-ahead journal: round opens and
+        accepted offers (the compressed partial sums — wire-sized, never
+        f32 trees) become durable, so a killed edge re-enters its open
+        round with the buffer intact (:meth:`restore_from_journal`)."""
+        self._journal = journal
+
+    def restore_from_journal(self) -> int:
+        """Rehydrate the open (un-closed) journaled round; returns the
+        number of salvaged child partial sums (0 = nothing open)."""
+        if self._journal is None:
+            return 0
+        from fedml_tpu.resilience.durability.journal import scan_open_round
+
+        # the shared replay state machine; an edge's terminal record is
+        # its round_closed (the uplink partial is the parent's problem)
+        open_rec, uploads, _ = scan_open_round(
+            self._journal.records(), terminal_kinds=("round_closed",),
+            note_kinds=())
+        if open_rec is None:
+            return 0
+        offers: Dict[int, PartialSum] = {
+            int(rec["child"]): PartialSum(rec["ct"], float(rec["weight"]),
+                                          int(rec["count"]))
+            for rec in uploads}
+        self._round = int(open_rec["round"])
+        # pre-crash evictions are implied by the journaled expectation
+        expected = {int(c) for c in open_rec.get("expected") or []}
+        self._evicted = {c for c in self.child_ids if c not in expected}
+        self._buffer = {}
+        self._buffered_nbytes = 0
+        for child, ps in offers.items():
+            self._buffer[child] = ps
+            self._buffered_nbytes += ps.nbytes
+        self.peak_buffered_nbytes = max(self.peak_buffered_nbytes,
+                                        self._buffered_nbytes)
+        return len(offers)
 
     # -- round lifecycle ---------------------------------------------------
     def begin_round(self, round_idx: int) -> List[int]:
@@ -79,7 +119,11 @@ class EdgeAggregator:
         self._round = int(round_idx)
         self._buffer = {}
         self._buffered_nbytes = 0
-        return self.expected()
+        expected = self.expected()
+        if self._journal is not None:
+            self._journal.append("round_open", round=self._round,
+                                 expected=[int(c) for c in expected])
+        return expected
 
     def expected(self) -> List[int]:
         return [c for c in self.child_ids if c not in self._evicted]
@@ -105,6 +149,13 @@ class EdgeAggregator:
             return False
         if child_id in self._evicted or child_id in self._buffer:
             return False
+        if self._journal is not None:
+            # durable BEFORE buffered, same contract as the server's
+            # upload journal — a crash after this line salvages the offer
+            self._journal.append("upload_received", round=self._round,
+                                 child=child_id, ct=ps.ct,
+                                 weight=float(ps.weight),
+                                 count=int(ps.count))
         self._buffer[child_id] = ps
         self._buffered_nbytes += ps.nbytes
         self.peak_buffered_nbytes = max(self.peak_buffered_nbytes,
@@ -135,6 +186,12 @@ class EdgeAggregator:
         survivor "meet quorum" over a cohort of one.
         """
         self._deadline.cancel()
+        if self._journal is not None:
+            # the close is the edge's commit point: the uplink partial is
+            # the parent's (journaled) problem from here on
+            self._journal.append("round_closed", durable=False,
+                                 round=int(self._round or 0))
+            self._journal.reset()
         expected = self.expected()
         missing = [c for c in expected if c not in self._buffer]
         need = quorum_size(max(1, len(expected)), self.quorum_frac)

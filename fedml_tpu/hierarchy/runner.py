@@ -41,7 +41,8 @@ logger = logging.getLogger(__name__)
 
 Pytree = Any
 
-__all__ = ["KillWindow", "TreeRunner", "default_template"]
+__all__ = ["EdgeKillWindow", "KillWindow", "TreeRunner",
+           "default_template"]
 
 # key-space offset for tier-aggregator encode keys, so edge re-encode
 # streams can never collide with leaf-client upload streams
@@ -64,6 +65,29 @@ class KillWindow:
 
     def dead_at(self, tier: int, round_idx: int) -> bool:
         return self.tier == tier and self.round <= round_idx < self.until
+
+
+class EdgeKillWindow:
+    """Chaos for the aggregator itself: CRASH the interior aggregator at
+    ``(tier, node)`` during round ``round``, after it has accepted
+    ``after_children`` offers — then restart it from its write-ahead
+    journal (requires ``TreeRunner(durability_dir=...)``).
+
+    Unlike :class:`KillWindow` (the node is *absent* for the window and
+    its cohort quorum-closes around it), this models the preemption the
+    durability layer exists for: the node comes straight back and must
+    finish its round with every already-buffered partial sum intact —
+    the run ends digest-identical to an unkilled one.
+    """
+
+    __slots__ = ("tier", "node", "round", "after_children")
+
+    def __init__(self, tier: int, node: int, round: int,
+                 after_children: int = 1):
+        self.tier = int(tier)
+        self.node = int(node)
+        self.round = int(round)
+        self.after_children = max(1, int(after_children))
 
 
 def default_template(n_params: int = 1024) -> Dict[str, np.ndarray]:
@@ -108,7 +132,8 @@ class TreeRunner:
                  live: Optional[Any] = None,
                  secagg: bool = False,
                  secagg_clip: float = 0.1,
-                 secagg_mod_bits: int = 8):
+                 secagg_mod_bits: int = 8,
+                 durability_dir: Optional[str] = None):
         self.topology = topology
         self.codec = get_codec(codec)
         if self.codec is None:
@@ -116,7 +141,17 @@ class TreeRunner:
                              "an uncompressed wire")
         self.seed = int(seed)
         self.quorum = float(quorum)
-        self.chaos = list(chaos or [])
+        # EdgeKillWindows (crash-and-journal-restart) are a different
+        # fault class than KillWindows (absent for the window)
+        self.edge_kills = [k for k in (chaos or [])
+                           if isinstance(k, EdgeKillWindow)]
+        self.chaos = [k for k in (chaos or [])
+                      if not isinstance(k, EdgeKillWindow)]
+        self.durability_dir = durability_dir
+        if self.edge_kills and not durability_dir:
+            raise ValueError(
+                "EdgeKillWindow chaos needs durability_dir — a crashed "
+                "edge can only restart from its write-ahead journal")
         self.server_lr = float(server_lr)
         template = default_template() if template is None else template
         leaves, self._treedef = jax.tree.flatten(template)
@@ -175,6 +210,16 @@ class TreeRunner:
                                self.codec, self.quorum)
                 for i in range(topology.levels[d])
             ]
+        if self.durability_dir:
+            # one journal per interior node, colocated like the server's:
+            # buffered partial sums become durable at wire size
+            from fedml_tpu.resilience.durability import RoundJournal
+
+            for d, aggs in self.aggregators.items():
+                for agg in aggs:
+                    agg.bind_journal(RoundJournal(
+                        f"{self.durability_dir}/edge_t{d}_n"
+                        f"{agg.node_id}.journal"))
         # per-client wire bytes, computed once from an encoded template
         ct = self.codec.encode(
             jax.tree.unflatten(self._treedef,
@@ -209,6 +254,29 @@ class TreeRunner:
                               "tier": tier, **fields})
         except Exception:  # pragma: no cover - observability must not kill
             logger.exception("tier event logging failed")
+
+    def _restart_edge(self, round_idx: int, tier: int, node: int,
+                      dead: EdgeAggregator, reg) -> EdgeAggregator:
+        """EdgeKillWindow seam: the interior aggregator 'process' dies
+        mid-round and a fresh one restarts from its journal — every
+        buffered partial sum must survive the hop (the digest-identity
+        test is the proof). Models per-tier preemption recovery."""
+        fresh = EdgeAggregator(tier, node, list(dead.child_ids),
+                               self.codec, self.quorum)
+        fresh.bind_journal(dead._journal)
+        salvaged = fresh.restore_from_journal()
+        self.aggregators[tier][node] = fresh
+        reg.counter("resilience/restarts").inc()
+        reg.counter("resilience/journal_replays").inc()
+        reg.counter("resilience/journal_salvaged").inc(salvaged)
+        self._event("edge_restarted", tier,
+                    reg.counter(f"tier/{tier}/restarts"), 1,
+                    round=round_idx, node=node, salvaged=salvaged)
+        logger.warning(
+            "chaos: tier %d node %d killed and journal-restarted at "
+            "round %d with %d salvaged partial sum(s)", tier, node,
+            round_idx, salvaged)
+        return fresh
 
     # -- the round ---------------------------------------------------------
     def _leaf_round(self, round_idx: int, reg) -> Dict[int, PartialSum]:
@@ -305,11 +373,21 @@ class TreeRunner:
                             reg.counter(f"tier/{tier + 1}/rejoined"), 1,
                                     round=round_idx, node=c)
             expected = agg.begin_round(round_idx)
+            kill = next(
+                (k for k in self.edge_kills
+                 if k.tier == tier and k.node == node
+                 and k.round == round_idx), None)
+            accepted = 0
             for c in expected:
                 ps = child_partials.get(c)
                 if ps is not None and c not in dead_here:
-                    agg.offer(c, ps)
+                    if agg.offer(c, ps):
+                        accepted += 1
                     upload_bytes += ps.nbytes
+                    if kill is not None and accepted == kill.after_children:
+                        agg = self._restart_edge(round_idx, tier, node,
+                                                 agg, reg)
+                        kill = None
             received = agg.received()
             key = derive_key(self.seed, round_idx,
                              _EDGE_KEY_BASE + (tier << 20) + node)

@@ -16,8 +16,12 @@ participation; Bonawitz et al.'s cross-device system design):
   adaptive per-round timer) + :func:`quorum_size`;
 - :mod:`chaos` — :class:`ChaosInjector`, a seeded deterministic fault
   injector at the comm boundary (drop/delay/duplicate messages, kill a
-  client for a round window, partition the broker), exposed as
-  ``fedml_tpu chaos``.
+  client for a round window, partition the broker), plus
+  :class:`ServerKillWindow` (SIGKILL the server itself mid-round),
+  exposed as ``fedml_tpu chaos``;
+- :mod:`durability` — the write-ahead round journal
+  (:class:`RoundJournal`) + replay that lets a killed server re-enter
+  the interrupted round with every already-received upload salvaged.
 
 Everything lands in the ``resilience/*`` metric namespace (one segment
 after the prefix, entities in labels — lint-enforced) plus
@@ -27,10 +31,17 @@ reads.
 """
 from fedml_tpu.resilience.chaos import (
     ChaosInjector,
+    ServerKillWindow,
     chaos_from_args,
     run_chaos_scenario,
 )
 from fedml_tpu.resilience.dedup import MessageDeduper
+from fedml_tpu.resilience.durability import (
+    RoundJournal,
+    SalvagedRound,
+    journal_from_args,
+    salvage_round,
+)
 from fedml_tpu.resilience.liveness import PeerLiveness
 from fedml_tpu.resilience.policy import (
     ResilienceConfig,
@@ -45,9 +56,14 @@ from fedml_tpu.resilience.quorum import (
 
 __all__ = [
     "ChaosInjector",
+    "ServerKillWindow",
     "chaos_from_args",
     "run_chaos_scenario",
     "MessageDeduper",
+    "RoundJournal",
+    "SalvagedRound",
+    "journal_from_args",
+    "salvage_round",
     "PeerLiveness",
     "ResilienceConfig",
     "RetryPolicy",

@@ -162,6 +162,56 @@ class ChaosInjector:
         return True
 
 
+class ServerKillWindow:
+    """Chaos for the process that matters most: SIGKILL the SERVER itself
+    mid-round, once it has journaled ``after_uploads`` uploads of round
+    ``round`` — the deterministic trigger the kill-the-server recovery
+    tests and ``bench.py --recover`` key their MTTR measurement to.
+
+    Spec rides ``args.chaos.kill_server`` or the ``FEDML_CHAOS_KILL_SERVER``
+    env var (JSON: ``{"round": 2, "after_uploads": 1}``) — the env form is
+    what the supervised restart runner passes to the FIRST server spawn
+    only, so the respawned server cannot re-trigger its own death."""
+
+    __slots__ = ("round", "after_uploads")
+
+    def __init__(self, round: int, after_uploads: int = 1):
+        self.round = int(round)
+        self.after_uploads = max(1, int(after_uploads))
+
+    @classmethod
+    def from_args(cls, args: Any) -> Optional["ServerKillWindow"]:
+        import os
+
+        raw = os.environ.get("FEDML_CHAOS_KILL_SERVER")
+        spec = None
+        if raw:
+            spec = json.loads(raw)
+        else:
+            chaos = getattr(args, "chaos", None)
+            if isinstance(chaos, str) and chaos:
+                chaos = json.loads(chaos)
+            if isinstance(chaos, dict):
+                spec = chaos.get("kill_server")
+        if not spec:
+            return None
+        return cls(int(spec.get("round", 0)),
+                   int(spec.get("after_uploads", 1)))
+
+    def maybe_kill(self, round_idx: int, n_received: int) -> None:
+        """SIGKILL this process — no cleanup, no atexit, no flush: the
+        honest preemption the journal exists to survive."""
+        if int(round_idx) == self.round and (
+                int(n_received) >= self.after_uploads):
+            import os
+            import signal
+
+            logger.warning(
+                "chaos: SIGKILLing the server at round %d after %d "
+                "upload(s)", round_idx, n_received)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
 def chaos_from_args(args: Any, rank: int,
                     round_provider: Optional[Callable[[], int]] = None
                     ) -> Optional[ChaosInjector]:

@@ -1,0 +1,32 @@
+"""Crash-anywhere durability: write-ahead round journal + replay.
+
+- :mod:`journal` — :class:`RoundJournal` (append-only, fsync'd,
+  CRC-framed, torn tails truncate), :func:`salvage_round` replay, and the
+  :func:`journal_from_args` constructor hook every engine shares.
+- :mod:`recover` — the supervised auto-restart runner behind
+  ``fedml_tpu chaos --kill-server`` and ``bench.py --recover``: spawns a
+  real cross-silo federation as OS processes over a broker, SIGKILLs the
+  server mid-round, restarts it with ``resume: true``, and measures MTTR
+  + salvaged uploads + bit-identity against an uninterrupted run.
+
+Wired into: the cross-silo sync server (mid-round re-entry), the async
+server's FedBuff buffer (buffered contributions survive restart), and
+the hierarchy runner's edge aggregators (per-tier recovery). Everything
+lands under ``resilience/journal_*`` + ``resilience/restarts`` counters
+and the doctor's recovery section.
+"""
+from fedml_tpu.resilience.durability.journal import (
+    RoundJournal,
+    SalvagedRound,
+    journal_from_args,
+    salvage_round,
+)
+from fedml_tpu.resilience.durability.recover import run_recover_scenario
+
+__all__ = [
+    "RoundJournal",
+    "SalvagedRound",
+    "journal_from_args",
+    "run_recover_scenario",
+    "salvage_round",
+]

@@ -1,0 +1,286 @@
+"""Write-ahead round journal — crash-anywhere durability for federations.
+
+The orbax round checkpoints (PR 2) make round *boundaries* durable; this
+journal makes the *inside* of a round durable. The server appends one
+record per round-state transition to an append-only, fsync'd, CRC-framed
+file colocated with the checkpoints:
+
+- ``round_open``          — cohort, silo map, seed, codec spec, secagg flag
+- ``upload_received``     — client id, msg_id, and the upload payload AS IT
+  CROSSED THE WIRE (a delta-encoded :class:`CompressedTree` journals as
+  its int8 blocks + scales, so journaling costs ~wire size, not f32 size)
+- ``quorum_close``        — the round closed on quorum; missing positions
+- ``aggregate_committed`` — the aggregate landed in a durable checkpoint;
+  every earlier record is now obsolete and the journal resets
+
+A killed server replays the journal at restart (:func:`salvage_round`) and
+re-enters the interrupted round mid-flight: salvaged uploads rehydrate
+into the aggregator (those clients never retrain; late duplicate
+deliveries drop on the PR 5 msg-id dedup), and only the missing cohort is
+re-broadcast. Masked (SecAgg) rounds are journaled but flagged
+non-resumable — pairwise masks are irrecoverable without the in-memory
+session, so replay aborts them cleanly to the last round boundary.
+
+Framing (all little-endian)::
+
+    record := b"RJ" | len(u32, payload bytes) | crc32(u32, of payload) | payload
+
+``payload`` is :func:`~fedml_tpu.utils.serialization.safe_dumps` of the
+record dict (pickle-free; numpy / CompressedTree payloads ride the
+existing versioned wire format). A torn tail — short header, short
+payload, or CRC mismatch from a crash mid-append — truncates the file at
+the last valid record instead of failing the replay.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RoundJournal", "SalvagedRound", "journal_from_args",
+           "salvage_round", "scan_open_round"]
+
+_MAGIC = b"RJ"
+_HEADER = struct.Struct("<2sII")  # magic, payload len, crc32
+
+
+class RoundJournal:
+    """Append-only fsync'd CRC-framed record log.
+
+    Thread-safety: appends land from the comm thread and the deadline
+    timer thread; every file mutation happens under ``_lock``.
+    ``fsync=False`` drops the per-record fsync (tests / benchmarks that
+    measure the seam without it).
+    """
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = os.path.abspath(path)
+        self.fsync = bool(fsync)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "ab")
+
+    # -- write path --------------------------------------------------------
+    def append(self, kind: str, durable: bool = True,
+               **fields: Any) -> None:
+        """Append one record; with ``durable`` (the default) it returns
+        only after the bytes are on disk (write + flush + fdatasync), so
+        a crash at ANY later instant replays it.
+
+        ``durable=False`` skips the sync for records whose loss replay
+        can re-derive — a ``quorum_close``/``aggregate_committed`` marker
+        lost to a crash just re-enters the round with all its (durable)
+        uploads and re-closes deterministically. The next durable append
+        syncs everything before it anyway (fdatasync is whole-file).
+        """
+        from fedml_tpu import telemetry
+        from fedml_tpu.utils.serialization import safe_dumps
+
+        payload = safe_dumps({"kind": str(kind), **fields})
+        frame = _HEADER.pack(_MAGIC, len(payload),
+                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with self._lock:
+            self._fh.write(frame)
+            self._fh.flush()
+            if self.fsync and durable:
+                self._sync()
+        reg = telemetry.get_registry()
+        reg.counter("resilience/journal_records").inc()
+        reg.counter("resilience/journal_bytes").inc(len(frame))
+
+    def _sync(self) -> None:
+        # fdatasync where the platform has it: an append-only log needs
+        # its DATA durable, not every metadata timestamp
+        fileno = self._fh.fileno()
+        if hasattr(os, "fdatasync"):
+            os.fdatasync(fileno)
+        else:  # pragma: no cover - non-POSIX fallback
+            os.fsync(fileno)
+
+    def reset(self) -> None:
+        """Truncate to empty — called once a round's aggregate is durably
+        checkpointed (every record before that boundary is obsolete).
+
+        No sync here on purpose: if the truncate isn't durable at the
+        next crash, replay sees the stale records of a round the
+        checkpoint already covers and drops them (salvage_round's
+        expected-round check) — correctness never depends on it, and the
+        hot path saves one fdatasync per round."""
+        with self._lock:
+            self._fh.truncate(0)
+            self._fh.seek(0)
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except OSError:  # pragma: no cover - double close
+                pass
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            self._fh.flush()
+            return os.path.getsize(self.path)
+
+    # -- read path ---------------------------------------------------------
+    def records(self) -> List[Dict]:
+        """Scan every valid record (oldest first). A torn tail — the
+        expected crash artifact of a mid-append kill — is TRUNCATED at
+        the last valid record, so the next append continues a clean
+        file; corruption inside a record drops it and everything after
+        (a CRC hole breaks the frame stream)."""
+        from fedml_tpu import telemetry
+        from fedml_tpu.utils.serialization import safe_loads
+
+        with self._lock:
+            self._fh.flush()
+            with open(self.path, "rb") as f:
+                data = f.read()
+            out: List[Dict] = []
+            offset = 0
+            valid_end = 0
+            while offset + _HEADER.size <= len(data):
+                magic, length, crc = _HEADER.unpack_from(data, offset)
+                body_start = offset + _HEADER.size
+                if magic != _MAGIC or body_start + length > len(data):
+                    break  # torn header or short payload
+                payload = data[body_start:body_start + length]
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    break  # corrupt record: stop at the last good frame
+                try:
+                    rec = safe_loads(payload)
+                except ValueError:
+                    break
+                if not isinstance(rec, dict):
+                    break
+                out.append(rec)
+                offset = body_start + length
+                valid_end = offset
+            if valid_end < len(data):
+                telemetry.get_registry().counter(
+                    "resilience/journal_truncations").inc()
+                logger.warning(
+                    "round journal %s has a torn tail: truncating %d "
+                    "byte(s) after the last valid record",
+                    self.path, len(data) - valid_end)
+                self._fh.truncate(valid_end)
+                self._fh.seek(valid_end)
+                self._fh.flush()
+                if self.fsync:
+                    self._sync()
+            return out
+
+
+class SalvagedRound:
+    """What the journal says about the round interrupted by the crash."""
+
+    __slots__ = ("round_idx", "cohort", "silo_index", "uploads", "closed",
+                 "missing", "secagg")
+
+    def __init__(self, round_idx: int, cohort: List[int],
+                 silo_index: Dict[int, int], uploads: List[Dict],
+                 closed: bool, missing: List[int], secagg: bool):
+        self.round_idx = int(round_idx)
+        self.cohort = [int(c) for c in cohort]
+        self.silo_index = {int(k): int(v) for k, v in silo_index.items()}
+        self.uploads = list(uploads)          # upload_received records
+        self.closed = bool(closed)            # quorum_close was journaled
+        self.missing = [int(m) for m in missing]
+        self.secagg = bool(secagg)
+
+    @property
+    def uploaded_clients(self) -> List[int]:
+        return [int(u["client"]) for u in self.uploads]
+
+
+def scan_open_round(
+    records: List[Dict],
+    terminal_kinds: tuple = ("aggregate_committed",),
+    note_kinds: tuple = ("quorum_close",),
+) -> tuple:
+    """The ONE journal-replay state machine every consumer shares:
+    latest ``round_open`` wins and resets the accumulation, records are
+    scoped to the open round, a ``terminal`` kind closes the round
+    (nothing left to salvage), ``note`` kinds are collected alongside
+    the uploads. Returns ``(open_rec, uploads, notes)`` with
+    ``open_rec`` None when no round is open."""
+    open_rec: Optional[Dict] = None
+    uploads: List[Dict] = []
+    notes: List[Dict] = []
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "round_open":
+            open_rec = rec
+            uploads = []
+            notes = []
+        elif open_rec is None:
+            continue
+        elif int(rec.get("round", -1)) != int(open_rec["round"]):
+            continue
+        elif kind == "upload_received":
+            uploads.append(rec)
+        elif kind in note_kinds:
+            notes.append(rec)
+        elif kind in terminal_kinds:
+            open_rec = None  # committed/closed: nothing to salvage
+    if open_rec is None:
+        return None, [], []
+    return open_rec, uploads, notes
+
+
+def salvage_round(records: List[Dict],
+                  expected_round: int) -> Optional[SalvagedRound]:
+    """Reconstruct the open (un-committed) round from a journal scan.
+
+    Returns None when the journal holds nothing salvageable: empty, only
+    committed rounds, or an open round that is not ``expected_round``
+    (e.g. the crash landed between the checkpoint save and the journal
+    reset — the checkpoint already covers those records)."""
+    open_rec, uploads, notes = scan_open_round(records)
+    closes = [n for n in notes if n.get("kind") == "quorum_close"]
+    closed = bool(closes)
+    missing = ([int(m) for m in closes[-1].get("missing") or []]
+               if closes else [])
+    if open_rec is None:
+        return None
+    if int(open_rec["round"]) != int(expected_round):
+        logger.warning(
+            "journal holds round %s but the checkpoint resumes at round "
+            "%s — stale records dropped (crash between checkpoint save "
+            "and journal reset)", open_rec["round"], expected_round)
+        return None
+    return SalvagedRound(
+        round_idx=int(open_rec["round"]),
+        cohort=open_rec.get("cohort") or [],
+        silo_index=open_rec.get("silo_index") or {},
+        uploads=uploads,
+        closed=closed,
+        missing=missing,
+        secagg=bool(open_rec.get("secagg")),
+    )
+
+
+def journal_from_args(args: Any,
+                      name: str = "server_round") -> Optional[RoundJournal]:
+    """The engine constructor hook: a journal colocated with the orbax
+    checkpoints when ``durability: true``, else None (the production hot
+    path stays a None-check). Durability without a checkpoint_dir is a
+    configuration error — mid-round replay is only meaningful relative
+    to a durable round boundary."""
+    if not bool(getattr(args, "durability", False)):
+        return None
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    if not ckpt_dir:
+        raise ValueError(
+            "durability: true needs checkpoint_dir — the round journal "
+            "replays relative to the last durable round boundary")
+    return RoundJournal(
+        os.path.join(str(ckpt_dir), f"{name}.journal"),
+        fsync=bool(getattr(args, "journal_fsync", True)))

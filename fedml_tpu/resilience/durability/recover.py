@@ -1,0 +1,316 @@
+"""Supervised kill-the-server recovery: the chaos the journal exists for.
+
+The PR 5 chaos harness can kill *clients*; this runner kills the process
+that matters most. It spawns a REAL cross-silo federation as OS processes
+over the in-tree broker, SIGKILLs the server mid-round (the
+:class:`~fedml_tpu.resilience.chaos.ServerKillWindow` fires inside the
+server after it has journaled ``after_uploads`` uploads), restarts it
+with ``resume: true``, and supervises to completion — measuring:
+
+- **MTTR** — wall seconds from the observed kill to the restarted server
+  announcing its journal replay (``RESUMED`` marker);
+- **salvaged uploads** — how many journaled uploads re-entered the
+  aggregator without any client retraining them (each client prints a
+  ``TRAINED <round>`` marker per local round, so retrains are visible);
+- **bit-identity** — the final-params digest, comparable against an
+  uninterrupted run of the same seed (identity codec ⇒ identical).
+
+Exposed as ``fedml_tpu chaos --kill-server`` and measured by
+``tools/recover_bench.py`` / ``bench.py --recover``.
+
+This module doubles as the per-rank entrypoint::
+
+    python -m fedml_tpu.resilience.durability.recover \
+        --cf cfg.json --rank 0 --role server
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+__all__ = ["run_recover_scenario", "scenario_config"]
+
+
+def _digest(params: Any) -> str:
+    import jax
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in jax.tree.leaves(params):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def scenario_config(run_id: str, seed: int, rounds: int, clients: int,
+                    broker_host: str, broker_port: int, tmp: str,
+                    compression: str = "identity",
+                    extra_train: Dict = None) -> Dict:
+    """The one federation config both the supervisor and the ranks use."""
+    return {
+        "common_args": {"training_type": "cross_silo", "random_seed": seed,
+                        "run_id": run_id,
+                        "log_file_dir": os.path.join(tmp, "logs")},
+        "data_args": {"dataset": "synthetic", "train_size": 80 * clients,
+                      "test_size": 40, "class_num": 4, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "comm_backend": "BROKER",
+            "broker_host": broker_host, "broker_port": broker_port,
+            "object_store_dir": os.path.join(tmp, "store"),
+            "client_num_in_total": clients,
+            "client_num_per_round": clients,
+            "comm_round": rounds, "epochs": 1, "batch_size": 16,
+            "learning_rate": 0.3,
+            "durability": True, "resume": True,
+            "checkpoint_dir": os.path.join(tmp, "ckpts"),
+            **({"compression": compression} if compression else {}),
+            **(extra_train or {}),
+        },
+    }
+
+
+# -- per-rank entrypoint ----------------------------------------------------
+def _rank_main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cf", required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--role", choices=("server", "client"), required=True)
+    ns = ap.parse_args(argv)
+
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+
+    with open(ns.cf) as f:
+        cfg = json.load(f)
+    args = load_arguments_from_dict(cfg)
+    args.rank = ns.rank
+    args = fedml_tpu.init(args)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+
+    if ns.role == "server":
+        from fedml_tpu.cross_silo.server.server import Server
+
+        server = Server(args, None, ds, model)
+        mgr = server.manager
+        sal = getattr(mgr, "_salvaged", None)
+        if sal is not None:
+            # the supervisor's MTTR clock stops here: the restarted
+            # server holds its salvaged round state and is accepting
+            print("RESUMED " + json.dumps({  # noqa: T201 (rank protocol)
+                "round": sal.round_idx,
+                "salvaged": len(sal.uploads),
+                "clients": sorted(sal.uploaded_clients),
+            }), flush=True)
+        result = server.run()
+        # land the registry snapshot (resilience/journal_* counters) in
+        # the run dir so `telemetry doctor` reads the recovery section
+        from fedml_tpu.telemetry import flush_run
+
+        flush_run()
+        print("DIGEST " + _digest(  # noqa: T201 (rank protocol)
+            mgr.aggregator.get_global_model_params()), flush=True)
+        print("RESULT " + json.dumps(result, default=str),  # noqa: T201 (rank protocol)
+              flush=True)
+        return 0
+
+    from fedml_tpu.cross_silo.client.client import Client
+
+    client = Client(args, None, ds, model)
+    adapter = client.manager.trainer_dist_adapter
+    orig_train = adapter.train
+
+    def train(round_idx, weights):
+        # retrain visibility: the recovery gates assert a salvaged
+        # client's journaled round is never trained twice
+        print(f"TRAINED {int(round_idx)}", flush=True)  # noqa: T201 (rank protocol)
+        return orig_train(round_idx, weights)
+
+    adapter.train = train
+    client.run()
+    print("CLIENT DONE", flush=True)  # noqa: T201 (rank protocol)
+    return 0
+
+
+# -- the supervisor ---------------------------------------------------------
+class _Pump(threading.Thread):
+    """Stream a child's stdout into a timestamped line list."""
+
+    def __init__(self, proc: subprocess.Popen, name: str):
+        super().__init__(name=f"pump-{name}", daemon=True)
+        self.proc = proc
+        self.lines: List[tuple] = []  # (ts, line)
+        self.start()
+
+    def run(self) -> None:
+        for raw in self.proc.stdout:
+            self.lines.append((time.time(), raw.rstrip("\n")))
+
+    def find(self, prefix: str) -> Optional[tuple]:
+        for ts, line in self.lines:
+            if line.startswith(prefix):
+                return ts, line
+        return None
+
+
+def _spawn(role: str, rank: int, cfg_path: str,
+           extra_env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m",
+         "fedml_tpu.resilience.durability.recover",
+         "--cf", cfg_path, "--rank", str(rank), "--role", role],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def run_recover_scenario(
+    seed: int = 0,
+    rounds: int = 5,
+    clients: int = 2,
+    kill_round: int = 2,
+    after_uploads: int = 1,
+    compression: str = "identity",
+    kill: bool = True,
+    max_restarts: int = 2,
+    timeout: float = 600.0,
+    tmp_dir: Optional[str] = None,
+    extra_train: Optional[Dict] = None,
+) -> Dict:
+    """Run one supervised federation; returns a JSON-safe summary.
+
+    ``kill=False`` runs the uninterrupted baseline of the same seed —
+    its ``digest`` is what the killed run must match bit-for-bit under
+    the identity codec.
+    """
+    import shutil
+    import tempfile
+
+    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+
+    tmp = tmp_dir or tempfile.mkdtemp(prefix="fedml_recover_")
+    owns_tmp = tmp_dir is None
+    broker = PubSubBroker(port=0).start()
+    host, port = broker.address
+    run_id = f"recover_{seed}_{'kill' if kill else 'base'}"
+    cfg = scenario_config(run_id, seed, rounds, clients, host, port, tmp,
+                          compression, extra_train=extra_train)
+    cfg_path = os.path.join(tmp, f"{run_id}.json")
+    os.makedirs(tmp, exist_ok=True)
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+
+    t0 = time.time()
+    restarts = 0
+    mttr_s = None
+    resumed: Dict = {}
+    server_pumps: List[_Pump] = []
+    client_procs = []
+    client_pumps = []
+    try:
+        for r in range(1, clients + 1):
+            p = _spawn("client", r, cfg_path)
+            client_procs.append(p)
+            client_pumps.append(_Pump(p, f"client{r}"))
+        kill_env = None
+        if kill:
+            # the kill spec rides an env var passed to the FIRST server
+            # spawn ONLY — the respawn must not re-trigger its own death
+            kill_env = {"FEDML_CHAOS_KILL_SERVER": json.dumps(
+                {"round": int(kill_round),
+                 "after_uploads": int(after_uploads)})}
+        server = _spawn("server", 0, cfg_path, extra_env=kill_env)
+        pump = _Pump(server, "server")
+        server_pumps.append(pump)
+        t_kill = None
+        deadline = time.time() + timeout
+        while True:
+            rc = server.poll()
+            if rc is None:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"recover scenario did not finish in {timeout}s")
+                time.sleep(0.05)
+                continue
+            if rc == -signal.SIGKILL and restarts < max_restarts:
+                t_kill = time.time()
+                restarts += 1
+                server = _spawn("server", 0, cfg_path)  # no kill env
+                pump = _Pump(server, "server")
+                server_pumps.append(pump)
+                continue
+            break
+        # the pump may still be draining the dead process's pipe buffer —
+        # join before reading lines or the tail markers can be missed
+        pump.join(timeout=30)
+        if server.returncode != 0:
+            tail = "\n".join(line for _, line in pump.lines[-30:])
+            raise RuntimeError(
+                f"server exited {server.returncode}:\n{tail}")
+        hit = pump.find("RESUMED ")
+        if hit is not None:
+            ts, line = hit
+            resumed = json.loads(line[len("RESUMED "):])
+            if t_kill is not None:
+                mttr_s = ts - t_kill
+        digest_line = pump.find("DIGEST ")
+        result_line = pump.find("RESULT ")
+        for p in client_procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for cp in client_pumps:
+            cp.join(timeout=30)  # drain TRAINED markers before counting
+        trained: Dict[str, List[int]] = {}
+        for r, cp in enumerate(client_pumps, start=1):
+            trained[str(r)] = [int(line.split()[1]) for _, line in cp.lines
+                               if line.startswith("TRAINED ")]
+        return {
+            "completed": result_line is not None,
+            "seed": int(seed), "rounds": int(rounds),
+            "clients": int(clients), "kill": bool(kill),
+            "compression": compression,
+            "restarts": restarts,
+            "mttr_s": round(mttr_s, 3) if mttr_s is not None else None,
+            "salvaged_uploads": int(resumed.get("salvaged", 0)),
+            "salvaged_clients": resumed.get("clients", []),
+            "resumed_round": resumed.get("round"),
+            "digest": (digest_line[1][len("DIGEST "):]
+                       if digest_line else None),
+            "result": (json.loads(result_line[1][len("RESULT "):])
+                       if result_line else None),
+            "trained": trained,
+            "wall_s": round(time.time() - t0, 3),
+        }
+    finally:
+        for p in client_procs + [
+                sp.proc for sp in server_pumps]:
+            if p.poll() is None:
+                p.kill()
+        broker.stop()
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(_rank_main(sys.argv[1:]))

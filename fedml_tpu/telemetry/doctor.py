@@ -434,6 +434,65 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "no data: no resilience/* metrics or resilience_event records "
             "(run predates the resilience layer, or nothing went wrong)")
 
+    # -- recovery (restarts / write-ahead journal replay) -----------------
+    # the durability layer's autopsy: was the process killed and
+    # restarted, what did the journal salvage, did a torn tail truncate,
+    # and did a secagg round have to abort to its round boundary
+    recovery_keys = ("restarts", "journal_replays", "journal_salvaged",
+                     "journal_records", "journal_bytes",
+                     "journal_truncations", "checkpoints_pruned")
+    recovery_counters = {k: res_counters[k] for k in recovery_keys
+                         if k in res_counters}
+    replay_events = [e for e in res_events
+                     if e.get("event") in ("journal_replayed",
+                                           "edge_restarted")]
+    sa_aborts = [e for e in health_events
+                 if e.get("kind") == "secagg_event"
+                 and e.get("event") == "resume_aborted"]
+    recovery: Dict[str, Any] = {"counters": recovery_counters,
+                                "events": replay_events[-16:],
+                                "secagg_aborts": sa_aborts[-8:]}
+    restarts = recovery_counters.get("restarts", 0.0)
+    salvaged = recovery_counters.get("journal_salvaged", 0.0)
+    if restarts:
+        verdict.append(
+            f"process restarted {restarts:.0f} time(s) mid-run; journal "
+            f"replay salvaged {salvaged:.0f} already-received upload(s) — "
+            + ("zero uploads lost to the crash window"
+               if salvaged else "nothing was in flight at the kill"))
+    for e in replay_events:
+        if e.get("event") == "journal_replayed":
+            verdict.append(
+                f"round {e.get('round')} re-entered MID-FLIGHT after a "
+                f"restart: clients {e.get('salvaged')} never retrained "
+                "(their uploads replayed from the journal)")
+        elif e.get("event") == "edge_restarted":
+            verdict.append(
+                f"tier {e.get('tier')} node {e.get('node')} restarted at "
+                f"round {e.get('round')} with {e.get('salvaged')} "
+                "salvaged partial sum(s)")
+    if recovery_counters.get("journal_truncations"):
+        verdict.append(
+            f"{recovery_counters['journal_truncations']:.0f} torn journal "
+            "tail(s) truncated at the last valid record (expected crash "
+            "artifact of a mid-append kill; no valid record was lost)")
+    if recovery_counters.get("checkpoints_pruned"):
+        verdict.append(
+            f"{recovery_counters['checkpoints_pruned']:.0f} half-written "
+            "checkpoint(s) pruned — resume fell back to the newest "
+            "restorable round")
+    for e in sa_aborts:
+        verdict.append(
+            f"secagg round {e.get('round')} ABORTED to its round boundary "
+            f"on restart ({e.get('uploads_dropped', 0)} masked upload(s) "
+            "dropped) — pairwise masks are unrecoverable without the "
+            "live session; the round restarted from the checkpoint")
+    if not recovery_counters and not replay_events and not sa_aborts:
+        notes.setdefault(
+            "recovery",
+            "no data: no restarts or journal activity (the process never "
+            "died, or durability was off)")
+
     # -- tiers (hierarchical federation: tier/<d>/* metrics + events) -----
     latest_tier: Dict[Any, float] = {}
     for rec in metric_records:
@@ -652,6 +711,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "services": services,
         "serving": serving,
         "connectivity": connectivity,
+        "recovery": recovery,
         "tiers": tiers,
         "secagg": secagg,
         "profile": profile,
@@ -759,6 +819,24 @@ def format_doctor(d: Dict) -> str:
                else "never rejoined"))
     if not counters and not conn.get("events"):
         add(f"  {notes.get('connectivity', 'no data')}")
+
+    add("")
+    add("recovery (restarts / journal replay):")
+    rec = d.get("recovery") or {}
+    rec_counters = rec.get("counters") or {}
+    if rec_counters or rec.get("events") or rec.get("secagg_aborts"):
+        for name, v in sorted(rec_counters.items()):
+            add(f"  resilience/{name:<33s}{v:>14.0f}")
+        for e in (rec.get("events") or [])[-6:]:
+            add("  event: " + " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("kind", "ts") and not isinstance(v, dict)))
+        for e in (rec.get("secagg_aborts") or [])[-4:]:
+            add(f"  secagg abort: round {e.get('round')} "
+                f"({e.get('uploads_dropped', 0)} masked upload(s) "
+                "dropped)")
+    else:
+        add(f"  {notes.get('recovery', 'no data')}")
 
     add("")
     add("tiers (hierarchical federation):")

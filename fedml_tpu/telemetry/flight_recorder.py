@@ -7,8 +7,11 @@ headers, health samples, round/checkpoint markers — in a byte-budgeted
 in-memory ring and lands them as ``<run_dir>/flight_recorder.jsonl`` the
 moment the process dies abnormally:
 
-- **SIGTERM** (preemption, ``kill``, scheduler stop): dump, then re-raise
-  the signal with the default handler so the exit code stays honest;
+- **SIGTERM** (preemption, ``kill``, scheduler stop) and **SIGINT**
+  (operator Ctrl-C, scheduler interrupt): dump, then chain — the
+  previous handler if one was installed, else re-raise with the default
+  disposition so the exit code stays honest (SIGINT's chained default
+  raises KeyboardInterrupt as usual);
 - **unhandled exception** (main thread via ``sys.excepthook``, any other
   thread via ``threading.excepthook``): dump with the exception type,
   message, and traceback as crash context, then chain to the previous
@@ -49,7 +52,7 @@ DUMP_FILENAME = "flight_recorder.jsonl"
 
 # reasons that mark a *crash* dump; a later atexit dump must not
 # overwrite the crash context they captured
-_CRASH_REASONS = ("sigterm", "exception", "handler_error")
+_CRASH_REASONS = ("sigterm", "sigint", "exception", "handler_error")
 
 
 class FlightRecorder:
@@ -235,21 +238,32 @@ def _install_hooks() -> None:
 
     threading.excepthook = _thread_hook
 
-    try:
-        prev_sig = signal.getsignal(signal.SIGTERM)
+    def _chain_signal(sig: int, reason: str) -> None:
+        """Dump-then-chain a termination signal. SIGTERM and SIGINT get
+        the SAME treatment: an operator Ctrl-C or a scheduler interrupt
+        must leave crash context just like a preemption — the journal
+        replay that follows should never be the only explanation. For
+        SIGINT the chained previous handler is normally
+        ``default_int_handler``, so KeyboardInterrupt still propagates
+        (and the exit status stays honest either way)."""
+        prev_sig = signal.getsignal(sig)
 
-        def _on_sigterm(signum, frame):
-            _dump_current("sigterm")
+        def _on_signal(signum, frame):
+            _dump_current(reason)
             if callable(prev_sig) and prev_sig not in (
                     signal.SIG_DFL, signal.SIG_IGN):
                 prev_sig(signum, frame)
                 return
             # restore the default disposition and re-raise so the exit
-            # status is a real SIGTERM death, not a masked clean exit
-            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            # status is a real signal death, not a masked clean exit
+            signal.signal(signum, signal.SIG_DFL)
             os.kill(os.getpid(), signum)
 
-        signal.signal(signal.SIGTERM, _on_sigterm)
+        signal.signal(sig, _on_signal)
+
+    try:
+        _chain_signal(signal.SIGTERM, "sigterm")
+        _chain_signal(signal.SIGINT, "sigint")
     except ValueError:
         # signal.signal only works on the main thread; a worker-thread
         # configure() still gets excepthook + atexit coverage
