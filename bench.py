@@ -323,6 +323,21 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--preempt" in sys.argv:
+        # job-plane gates: deterministic crasher contained (bounded
+        # attempts, bit-deterministic backoff), drained node's federation
+        # finishes with salvaged uploads never retrained, identity-codec
+        # final params bit-identical to an undisturbed run, and
+        # preempt-to-resumed MTTR within budget — one JSON line
+        # (tools/preempt_bench.py; FEDML_PREEMPT_* env knobs)
+        from tools.preempt_bench import run_preempt_bench
+
+        row = run_preempt_bench()
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--tree" in sys.argv:
         # hierarchical-federation bench: a seeded 3-tier 100k-client
         # aggregation tree on this machine — rounds/s, peak wire bytes

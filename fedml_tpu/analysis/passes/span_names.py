@@ -64,6 +64,13 @@ _LIVE_SHAPE = re.compile(r"^live/[a-z0-9_]+$")
 # phases ride the existing compress/* spans); one signal segment, and
 # counters only — every secagg signal is a protocol occurrence count
 _SECAGG_SHAPE = re.compile(r"^secagg/[a-z0-9_]+$")
+# job plane: sched/* is the supervision/preemption namespace — metric
+# only, one signal segment (run/job/node ids ride event fields in
+# sched_event records, never name segments); counters or gauges only —
+# restart/preempt/reschedule signals are occurrence counts, queue depths
+# are levels, neither is a latency distribution (MTTR is a bench metric,
+# not a histogram)
+_SCHED_SHAPE = re.compile(r"^sched/[a-z0-9_]+$")
 # performance attribution: profile/* is the program-catalog namespace —
 # metric-only (catalog programs are NOT spans; their names live in the
 # `program` label), one signal segment, counter/gauge only (flops/bytes/
@@ -136,10 +143,10 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
                     "or compress/decode")
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
-                 "secagg/", "profile/")):
+                 "secagg/", "profile/", "sched/")):
             bad(f"{name!r} — mem/, health/, resilience/, tier/, "
-                "live/, secagg/ and profile/ are metric namespaces, not "
-                "span names")
+                "live/, secagg/, profile/ and sched/ are metric "
+                "namespaces, not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 bad(f"span {name!r} must be serve/stage, "
@@ -195,6 +202,15 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
             elif kind == "histogram":
                 bad(f"{kind} {name!r} — profile/* signals are "
                     "levels (gauge) or occurrence counts (counter), not "
+                    "histograms")
+        if kind != "span" and name.startswith("sched/"):
+            if not _SCHED_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be sched/<signal> "
+                    "(one segment; run/job/node ids ride sched_event "
+                    "fields)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — sched/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
                     "histograms")
         if kind != "span" and name.startswith("secagg/"):
             if not _SECAGG_SHAPE.match(name):

@@ -96,6 +96,27 @@ def stop(run_id: str, workdir: str) -> None:
 
 @cli.command()
 @click.argument("run_id")
+@click.option("--grace", default=10.0, show_default=True,
+              help="seconds for the run to quiesce after SIGTERM before "
+                   "SIGKILL escalation")
+@click.option("--workdir", default=".fedml_runs", show_default=True)
+def preempt(run_id: str, grace: float, workdir: str) -> None:
+    """Gracefully quiesce a run (SIGTERM + grace → PREEMPTED).
+
+    The journal/checkpoint state a durable job fdatasyncs makes the kill
+    point safe; a master (or a fresh `launch` elsewhere with resume)
+    picks the job up from where it quiesced.
+    """
+    from fedml_tpu.scheduler.launch import get_agent
+
+    ok = get_agent(workdir).preempt(run_id, grace_s=grace)
+    click.echo("preempted" if ok else "no such running job")
+    if not ok:
+        raise SystemExit(1)
+
+
+@cli.command()
+@click.argument("run_id")
 @click.option("--workdir", default=".fedml_runs", show_default=True)
 def status(run_id: str, workdir: str) -> None:
     from fedml_tpu.scheduler.launch import run_status
@@ -236,6 +257,34 @@ def cluster_submit(yaml_path: str, broker: str, ranks: int, nodes,
                 raise SystemExit(1)
     finally:
         master.shutdown()
+
+
+@cluster.command("drain")
+@click.argument("node_id")
+@click.option("--broker", default="127.0.0.1:18923", show_default=True)
+@click.option("--grace", default=10.0, show_default=True,
+              help="per-run quiesce grace before SIGKILL escalation")
+def cluster_drain(node_id: str, broker: str, grace: float) -> None:
+    """Deliver a reclaim notice to a node agent: preempt ALL its runs.
+
+    The node quiesces every run (SIGTERM + grace); the job-owning master
+    sees the PREEMPTED statuses and reschedules durable jobs onto
+    surviving nodes, where they resume from their journals. This command
+    only delivers the notice — it is what a preemptible-capacity
+    maintenance hook calls with the provider's warning.
+    """
+    from fedml_tpu.core.distributed.communication.broker_agent import (
+        BrokerJsonAgent,
+    )
+
+    host, port = _broker_addr(broker)
+    agent = BrokerJsonAgent(host, port)
+    try:
+        agent.publish_json(f"sched/default/node/{node_id}",
+                           {"type": "drain_node", "grace_s": grace})
+        click.echo(f"drain notice sent to {node_id} (grace {grace:g}s)")
+    finally:
+        agent.stop_agent()
 
 
 @cli.group()
@@ -565,11 +614,26 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
                    "OS processes over the broker transport")
 @click.option("--after-uploads", default=1, show_default=True,
               help="with --kill-server: uploads journaled before the kill")
+@click.option("--drain", is_flag=True, default=False,
+              help="scheduler-tier chaos: run the federation under real "
+                   "node agents and DRAIN the server's node mid-round "
+                   "(SIGTERM + grace, reschedule to the second agent, "
+                   "journal resume) — the preemptible-capacity story")
+@click.option("--grace-s", default=10.0, show_default=True,
+              help="with --drain: per-run quiesce grace")
+@click.option("--drain-via", default="master", show_default=True,
+              type=click.Choice(["master", "reclaim"]),
+              help="with --drain: drive the drain from the master, or "
+                   "deliver a reclaim notice to the node agent")
+@click.option("--agent-kill", is_flag=True, default=False,
+              help="with --drain: also SIGKILL + restart the surviving "
+                   "node agent after the resume (re-adoption proof)")
 def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
           revive_round, drop: float, duplicate: float, delay_ms: float,
           compression: str, secagg: str, round_deadline_s: float,
           round_quorum: float, kill_server: bool,
-          after_uploads: int) -> None:
+          after_uploads: int, drain: bool, grace_s: float, drain_via: str,
+          agent_kill: bool) -> None:
     """Run a seeded chaos scenario against an in-proc federation.
 
     Injects deterministic faults (message drop/duplicate/delay, client
@@ -582,7 +646,28 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
     process itself is SIGKILLed mid-round and supervised back to life,
     re-entering the round from its write-ahead journal (MTTR, salvaged
     uploads and the final-params digest land in the JSON line).
+
+    --drain raises the tier once more: the federation runs under REAL
+    node agents, and the server's NODE is drained mid-round — graceful
+    SIGTERM quiesce, master reschedule to the surviving agent, journal
+    resume (MTTR = notice → RESUMED).
     """
+    if drain:
+        if secagg:
+            raise click.UsageError(
+                "--drain with secagg aborts to the round boundary by "
+                "design (masks die with the session)")
+        from fedml_tpu.scheduler.preempt import run_preempt_scenario
+
+        out = run_preempt_scenario(
+            seed=seed, rounds=rounds, clients=clients,
+            drain_round=kill_round, after_uploads=after_uploads,
+            grace_s=grace_s, compression=compression or "identity",
+            via=drain_via, agent_kill=agent_kill)
+        click.echo(json.dumps(out))
+        if not out["completed"]:
+            raise SystemExit(1)
+        return
     if kill_server:
         if secagg:
             raise click.UsageError(

@@ -20,22 +20,31 @@ class RunStatus:
     PROVISIONING = "PROVISIONING"
     RUNNING = "RUNNING"
     STOPPING = "STOPPING"
+    # supervision: the run's process died abnormally and the agent is
+    # waiting out the restart backoff before relaunching it
+    RESTARTING = "RESTARTING"
     FINISHED = "FINISHED"
     FAILED = "FAILED"
     KILLED = "KILLED"
+    # preemption: the run was gracefully quiesced (SIGTERM + grace) so a
+    # master can reschedule it elsewhere; terminal FOR THIS AGENT — the
+    # job plane supersedes the run with a resumed one on another node
+    PREEMPTED = "PREEMPTED"
     EXCEPTION = "EXCEPTION"
 
-    TERMINAL = {FINISHED, FAILED, KILLED, EXCEPTION}
+    TERMINAL = {FINISHED, FAILED, KILLED, PREEMPTED, EXCEPTION}
 
     _ALLOWED = {
         IDLE: {QUEUED, PROVISIONING, RUNNING, KILLED},
         QUEUED: {PROVISIONING, RUNNING, KILLED, FAILED},
         PROVISIONING: {RUNNING, FAILED, KILLED, EXCEPTION},
-        RUNNING: {STOPPING, FINISHED, FAILED, KILLED, EXCEPTION},
-        STOPPING: {KILLED, FINISHED, FAILED, EXCEPTION},
+        RUNNING: {STOPPING, RESTARTING, FINISHED, FAILED, KILLED, EXCEPTION},
+        RESTARTING: {RUNNING, STOPPING, FAILED, KILLED},
+        STOPPING: {KILLED, PREEMPTED, FINISHED, FAILED, EXCEPTION},
         FINISHED: set(),
         FAILED: set(),
         KILLED: set(),
+        PREEMPTED: set(),
         EXCEPTION: set(),
     }
 

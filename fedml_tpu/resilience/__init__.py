@@ -30,7 +30,9 @@ recorder, which is what ``telemetry doctor``'s connectivity section
 reads.
 """
 from fedml_tpu.resilience.chaos import (
+    AgentKillWindow,
     ChaosInjector,
+    NodeDrain,
     ServerKillWindow,
     chaos_from_args,
     run_chaos_scenario,
@@ -55,7 +57,9 @@ from fedml_tpu.resilience.quorum import (
 )
 
 __all__ = [
+    "AgentKillWindow",
     "ChaosInjector",
+    "NodeDrain",
     "ServerKillWindow",
     "chaos_from_args",
     "run_chaos_scenario",

@@ -212,6 +212,48 @@ class ServerKillWindow:
             os.kill(os.getpid(), signal.SIGKILL)
 
 
+class AgentKillWindow:
+    """Scheduler-tier chaos: SIGKILL a NODE AGENT process (not its runs)
+    after it has supervised ``after_s`` seconds — then restart it over
+    the same workdir. The runs keep executing as orphans of the dead
+    agent; the restarted agent must RE-ADOPT them from the persisted run
+    table (pid + ``_pid_reused`` check) instead of abandoning them to the
+    JobMonitor's FAILED sweep. Consumed by the preempt scenario runner
+    (:mod:`fedml_tpu.scheduler.preempt`)."""
+
+    __slots__ = ("node", "after_s", "restart_after_s")
+
+    def __init__(self, node: str, after_s: float = 2.0,
+                 restart_after_s: float = 0.5):
+        self.node = str(node)
+        self.after_s = float(after_s)
+        self.restart_after_s = float(restart_after_s)
+
+
+class NodeDrain:
+    """Scheduler-tier chaos: a simulated preemptible-capacity reclaim
+    notice — "node ``node`` is being reclaimed, you have ``grace_s``
+    seconds". Triggered deterministically on journal evidence (round
+    ``round`` has journaled ``after_uploads`` uploads), like
+    :class:`ServerKillWindow`, so the preempt happens mid-round every
+    run. ``via='master'`` drives :meth:`MasterAgent.drain_node`;
+    ``via='reclaim'`` publishes the ``drain_node`` wire verb to the node
+    agent itself (the master only sees the PREEMPTED statuses and must
+    reschedule from those alone)."""
+
+    __slots__ = ("node", "round", "after_uploads", "grace_s", "via")
+
+    def __init__(self, node: str, round: int = 2, after_uploads: int = 1,
+                 grace_s: float = 10.0, via: str = "master"):
+        if via not in ("master", "reclaim"):
+            raise ValueError(f"NodeDrain via must be master|reclaim, got {via!r}")
+        self.node = str(node)
+        self.round = int(round)
+        self.after_uploads = max(1, int(after_uploads))
+        self.grace_s = float(grace_s)
+        self.via = via
+
+
 def chaos_from_args(args: Any, rank: int,
                     round_provider: Optional[Callable[[], int]] = None
                     ) -> Optional[ChaosInjector]:

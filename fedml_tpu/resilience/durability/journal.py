@@ -43,10 +43,42 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 __all__ = ["RoundJournal", "SalvagedRound", "journal_from_args",
-           "salvage_round", "scan_open_round"]
+           "parse_frames", "salvage_round", "scan_open_round"]
 
 _MAGIC = b"RJ"
 _HEADER = struct.Struct("<2sII")  # magic, payload len, crc32
+
+
+def parse_frames(data: bytes):
+    """``(records, valid_end)`` — side-effect-free scan of the RJ frame
+    stream. Stops at a torn header/short payload/CRC hole; ``valid_end``
+    is the byte offset after the last valid record. Shared by
+    :meth:`RoundJournal.records` (which additionally truncates the file
+    at ``valid_end``) and read-only spies on a LIVE journal (the
+    scheduler's drain trigger) that must never mutate it."""
+    from fedml_tpu.utils.serialization import safe_loads
+
+    out: List[Dict] = []
+    offset = 0
+    valid_end = 0
+    while offset + _HEADER.size <= len(data):
+        magic, length, crc = _HEADER.unpack_from(data, offset)
+        body_start = offset + _HEADER.size
+        if magic != _MAGIC or body_start + length > len(data):
+            break  # torn header or short payload
+        payload = data[body_start:body_start + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            break  # corrupt record: stop at the last good frame
+        try:
+            rec = safe_loads(payload)
+        except ValueError:
+            break
+        if not isinstance(rec, dict):
+            break
+        out.append(rec)
+        offset = body_start + length
+        valid_end = offset
+    return out, valid_end
 
 
 class RoundJournal:
@@ -137,32 +169,12 @@ class RoundJournal:
         file; corruption inside a record drops it and everything after
         (a CRC hole breaks the frame stream)."""
         from fedml_tpu import telemetry
-        from fedml_tpu.utils.serialization import safe_loads
 
         with self._lock:
             self._fh.flush()
             with open(self.path, "rb") as f:
                 data = f.read()
-            out: List[Dict] = []
-            offset = 0
-            valid_end = 0
-            while offset + _HEADER.size <= len(data):
-                magic, length, crc = _HEADER.unpack_from(data, offset)
-                body_start = offset + _HEADER.size
-                if magic != _MAGIC or body_start + length > len(data):
-                    break  # torn header or short payload
-                payload = data[body_start:body_start + length]
-                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                    break  # corrupt record: stop at the last good frame
-                try:
-                    rec = safe_loads(payload)
-                except ValueError:
-                    break
-                if not isinstance(rec, dict):
-                    break
-                out.append(rec)
-                offset = body_start + length
-                valid_end = offset
+            out, valid_end = parse_frames(data)
             if valid_end < len(data):
                 telemetry.get_registry().counter(
                     "resilience/journal_truncations").inc()

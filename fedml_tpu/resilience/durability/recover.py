@@ -28,7 +28,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import signal
 import subprocess
 import sys
 import threading
@@ -192,6 +191,7 @@ def run_recover_scenario(
     compression: str = "identity",
     kill: bool = True,
     max_restarts: int = 2,
+    restart_backoff_s: float = 0.25,
     timeout: float = 600.0,
     tmp_dir: Optional[str] = None,
     extra_train: Optional[Dict] = None,
@@ -201,6 +201,14 @@ def run_recover_scenario(
     ``kill=False`` runs the uninterrupted baseline of the same seed —
     its ``digest`` is what the killed run must match bit-for-bit under
     the identity codec.
+
+    The supervisor re-arms on ANY abnormal server exit — SIGKILL chaos,
+    OOM, unhandled exception — through the job plane's shared
+    :class:`~fedml_tpu.scheduler.supervision.RestartTracker` (exponential
+    backoff, crash-loop containment), not just the chaos kill: a server
+    that dies of a real bug gets the same bounded relaunch budget the
+    agent gives its runs, and each relaunch counts under
+    ``resilience/restarts``.
     """
     import shutil
     import tempfile
@@ -238,9 +246,21 @@ def run_recover_scenario(
             kill_env = {"FEDML_CHAOS_KILL_SERVER": json.dumps(
                 {"round": int(kill_round),
                  "after_uploads": int(after_uploads)})}
+        from fedml_tpu.scheduler.supervision import (
+            RestartPolicy,
+            RestartTracker,
+            describe_rc,
+        )
+        from fedml_tpu.telemetry import get_registry
+
+        tracker = RestartTracker(RestartPolicy(
+            max_restarts=max_restarts, backoff_s=restart_backoff_s,
+            crash_loop_threshold=3, fast_fail_s=10.0, resume=True))
+        give_up_reason = None
         server = _spawn("server", 0, cfg_path, extra_env=kill_env)
         pump = _Pump(server, "server")
         server_pumps.append(pump)
+        spawned_at = time.time()
         t_kill = None
         deadline = time.time() + timeout
         while True:
@@ -251,21 +271,33 @@ def run_recover_scenario(
                         f"recover scenario did not finish in {timeout}s")
                 time.sleep(0.05)
                 continue
-            if rc == -signal.SIGKILL and restarts < max_restarts:
+            if rc == 0:
+                break
+            # ANY abnormal exit (chaos SIGKILL, OOM, bad config, unhandled
+            # exception) goes through the shared supervision policy — the
+            # old runner silently never restarted a non-SIGKILL death
+            action, detail = tracker.on_exit(rc, time.time() - spawned_at)
+            if action != "restart":
+                give_up_reason = detail
+                break
+            if t_kill is None:
                 t_kill = time.time()
-                restarts += 1
-                server = _spawn("server", 0, cfg_path)  # no kill env
-                pump = _Pump(server, "server")
-                server_pumps.append(pump)
-                continue
-            break
+            restarts += 1
+            get_registry().counter("resilience/restarts").inc()
+            time.sleep(detail)  # deterministic backoff (no jitter)
+            server = _spawn("server", 0, cfg_path)  # no kill env: resume
+            pump = _Pump(server, "server")
+            server_pumps.append(pump)
+            spawned_at = time.time()
         # the pump may still be draining the dead process's pipe buffer —
         # join before reading lines or the tail markers can be missed
         pump.join(timeout=30)
         if server.returncode != 0:
             tail = "\n".join(line for _, line in pump.lines[-30:])
             raise RuntimeError(
-                f"server exited {server.returncode}:\n{tail}")
+                f"server exited {describe_rc(server.returncode)}"
+                + (f" ({give_up_reason})" if give_up_reason else "")
+                + f":\n{tail}")
         hit = pump.find("RESUMED ")
         if hit is not None:
             ts, line = hit

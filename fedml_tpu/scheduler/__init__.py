@@ -1,8 +1,12 @@
-"""Compute plane — local agent daemon, launch manager, job yaml, env.
+"""Job plane — supervising agents, preemption, launch manager, job yaml.
 
 Parity: reference ``computing/scheduler/`` (slave/master agents,
-scheduler_entry launch path) in the thin single-host shape SURVEY §7.8
-plans: job-yaml runner + agent daemon + local metrics sink.
+scheduler_entry launch path), grown past observation into supervision:
+agents restart crashed runs (exponential backoff, crash-loop
+containment), `preempt` quiesces a run for preemptible-capacity
+reclaims, masters reschedule preempted/lost durable jobs onto surviving
+nodes (peak-HBM-gated admission) where they resume from their PR 12
+write-ahead journals. See docs/scheduler.md.
 """
 from fedml_tpu.scheduler.agent import LocalAgent
 from fedml_tpu.scheduler.env_collect import collect_env
@@ -14,14 +18,24 @@ from fedml_tpu.scheduler.launch import (
     run_status,
     run_stop,
 )
+from fedml_tpu.scheduler.preempt import run_preempt_scenario
+from fedml_tpu.scheduler.supervision import (
+    RestartPolicy,
+    RestartTracker,
+    peak_hbm_from_programs,
+)
 
 __all__ = [
     "LocalAgent",
     "JobSpec",
+    "RestartPolicy",
+    "RestartTracker",
     "collect_env",
     "launch_job",
     "list_jobs",
+    "peak_hbm_from_programs",
     "run_logs",
+    "run_preempt_scenario",
     "run_status",
     "run_stop",
 ]

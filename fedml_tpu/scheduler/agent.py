@@ -1,4 +1,4 @@
-"""Local agent — spawns, monitors, and kills job subprocesses.
+"""Local agent — spawns, supervises, preempts, and kills job subprocesses.
 
 Parity target: the reference slave agent (``slave/client_runner.py:60`` —
 ``run`` :378 spawns the job process, ``callback_start_train`` :893,
@@ -9,10 +9,34 @@ owns a run table; each run is a subprocess started from a JobSpec
 status tracked by the validated FSM and mirrored into the JSONL metrics
 sink. A monitor thread reaps exits; `kill` terminates the whole process
 group (the reference's cleanup_all_fedml_client_* equivalent).
+
+Job-plane additions (the parts the reference's daemon loop only gestures
+at):
+
+* **supervision** — a run whose spec carries a ``restart`` policy is
+  relaunched on ANY abnormal exit with exponential backoff; N fast
+  identical failures trip crash-loop containment (FAILED with a
+  doctor-visible reason instead of flapping). Durable jobs relaunch with
+  ``FEDML_RESUME=1`` so a federation server re-enters via the write-ahead
+  journal instead of round 0. ``sched/restarts`` / ``sched/crash_loops``.
+* **preemption** — :meth:`preempt` is the graceful quiesce verb for
+  preemptible capacity: SIGTERM to the process group, wait for the WHOLE
+  group to drain within the grace window (the flight recorder's SIGTERM
+  dump + the fdatasync'd journal make the kill-point safe anywhere),
+  escalate to SIGKILL only past the deadline. Terminal status PREEMPTED,
+  which a master treats as "reschedule me", not "I failed".
+  ``sched/preemptions``.
+* **re-adoption** — an agent restarted over live runs re-adopts the runs
+  the store says it owns (pid still alive + the ``_pid_reused`` check)
+  instead of abandoning them to the JobMonitor's FAILED sweep; each run's
+  shell writes its exit code to a ``<run_id>.rc`` file so even a run that
+  finished while no agent was watching lands on its true terminal status.
+  ``sched/adopted``.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import subprocess
@@ -24,6 +48,14 @@ from typing import Any, Dict, List, Optional
 from fedml_tpu.core.mlops.metrics import MLOpsMetrics
 from fedml_tpu.core.mlops.status import RunStatus, RunStatusMachine
 from fedml_tpu.scheduler.job_yaml import JobSpec
+from fedml_tpu.scheduler.supervision import (
+    RestartPolicy,
+    RestartTracker,
+    describe_rc,
+    sched_event,
+)
+
+logger = logging.getLogger(__name__)
 
 
 def _pid_alive(pid: int) -> bool:
@@ -34,16 +66,46 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def _pgid_alive(pgid: int) -> bool:
+    """True while ANY member of the process group survives — the quiesce
+    wait must cover the whole group, not just the shell leader (the job's
+    python child keeps flushing its journal after sh dies)."""
+    try:
+        os.killpg(int(pgid), 0)
+        return True
+    except (ProcessLookupError, ValueError):
+        return False
+    except PermissionError:  # pragma: no cover - foreign uid member
+        return True
+
+
 class RunRecord:
     def __init__(self, run_id: str, spec: JobSpec, log_path: str, sink):
         self.run_id = run_id
         self.spec = spec
         self.log_path = log_path
+        self.rc_path = log_path[:-4] + ".rc" if log_path.endswith(".log") \
+            else log_path + ".rc"
         self.proc: Optional[subprocess.Popen] = None
         self.pid: Optional[int] = None  # survives across agent processes
         self.fsm = RunStatusMachine(run_id, sink=sink)
         self.returncode: Optional[int] = None
         self.started = time.time()
+        self.spawned_at = self.started  # last (re)spawn, for fast-fail judge
+        # supervision state
+        policy = RestartPolicy.from_spec(spec.restart)
+        self.tracker: Optional[RestartTracker] = (
+            RestartTracker(policy) if policy else None)
+        self.next_restart_at: Optional[float] = None
+        self.reason = ""                # last supervision verdict
+        self.extra_env: Dict[str, str] = {}
+        # intent (persisted: the exit verdict must say PREEMPTED even if
+        # a different agent process ends up judging it) vs in-flight
+        # (process-local: this preempt() call owns the quiesce, monitor
+        # hands off)
+        self.preempt_requested = False
+        self.preempt_inflight = False
+        self.adopted = False
 
 
 class LocalAgent:
@@ -68,6 +130,13 @@ class LocalAgent:
         self.compute_store = ComputeStore(self.workdir)
         self.node_id = getattr(args, "node_id", None) or "local"
         self._persist_lock = threading.Lock()
+        from fedml_tpu.telemetry import get_registry
+
+        reg = get_registry()
+        self._m_restarts = reg.counter("sched/restarts")
+        self._m_crash_loops = reg.counter("sched/crash_loops")
+        self._m_preemptions = reg.counter("sched/preemptions")
+        self._m_adopted = reg.counter("sched/adopted")
         # inventory probe runs out-of-process (jax.devices() in this daemon
         # would grab the TPU the spawned jobs need) and off-thread (so agent
         # construction stays fast); the row lands when the probe returns
@@ -88,7 +157,9 @@ class LocalAgent:
     # -- cross-process run table -----------------------------------------
     # the reference's agents persist run state in sqlite
     # (slave/client_data_interface.py) so `fedml stop` works from any
-    # process; here a json table in the workdir serves the same purpose
+    # process; here a json table in the workdir serves the same purpose.
+    # The full spec rides along so a RESTARTED AGENT can keep supervising
+    # (relaunch a run in backoff, re-arm an adopted run's policy).
     def _persist_table(self) -> None:
         rows = {}
         with self._lock:
@@ -99,6 +170,13 @@ class LocalAgent:
                     "pid": rec.pid,
                     "status": rec.fsm.status,
                     "returncode": rec.returncode,
+                    "started": rec.started,
+                    "spawned": rec.spawned_at,
+                    "preempted_intent": rec.preempt_requested,
+                    "spec": rec.spec.wire(),
+                    "extra_env": rec.extra_env,
+                    "restarts": rec.tracker.restarts if rec.tracker else 0,
+                    "reason": rec.reason,
                 }
         # the monitor thread and a wait()ing caller can persist concurrently —
         # serialize, and write via mkstemp so a torn write can't be promoted
@@ -113,6 +191,7 @@ class LocalAgent:
                     rid, job_name=row["job_name"], node_id=self.node_id,
                     status=row["status"], pid=row["pid"],
                     returncode=row["returncode"], log_path=row["log_path"],
+                    restarts=row["restarts"], reason=row["reason"],
                 )
                 if row["status"] in RunStatus.TERMINAL:
                     prev = self.compute_store.get_run(rid)
@@ -128,23 +207,72 @@ class LocalAgent:
                 rows = json.load(f)
         except (OSError, ValueError):
             return
+        from fedml_tpu.scheduler.job_monitor import _pid_reused
+
         for rid, row in rows.items():
-            rec = RunRecord(
-                rid, JobSpec(job_name=row.get("job_name", rid), job="",
-                             workspace="."),
-                row.get("log_path", ""), self._status_sink,
-            )
+            spec = JobSpec.from_wire(row.get("spec") or
+                                     {"job_name": row.get("job_name", rid)},
+                                     default_name=rid)
+            rec = RunRecord(rid, spec, row.get("log_path", ""),
+                            self._status_sink)
             rec.pid = row.get("pid")
             rec.returncode = row.get("returncode")
+            rec.started = float(row.get("started") or rec.started)
+            # the pid-reuse judgment must key off the LAST respawn, not
+            # the first launch — a supervised relaunch >120s in would
+            # otherwise look "reused", and a live run would be doubled
+            rec.spawned_at = float(row.get("spawned") or rec.started)
+            rec.extra_env = {k: str(v) for k, v in
+                             (row.get("extra_env") or {}).items()}
+            rec.reason = str(row.get("reason") or "")
+            # intent survives the agent: a run SIGTERM'd by a preempt
+            # whose agent died mid-grace must still land PREEMPTED (the
+            # reschedulable verdict), not KILLED; in-flight does NOT
+            # survive — no preempt() owns the quiesce here, the monitor
+            # judges the exit
+            rec.preempt_requested = bool(row.get("preempted_intent"))
+            if rec.tracker is not None:
+                rec.tracker.restarts = int(row.get("restarts") or 0)
             rec.fsm.status = row.get("status", RunStatus.IDLE)
-            if (rec.fsm.status == RunStatus.RUNNING and rec.pid
-                    and not _pid_alive(rec.pid)):
-                # process died while no agent was watching; exact rc unknown.
-                # FAILED, matching JobMonitor.sweep_runs for the same
-                # condition — terminal status must not depend on which
-                # component notices first.
-                rec.fsm.status = RunStatus.FAILED
+            if rec.fsm.status in (RunStatus.RUNNING, RunStatus.STOPPING):
+                # STOPPING rows too: a kill/preempt grace window can be
+                # persisted mid-flight by a concurrent _persist_table —
+                # left alone the row would sit non-terminal forever
+                # (neither the monitor's branches nor the JobMonitor's
+                # RUNNING-only sweep would ever judge it)
+                alive = (rec.pid and _pid_alive(rec.pid)
+                         and not _pid_reused(rec.pid, rec.spawned_at))
+                rc = self._read_rc(rec)
+                if alive and rc is None:
+                    # re-adopt: the previous agent process died over this
+                    # live run; keep supervising it (pid polls + rc file)
+                    # instead of abandoning it to the JobMonitor sweep
+                    rec.adopted = True
+                    self._m_adopted.inc()
+                    sched_event("run_adopted", run_id=rid, pid=rec.pid,
+                                node=self.node_id)
+                    self._start_log_daemon(rec, from_beginning=False)
+                else:
+                    # died while no agent was watching: the rc file (if
+                    # the shell got far enough to write it) gives the
+                    # true terminal status; otherwise judge it like any
+                    # abnormal exit — which lets a supervised run
+                    # RESTART instead of rotting as FAILED
+                    self._judge_exit(rec, rc, persist=False)
+            elif (rec.fsm.status == RunStatus.RESTARTING
+                  and rec.tracker is not None):
+                # relaunch owed from the previous agent life: re-arm at
+                # the policy's base backoff (exact remaining delay died
+                # with the old process; the budget in `restarts` didn't)
+                rec.next_restart_at = time.time() + rec.tracker.policy.backoff_s
             self._runs[rid] = rec
+        if self._runs:
+            # land the load-time judgments (died-unwatched runs just
+            # landed FINISHED/FAILED/RESTARTING in memory) back in the
+            # table + store NOW: a stale RUNNING row with a dead pid is
+            # exactly what the JobMonitor sweep flips to FAILED — it
+            # would overwrite a true rc-file FINISHED verdict
+            self._persist_table()
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "LocalAgent":
@@ -169,84 +297,218 @@ class LocalAgent:
         run_id = run_id or f"run-{int(time.time()*1000)}-{len(self._runs)}"
         log_path = os.path.join(self.workdir, f"{run_id}.log")
         rec = RunRecord(run_id, spec, log_path, self._status_sink)
+        rec.extra_env = dict(extra_env or {})
         rec.fsm.transition(RunStatus.PROVISIONING, "agent accepted job")
-
-        script = ""
-        if spec.bootstrap:
-            script += spec.bootstrap.rstrip() + "\n"
-        script += spec.job
-        env = dict(os.environ)
-        env.update(spec.env)
-        env.update(extra_env or {})
-        env["FEDML_RUN_ID"] = run_id
-        log_f = open(log_path, "ab")
         try:
-            rec.proc = subprocess.Popen(
-                ["/bin/sh", "-c", script],
-                cwd=spec.workspace,
-                env=env,
-                stdout=log_f,
-                stderr=subprocess.STDOUT,
-                start_new_session=True,  # own pgid → group kill works
-            )
+            self._spawn(rec)
         except Exception as e:
-            log_f.close()
             rec.fsm.transition(RunStatus.FAILED, f"spawn error: {e}")
             with self._lock:
                 self._runs[run_id] = rec
             raise
-        finally:
-            if rec.proc is not None:
-                log_f.close()  # child holds its own fd
-        rec.pid = rec.proc.pid
         rec.fsm.transition(RunStatus.RUNNING, f"pid {rec.proc.pid}")
-        # ship the run's log lines into the same sink as its status events
-        from fedml_tpu.core.mlops.log_daemon import MLOpsRuntimeLogDaemon
-
-        rec.log_daemon = MLOpsRuntimeLogDaemon(
-            run_id, log_path, sink_dir=os.path.join(self.workdir, "mlops")
-        ).start()
+        self._start_log_daemon(rec)
         with self._lock:
             self._runs[run_id] = rec
         self._persist_table()
         self.start()
         return run_id
 
+    def _spawn(self, rec: RunRecord, resume: bool = False) -> None:
+        """(Re)spawn a record's process; the caller owns the status
+        transition. The shell writes its exit code to ``<run_id>.rc`` so
+        an adopted run's true rc survives the agent that spawned it."""
+        script = ""
+        if rec.spec.bootstrap:
+            script += rec.spec.bootstrap.rstrip() + "\n"
+        script += rec.spec.job
+        # rc file: written atomically (tmp + mv) so a reader never sees a
+        # torn value; staleness handled by deleting it pre-spawn. The user
+        # script runs in a SUBSHELL so its own `exit N` cannot skip the
+        # rc capture, and the wrapper shell ignores TERM so a group-wide
+        # preempt/kill signal still lets it record the job's true rc
+        # (the job itself — the subshell and its children — still gets
+        # the signal and may trap it for a graceful quiesce).
+        script = ("trap : TERM\n"
+                  "(\n" + script.rstrip() + "\n)\n"
+                  '__fedml_rc=$?\n'
+                  'printf %s "$__fedml_rc" > "$FEDML_RC_FILE.tmp" && '
+                  'mv "$FEDML_RC_FILE.tmp" "$FEDML_RC_FILE"\n'
+                  'exit "$__fedml_rc"\n')
+        env = dict(os.environ)
+        env.update(rec.spec.env)
+        env.update(rec.extra_env)
+        env["FEDML_RUN_ID"] = rec.run_id
+        env["FEDML_RC_FILE"] = rec.rc_path
+        if resume:
+            # durable jobs re-enter via their journal/checkpoints, not
+            # round 0 — the job's config reads resume: true; this env var
+            # is the plane's signal for jobs that gate resume on it
+            env["FEDML_RESUME"] = "1"
+        try:
+            os.remove(rec.rc_path)
+        except OSError:
+            pass
+        log_f = open(rec.log_path, "ab")
+        try:
+            rec.proc = subprocess.Popen(
+                ["/bin/sh", "-c", script],
+                cwd=rec.spec.workspace,
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # own pgid → group kill works
+            )
+        finally:
+            log_f.close()  # child holds its own fd
+        rec.pid = rec.proc.pid
+        rec.spawned_at = time.time()
+        rec.returncode = None
+
+    def _start_log_daemon(self, rec: RunRecord,
+                          from_beginning: bool = True) -> None:
+        # ship the run's log lines into the same sink as its status
+        # events; an ADOPTING agent tails from the current end — the
+        # previous agent's daemon already shipped the history
+        from fedml_tpu.core.mlops.log_daemon import MLOpsRuntimeLogDaemon
+
+        rec.log_daemon = MLOpsRuntimeLogDaemon(
+            rec.run_id, rec.log_path,
+            sink_dir=os.path.join(self.workdir, "mlops")
+        ).start(from_beginning=from_beginning)
+
+    def _read_rc(self, rec: RunRecord) -> Optional[int]:
+        try:
+            with open(rec.rc_path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _drain_group(self, pgid: int, grace_s: float, leader_done) -> bool:
+        """SIGTERM the process group and wait for the WHOLE group to
+        drain within the grace window, re-sending SIGTERM every 0.5 s —
+        a child exec'd in the window between a signal's delivery and its
+        own birth never saw it (group signals don't reach future
+        members); without the re-send that race escalates ~20% of
+        graceful quiesces to SIGKILL. Past the deadline, SIGKILL the
+        group. Returns True when escalation fired."""
+        try:
+            os.killpg(pgid, signal.SIGTERM)
+            deadline = time.time() + grace_s
+            last_term = time.time()
+            while time.time() < deadline:
+                if leader_done() and not _pgid_alive(pgid):
+                    return False  # quiesced: every group member drained
+                if time.time() - last_term > 0.5:
+                    last_term = time.time()
+                    os.killpg(pgid, signal.SIGTERM)
+                time.sleep(0.05)
+            os.killpg(pgid, signal.SIGKILL)
+            return True
+        except ProcessLookupError:
+            return False  # group already gone — the drain we wanted
+
     def kill(self, run_id: str, grace_s: float = 3.0) -> bool:
         rec = self._runs.get(run_id)
         if rec is None:
             return False
+        if rec.fsm.status == RunStatus.RESTARTING:
+            # no live process — cancel the pending relaunch
+            rec.next_restart_at = None
+            rec.fsm.transition(RunStatus.KILLED, "restart cancelled by kill")
+            self._persist_table()
+            return True
         if rec.proc is None:
             # adopted from the persisted table (other-process launch):
             # the child got its own session, so its pgid == its pid
             if rec.pid is None or not _pid_alive(rec.pid):
                 return False
             rec.fsm.transition(RunStatus.STOPPING, "kill requested (adopted)")
-            try:
-                os.killpg(rec.pid, signal.SIGTERM)
-                deadline = time.time() + grace_s
-                while time.time() < deadline and _pid_alive(rec.pid):
-                    time.sleep(0.05)
-                if _pid_alive(rec.pid):
-                    os.killpg(rec.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
+            self._drain_group(rec.pid, grace_s,
+                              lambda: not _pid_alive(rec.pid))
             rec.fsm.transition(RunStatus.KILLED, "adopted pgid killed")
             self._persist_table()
             return True
         if rec.proc.poll() is not None:
             return False
         rec.fsm.transition(RunStatus.STOPPING, "kill requested")
-        pgid = os.getpgid(rec.proc.pid)
-        os.killpg(pgid, signal.SIGTERM)
-        deadline = time.time() + grace_s
-        while time.time() < deadline and rec.proc.poll() is None:
-            time.sleep(0.05)
-        if rec.proc.poll() is None:
-            os.killpg(pgid, signal.SIGKILL)
+        self._drain_group(os.getpgid(rec.proc.pid), grace_s,
+                          lambda: rec.proc.poll() is not None)
+        try:
             rec.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            pass
         rec.returncode = rec.proc.returncode
         rec.fsm.transition(RunStatus.KILLED, f"rc={rec.returncode}")
+        self._persist_table()
+        return True
+
+    def preempt(self, run_id: str, grace_s: float = 10.0) -> bool:
+        """Gracefully quiesce a run for rescheduling: SIGTERM the process
+        group, wait for the WHOLE group to drain (flight-recorder dump +
+        journal fdatasync make any point of death safe), escalate to
+        SIGKILL only past the grace deadline. Terminal status PREEMPTED —
+        the job plane's "resume me elsewhere" verdict, distinct from
+        KILLED ("operator said stop")."""
+        rec = self._runs.get(run_id)
+        if rec is None or rec.fsm.is_terminal:
+            return False
+        if rec.proc is not None and rec.proc.poll() is not None:
+            # the run already exited on its own inside the last poll
+            # window — land its TRUE verdict first: a clean FINISH must
+            # not be re-labeled PREEMPTED, but a supervised crash heading
+            # into RESTARTING is still preemptable (the backoff branch
+            # below cancels the relaunch — it must not land on a node
+            # that is being drained)
+            self._judge_exit(rec, rec.proc.returncode)
+            if rec.fsm.status != RunStatus.RESTARTING:
+                return False
+        if rec.fsm.status == RunStatus.RESTARTING:
+            self._m_preemptions.inc()
+            rec.preempt_requested = True
+            rec.next_restart_at = None
+            rec.fsm.transition(RunStatus.STOPPING, "preempt (in backoff)")
+            rec.fsm.transition(RunStatus.PREEMPTED, "preempted during backoff")
+            sched_event("run_preempted", run_id=run_id, node=self.node_id,
+                        rc=None, escalated=False)
+            self._persist_table()
+            return True
+        if rec.proc is None:
+            # adopted run: it may already be done (rc file written, pid a
+            # lingering zombie) — land its TRUE terminal status rather
+            # than claiming a preemption of a finished process
+            rc = self._read_rc(rec)
+            if rc is not None:
+                self._judge_exit(rec, rc)
+                return False
+        pgid = rec.proc.pid if rec.proc is not None else rec.pid
+        if pgid is None or (rec.proc is None and not _pid_alive(pgid)):
+            return False
+        # only now — past every no-process early-return — may the flags
+        # be set: the monitor skips in-flight preemptions, so a flag with
+        # no preemption in flight would strand the run un-judged forever
+        self._m_preemptions.inc()
+        rec.preempt_requested = True
+        rec.preempt_inflight = True
+        rec.fsm.transition(RunStatus.STOPPING, f"preempt grace={grace_s:g}s")
+        escalated = self._drain_group(
+            pgid, grace_s,
+            (lambda: rec.proc.poll() is not None) if rec.proc is not None
+            else (lambda: not _pid_alive(pgid)))
+        if rec.proc is not None:
+            try:
+                rec.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+            rec.returncode = rec.proc.returncode
+        else:
+            rec.returncode = self._read_rc(rec)
+        rec.fsm.transition(
+            RunStatus.PREEMPTED,
+            f"{describe_rc(rec.returncode)}"
+            + (" after SIGKILL escalation" if escalated else " within grace"))
+        sched_event("run_preempted", run_id=run_id, node=self.node_id,
+                    rc=rec.returncode, escalated=escalated)
         self._persist_table()
         return True
 
@@ -286,6 +548,8 @@ class LocalAgent:
                     "status": rec.fsm.status,
                     "returncode": rec.returncode,
                     "log_path": rec.log_path,
+                    "restarts": rec.tracker.restarts if rec.tracker else 0,
+                    "reason": rec.reason,
                 }
                 for rid, rec in self._runs.items()
             ]
@@ -303,23 +567,111 @@ class LocalAgent:
     def _status_sink(self, entry: Dict) -> None:
         self._metrics.report_training_status(entry["to"], run_id=entry["run_id"])
 
+    def _judge_exit(self, rec: RunRecord, rc: Optional[int],
+                    persist: bool = True) -> None:
+        """Route one observed exit through the supervision policy."""
+        rec.returncode = rc
+        if rec.fsm.status == RunStatus.STOPPING:
+            # kill() and preempt() both pass through STOPPING; the monitor
+            # may observe the exit first — land on the verdict the caller
+            # asked for, not a generic KILLED
+            rec.fsm.transition(
+                RunStatus.PREEMPTED if rec.preempt_requested
+                else RunStatus.KILLED, describe_rc(rc))
+        elif rc == 0:
+            rec.fsm.transition(RunStatus.FINISHED, "rc=0")
+        elif rec.tracker is None:
+            rec.fsm.transition(RunStatus.FAILED, describe_rc(rc))
+        else:
+            uptime = time.time() - rec.spawned_at
+            action, detail = rec.tracker.on_exit(rc, uptime)
+            if action == "restart":
+                rec.reason = (f"{describe_rc(rc)} after {uptime:.1f}s; "
+                              f"relaunch #{rec.tracker.restarts} in "
+                              f"{detail:g}s")
+                rec.proc = None
+                rec.next_restart_at = time.time() + detail
+                rec.fsm.transition(RunStatus.RESTARTING, rec.reason)
+            else:
+                rec.reason = detail
+                if action == "crash_loop":
+                    self._m_crash_loops.inc()
+                    sched_event("crash_loop", run_id=rec.run_id,
+                                node=self.node_id, rc=rc,
+                                attempts=rec.tracker.restarts + 1,
+                                reason=detail)
+                rec.fsm.transition(RunStatus.FAILED, detail)
+        if rec.fsm.is_terminal:
+            daemon = getattr(rec, "log_daemon", None)
+            if daemon is not None:
+                daemon.stop()  # final flush of the tail
+        if persist:
+            self._persist_table()
+
+    def _relaunch(self, rec: RunRecord) -> None:
+        rec.next_restart_at = None
+        # another process may have judged this run while we were in
+        # backoff: `fedml_tpu stop`/`preempt` from a CLI adopts the run
+        # via the shared table and lands KILLED/PREEMPTED there — honor
+        # that verdict instead of relaunching a run an operator (or a
+        # reclaim notice) just quiesced
+        row = self.compute_store.get_run(rec.run_id)
+        foreign = (row or {}).get("status")
+        if foreign in (RunStatus.KILLED, RunStatus.PREEMPTED):
+            rec.returncode = row.get("returncode", rec.returncode)
+            rec.fsm.transition(RunStatus.STOPPING,
+                               f"{foreign} by another process")
+            rec.fsm.transition(foreign, "restart cancelled: judged "
+                               "terminal out-of-process")
+            self._persist_table()
+            return
+        resume = bool(rec.tracker and rec.tracker.policy.resume
+                      and rec.spec.durable)
+        try:
+            self._spawn(rec, resume=resume)
+        except Exception as e:
+            rec.reason = f"relaunch spawn error: {e}"
+            rec.fsm.transition(RunStatus.FAILED, rec.reason)
+            self._persist_table()
+            return
+        self._m_restarts.inc()
+        sched_event("run_restarted", run_id=rec.run_id, node=self.node_id,
+                    attempt=rec.tracker.restarts if rec.tracker else 0,
+                    resume=resume)
+        rec.fsm.transition(
+            RunStatus.RUNNING,
+            f"relaunched pid {rec.proc.pid}"
+            + (" (resume)" if resume else ""))
+        self._persist_table()
+
     def _monitor_loop(self) -> None:
         while not self._stopping.is_set():
             for rec in list(self._runs.values()):
-                if rec.proc is None or rec.fsm.is_terminal:
+                if rec.fsm.is_terminal:
                     continue
-                rc = rec.proc.poll()
-                if rc is None:
+                if rec.preempt_inflight:
+                    # an in-process preempt() owns the quiesce verdict:
+                    # the LEADER may exit while other group members are
+                    # still draining — judging that early exit here would
+                    # mis-time the escalation decision
                     continue
-                rec.returncode = rc
-                if rec.fsm.status == RunStatus.STOPPING:
-                    rec.fsm.transition(RunStatus.KILLED, f"rc={rc}")
-                elif rc == 0:
-                    rec.fsm.transition(RunStatus.FINISHED, "rc=0")
-                else:
-                    rec.fsm.transition(RunStatus.FAILED, f"rc={rc}")
-                daemon = getattr(rec, "log_daemon", None)
-                if daemon is not None:
-                    daemon.stop()  # final flush of the tail
-                self._persist_table()
+                if rec.fsm.status == RunStatus.RESTARTING:
+                    if (rec.next_restart_at is not None
+                            and time.time() >= rec.next_restart_at):
+                        self._relaunch(rec)
+                    continue
+                if rec.proc is not None:
+                    rc = rec.proc.poll()
+                    if rc is not None:
+                        self._judge_exit(rec, rc)
+                    continue
+                if rec.adopted and rec.pid is not None:
+                    # adopted run: no Popen handle — the rc file is the
+                    # truth (it also outlives a zombie pid); a dead pid
+                    # with no rc file is an abnormal, rc-unknown exit
+                    rc = self._read_rc(rec)
+                    if rc is not None:
+                        self._judge_exit(rec, rc)
+                    elif not _pid_alive(rec.pid):
+                        self._judge_exit(rec, None)
             time.sleep(self._poll_interval)

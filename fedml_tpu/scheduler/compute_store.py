@@ -44,7 +44,9 @@ CREATE TABLE IF NOT EXISTS runs (
     returncode  INTEGER,
     log_path    TEXT NOT NULL DEFAULT '',
     started_at  REAL,
-    finished_at REAL
+    finished_at REAL,
+    restarts    INTEGER NOT NULL DEFAULT 0,
+    reason      TEXT NOT NULL DEFAULT ''
 );
 CREATE TABLE IF NOT EXISTS metrics (
     run_id  TEXT NOT NULL,
@@ -67,6 +69,16 @@ class ComputeStore:
         self._local = threading.local()
         with self._conn() as c:
             c.executescript(_SCHEMA)
+            # pre-job-plane stores lack the supervision columns; ALTER is
+            # idempotent-by-catch (sqlite has no ADD COLUMN IF NOT EXISTS)
+            for ddl in (
+                "ALTER TABLE runs ADD COLUMN restarts INTEGER NOT NULL DEFAULT 0",
+                "ALTER TABLE runs ADD COLUMN reason TEXT NOT NULL DEFAULT ''",
+            ):
+                try:
+                    c.execute(ddl)
+                except sqlite3.OperationalError:
+                    pass  # duplicate column: schema already current
 
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
@@ -111,7 +123,8 @@ class ComputeStore:
     # -- run history (compute_cache_manager parity) --------------------
     def upsert_run(self, run_id: str, **fields: Any) -> None:
         allowed = {"job_name", "node_id", "status", "pid", "returncode",
-                   "log_path", "started_at", "finished_at"}
+                   "log_path", "started_at", "finished_at", "restarts",
+                   "reason"}
         bad = set(fields) - allowed
         if bad:
             raise ValueError(f"unknown run fields: {sorted(bad)}")

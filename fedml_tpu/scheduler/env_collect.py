@@ -57,6 +57,16 @@ def collect_resources() -> Dict:
         out["platform"] = jax.default_backend()
         out["device_count"] = len(devs)
         out["device_kind"] = devs[0].device_kind if devs else ""
+        try:
+            # per-device HBM ceiling — the job plane's admission figure
+            # (PR 10 programs.jsonl peak-HBM is judged against this);
+            # absent on backends without memory_stats (CPU) → admission
+            # treats the node as unconstrained
+            limit = devs[0].memory_stats().get("bytes_limit") if devs else None
+            if limit:
+                out["hbm_bytes_limit"] = float(limit)
+        except Exception:
+            pass
     except Exception as e:
         out["error"] = str(e)
     return out

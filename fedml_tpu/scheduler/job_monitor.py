@@ -110,6 +110,10 @@ class JobMonitor:
         self._m_endpoint_flips = reg.counter("scheduler/endpoint_flips")
         self._g_runs_running = reg.gauge("scheduler/runs_running")
         self._g_endpoints_offline = reg.gauge("scheduler/endpoints_offline")
+        # job-plane visibility: a RESTARTING row is a run in supervision
+        # backoff — its pid is legitimately dead, which is exactly why the
+        # pid sweep only judges RUNNING rows (the agent owns the relaunch)
+        self._g_runs_restarting = reg.gauge("sched/runs_restarting")
 
     # -- singleton (reference keeps one monitor per agent process) -----
     @classmethod
@@ -190,6 +194,8 @@ class JobMonitor:
         if self.compute_store is not None:
             self._g_runs_running.set(
                 len(self.compute_store.runs(status=RunStatus.RUNNING)))
+            self._g_runs_restarting.set(
+                len(self.compute_store.runs(status=RunStatus.RESTARTING)))
         if self.endpoint_cache is not None:
             offline = sum(
                 1

@@ -12,8 +12,16 @@ workspace, computing resources). The TPU build keeps the same shape:
       python my_train.py --cf fedml_config.yaml
     computing:
       minimum_num_chips: 0       # informational on a single host
+      peak_hbm_bytes: 0          # admission figure (or programs_jsonl:
+                                 # a PR 10 programs.jsonl to read it from)
     env:                         # extra environment for the job
       MY_FLAG: "1"
+    durable: true                # job checkpoints/journals its state:
+                                 # preempt/node-loss reschedule + resume
+    restart:                     # supervision policy (see supervision.py)
+      max_restarts: 3
+      backoff_s: 0.5
+      crash_loop_threshold: 3
 """
 from __future__ import annotations
 
@@ -32,6 +40,33 @@ class JobSpec:
     bootstrap: Optional[str] = None
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     computing: Dict = dataclasses.field(default_factory=dict)
+    # job plane: restart supervision policy (dict, see RestartPolicy) and
+    # the durable flag — a durable job owns checkpoint/journal state, so
+    # preemption and node loss reschedule-and-resume it instead of
+    # failing the job
+    restart: Optional[Dict] = None
+    durable: bool = False
+
+    def wire(self) -> Dict:
+        """The JSON shape shipped over the scheduler control plane."""
+        return {"job_name": self.job_name, "job": self.job,
+                "workspace": self.workspace, "bootstrap": self.bootstrap,
+                "env": self.env, "computing": self.computing,
+                "restart": self.restart, "durable": self.durable}
+
+    @classmethod
+    def from_wire(cls, raw: Dict, default_name: str = "job") -> "JobSpec":
+        raw = raw or {}
+        return cls(
+            job_name=str(raw.get("job_name", default_name)),
+            job=str(raw.get("job", "")),
+            workspace=str(raw.get("workspace", ".")),
+            bootstrap=raw.get("bootstrap"),
+            env={k: str(v) for k, v in (raw.get("env") or {}).items()},
+            computing=raw.get("computing") or {},
+            restart=raw.get("restart") or None,
+            durable=bool(raw.get("durable", False)),
+        )
 
     @staticmethod
     def load(path: str) -> "JobSpec":
@@ -44,11 +79,8 @@ class JobSpec:
             workspace = os.path.normpath(
                 os.path.join(os.path.dirname(os.path.abspath(path)), workspace)
             )
-        return JobSpec(
-            job_name=str(raw.get("job_name", os.path.basename(path))),
-            job=str(raw["job"]),
-            workspace=workspace,
-            bootstrap=raw.get("bootstrap"),
-            env={k: str(v) for k, v in (raw.get("env") or {}).items()},
-            computing=raw.get("computing") or {},
-        )
+        # one field list: the yaml path and the control-plane wire path
+        # construct through the same coercions, so a new spec field can't
+        # silently exist on only one of them
+        return JobSpec.from_wire({**raw, "workspace": workspace},
+                                 default_name=os.path.basename(path))

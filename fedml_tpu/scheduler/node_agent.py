@@ -16,19 +16,29 @@ Wire protocol (JSON over broker topics):
       run_status  {node_id, run_id, status, returncode}
       run_logs    {node_id, run_id, data}
   master → ``sched/{cluster}/node/{node_id}``:
-      start_run {run_id, spec {job_name, job, workspace, bootstrap, env},
-                 env {..extra per-rank env..}}
-      stop_run  {run_id}
-      get_logs  {run_id, tail}
+      start_run   {run_id, spec {job_name, job, workspace, bootstrap, env,
+                   computing, restart, durable}, env {..per-rank env..}}
+      stop_run    {run_id}
+      preempt_run {run_id, grace_s}      # graceful quiesce → PREEMPTED
+      drain_node  {grace_s}              # reclaim notice: preempt ALL runs
+      get_logs    {run_id, tail}
+
+The ``drain_node`` verb is how preemptible capacity plugs in: whatever
+delivers the provider's "this node is being reclaimed in N seconds"
+notice publishes it here; the local agent quiesces every run (SIGTERM +
+grace, journals already fdatasync'd) and the master reacts to the
+PREEMPTED status reports by rescheduling durable jobs onto survivors.
 """
 from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from fedml_tpu.core.distributed.communication.broker_agent import BrokerJsonAgent
+from fedml_tpu.core.mlops.status import RunStatus
 from fedml_tpu.scheduler.agent import LocalAgent
 from fedml_tpu.scheduler.job_yaml import JobSpec
 
@@ -47,6 +57,8 @@ class NodeAgent(BrokerJsonAgent):
         self.agent = LocalAgent(workdir=self.workdir)
         self._heartbeat_s = heartbeat_s
         self._reported: Dict[str, str] = {}  # run_id → last status sent
+        self._resources: Optional[Dict] = None  # last known probe snapshot
+        self._res_lock = threading.Lock()  # start() vs refresh thread
         if store is None:
             from fedml_tpu.core.distributed.communication.object_store import (
                 create_object_store,
@@ -64,11 +76,23 @@ class NodeAgent(BrokerJsonAgent):
         )
 
         self.agent.start()
+        res = collect_resources()
+        with self._res_lock:
+            self._resources = res
         self._publish({"type": "node_online", "node_id": self.node_id,
-                       "slots": self.slots,
-                       "resources": collect_resources()})
+                       "slots": self.slots, "resources": res})
         self.spawn_loop(self._heartbeat_loop)
         return self
+
+    def _refresh_resources(self) -> None:
+        from fedml_tpu.scheduler.env_collect import collect_resources_probe
+
+        try:
+            res = collect_resources_probe()
+            with self._res_lock:
+                self._resources = res
+        except Exception:  # pragma: no cover - probe is best-effort
+            logger.exception("node %s: resource probe failed", self.node_id)
 
     def shutdown(self, kill_running: bool = True) -> None:
         self.agent.shutdown(kill_running=kill_running)
@@ -89,6 +113,11 @@ class NodeAgent(BrokerJsonAgent):
             self._handle_start(msg)
         elif mtype == "stop_run":
             self.agent.kill(str(msg["run_id"]))
+        elif mtype == "preempt_run":
+            self._preempt_async(str(msg["run_id"]),
+                                float(msg.get("grace_s", 10.0)))
+        elif mtype == "drain_node":
+            self._handle_drain(msg)
         elif mtype == "get_logs":
             rid = str(msg["run_id"])
             self._publish({"type": "run_logs", "node_id": self.node_id,
@@ -114,16 +143,33 @@ class NodeAgent(BrokerJsonAgent):
                            "version": version, "ok": False,
                            "error": str(e)})
 
+    def _preempt_async(self, run_id: str, grace_s: float) -> None:
+        """Quiesce off the broker reader thread: a preempt blocks for up
+        to its grace window, and handlers dispatch inline on the single
+        read loop — a serial drain of N runs would take N×grace and
+        freeze every other control verb (stop_run, get_logs) meanwhile.
+        Preempts of distinct runs are independent SIGTERM+wait loops;
+        concurrent calls for the SAME run converge on idempotent FSM
+        transitions."""
+        threading.Thread(target=self.agent.preempt, args=(run_id,),
+                         kwargs={"grace_s": grace_s}, daemon=True,
+                         name=f"preempt-{run_id}").start()
+
+    def _handle_drain(self, msg: Dict) -> None:
+        """Reclaim notice landed at the node: quiesce everything local,
+        concurrently. The master never hears a special message — the
+        PREEMPTED status reports ARE the signal it reschedules durable
+        jobs from."""
+        grace_s = float(msg.get("grace_s", 10.0))
+        logger.warning("node %s: drain notice (grace %gs)", self.node_id,
+                       grace_s)
+        for row in self.agent.list_runs():
+            if row["status"] not in RunStatus.TERMINAL:
+                self._preempt_async(row["run_id"], grace_s)
+
     def _handle_start(self, msg: Dict) -> None:
         rid = str(msg["run_id"])
-        raw = msg.get("spec") or {}
-        spec = JobSpec(
-            job_name=str(raw.get("job_name", rid)),
-            job=str(raw.get("job", "")),
-            workspace=str(raw.get("workspace", ".")),
-            bootstrap=raw.get("bootstrap"),
-            env={k: str(v) for k, v in (raw.get("env") or {}).items()},
-        )
+        spec = JobSpec.from_wire(msg.get("spec") or {}, default_name=rid)
         from fedml_tpu.scheduler import ota
 
         try:
@@ -139,6 +185,7 @@ class NodeAgent(BrokerJsonAgent):
 
     # -- status shipping --------------------------------------------------
     def _heartbeat_loop(self) -> None:
+        beats = 0
         while not self._stopping.is_set():
             runs = {}
             for row in self.agent.list_runs():
@@ -151,8 +198,22 @@ class NodeAgent(BrokerJsonAgent):
                         "run_id": rid, "status": status,
                         "returncode": row.get("returncode"),
                     })
-            self._publish({"type": "heartbeat", "node_id": self.node_id,
-                           "runs": runs})
+            # slots ride every heartbeat (a master that missed the
+            # one-shot node_online — e.g. it restarted, or came up after
+            # this node — must still learn the placement capacity);
+            # resources re-advertise periodically from the last known
+            # snapshot, refreshed OFF this thread — a hanging probe
+            # (unmemoized on failure, up to its 60s timeout) on the
+            # heartbeat path would silence us past node_loss_deadline_s
+            # and get a healthy node's jobs rescheduled out from under it
+            hb = {"type": "heartbeat", "node_id": self.node_id,
+                  "runs": runs, "slots": self.slots}
+            if beats % 30 == 0 and self._resources is not None:
+                hb["resources"] = self._resources
+                threading.Thread(target=self._refresh_resources,
+                                 daemon=True).start()
+            beats += 1
+            self._publish(hb)
             time.sleep(self._heartbeat_s)
 
     def _publish(self, msg: Dict) -> None:

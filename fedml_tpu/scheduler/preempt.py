@@ -1,0 +1,311 @@
+"""Preempt-and-resume scenario — the job plane's chaos acceptance.
+
+The PR 12 recovery runner proves the journal survives a SIGKILL; this
+scenario proves the *scheduler* turns preemptible capacity into a
+non-event: a durable cross-silo federation runs under REAL node-agent
+subprocesses, the server's node receives a drain (simulated reclaim
+notice) mid-round, the run is SIGTERM-quiesced within a grace window
+(flight-recorder dump + fdatasync'd journal make any kill point safe),
+the master reschedules it onto a surviving node, and it resumes
+MID-ROUND from the journal — salvaged uploads never retrained, and under
+the identity codec the final params are bit-identical to an undisturbed
+run.
+
+Measured: **MTTR** = wall seconds from the reclaim notice to the
+rescheduled server announcing its journal replay (the ``RESUMED`` marker
+in its run log). Exposed as ``fedml_tpu chaos --drain`` and gated by
+``tools/preempt_bench.py`` / ``bench.py --preempt``.
+
+Optionally an :class:`~fedml_tpu.resilience.chaos.AgentKillWindow`
+SIGKILLs the *surviving node's agent* after the resume and restarts it
+over the same workdir — the restarted agent must re-adopt the live
+resumed server (pid + rc-file supervision) for the federation to finish,
+which is the cross-process proof of the re-adoption satellite.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from fedml_tpu.resilience.chaos import AgentKillWindow, NodeDrain
+
+logger = logging.getLogger(__name__)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+__all__ = ["run_preempt_scenario", "read_journal_records"]
+
+
+def read_journal_records(path: str) -> List[Dict]:
+    """READ-ONLY journal scan for the drain trigger — unlike
+    ``RoundJournal.records()`` it never truncates a (possibly mid-append)
+    tail, because the journal belongs to a LIVE server we are only
+    spying on. Frame parsing is the journal module's own
+    :func:`~fedml_tpu.resilience.durability.journal.parse_frames`, so a
+    format change can't silently break the trigger."""
+    from fedml_tpu.resilience.durability.journal import parse_frames
+
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return []
+    records, _ = parse_frames(data)
+    return records
+
+
+def _spawn_node_agent(node_id: str, broker: Tuple[str, int], workdir: str,
+                      slots: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu.cli", "cluster", "node",
+         "--id", node_id, "--broker", f"{broker[0]}:{broker[1]}",
+         "--workdir", workdir, "--slots", str(slots)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        env=env, start_new_session=True)
+
+
+def _find_marker(log_path: str, prefix: str) -> Optional[str]:
+    try:
+        with open(log_path, "rb") as f:
+            for raw in f.read().decode(errors="replace").splitlines():
+                if raw.startswith(prefix):
+                    return raw[len(prefix):]
+    except OSError:
+        pass
+    return None
+
+
+def run_preempt_scenario(
+    seed: int = 0,
+    rounds: int = 5,
+    clients: int = 2,
+    drain_round: int = 2,
+    after_uploads: int = 1,
+    grace_s: float = 10.0,
+    compression: str = "identity",
+    via: str = "master",
+    agent_kill: bool = False,
+    timeout: float = 600.0,
+    tmp_dir: Optional[str] = None,
+    extra_train: Optional[Dict] = None,
+) -> Dict:
+    """One drained federation on a two-node cluster; JSON-safe summary.
+
+    ``via='master'`` drives :meth:`MasterAgent.drain_node`;
+    ``via='reclaim'`` delivers the drain notice to the NODE agent (wire
+    verb), and the master reschedules purely from the PREEMPTED status
+    reports. ``agent_kill=True`` additionally SIGKILLs + restarts the
+    surviving node's agent after the resume (re-adoption proof).
+    """
+    import shutil
+    import tempfile
+
+    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+    from fedml_tpu.resilience.durability.recover import scenario_config
+    from fedml_tpu.scheduler.job_yaml import JobSpec
+    from fedml_tpu.scheduler.master_agent import MasterAgent
+
+    drain = NodeDrain("n1", round=drain_round, after_uploads=after_uploads,
+                      grace_s=grace_s, via=via)
+    kill_spec = AgentKillWindow("n2") if agent_kill else None
+    tmp = tmp_dir or tempfile.mkdtemp(prefix="fedml_preempt_")
+    owns_tmp = tmp_dir is None
+    os.makedirs(tmp, exist_ok=True)
+    agents_dir = os.path.join(tmp, "agents")
+    broker = PubSubBroker(port=0).start()
+    host, port = broker.address
+    run_id = f"preempt_{seed}"
+    cfg = scenario_config(run_id, seed, rounds, clients, host, port, tmp,
+                          compression, extra_train=extra_train)
+    cfg_path = os.path.join(tmp, f"{run_id}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    journal_path = os.path.join(tmp, "ckpts", "server_round.journal")
+
+    py = sys.executable
+    rank_cmd = (f'"{py}" -m fedml_tpu.resilience.durability.'
+                f'recover --cf "{cfg_path}"')
+    common_env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    server_spec = JobSpec(
+        job_name="fed-server", workspace=REPO, env=dict(common_env),
+        durable=True,
+        job=f'{rank_cmd} --rank 0 --role server\n')
+    client_spec = JobSpec(
+        job_name="fed-clients", workspace=REPO, env=dict(common_env),
+        job=f'{rank_cmd} --rank "$((FEDML_RANK+1))" --role client\n')
+
+    t0 = time.time()
+    master = None
+    agents: Dict[str, subprocess.Popen] = {}
+    result: Dict = {
+        "seed": int(seed), "rounds": int(rounds), "clients": int(clients),
+        "drain_round": int(drain_round), "grace_s": float(grace_s),
+        "via": via, "compression": compression,
+        "agent_kill": bool(agent_kill),
+    }
+    try:
+        # node n1 hosts only the server; n2 hosts the clients AND must
+        # have a spare slot for the rescheduled server
+        agents["n1"] = _spawn_node_agent("n1", (host, port), agents_dir, 1)
+        agents["n2"] = _spawn_node_agent("n2", (host, port), agents_dir,
+                                         clients + 1)
+        master = MasterAgent(host, port, node_timeout_s=5.0,
+                             node_loss_deadline_s=30.0).start()
+        master.wait_for_nodes(2, timeout=60)
+        client_job = master.submit_job(client_spec, n_ranks=clients,
+                                       nodes=["n2"])
+        server_job = master.submit_job(server_spec, n_ranks=1, nodes=["n1"])
+        server_rid = f"{server_job}-r0"
+
+        # deterministic mid-round trigger: the journal says round
+        # `drain_round` is open with >= after_uploads uploads durable
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            recs = read_journal_records(journal_path)
+            opened = [r for r in recs if r.get("kind") == "round_open"]
+            if opened and int(opened[-1].get("round", -1)) >= drain.round:
+                rnd = int(opened[-1]["round"])
+                got = sum(1 for r in recs
+                          if r.get("kind") == "upload_received"
+                          and int(r.get("round", -1)) == rnd)
+                if got >= drain.after_uploads:
+                    result["drained_at_round"] = rnd
+                    result["uploads_journaled_at_drain"] = got
+                    break
+            st = master.job_status(server_job)["status"]
+            if st in ("FAILED", "KILLED"):
+                raise RuntimeError(f"server job died pre-drain: {st}")
+            time.sleep(0.02)
+        else:
+            raise TimeoutError("journal never showed the drain window")
+
+        # the reclaim notice
+        t_drain = time.time()
+        if drain.via == "master":
+            drain_out = master.drain_node("n1", grace_s=drain.grace_s,
+                                          timeout=timeout)
+        else:
+            # provider notice lands at the NODE; master only sees the
+            # PREEMPTED status report and must reschedule from that
+            master._send("n1", {"type": "drain_node",
+                                "grace_s": drain.grace_s})
+            view = master.jobs[server_job]
+            while time.time() < deadline and server_rid not in view.resched_map:
+                time.sleep(0.05)
+            drain_out = {"node": "n1", "preempted": [server_rid],
+                         "rescheduled": dict(view.resched_map), "failed": []}
+        result["drain"] = drain_out
+        new_rid = drain_out["rescheduled"].get(server_rid)
+        if new_rid is None:
+            raise RuntimeError(f"server run was not rescheduled: {drain_out}")
+        view = master.jobs[server_job]
+        new_node = view.ranks[new_rid]
+        result["rescheduled_to"] = new_node
+        new_log = os.path.join(agents_dir, new_node, f"{new_rid}.log")
+
+        # MTTR clock stops at the resumed server's journal-replay marker
+        resumed_raw = None
+        while time.time() < deadline:
+            resumed_raw = _find_marker(new_log, "RESUMED ")
+            if resumed_raw is not None:
+                result["mttr_s"] = round(time.time() - t_drain, 3)
+                break
+            time.sleep(0.05)
+        if resumed_raw is None:
+            raise TimeoutError("rescheduled server never announced RESUMED")
+        resumed = json.loads(resumed_raw)
+        result["resumed_round"] = resumed.get("round")
+        result["salvaged_uploads"] = int(resumed.get("salvaged", 0))
+        result["salvaged_clients"] = resumed.get("clients", [])
+
+        if kill_spec is not None:
+            # scheduler-tier chaos: kill the surviving node's AGENT over
+            # the live resumed run; the restart must re-adopt it
+            time.sleep(kill_spec.after_s)
+            victim = agents[kill_spec.node]
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.wait(timeout=30)
+            time.sleep(kill_spec.restart_after_s)
+            agents[kill_spec.node] = _spawn_node_agent(
+                kill_spec.node, (host, port), agents_dir, clients + 1)
+            result["agent_killed"] = kill_spec.node
+
+        out = master.wait_job(server_job,
+                              timeout=max(5.0, deadline - time.time()))
+        result["job_status"] = out["status"]
+        master.wait_job(client_job,
+                        timeout=max(5.0, deadline - time.time()))
+        result["completed"] = out["status"] == "FINISHED"
+
+        digest = _find_marker(new_log, "DIGEST ")
+        res_line = _find_marker(new_log, "RESULT ")
+        result["digest"] = digest
+        result["result"] = json.loads(res_line) if res_line else None
+        trained: Dict[str, List[int]] = {}
+        for k in range(clients):
+            clog = os.path.join(agents_dir, "n2", f"{client_job}-r{k}.log")
+            try:
+                with open(clog, "rb") as f:
+                    lines = f.read().decode(errors="replace").splitlines()
+            except OSError:
+                lines = []
+            trained[str(k + 1)] = [int(ln.split()[1]) for ln in lines
+                                   if ln.startswith("TRAINED ")]
+        result["trained"] = trained
+        from fedml_tpu.telemetry import get_registry
+
+        reg = get_registry()
+        counters = {}
+        for rec in reg.snapshot():
+            name = rec.get("name", "")
+            if name.startswith("sched/"):
+                key = name.split("/", 1)[1]
+                counters[key] = counters.get(key, 0.0) + float(
+                    rec.get("value", rec.get("count", 0)) or 0)
+        result["counters"] = counters
+        result["wall_s"] = round(time.time() - t0, 3)
+        return result
+    finally:
+        if master is not None:
+            master.shutdown()
+        for p in agents.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        # the runs live in their OWN sessions (start_new_session), so the
+        # agent group-kill above does not reach them — reap any stragglers
+        # off the persisted run tables
+        for node in ("n1", "n2"):
+            table = os.path.join(agents_dir, node, "runs.json")
+            try:
+                with open(table) as f:
+                    rows = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for row in rows.values():
+                pid = row.get("pid")
+                if pid:
+                    try:
+                        os.killpg(int(pid), signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError, ValueError):
+                        pass
+        broker.stop()
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)

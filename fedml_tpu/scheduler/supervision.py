@@ -1,0 +1,180 @@
+"""Run supervision policy — restart backoff + crash-loop containment.
+
+The job plane's core judgment call lives here so every supervisor in the
+repo makes it the same way: the :class:`~fedml_tpu.scheduler.agent.LocalAgent`
+relaunching a dead run, and the kill-the-server recovery runner
+(:mod:`fedml_tpu.resilience.durability.recover`) re-arming a crashed
+federation server, both ask one :class:`RestartTracker` what to do with
+an exit code.
+
+Policy semantics:
+
+* **restart** — any abnormal exit (nonzero rc, signal death) relaunches
+  after an exponential backoff ``backoff_s * 2^k`` capped at
+  ``max_backoff_s``. The schedule is deliberately UN-jittered: two
+  supervisors with the same policy produce bit-identical delay
+  sequences, which is what the crash-loop determinism test pins.
+* **crash-loop containment** — ``crash_loop_threshold`` *consecutive*
+  failures that are both *fast* (the process lived less than
+  ``fast_fail_s``) and *identical* (same rc) stop the relaunching: the
+  run is FAILED with a doctor-visible reason instead of flapping
+  forever. A slow failure or a different rc resets the streak — that is
+  a run making (different) progress, not a config error in a loop.
+* **give-up** — ``max_restarts`` total relaunches bound the budget even
+  for slow/varied failures.
+* **resume** — durable jobs relaunch with ``FEDML_RESUME=1`` exported,
+  so a federation server re-enters via the PR 12 write-ahead journal
+  (mid-round, uploads salvaged) rather than from round 0.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RestartPolicy", "RestartTracker", "describe_rc",
+           "sched_event", "peak_hbm_from_programs"]
+
+
+def describe_rc(rc: Optional[int]) -> str:
+    """Human-readable exit code (``rc=-15 (SIGTERM)`` / ``rc=7``)."""
+    if rc is None:
+        return "rc=unknown"
+    if rc < 0:
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        return f"rc={rc} ({name})"
+    return f"rc={rc}"
+
+
+class RestartPolicy:
+    """The per-run supervision knobs (job yaml ``restart:`` block)."""
+
+    def __init__(self, max_restarts: int = 0, backoff_s: float = 0.5,
+                 max_backoff_s: float = 30.0, crash_loop_threshold: int = 3,
+                 fast_fail_s: float = 5.0, resume: bool = True):
+        self.max_restarts = max(0, int(max_restarts))
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.crash_loop_threshold = max(1, int(crash_loop_threshold))
+        self.fast_fail_s = float(fast_fail_s)
+        self.resume = bool(resume)
+
+    @classmethod
+    def from_spec(cls, raw: Any) -> Optional["RestartPolicy"]:
+        """``None`` (no supervision) unless the spec asks for it.
+        Accepts a dict, a JSON string, or a bare int (= max_restarts)."""
+        if raw in (None, "", False, 0):
+            return None
+        if isinstance(raw, str):
+            raw = json.loads(raw)
+        if isinstance(raw, bool):
+            raw = {"max_restarts": 3}
+        if isinstance(raw, int):
+            raw = {"max_restarts": raw}
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f"restart policy must be a dict/int/bool, got {type(raw).__name__}")
+        allowed = {"max_restarts", "backoff_s", "max_backoff_s",
+                   "crash_loop_threshold", "fast_fail_s", "resume"}
+        bad = set(raw) - allowed
+        if bad:
+            raise ValueError(f"unknown restart policy keys: {sorted(bad)}")
+        pol = cls(**raw)
+        return pol if pol.max_restarts > 0 else None
+
+    def to_dict(self) -> Dict:
+        return {"max_restarts": self.max_restarts,
+                "backoff_s": self.backoff_s,
+                "max_backoff_s": self.max_backoff_s,
+                "crash_loop_threshold": self.crash_loop_threshold,
+                "fast_fail_s": self.fast_fail_s,
+                "resume": self.resume}
+
+
+class RestartTracker:
+    """One run's supervision state; ask :meth:`on_exit` after each death.
+
+    Not thread-safe by itself — callers serialize (the agent's monitor
+    loop is the only writer per record; the recovery supervisor is
+    single-threaded).
+    """
+
+    def __init__(self, policy: RestartPolicy):
+        self.policy = policy
+        self.restarts = 0            # relaunches performed
+        self.fast_streak = 0         # consecutive fast identical failures
+        self.last_rc: Optional[int] = None
+        self.delays_s: List[float] = []  # the backoff schedule actually used
+
+    def on_exit(self, rc: Optional[int], uptime_s: float
+                ) -> Tuple[str, Any]:
+        """Judge one abnormal exit.
+
+        Returns ``("restart", delay_s)``, ``("crash_loop", reason)`` or
+        ``("give_up", reason)``. Callers only consult this for abnormal
+        exits (rc != 0); a clean exit is FINISHED, not a supervision
+        decision.
+        """
+        fast = uptime_s < self.policy.fast_fail_s
+        if fast and rc == self.last_rc:
+            self.fast_streak += 1
+        else:
+            self.fast_streak = 1 if fast else 0
+        self.last_rc = rc
+        if self.fast_streak >= self.policy.crash_loop_threshold:
+            return ("crash_loop",
+                    f"crash-loop contained: {self.fast_streak} consecutive "
+                    f"fast (<{self.policy.fast_fail_s:g}s) identical "
+                    f"failures ({describe_rc(rc)}) after backoff "
+                    f"{[round(d, 3) for d in self.delays_s]}")
+        if self.restarts >= self.policy.max_restarts:
+            return ("give_up",
+                    f"restart budget exhausted: {self.restarts} relaunch(es) "
+                    f"already spent, last exit {describe_rc(rc)}")
+        delay = min(self.policy.backoff_s * (2.0 ** self.restarts),
+                    self.policy.max_backoff_s)
+        self.restarts += 1
+        self.delays_s.append(delay)
+        return ("restart", delay)
+
+
+def sched_event(event: str, **fields: Any) -> None:
+    """Land one job-plane event everywhere the doctor looks (mirror of
+    the secagg protocol's event helper): ``health.jsonl`` + the flight
+    recorder, both best-effort."""
+    from fedml_tpu.telemetry import flight_recorder
+    from fedml_tpu.telemetry.health import log_health_event
+
+    try:
+        log_health_event({"kind": "sched_event", "event": event, **fields})
+    except Exception:  # pragma: no cover - observability must not kill
+        logger.exception("sched event logging failed")
+    flight_recorder.record("sched_event", event=event, **fields)
+
+
+def peak_hbm_from_programs(run_dir: str) -> Optional[float]:
+    """Max ``peak_hbm_bytes`` over a run's PR 10 program catalog
+    (``programs.jsonl``) — the admission figure a master gates
+    rescheduling on. None when the file is missing/empty/unreadable
+    (admission then treats the job's demand as unknown)."""
+    path = (run_dir if run_dir.endswith(".jsonl")
+            else os.path.join(run_dir, "programs.jsonl"))
+    try:
+        peak = 0.0
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                peak = max(peak, float(rec.get("peak_hbm_bytes", 0) or 0))
+        return peak or None
+    except (OSError, ValueError):
+        return None

@@ -493,6 +493,75 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "no data: no restarts or journal activity (the process never "
             "died, or durability was off)")
 
+    # -- job plane (supervision / preemption / rescheduling) --------------
+    # sched/* counters land from agents and masters; sched_event records
+    # carry the identities (run/job/node) and the doctor-visible reasons
+    # (crash-loop containment verdicts especially)
+    latest_sched: Dict[Any, float] = {}
+    for rec in metric_records:
+        name = rec.get("name", "")
+        if name.startswith("sched/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest_sched[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    sched_counters: Dict[str, float] = {}
+    for (name, _), val in latest_sched.items():
+        key = name.split("/", 1)[1]
+        sched_counters[key] = sched_counters.get(key, 0.0) + val
+    sched_events = [e for e in health_events
+                    if e.get("kind") == "sched_event"]
+    jobplane: Dict[str, Any] = {"counters": sched_counters,
+                                "events": sched_events[-16:]}
+    crash_loop_runs = set()
+    for e in sched_events:
+        ev = e.get("event")
+        if ev == "crash_loop":
+            crash_loop_runs.add(str(e.get("run_id")))
+            verdict.append(
+                f"run {e.get('run_id')} CRASH-LOOPED into containment "
+                f"after {e.get('attempts')} attempt(s): {e.get('reason')} "
+                "— FAILED instead of flapping; fix the job before "
+                "resubmitting")
+        elif ev == "reschedule_refused":
+            verdict.append(
+                f"run {e.get('run_id')} could NOT be rescheduled "
+                f"({e.get('reason')}; peak-HBM demand "
+                f"{e.get('hbm_demand', 0):.0f} B) — no surviving node "
+                "admitted the job; add capacity or free HBM headroom")
+        elif ev == "node_lost":
+            verdict.append(
+                f"node {e.get('node')} declared LOST (silent > "
+                f"{e.get('deadline_s', 0):g}s) — its durable runs were "
+                "rescheduled onto survivors")
+    if sched_counters.get("crash_loops", 0.0) > len(crash_loop_runs):
+        verdict.append(
+            f"{sched_counters['crash_loops']:.0f} crash-loop "
+            "containment(s) tripped (run identities not in this sink)")
+    restarts_s = sched_counters.get("restarts", 0.0)
+    if restarts_s:
+        verdict.append(
+            f"supervision relaunched run(s) {restarts_s:.0f} time(s) "
+            "after abnormal exits (sched/restarts)")
+    preempts = sched_counters.get("preemptions", 0.0)
+    if preempts:
+        verdict.append(
+            f"{preempts:.0f} preemption(s) quiesced; "
+            f"{sched_counters.get('reschedules', 0.0):.0f} rank(s) "
+            f"rescheduled, {sched_counters.get('jobs_resumed', 0.0):.0f} "
+            "resumed on a surviving node")
+    lost = sched_counters.get("jobs_lost", 0.0)
+    resumed_s = sched_counters.get("jobs_resumed", 0.0)
+    if lost > resumed_s:
+        verdict.append(
+            f"{lost - resumed_s:.0f} job rank(s) declared lost on silent "
+            "nodes and NEVER resumed — check surviving capacity and the "
+            "reschedule_refused events above")
+    if not sched_counters and not sched_events:
+        notes.setdefault(
+            "jobplane",
+            "no data: no sched/* metrics or sched_event records (no job "
+            "plane activity in this run)")
+
     # -- tiers (hierarchical federation: tier/<d>/* metrics + events) -----
     latest_tier: Dict[Any, float] = {}
     for rec in metric_records:
@@ -712,6 +781,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "serving": serving,
         "connectivity": connectivity,
         "recovery": recovery,
+        "jobplane": jobplane,
         "tiers": tiers,
         "secagg": secagg,
         "profile": profile,
@@ -837,6 +907,20 @@ def format_doctor(d: Dict) -> str:
                 "dropped)")
     else:
         add(f"  {notes.get('recovery', 'no data')}")
+
+    add("")
+    add("job plane (supervision / preemption / rescheduling):")
+    jp = d.get("jobplane") or {}
+    jp_counters = jp.get("counters") or {}
+    if jp_counters or jp.get("events"):
+        for name, v in sorted(jp_counters.items()):
+            add(f"  sched/{name:<37s}{v:>14.0f}")
+        for e in (jp.get("events") or [])[-8:]:
+            add("  event: " + " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("kind", "ts") and not isinstance(v, dict)))
+    else:
+        add(f"  {notes.get('jobplane', 'no data')}")
 
     add("")
     add("tiers (hierarchical federation):")

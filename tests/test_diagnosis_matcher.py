@@ -67,6 +67,7 @@ def _master_with_nodes(table):
     import threading
 
     master._lock = threading.Lock()
+    master._draining = set()
     master.cluster = "default"
     sent = []
     master.publish_json = lambda topic, msg, **kw: sent.append((topic, msg))

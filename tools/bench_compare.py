@@ -21,7 +21,8 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["compare_recover", "load_headline", "run_compare", "main"]
+__all__ = ["compare_preempt", "compare_recover", "load_headline",
+           "run_compare", "main"]
 
 
 def _natural_key(path: str):
@@ -153,6 +154,50 @@ def compare_recover(bench_dir: str = ".",
     return out
 
 
+def compare_preempt(bench_dir: str = ".",
+                    mttr_threshold: float = 0.50) -> Optional[Dict]:
+    """Diff the newest two ``PREEMPT_*.json`` job-plane bench records.
+
+    Same contract as :func:`compare_recover`: an MTTR regression past
+    ``mttr_threshold`` fails, and ANY gate going false where it was true
+    — lost salvage, broken bit-identity, a crasher no longer contained —
+    is a correctness regression at any magnitude. None when fewer than
+    two files exist."""
+    files = sorted(glob.glob(os.path.join(bench_dir, "PREEMPT_*.json")),
+                   key=_natural_key)
+    if len(files) < 2:
+        return None
+    prev_rec = _load_record(files[-2])
+    new_rec = _load_record(files[-1])
+    if prev_rec is None or new_rec is None:
+        return {"ok": True,
+                "note": "no parseable preempt record in "
+                        f"{files[-2] if prev_rec is None else files[-1]}"}
+    out: Dict = {
+        "ok": True,
+        "prev_file": os.path.basename(files[-2]),
+        "new_file": os.path.basename(files[-1]),
+        "regressions": [],
+    }
+    prev_mttr = prev_rec.get("mttr_s")
+    new_mttr = new_rec.get("mttr_s")
+    if prev_mttr and new_mttr is not None:
+        delta = (float(new_mttr) - float(prev_mttr)) / float(prev_mttr)
+        out["mttr_prev_s"] = prev_mttr
+        out["mttr_new_s"] = new_mttr
+        out["mttr_delta_pct"] = round(delta * 100.0, 2)
+        if delta > mttr_threshold:
+            out["regressions"].append(
+                f"preempt MTTR regressed {delta * 100:.1f}% "
+                f"({prev_mttr}s -> {new_mttr}s)")
+    for gate in ("bit_identical", "no_retrain_of_salvaged", "ok_salvaged",
+                 "ok_contained", "ok_completed"):
+        if prev_rec.get(gate) is True and new_rec.get(gate) is False:
+            out["regressions"].append(f"preempt gate {gate} went false")
+    out["ok"] = not out["regressions"]
+    return out
+
+
 def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                 pattern: str = "BENCH_*.json") -> Dict:
     """Diff the newest two BENCH files; ``ok`` is False only on a real,
@@ -195,13 +240,16 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                 "delta_pct": round(mfu_delta * 100.0, 2),
                 "note": f"whole-run MFU dropped {-mfu_delta * 100:.1f}%",
             })
-    # recovery-bench gates ride the same invocation: an MTTR regression
-    # or a lost-salvage/bit-identity break between archived RECOVER_*
-    # runs fails the compare exactly like a rounds/s drop
+    # recovery/preempt-bench gates ride the same invocation: an MTTR
+    # regression or a lost-salvage/bit-identity/containment break between
+    # archived RECOVER_*/PREEMPT_* runs fails the compare exactly like a
+    # rounds/s drop
     recover = compare_recover(bench_dir)
+    preempt = compare_preempt(bench_dir)
     return {
         "ok": (delta >= -threshold and not program_regressions
-               and (recover is None or recover["ok"])),
+               and (recover is None or recover["ok"])
+               and (preempt is None or preempt["ok"])),
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -213,6 +261,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                           if mfu_delta is not None else None),
         "program_regressions": program_regressions,
         **({"recover": recover} if recover is not None else {}),
+        **({"preempt": preempt} if preempt is not None else {}),
     }
 
 

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Preemptible-capacity job-plane bench — ONE JSON line (``bench.py --preempt``).
+
+Two halves:
+
+1. **Supervision micro** (always, the tier-1 smoke): a deterministic
+   crasher under a real :class:`LocalAgent` must trip crash-loop
+   containment after exactly ``crash_loop_threshold`` fast identical
+   failures, with a bit-deterministic backoff schedule (the policy is
+   un-jittered by design); plus a preempt quiesce micro — SIGTERM →
+   whole-process-group drained — on a TERM-trapping run, reporting the
+   quiesce wall.
+
+2. **Drain scenario** (skipped in smoke mode): the cross-process
+   preempt/resume acceptance from :mod:`fedml_tpu.scheduler.preempt` —
+   two node-agent subprocesses, a durable cross-silo federation whose
+   server node is drained mid-round (SIGTERM + grace, reschedule to the
+   second agent), measuring **MTTR** (reclaim notice → the rescheduled
+   server's journal-replay ``RESUMED`` marker), **salvaged uploads**
+   (> 0, none retrained), and **bit-identity** of the final params
+   against an undisturbed same-seed run (identity codec).
+
+Env knobs: ``FEDML_PREEMPT_ROUNDS`` / ``FEDML_PREEMPT_CLIENTS`` /
+``FEDML_PREEMPT_DRAIN_ROUND`` / ``FEDML_PREEMPT_MTTR_BUDGET_S`` /
+``FEDML_PREEMPT_SMOKE``. The emitted line carries
+``metric: preempt_mttr_s`` so archived ``PREEMPT_*.json`` files diff
+through ``tools/bench_compare.py`` (``compare_preempt``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+__all__ = ["run_preempt_bench", "main"]
+
+
+def _supervision_micro(tmp: str) -> Dict:
+    """Crash-loop containment + preempt quiesce, in-proc, deterministic."""
+    from fedml_tpu.core.mlops.status import RunStatus
+    from fedml_tpu.scheduler.agent import LocalAgent
+    from fedml_tpu.scheduler.job_yaml import JobSpec
+    from fedml_tpu.scheduler.supervision import RestartPolicy, RestartTracker
+
+    policy = {"max_restarts": 5, "backoff_s": 0.05,
+              "crash_loop_threshold": 3, "fast_fail_s": 10}
+    agent = LocalAgent(workdir=os.path.join(tmp, "agent"),
+                       poll_interval=0.02).start()
+    try:
+        rid = agent.start_run(JobSpec(
+            job_name="crasher", job="exit 7", workspace=".",
+            restart=dict(policy)))
+        status = agent.wait(rid, timeout=60)
+        rec = agent._runs[rid]
+        contained = (status == RunStatus.FAILED
+                     and "crash-loop contained" in rec.reason)
+        # the backoff schedule must be bit-deterministic: what the run
+        # actually slept matches a fresh tracker's arithmetic exactly
+        ref = RestartTracker(RestartPolicy(**policy))
+        expect = []
+        for _ in range(2):  # threshold 3 → 2 relaunches before containment
+            action, delay = ref.on_exit(7, 0.0)
+            assert action == "restart"
+            expect.append(delay)
+        deterministic = rec.tracker.delays_s == expect
+
+        rid2 = agent.start_run(JobSpec(
+            job_name="quiesce",
+            job='trap "exit 0" TERM; echo armed; sleep 30', workspace="."))
+        deadline = time.time() + 10
+        while "armed" not in agent.logs(rid2) and time.time() < deadline:
+            time.sleep(0.01)  # wait for the shell to arm the trap
+        t0 = time.perf_counter()
+        agent.preempt(rid2, grace_s=10.0)
+        quiesce_ms = (time.perf_counter() - t0) * 1e3
+        preempted = agent.status(rid2) == RunStatus.PREEMPTED
+        return {
+            "crash_loop_contained": bool(contained),
+            "crash_loop_attempts": rec.tracker.restarts + 1,
+            "backoff_schedule_s": [round(d, 4) for d in rec.tracker.delays_s],
+            "backoff_deterministic": bool(deterministic),
+            "preempt_quiesce_ms": round(quiesce_ms, 2),
+            "preempt_status_ok": bool(preempted),
+            "ok_contained": bool(contained and deterministic and preempted),
+        }
+    finally:
+        agent.shutdown()
+
+
+def run_preempt_bench(full: Optional[bool] = None) -> Dict:
+    import shutil
+    import tempfile
+
+    rounds = int(os.environ.get("FEDML_PREEMPT_ROUNDS", "4"))
+    clients = int(os.environ.get("FEDML_PREEMPT_CLIENTS", "2"))
+    drain_round = int(os.environ.get("FEDML_PREEMPT_DRAIN_ROUND", "2"))
+    mttr_budget = float(os.environ.get("FEDML_PREEMPT_MTTR_BUDGET_S", "60"))
+    if full is None:
+        full = os.environ.get("FEDML_PREEMPT_SMOKE") != "1"
+
+    tmp = tempfile.mkdtemp(prefix="fedml_preempt_bench_")
+    try:
+        return _run(tmp, rounds, clients, drain_round, mttr_budget, full)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run(tmp: str, rounds: int, clients: int, drain_round: int,
+         mttr_budget: float, full: bool) -> Dict:
+    row: Dict = {
+        "metric": "preempt_mttr_s",
+        "value": None,
+        "unit": "s",
+        "rounds": rounds, "clients": clients, "drain_round": drain_round,
+        "smoke": not full,
+    }
+    row.update(_supervision_micro(tmp))
+    if not full:
+        row["ok"] = row["ok_contained"]
+        return row
+
+    from fedml_tpu.resilience.durability import run_recover_scenario
+    from fedml_tpu.scheduler.preempt import run_preempt_scenario
+
+    base = run_recover_scenario(seed=7, rounds=rounds, clients=clients,
+                                kill=False, compression="identity")
+    drained = run_preempt_scenario(
+        seed=7, rounds=rounds, clients=clients, drain_round=drain_round,
+        compression="identity", tmp_dir=os.path.join(tmp, "drain"))
+    # no-retrain: a salvaged client's journaled round appears exactly
+    # once in its TRAINED history across both server placements
+    no_retrain = all(
+        drained["trained"].get(str(c), []).count(drained["resumed_round"]) == 1
+        for c in drained["salvaged_clients"])
+    row.update({
+        "value": drained["mttr_s"],
+        "mttr_s": drained["mttr_s"],
+        "salvaged_uploads": drained["salvaged_uploads"],
+        "rescheduled_to": drained.get("rescheduled_to"),
+        "bit_identical": (base["digest"] is not None
+                          and base["digest"] == drained["digest"]),
+        "no_retrain_of_salvaged": no_retrain,
+        "scenario_wall_s": drained["wall_s"],
+        "sched_counters": drained.get("counters"),
+        "ok_mttr": (drained["mttr_s"] is not None
+                    and drained["mttr_s"] < mttr_budget),
+        "ok_salvaged": drained["salvaged_uploads"] > 0,
+        "ok_completed": bool(drained["completed"]),
+    })
+    row["ok"] = bool(row["ok_contained"] and row["ok_completed"]
+                     and row["ok_mttr"] and row["ok_salvaged"]
+                     and row["bit_identical"]
+                     and row["no_retrain_of_salvaged"])
+    return row
+
+
+def main() -> int:
+    row = run_preempt_bench()
+    print(json.dumps(row))  # noqa: T201 (CLI output)
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
