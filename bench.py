@@ -323,6 +323,21 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--integrity" in sys.argv:
+        # update-integrity gates: ring 1's screen seam < 2% of a round,
+        # a poisoned same-seed federation (NaN + magnitude poison at the
+        # comm seam) finishing within tolerance of clean with every
+        # corrupt upload screened or rolled back, and a round rollback
+        # (reject -> restore -> re-run) inside its MTTR budget — one
+        # JSON line (tools/integrity_bench.py; FEDML_INTEGRITY_* env)
+        from tools.integrity_bench import run_integrity_bench
+
+        row = run_integrity_bench()
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--preempt" in sys.argv:
         # job-plane gates: deterministic crasher contained (bounded
         # attempts, bit-deterministic backoff), drained node's federation

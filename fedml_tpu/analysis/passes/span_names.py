@@ -71,6 +71,14 @@ _SECAGG_SHAPE = re.compile(r"^secagg/[a-z0-9_]+$")
 # are levels, neither is a latency distribution (MTTR is a bench metric,
 # not a histogram)
 _SCHED_SHAPE = re.compile(r"^sched/[a-z0-9_]+$")
+# update integrity: integrity/* is the containment namespace (screen
+# drops, quarantine, rollbacks, non-finite wire refusals) — metric-only
+# (the screen/robust-agg programs live in the catalog as
+# integrity/<name> PROGRAM names, not spans), one signal segment
+# (clients/rounds/reasons ride integrity_event fields); counters or
+# gauges only — screen/rollback signals are occurrence counts, the
+# quarantine population is a level, neither is a distribution
+_INTEGRITY_SHAPE = re.compile(r"^integrity/[a-z0-9_]+$")
 # performance attribution: profile/* is the program-catalog namespace —
 # metric-only (catalog programs are NOT spans; their names live in the
 # `program` label), one signal segment, counter/gauge only (flops/bytes/
@@ -143,10 +151,10 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
                     "or compress/decode")
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
-                 "secagg/", "profile/", "sched/")):
+                 "secagg/", "profile/", "sched/", "integrity/")):
             bad(f"{name!r} — mem/, health/, resilience/, tier/, "
-                "live/, secagg/, profile/ and sched/ are metric "
-                "namespaces, not span names")
+                "live/, secagg/, profile/, sched/ and integrity/ are "
+                "metric namespaces, not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 bad(f"span {name!r} must be serve/stage, "
@@ -202,6 +210,15 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
             elif kind == "histogram":
                 bad(f"{kind} {name!r} — profile/* signals are "
                     "levels (gauge) or occurrence counts (counter), not "
+                    "histograms")
+        if kind != "span" and name.startswith("integrity/"):
+            if not _INTEGRITY_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be integrity/<signal> "
+                    "(one segment; clients/rounds/reasons ride "
+                    "integrity_event fields)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — integrity/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
                     "histograms")
         if kind != "span" and name.startswith("sched/"):
             if not _SCHED_SHAPE.match(name):

@@ -606,6 +606,25 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
                    "then exercise the seed-reveal mask recovery")
 @click.option("--round-deadline-s", default=30.0, show_default=True)
 @click.option("--round-quorum", default=2.0 / 3.0, show_default=True)
+@click.option("--corrupt-rank", default=None, type=int,
+              help="update-integrity chaos: corrupt this rank's model "
+                   "uploads at --corrupt-round (NaN blocks or scaled "
+                   "poison) — pair with --integrity/--agg-robust to "
+                   "prove containment")
+@click.option("--corrupt-round", default=1, show_default=True,
+              help="round the corruption window opens")
+@click.option("--corrupt-mode", default="nan", show_default=True,
+              type=click.Choice(["nan", "scale"]),
+              help="nan = non-finite blocks; scale = magnitude poison")
+@click.option("--corrupt-factor", default=50.0, show_default=True,
+              help="with --corrupt-mode scale: the poison multiplier")
+@click.option("--integrity", is_flag=True, default=False,
+              help="arm the update-integrity rings (admission screen + "
+                   "quarantine + round rollback; docs/integrity.md)")
+@click.option("--agg-robust", default="", show_default=True,
+              help="fused robust aggregation spec (trimmed_mean@0.1 | "
+                   "median) — Byzantine-robust rounds without the "
+                   "decode fallback")
 @click.option("--kill-server", is_flag=True, default=False,
               help="SIGKILL the SERVER mid-round (at --kill-round, after "
                    "--after-uploads journaled uploads) and supervise an "
@@ -631,7 +650,9 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
 def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
           revive_round, drop: float, duplicate: float, delay_ms: float,
           compression: str, secagg: str, round_deadline_s: float,
-          round_quorum: float, kill_server: bool,
+          round_quorum: float, corrupt_rank, corrupt_round: int,
+          corrupt_mode: str, corrupt_factor: float, integrity: bool,
+          agg_robust: str, kill_server: bool,
           after_uploads: int, drain: bool, grace_s: float, drain_via: str,
           agent_kill: bool) -> None:
     """Run a seeded chaos scenario against an in-proc federation.
@@ -691,7 +712,10 @@ def chaos(seed: int, rounds: int, clients: int, kill_rank, kill_round: int,
         kill_round=kill_round, revive_round=revive_round, drop=drop,
         duplicate=duplicate, delay_ms=delay_ms, compression=compression,
         secagg=secagg, round_deadline_s=round_deadline_s,
-        round_quorum=round_quorum)
+        round_quorum=round_quorum, corrupt_rank=corrupt_rank,
+        corrupt_round=corrupt_round, corrupt_mode=corrupt_mode,
+        corrupt_factor=corrupt_factor, integrity=integrity,
+        agg_robust=agg_robust)
     click.echo(json.dumps(out))
     if not out["completed"]:
         raise SystemExit(1)

@@ -34,7 +34,7 @@ from fedml_tpu.compression.codecs import (
 from fedml_tpu.compression.error_feedback import ErrorFeedback
 
 
-def requires_full_trees() -> bool:
+def requires_full_trees(codec=None) -> bool:
     """True when the server-side trust stack needs full per-client models.
 
     The dequant-fused aggregation path never materializes per-client f32
@@ -47,6 +47,17 @@ def requires_full_trees() -> bool:
     (the same path the health tracker uses) and the clip factor folds
     into the fused aggregation weight — see
     ``FedMLDefender.fused_clip_factors``.
+
+    FUSED robust defenses (coordinate-wise trimmed mean / median) are
+    exempt too — but only for a caller that passes the ``codec`` its
+    updates actually ride: those statistics are shift-equivariant, so on
+    a DENSE codec they compute on the stacked compressed *deltas* inside
+    one jitted reduction (``fedml_tpu.integrity.fused_robust_sum``) and
+    resolve against the broadcast base — the same aggregation the decode
+    fallback would produce on full models, without ever materializing N
+    f32 client trees (``FedMLDefender.is_fused_defense``). Sparse
+    codecs (top-k) cannot sort per coordinate, and a ``codec=None``
+    caller has no fused path at all — both keep the decode fallback.
     """
     from fedml_tpu.core.dp.fedml_differential_privacy import (
         FedMLDifferentialPrivacy,
@@ -57,11 +68,15 @@ def requires_full_trees() -> bool:
 
     dp = FedMLDifferentialPrivacy.get_instance()
     defender = FedMLDefender.get_instance()
+    fused_capable = (codec is not None
+                     and getattr(codec, "broadcast_safe", False)
+                     and not getattr(codec, "maskable", False))
     return (
         FedMLFHE.get_instance().is_fhe_enabled()
         or FedMLAttacker.get_instance().is_model_attack()
         or (defender.is_defense_enabled()
-            and not defender.is_norm_only_defense())
+            and not defender.is_norm_only_defense()
+            and not (defender.is_fused_defense() and fused_capable))
         or (dp.is_dp_enabled() and dp.is_global_dp_enabled())
     )
 

@@ -174,6 +174,30 @@ class Codec:
         enc = self._encode_leaves(leaves, meta, key)
         return jax.tree.unflatten(treedef, self._decode_leaves(enc, meta))
 
+    # -- wire validation ---------------------------------------------------
+    def check_wire(self, ct: "CompressedTree") -> None:
+        """Reject wire payloads whose metadata-like parts are non-finite.
+
+        A NaN/Inf *scale* poisons every element of its block on decode
+        and is absorbing under every weighted sum — silently propagating
+        it turns one corrupt upload into a corrupt global model. Codecs
+        with scale-like parts override this (int8 scales, top-k values);
+        the check runs on HOST arrays only (the wire form — what a
+        hostile peer controls): device-resident arrays from an
+        in-process encode are covered by the integrity screen's jitted
+        pass instead, so the hot path never pays a forced sync here.
+        Raises ``ValueError`` and counts
+        ``integrity/nonfinite_wire`` on a hit.
+        """
+
+    def _reject_nonfinite_wire(self, what: str) -> None:
+        from fedml_tpu import telemetry
+
+        telemetry.get_registry().counter("integrity/nonfinite_wire").inc()
+        raise ValueError(
+            f"non-finite {what} in a {self.name} wire payload — refusing "
+            "to decode/aggregate a poisoned tree (see docs/integrity.md)")
+
     # -- whole-tree entry points ------------------------------------------
     def encode(self, tree: Pytree, key=None, is_delta: bool = False,
                residual: Optional[Pytree] = None):
@@ -220,6 +244,7 @@ class Codec:
         if ct.version != WIRE_VERSION:
             raise ValueError(
                 f"unsupported compression wire version {ct.version}")
+        self.check_wire(ct)
         with telemetry.get_tracer().span("compress/decode", codec=self.name,
                                          n_leaves=len(ct.arrays)):
             flat = _decode_program(
@@ -364,6 +389,10 @@ def fused_weighted_sum(cts: Sequence[CompressedTree], weights) -> Pytree:
     n_leaves = len(first.meta)
     if any(len(ct.arrays) != n_leaves for ct in cts):
         raise ValueError("compressed update leaf count mismatch")
+    for ct in cts:
+        # a NaN/Inf scale is absorbing under the fused einsum — one
+        # corrupt wire payload must fail loudly, not poison the sum
+        codec.check_wire(ct)
     try:
         stacked = tuple(
             tuple(jnp.stack([ct.arrays[j][p] for ct in cts])
@@ -423,6 +452,17 @@ class Int8Codec(Codec):
         q, scale = parts
         return (q.astype(jnp.float32) * scale).astype(_dtype_from_str(dt))
 
+    def check_wire(self, ct: "CompressedTree") -> None:
+        # int8 blocks are finite by dtype; the scale scalar is the whole
+        # attack surface — and tiny, so the host check is free
+        for parts, (dt, _) in zip(ct.arrays, ct.meta):
+            if not _is_float_meta(dt) or len(parts) < 2:
+                continue
+            scale = parts[1]
+            if isinstance(scale, (np.ndarray, np.generic, float)) and not (
+                    np.all(np.isfinite(scale))):
+                self._reject_nonfinite_wire("scale")
+
     def weighted_sum_leaf(self, stacked, w, dt, shape):
         # the dequant is fused INTO the reduction: the (w_i · s_i) scalar
         # product folds both the per-client scale and the FedAvg weight,
@@ -479,6 +519,16 @@ class TopKCodec(Codec):
         contrib = (w[:, None] * v).ravel()
         out = jnp.zeros((size,), jnp.float32).at[idx.ravel()].add(contrib)
         return out.reshape(shape).astype(_dtype_from_str(dt))
+
+    def check_wire(self, ct: "CompressedTree") -> None:
+        # the kept values ARE the payload — scale-like, worth the check
+        for parts, (dt, _) in zip(ct.arrays, ct.meta):
+            if not _is_float_meta(dt):
+                continue
+            v = parts[0]
+            if isinstance(v, (np.ndarray, np.generic)) and not np.all(
+                    np.isfinite(v)):
+                self._reject_nonfinite_wire("top-k values")
 
 
 _CODEC_CLASSES: Dict[str, type] = {

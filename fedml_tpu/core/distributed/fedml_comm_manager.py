@@ -248,6 +248,11 @@ class FedMLCommManager(Observer):
                     reg.counter("live/frames_piggybacked").inc()
             except Exception:  # observability must not break the send
                 logger.exception("telemetry frame piggyback failed")
+        # chaos: update-corruption windows mutate the model payload at
+        # exactly this seam — after encode, before the wire (None-check
+        # in production; the injector no-ops without corrupt windows)
+        if self._chaos is not None:
+            self._chaos.corrupt_payload(message)
         # idempotent-send header: stamped once per logical message (a
         # retried send reuses it, so the receiver's deduper catches the
         # case where the first attempt DID land)

@@ -30,6 +30,11 @@ class Message:
     # own model uploads (see fedml_tpu/compression); payloads are
     # additionally self-describing via the wire format's __codec__ node
     MSG_ARG_KEY_COMPRESSION = "compression"
+    # negotiation header: the robust-aggregation spec every aggregation
+    # point of this round applies (trimmed_mean@0.1 / median — see
+    # fedml_tpu/integrity/robust_agg.py); informational for flat
+    # clients, authoritative for interior tiers of an aggregation tree
+    MSG_ARG_KEY_AGG_ROBUST = "agg_robust"
     # piggybacked heartbeat/health fields (JSON-safe scalars only: train
     # wall, train loss, live memory bytes) — rides existing status and
     # model-upload messages, never its own round-trip

@@ -52,6 +52,25 @@ class FedMLDefender:
         """The active norm bound (norm-only defenses)."""
         return float(getattr(self.defender, "norm_bound", 0.0))
 
+    def is_fused_defense(self) -> bool:
+        """True when the active defense is a coordinate-wise robust
+        statistic the integrity layer computes in the compressed domain
+        (``fedml_tpu.integrity.fused_robust_sum``): shift-equivariant,
+        so running it on the stacked compressed DELTAS and adding the
+        broadcast base equals running it on full client models — no
+        decode fallback needed."""
+        return self.is_enabled and self.defense_type in (
+            "trimmed_mean", "coordinate_wise_median")
+
+    def fused_agg_spec(self) -> Optional[str]:
+        """The active fused defense as an ``agg_robust`` negotiation
+        spec (``trimmed_mean@beta`` / ``median``), or None."""
+        if not self.is_fused_defense():
+            return None
+        if self.defense_type == "coordinate_wise_median":
+            return "median"
+        return f"trimmed_mean@{float(getattr(self.defender, 'beta', 0.1)):g}"
+
     def fused_clip_factors(self, cts) -> Optional[List[float]]:
         """Per-client clip factors for the dequant-fused aggregation
         path: ``min(1, bound/‖d_i‖)`` with the delta norm read straight

@@ -182,6 +182,15 @@ class ClientMasterManager(FedMLCommManager):
                     header, int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0)))
             self._global_ref = global_params
             return global_params
+        robust = msg.get(Message.MSG_ARG_KEY_AGG_ROBUST)
+        if robust is not None:
+            # informational for a flat client (aggregation is server-
+            # side), but a spec this process cannot parse means the
+            # federation disagrees about its aggregation semantics —
+            # fail loudly, exactly like an unknown codec tag
+            from fedml_tpu.integrity import parse_robust_spec
+
+            parse_robust_spec(robust)
         negotiated = msg.get(Message.MSG_ARG_KEY_COMPRESSION)
         if negotiated is not None and not bool(
                 getattr(self.args, "secure_aggregation", False)):

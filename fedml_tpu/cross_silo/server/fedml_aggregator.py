@@ -174,17 +174,29 @@ class FedMLAggregator:
         # deltas resolve against the broadcast as clients decoded it (the
         # server manager records it under a lossy broadcast codec)
         base = self.get_upload_base()
+        upload_codec = next(
+            (get_codec(m.codec) for _, m in raw_list
+             if isinstance(m, CompressedTree)), None)
         if all(isinstance(m, CompressedTree) and m.is_delta
                for _, m in raw_list) and not (
-                   requires_full_trees() or self._contrib.is_enabled()):
+                   requires_full_trees(upload_codec)
+                   or self._contrib.is_enabled()):
             # norm-only defenses ride this path: clip factors read off
-            # the blocks × scales, folded into the fused weights
+            # the blocks × scales, folded into the fused weights; fused
+            # robust defenses (trimmed mean / median) and an explicit
+            # agg_robust spec swap the weighted mean for the robust
+            # statistic — still one jitted reduction, still no f32
+            # per-client trees
             from fedml_tpu.core.security.defender import FedMLDefender
+            from fedml_tpu.integrity import resolve_agg_robust
 
+            agg_robust = resolve_agg_robust(self.args, codec=upload_codec)
             return raw_list, FedMLAggOperator.agg_compressed(
                 self.args, raw_list, base,
-                clip_factors=FedMLDefender.get_instance()
-                .fused_clip_factors([m for _, m in raw_list]))
+                clip_factors=None if agg_robust else
+                FedMLDefender.get_instance()
+                .fused_clip_factors([m for _, m in raw_list]),
+                agg_robust=agg_robust)
         decoded = []
         for n, m in raw_list:
             if isinstance(m, CompressedTree):

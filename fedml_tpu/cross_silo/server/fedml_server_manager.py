@@ -184,6 +184,77 @@ class FedMLServerManager(FedMLCommManager):
                 with self._round_lock:
                     self._salvaged = sal
 
+        # update-integrity containment (integrity: true / agg_robust):
+        # ring 1 screens every upload in the compressed domain at
+        # admission (non-finite, norm overflow, per-block robust z at
+        # close) and quarantines flagged senders; ring 2 swaps the fused
+        # weighted mean for a coordinate-wise robust statistic; ring 3
+        # rejects a poisoned aggregate post-eval and rolls the round
+        # back to the last committed state (docs/integrity.md)
+        from fedml_tpu.integrity import (
+            AcceptanceGuard,
+            IntegrityConfig,
+            QuarantineList,
+            UpdateScreen,
+            resolve_agg_robust,
+        )
+
+        from fedml_tpu.integrity import parse_robust_spec
+
+        self._agg_robust = resolve_agg_robust(args, codec=self._codec)
+        explicit_robust = parse_robust_spec(
+            getattr(args, "agg_robust", "")) is not None
+        icfg = IntegrityConfig.from_args(args)
+        self._screen = None
+        self._quarantine = None
+        self._guard = None
+        if self._secagg is not None:
+            conflicts = []
+            if self._agg_robust:
+                conflicts.append(
+                    f"agg_robust {self._agg_robust!r} (per-coordinate "
+                    "sorting needs per-client values the masks hide)")
+            if icfg is not None and icfg.screen_enabled:
+                conflicts.append(
+                    "integrity screening (per-upload introspection is "
+                    "what the masks exist to prevent; secagg_clip is the "
+                    "masked wire's admission control)")
+            if conflicts:
+                raise ValueError(
+                    "secure aggregation (secagg: int8) cannot run with: "
+                    + "; ".join(conflicts))
+        # refusals apply to an EXPLICIT agg_robust only — a fused-capable
+        # DEFENSE on an uncompressed/top-k run simply keeps its decode
+        # path (resolve_agg_robust returned None for it above)
+        if explicit_robust and self._codec is None:
+            raise ValueError(
+                "agg_robust rides the compressed fused aggregation path; "
+                "set compression (int8/bf16/identity), or use "
+                "enable_defense + defense_type for uncompressed runs")
+        if explicit_robust and self._codec is not None and not getattr(
+                self._codec, "broadcast_safe", True):
+            raise ValueError(
+                f"agg_robust needs dense per-coordinate uploads; codec "
+                f"{self._codec.spec!r} is sparse — use int8/bf16/identity")
+        if icfg is not None:
+            self._quarantine = QuarantineList(icfg.quarantine_rounds)
+            if icfg.screen_enabled and self._secagg is None:
+                self._screen = UpdateScreen(icfg.norm_mult,
+                                            icfg.z_threshold)
+            if icfg.rollback_enabled:
+                self._guard = AcceptanceGuard(
+                    icfg.loss_mult, icfg.loss_min_history,
+                    icfg.max_rollbacks)
+        # senders whose upload was screened out THIS round: they will
+        # never re-upload, so round completion counts them as missing
+        # (the close evicts them; quarantine keeps a readmitted sender
+        # out of selection until its rounds elapse)
+        self._screened_out: set = set()
+        # ring 3's restore point: the round-open state snapshot — under
+        # durability this equals the last PR 12 checkpoint (the journal
+        # forces a checkpoint at every commit)
+        self._pre_round_state = None
+
         # live serving plane: listeners see every closed round's aggregate
         # (round_idx, global_params) — the serving publisher attaches here
         # (serving/live/bridge.py). Guarded at call time: a serving-plane
@@ -292,6 +363,11 @@ class FedMLServerManager(FedMLCommManager):
             if self._codec is not None:
                 msg.add_params(Message.MSG_ARG_KEY_COMPRESSION,
                                self._codec.spec)
+            if self._agg_robust:
+                # negotiated like the codec spec: every peer (and every
+                # tier, in a tree) sees which statistic closes the round
+                msg.add_params(Message.MSG_ARG_KEY_AGG_ROBUST,
+                               self._agg_robust)
             if sa_header is not None:
                 from fedml_tpu.privacy.secagg import SecAggMessage
 
@@ -314,11 +390,13 @@ class FedMLServerManager(FedMLCommManager):
         global_params = self.aggregator.get_global_model_params()
         payload = self._broadcast_payload(global_params)
         sa_header = self._secagg_round_header()
+        self._capture_round_state()
         with self._round_lock:
             self._round_closed = False
             self._deadline_expired = False
             self._deadline_extensions_used = 0
             self._completing = False
+            self._screened_out = set()
         self._journal_round_open()
         # the open span's context rides each init message, so every
         # client's training span joins this round's server-side trace
@@ -421,6 +499,17 @@ class FedMLServerManager(FedMLCommManager):
 
     def _select_round_clients(self) -> None:
         client_ids = list(range(1, self.client_num + 1))
+        # update integrity: quarantined clients sit out selection until
+        # their quarantine_rounds elapse — orthogonal to eviction (a
+        # readmitted rejoiner can still be quarantined)
+        if self._quarantine is not None:
+            client_ids = self._quarantine.filter_selection(
+                client_ids, int(self.args.round_idx))
+            if not client_ids:
+                raise RuntimeError(
+                    "every client is quarantined; the federation has no "
+                    "trustworthy cohort left (see integrity/* counters "
+                    "and docs/integrity.md)")
         # dropout: evicted clients sit out selection until they rejoin;
         # probe them each round so a revived client has a deterministic
         # path back in (its status reply triggers the rejoin resync)
@@ -452,11 +541,15 @@ class FedMLServerManager(FedMLCommManager):
             )
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        from fedml_tpu.compression import CompressedTree
+
         sender = msg.get_sender_id()
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
         invalid = None
+        screened = None
+        missing = None
         with self._round_lock:
             cohort = list(self.client_id_list_in_this_round or [])
             stale = (
@@ -476,7 +569,24 @@ class FedMLServerManager(FedMLCommManager):
                         # (the client effectively never uploaded this
                         # round) — it can never reach the aggregate
                         invalid = str(e)
-                if invalid is None:
+                if invalid is None and self._screen is not None:
+                    # ring 1 admission: non-finite blocks/scales and
+                    # norm overflow drop the upload HERE — before the
+                    # journal, before the aggregator, exactly like a
+                    # stale upload. The sender counts as missing for
+                    # the round (quorum reweights it out) and goes to
+                    # quarantine below, outside the lock.
+                    base = None
+                    if not (isinstance(model_params, CompressedTree)
+                            and model_params.is_delta):
+                        base = self.aggregator.get_upload_base()
+                    screened = self._screen.admit(
+                        sender, int(self.args.round_idx), model_params,
+                        base=base)
+                    if screened is not None:
+                        self._screened_out.add(sender)
+                        missing = self._try_close_round(cohort)
+                if invalid is None and screened is None:
                     self._observe_client_upload(sender, msg, model_params)
                     if self._journal is not None:
                         # the upload is durable BEFORE it is applied: a
@@ -496,7 +606,8 @@ class FedMLServerManager(FedMLCommManager):
                         local_sample_num, local_steps=msg.get("local_steps"),
                     )
                     missing = self._try_close_round(cohort)
-        if self._kill_window is not None and not stale and invalid is None:
+        if (self._kill_window is not None and not stale
+                and invalid is None and screened is None):
             # chaos seam: the seeded kill-the-server window fires AFTER
             # the upload is journaled — the recovery tests assert exactly
             # this upload is salvaged, never retrained
@@ -509,6 +620,19 @@ class FedMLServerManager(FedMLCommManager):
                 counter="secagg/invalid_uploads")
             logger.warning("dropping invalid masked upload from client "
                            "%s: %s", sender, invalid)
+            return
+        if screened is not None:
+            # the screen already counted + logged the integrity_event;
+            # here the sender loses its trust (quarantine) — the round
+            # close will evict it (missing), the probe readmits it, and
+            # quarantine keeps it out of selection until its rounds
+            # elapse (its rejoin resync resets the EF residual)
+            self._quarantine.quarantine(sender, int(self.args.round_idx),
+                                        screened)
+            logger.warning("dropping screened upload from client %s: %s",
+                           sender, screened)
+            if missing is not None:
+                self._finish_round(missing)
             return
         if stale:
             # a quorum round already closed (or the sender was never in
@@ -532,18 +656,69 @@ class FedMLServerManager(FedMLCommManager):
         """Under ``_round_lock``: close the round if complete. Returns the
         missing cohort ids (possibly []) once closed, else None.
 
-        Completion = all expected uploads arrived, OR the deadline
-        expired and at least the quorum arrived.
+        Completion = all expected uploads arrived, OR every sender whose
+        upload wasn't screened out has arrived (a screened sender will
+        never re-upload — waiting for it is waiting for the deadline to
+        tell us what we already know) while the quorum still holds, OR
+        the deadline expired and at least the quorum arrived.
         """
         from fedml_tpu.resilience import quorum_size
 
         expected = len(cohort)
         received = self.aggregator.n_received()
+        need = quorum_size(expected, self.resilience.round_quorum)
         if received < expected:
-            if not (self._deadline_expired
-                    and received >= quorum_size(
-                        expected, self.resilience.round_quorum)):
+            # a screened sender will NEVER re-upload, so once every
+            # unscreened upload is in the round is as complete as it can
+            # get. The quorum floor still applies in a quorum regime;
+            # under the legacy all-received contract (round_quorum 1.0,
+            # where need == expected could never be met minus the
+            # screened) "all available" is the only non-hanging reading.
+            quorum_ok = (received >= need
+                         or self.resilience.round_quorum >= 1.0)
+            screened_complete = (
+                self._screened_out
+                and received >= max(1, expected - len(self._screened_out))
+                and quorum_ok)
+            if not (screened_complete
+                    or (self._deadline_expired and received >= need)):
                 return None
+        if self._screen is not None:
+            # ring 1's cohort pass: per-block robust z needs the whole
+            # round assembled — outliers flagged here are dropped from
+            # the staged uploads (never aggregated) and quarantined; the
+            # close below lists them as missing, so the PR 5 eviction/
+            # reweighting machinery handles them like any dropout
+            for cid, reason in self._screen.close_round(
+                    int(self.args.round_idx)).items():
+                if cid in cohort:
+                    self.aggregator.drop_client_upload(cohort.index(cid))
+                    self._screened_out.add(cid)
+                    self._quarantine.quarantine(
+                        cid, int(self.args.round_idx), reason)
+                    logger.warning("dropping z-outlier upload from "
+                                   "client %s: %s", cid, reason)
+            received = self.aggregator.n_received()
+            if received == 0:
+                # everything flagged: nothing trustworthy to aggregate —
+                # let the deadline/extension machinery abort loudly
+                return None
+            if received < need:
+                # integrity drops can take a fully-arrived round below
+                # the liveness quorum. Quorum counts processes, not
+                # trust: the honest subset still aggregates (renormalized
+                # FedAvg), but NEVER silently — this is the one close
+                # that commits under the quorum floor
+                logger.warning(
+                    "round %d closing BELOW quorum after z-outlier "
+                    "drops: %d/%d honest uploads (quorum %d) — the "
+                    "dropped uploads were poison, not dropouts",
+                    int(self.args.round_idx), received, expected, need)
+                self._resilience_event(
+                    "below_quorum_integrity_close",
+                    round=int(self.args.round_idx), received=received,
+                    expected=expected, quorum=need,
+                    counter="integrity/below_quorum_closes")
         missing_idx = self.aggregator.close_round_quorum(expected)
         self._round_closed = True
         self._deadline.cancel()
@@ -818,6 +993,14 @@ class FedMLServerManager(FedMLCommManager):
         with tracer.span(f"round/{self.args.round_idx}/aggregate",
                          n_clients=self.aggregator.n_received()):
             global_params = self.aggregator.aggregate()
+        if self._guard is not None:
+            # ring 3, first gate: a non-finite aggregate must be caught
+            # BEFORE the round listeners — a live serving endpoint must
+            # never hot-swap NaN weights in
+            reason = self._guard.check(global_params)
+            if reason is not None:
+                self._rollback_round(reason)
+                return
         self._health.finish_round(self.args.round_idx)
         self._devstats.sample("aggregate", self.args.round_idx)
         if self._live is not None:
@@ -843,10 +1026,22 @@ class FedMLServerManager(FedMLCommManager):
         except Exception:  # profiling must never break the round
             logger.exception("trace controller round hook failed at "
                              "round %d", self.args.round_idx)
-        self._notify_round_listeners(self.args.round_idx, global_params)
         with tracer.span(f"round/{self.args.round_idx}/eval"):
             metrics = self.aggregator.test_on_server_for_all_clients(
                 self.args.round_idx)
+        if self._guard is not None:
+            # ring 3, second gate: eval-loss spike vs the accepted-
+            # history EWMA. MUST run before the checkpoint save, the
+            # journal commit AND the round listeners below — a rejected
+            # round's state must neither become durable nor hot-swap
+            # into a live serving endpoint.
+            reason = self._guard.check(None, metrics.get("test_loss"))
+            if reason is not None:
+                self._rollback_round(reason)
+                return
+            self._guard.accept(metrics.get("test_loss"))
+        # listeners (the live serving bridge) see only ACCEPTED rounds
+        self._notify_round_listeners(self.args.round_idx, global_params)
         mlops.log({"round": self.args.round_idx, **{k: v for k, v in metrics.items()}})
 
         if self._ckpt is not None:
@@ -880,14 +1075,143 @@ class FedMLServerManager(FedMLCommManager):
         self._select_round_clients()
         payload = self._broadcast_payload(global_params)
         sa_header = self._secagg_round_header()
+        self._capture_round_state()
         with self._round_lock:
             self._round_closed = False
             self._deadline_expired = False
             self._deadline_extensions_used = 0
             self._completing = False
+            self._screened_out = set()
         self._journal_round_open()
         with tracer.span(f"round/{self.args.round_idx}/sync",
                          n_clients=len(self.client_id_list_in_this_round)):
+            self._send_round_config(self.client_id_list_in_this_round,
+                                    payload, sa_header, init=False)
+        self._arm_round_deadline()
+
+    # -- update integrity: ring 3 rollback ---------------------------------
+    def _capture_round_state(self) -> None:
+        """Snapshot the round-open state as ring 3's restore point.
+
+        Under durability this is byte-equivalent to the last PR 12
+        checkpoint (the journal forces a checkpoint at every commit);
+        keeping the in-memory twin means rollback also works on runs
+        without a checkpoint_dir, and costs one pytree of references —
+        ``aggregate()`` replaces the global tree, never mutates it.
+        """
+        if self._guard is None:
+            return
+        from fedml_tpu.core.checkpoint import pack_round_state
+
+        state = pack_round_state(
+            self.aggregator.get_global_model_params(),
+            self.aggregator.server_opt, int(self.args.round_idx))
+        # captured from the comm thread (upload-complete round advance)
+        # AND the timer thread (deadline-path advance) — the same lock
+        # the round-flag resets take
+        with self._round_lock:
+            self._pre_round_state = state
+
+    def _rollback_round(self, reason: str) -> None:
+        """Ring 3: the aggregated round was REJECTED — restore the last
+        committed round state (the PR 12 checkpoint when one exists),
+        quarantine the suspects, journal ``round_rolled_back``, and
+        re-run the same round index with a fresh cohort. Bounded by
+        ``max_rollbacks`` consecutive rollbacks, then a loud abort."""
+        from fedml_tpu import telemetry
+        from fedml_tpu.core.checkpoint import (
+            apply_round_state,
+            pack_round_state,
+        )
+        from fedml_tpu.integrity import RollbackBudgetExceeded
+
+        round_idx = int(self.args.round_idx)
+        try:
+            self._guard.record_rollback(round_idx, reason)
+        except RollbackBudgetExceeded as e:
+            self._abort_federation(str(e))
+            return
+        state = None
+        restored_from = None
+        if self._ckpt is not None:
+            template = pack_round_state(
+                self.aggregator.get_global_model_params(),
+                self.aggregator.server_opt, 0)
+            got = self._ckpt.restore_latest(template)
+            if got is not None:
+                state = got[1]
+                restored_from = f"checkpoint round {got[0]}"
+        if state is None and self._pre_round_state is not None:
+            state = self._pre_round_state
+            restored_from = "round-open state snapshot"
+        if state is None:
+            self._abort_federation(
+                f"round {round_idx} rejected ({reason}) with no state to "
+                "roll back to — enable checkpoint_dir or accept the loss")
+            return
+        self.aggregator.set_global_model_params(state["global_params"])
+        apply_round_state(state, self.aggregator.server_opt)
+        with self._round_lock:
+            cohort = list(self.client_id_list_in_this_round or [])
+        # suspects: ring 1's screen stats rank the admitted cohort by
+        # suspicion (norm past the cohort envelope, else the single
+        # largest update); with no screen there is nothing to
+        # distinguish them — the WHOLE cohort is suspect
+        suspects = []
+        if self._screen is not None:
+            suspects = [c for c in self._screen.suspects() if c in cohort]
+        if not suspects:
+            suspects = cohort
+        if self._quarantine is not None:
+            # quarantining must leave the re-run a cohort: when the
+            # suspects cover every remaining client, skip the quarantine
+            # and let the bounded rollback budget decide — an abort
+            # beats a federation with nobody to select
+            pool = self._quarantine.filter_selection(
+                [c for c in range(1, self.client_num + 1)
+                 if c not in set(suspects)], round_idx)
+            if pool:
+                for cid in suspects:
+                    self._quarantine.quarantine(
+                        cid, round_idx, f"round {round_idx} rolled "
+                        f"back: {reason}")
+            else:
+                logger.warning(
+                    "rollback suspects %s cover every remaining client — "
+                    "re-running unquarantined (bounded by max_rollbacks)",
+                    suspects)
+        if self._journal is not None:
+            # the rolled-back round's journaled uploads must never be
+            # salvaged: record the rollback (durable), then reset to the
+            # round boundary — a crash here resumes at the restored
+            # checkpoint and re-runs the round cleanly
+            self._journal.append("round_rolled_back", round=round_idx,
+                                 reason=str(reason),
+                                 suspects=[int(c) for c in suspects])
+            self._journal.reset()
+        logger.warning(
+            "round %d rolled back to %s; suspects %s quarantined — "
+            "re-running the round with a fresh cohort", round_idx,
+            restored_from, suspects)
+        # re-run the SAME round index with the quarantine applied: the
+        # selection below excludes the suspects, the broadcast re-derives
+        # from the restored params under the same seeded encode key
+        self._select_round_clients()
+        payload = self._broadcast_payload(
+            self.aggregator.get_global_model_params())
+        sa_header = self._secagg_round_header()
+        self._capture_round_state()
+        with self._round_lock:
+            self._round_closed = False
+            self._deadline_expired = False
+            self._deadline_extensions_used = 0
+            self._completing = False
+            self._screened_out = set()
+        self._journal_round_open()
+        with telemetry.get_tracer().span(
+            f"round/{round_idx}/sync",
+            n_clients=len(self.client_id_list_in_this_round),
+        ):
             self._send_round_config(self.client_id_list_in_this_round,
                                     payload, sa_header, init=False)
         self._arm_round_deadline()
@@ -929,6 +1253,7 @@ class FedMLServerManager(FedMLCommManager):
         if sal is None:  # pragma: no cover - guarded by the caller
             return
         cohort = list(sal.cohort)
+        self._capture_round_state()
         with self._round_lock:
             self.client_id_list_in_this_round = cohort
             self.data_silo_index_of_client = dict(sal.silo_index)
@@ -938,6 +1263,7 @@ class FedMLServerManager(FedMLCommManager):
             self._deadline_expired = sal.closed
             self._deadline_extensions_used = 0
             self._completing = False
+            self._screened_out = set()
         # re-derive the broadcast (same params, same seeded encode key)
         # so the delta base matches what the clients decoded pre-crash
         payload = self._broadcast_payload(

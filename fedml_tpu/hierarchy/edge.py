@@ -59,12 +59,17 @@ class EdgeAggregator:
     """
 
     def __init__(self, tier: int, node_id: int, child_ids: Sequence[int],
-                 codec: Codec, quorum_frac: float = 1.0):
+                 codec: Codec, quorum_frac: float = 1.0,
+                 agg_robust: Optional[str] = None):
         self.tier = int(tier)
         self.node_id = int(node_id)
         self.child_ids = [int(c) for c in child_ids]
         self.codec = codec
         self.quorum_frac = float(quorum_frac)
+        # Byzantine-robust tier reduction (integrity ring 2): close the
+        # cohort with the fused coordinate-wise trimmed mean / median of
+        # the children's partial sums instead of their weighted mean
+        self.agg_robust = str(agg_robust) if agg_robust else None
         self._evicted: set = set()
         self._buffer: Dict[int, PartialSum] = {}
         self._round: Optional[int] = None
@@ -219,8 +224,8 @@ class EdgeAggregator:
         if closed is None:
             return None, missing
         contribs, counts = closed
-        return reduce_cohort(contribs, self.codec, key,
-                             counts=counts), missing
+        return reduce_cohort(contribs, self.codec, key, counts=counts,
+                             agg_robust=self.agg_robust), missing
 
     def close_round_root(self) -> Tuple[Optional[Pytree], float, List[int]]:
         """Root variant: decode the global mean instead of re-encoding —
@@ -232,7 +237,7 @@ class EdgeAggregator:
         if closed is None:
             return None, 0.0, missing
         contribs, _ = closed
-        mean, total = finalize_root(contribs)
+        mean, total = finalize_root(contribs, agg_robust=self.agg_robust)
         return mean, total, missing
 
     def readmit(self, child_id: int) -> bool:
@@ -248,16 +253,21 @@ class EdgeAggregator:
 
 
 # -- leaf tier: fused chunked reduction ------------------------------------
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _leaf_chunk_program(codec: Codec, meta, delta_fn: DeltaFn, ef: bool,
+                        agg: str, trim: float,
                         key_data, weights, residuals):
-    """generate → (EF) → encode → dequant-fused weighted SUM, one program.
+    """generate → (EF) → encode → dequant-fused reduction, one program.
 
     ``key_data`` [C, …] per-client PRNG key data, ``weights`` [C] f32
     (0 for dead/padded slots), ``residuals`` tuple of [C, …] stacked EF
-    leaves (empty tuple when ``ef`` is False). Returns the cohort's
-    *unnormalized* weighted-sum leaves plus the new stacked residuals —
-    per-client f32 deltas and decoded blocks are XLA temporaries only.
+    leaves (empty tuple when ``ef`` is False). With ``agg='mean'``
+    returns the cohort's *unnormalized* weighted-sum leaves; with a
+    robust mode (``'trimmed_mean'``/``'median'`` — integrity ring 2)
+    the coordinate-wise robust statistic over the live (weight > 0)
+    clients, which is already the cohort MEAN — dead/padded slots are
+    masked rows in the sort, not shape changes. Per-client f32 deltas
+    and decoded blocks are XLA temporaries only, either way.
     """
 
     def per_client(kd, res):
@@ -282,11 +292,26 @@ def _leaf_chunk_program(codec: Codec, meta, delta_fn: DeltaFn, ef: bool,
         enc_stacked, new_res = jax.vmap(
             lambda kd: per_client(kd, ()))(key_data)
     w = weights.astype(jnp.float32)
-    summed = tuple(
-        codec.weighted_sum_leaf(parts, w, dt, sh)
-        if _is_float_meta(dt) else _raw_weighted_sum(parts[0], w)
-        for parts, (dt, sh) in zip(enc_stacked, meta))
-    return summed, new_res
+    if agg == "mean":
+        summed = tuple(
+            codec.weighted_sum_leaf(parts, w, dt, sh)
+            if _is_float_meta(dt) else _raw_weighted_sum(parts[0], w)
+            for parts, (dt, sh) in zip(enc_stacked, meta))
+        return summed, new_res
+    from fedml_tpu.integrity.robust_agg import masked_robust_leaf
+
+    valid = w > 0
+    out = []
+    for parts, (dt, sh) in zip(enc_stacked, meta):
+        if _is_float_meta(dt):
+            dec = jax.vmap(
+                lambda *p, dt=dt, sh=sh: codec.decode_leaf(p, dt, sh)
+            )(*parts).astype(jnp.float32)
+        else:
+            dec = parts[0].astype(jnp.float32)
+        out.append(masked_robust_leaf(dec, valid, agg, trim).astype(
+            jnp.float32))
+    return tuple(out), new_res
 
 
 # cataloged: the hierarchy tier's hot program — one variant per
@@ -295,7 +320,7 @@ from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
 
 _leaf_chunk_program = _wrap_jit(
     "hierarchy/leaf_chunk", _leaf_chunk_program,
-    static_argnums=(0, 1, 2, 3), multi_shape=True)
+    static_argnums=(0, 1, 2, 3, 4, 5), multi_shape=True)
 
 
 class LeafCohort:
@@ -312,7 +337,8 @@ class LeafCohort:
     def __init__(self, tier: int, edge_id: int, client_ids: np.ndarray,
                  codec: Codec, meta, delta_fn: DeltaFn, seed: int,
                  chunk: int = 2048, ef: bool = False,
-                 weights: Optional[np.ndarray] = None):
+                 weights: Optional[np.ndarray] = None,
+                 agg_robust: Optional[str] = None):
         self.tier = int(tier)
         self.edge_id = int(edge_id)
         self.client_ids = np.asarray(client_ids, np.int64)
@@ -321,6 +347,20 @@ class LeafCohort:
         self.delta_fn = delta_fn
         self.seed = int(seed)
         n = len(self.client_ids)
+        # Byzantine-robust cohort reduction (integrity ring 2): the
+        # chunk program computes the coordinate-wise robust statistic
+        # instead of the weighted sum. A robust statistic is NOT
+        # chunk-decomposable (the per-coordinate sort needs every
+        # client), so the cohort is forced into ONE chunk — robust leaf
+        # cohorts are a bounded-cohort mode, same as ef=True.
+        self._robust = None
+        if agg_robust:
+            from fedml_tpu.integrity import parse_robust_spec
+
+            self._robust = parse_robust_spec(agg_robust)
+        self.returns_mean = self._robust is not None
+        if self._robust is not None:
+            chunk = _next_pow2(n)
         # bucket the chunk to the cohort: padding a 316-client cohort to
         # a 4096-slot program is 13x wasted compute; the power-of-2
         # bucket keeps near-identical cohort sizes (316 vs 317) on ONE
@@ -376,7 +416,9 @@ class LeafCohort:
         ``alive_local`` is the boolean per-client liveness mask for this
         round (chaos); evicted clients are excluded regardless. Returns
         ``(sum_leaves, total_weight, n_received)`` — sum_leaves is None
-        when nobody reported.
+        when nobody reported. With ``agg_robust`` (``returns_mean``) the
+        leaves are already the cohort's robust MEAN (single-chunk by
+        construction) and the caller must not divide by the weight.
         """
         live = np.asarray(alive_local, bool) & ~self.evicted_mask
         n = len(self.client_ids)
@@ -401,9 +443,11 @@ class LeafCohort:
                     for r in self._residuals)
             else:
                 res = ()
+            agg, trim = (("mean", 0.0) if self._robust is None
+                         else self._robust)
             summed, new_res = _leaf_chunk_program(
                 self.codec, self.meta, self.delta_fn, self.ef,
-                jnp.asarray(kd), jnp.asarray(w), res)
+                agg, trim, jnp.asarray(kd), jnp.asarray(w), res)
             if self.ef:
                 # only clients that actually trained advance their
                 # residual; dead/evicted ones keep their state

@@ -109,27 +109,62 @@ def _weighted_mean(contribs: Sequence[Tuple[CompressedTree, float]]) -> Tuple[
     return mean, total
 
 
+def _robust_mean(contribs: Sequence[Tuple[CompressedTree, float]],
+                 agg_robust: str) -> Tuple[Pytree, float]:
+    """Coordinate-wise robust statistic over the cohort's contributions.
+
+    Per-tier Byzantine robustness: at an interior tier the contributions
+    are the children's cohort MEANS, so a poisoned subtree's mean is an
+    outlier among its siblings and the trimmed mean / median discards
+    it — same fused contract as :func:`_weighted_mean` (one jitted
+    program, no per-contributor f32 trees), but deliberately unweighted:
+    a subtree claiming a huge accumulated weight is exactly the lever
+    robustness removes. The accumulated weight still flows up for
+    bookkeeping and leaf-count diagnostics.
+    """
+    from fedml_tpu.integrity import fused_robust_sum, parse_robust_spec
+
+    if not contribs:
+        raise ValueError("empty cohort: nothing to reduce")
+    mode, trim = parse_robust_spec(agg_robust)
+    total = float(np.sum([w for _, w in contribs], dtype=np.float64))
+    if total <= 0:
+        raise ValueError(f"cohort weights must sum > 0, got {total}")
+    return fused_robust_sum([ct for ct, _ in contribs], mode, trim), total
+
+
 def reduce_cohort(contribs: Sequence[Tuple[CompressedTree, float]],
                   out_codec: Codec, key,
-                  counts: Optional[Sequence[int]] = None) -> PartialSum:
+                  counts: Optional[Sequence[int]] = None,
+                  agg_robust: Optional[str] = None) -> PartialSum:
     """Reduce one cohort's compressed contributions into a PartialSum.
 
     ``contribs`` are ``(CompressedTree, weight)`` pairs — leaf-client
     deltas at the bottom tier, child PartialSum.ct's anywhere above. The
-    dequant-fused weighted mean and the re-encode each run as one jitted
-    program; nothing per-contributor ever exists in f32.
+    dequant-fused weighted mean (or, with ``agg_robust``, the fused
+    coordinate-wise robust statistic) and the re-encode each run as one
+    jitted program; nothing per-contributor ever exists in f32. This is
+    the "dequant-sort-trim-requant" tier step: the robust mean re-encodes
+    for the uplink exactly like the weighted mean does.
     """
-    mean, total = _weighted_mean(contribs)
+    if agg_robust:
+        mean, total = _robust_mean(contribs, agg_robust)
+    else:
+        mean, total = _weighted_mean(contribs)
     is_delta = contribs[0][0].is_delta
     ct = out_codec.encode(mean, key=key, is_delta=is_delta)
     count = int(sum(counts)) if counts is not None else len(contribs)
     return PartialSum(ct, total, count)
 
 
-def finalize_root(contribs: Sequence[Tuple[CompressedTree, float]]) -> Tuple[
+def finalize_root(contribs: Sequence[Tuple[CompressedTree, float]],
+                  agg_robust: Optional[str] = None) -> Tuple[
         Pytree, float]:
-    """Close the global round: fused weighted mean of the top-tier partial
-    sums, decoded exactly once — the only full f32 tree of the round."""
+    """Close the global round: fused weighted mean (or robust statistic)
+    of the top-tier partial sums, decoded exactly once — the only full
+    f32 tree of the round."""
+    if agg_robust:
+        return _robust_mean(contribs, agg_robust)
     mean, total = _weighted_mean(contribs)
     return mean, total
 

@@ -133,7 +133,11 @@ class TreeRunner:
                  secagg: bool = False,
                  secagg_clip: float = 0.1,
                  secagg_mod_bits: int = 8,
-                 durability_dir: Optional[str] = None):
+                 durability_dir: Optional[str] = None,
+                 agg_robust: Optional[str] = None,
+                 screen: bool = False):
+        from fedml_tpu.resilience.chaos import CorruptUpdateWindow
+
         self.topology = topology
         self.codec = get_codec(codec)
         if self.codec is None:
@@ -141,12 +145,43 @@ class TreeRunner:
                              "an uncompressed wire")
         self.seed = int(seed)
         self.quorum = float(quorum)
+        # update integrity: agg_robust closes EVERY tier's cohort with
+        # the fused coordinate-wise robust statistic; screen=True arms
+        # per-tier admission screening of the partial sums that travel
+        # between tiers (corrupt uplinks are refused at the next tier up)
+        self.agg_robust = None
+        if agg_robust:
+            from fedml_tpu.integrity import parse_robust_spec
+
+            parse_robust_spec(agg_robust)  # validate, fail loudly
+            if secagg:
+                raise ValueError(
+                    "agg_robust cannot run under per-cohort secagg — "
+                    "per-coordinate sorting needs the per-client values "
+                    "the masks hide")
+            self.agg_robust = str(agg_robust)
+        self._screens: Dict[int, Any] = {}
+        if screen:
+            if secagg:
+                raise ValueError(
+                    "per-tier screening cannot run under secagg (masked "
+                    "partials are opaque by design)")
+            from fedml_tpu.integrity import UpdateScreen
+
+            # one screen per tier: the norm-overflow baseline must not
+            # mix leaf-delta norms with interior cohort-mean norms
+            self._screens = {
+                d: UpdateScreen() for d in range(topology.n_tiers)}
         # EdgeKillWindows (crash-and-journal-restart) are a different
-        # fault class than KillWindows (absent for the window)
+        # fault class than KillWindows (absent for the window);
+        # CorruptUpdateWindows poison a node's UPLINK at the comm seam
+        self.corrupts = [k for k in (chaos or [])
+                         if isinstance(k, CorruptUpdateWindow)]
         self.edge_kills = [k for k in (chaos or [])
                            if isinstance(k, EdgeKillWindow)]
         self.chaos = [k for k in (chaos or [])
-                      if not isinstance(k, EdgeKillWindow)]
+                      if not isinstance(k, (EdgeKillWindow,
+                                            CorruptUpdateWindow))]
         self.durability_dir = durability_dir
         if self.edge_kills and not durability_dir:
             raise ValueError(
@@ -199,7 +234,8 @@ class TreeRunner:
             else:
                 self.cohorts.append(LeafCohort(
                     L, e, cids, self.codec, self.meta, self.delta_fn,
-                    self.seed, chunk=chunk, ef=ef))
+                    self.seed, chunk=chunk, ef=ef,
+                    agg_robust=self.agg_robust))
         # interior aggregators for tiers 0..L-2 (children are tier d+1
         # node indices; the tier L-1 edges' children are their cohorts,
         # handled vectorized above)
@@ -207,7 +243,8 @@ class TreeRunner:
         for d in range(0, L - 1):
             self.aggregators[d] = [
                 EdgeAggregator(d, i, topology.children(d, i).tolist(),
-                               self.codec, self.quorum)
+                               self.codec, self.quorum,
+                               agg_robust=self.agg_robust)
                 for i in range(topology.levels[d])
             ]
         if self.durability_dir:
@@ -254,6 +291,49 @@ class TreeRunner:
                               "tier": tier, **fields})
         except Exception:  # pragma: no cover - observability must not kill
             logger.exception("tier event logging failed")
+
+    def _maybe_corrupt(self, tier: int, node: int, round_idx: int,
+                       ps: PartialSum, reg) -> PartialSum:
+        """CorruptUpdateWindow seam: poison node ``(tier, node)``'s
+        UPLINK partial sum for the window — the tree's comm seam, where
+        a hostile or sick aggregator would land its damage."""
+        from fedml_tpu.resilience.chaos import corrupt_model_payload
+
+        for w in self.corrupts:
+            if (w.tier == tier and w.rank == node
+                    and w.round <= round_idx < w.until):
+                reg.counter("resilience/chaos_injections",
+                            labels={"action": "corrupt_update"}).inc()
+                ps = PartialSum(
+                    corrupt_model_payload(ps.ct, w.mode, w.factor),
+                    ps.weight, ps.count)
+        return ps
+
+    def _screen_partials(self, tier: int, round_idx: int,
+                         partials: Dict[int, PartialSum],
+                         reg) -> Dict[int, PartialSum]:
+        """Per-tier admission screen (integrity ring 1): a corrupt
+        partial sum is refused at the tier ABOVE its producer — the
+        producer counts as missing for the round, so the quorum/evict
+        machinery reweights its whole subtree out."""
+        screen = self._screens.get(tier)
+        if screen is None:
+            return partials
+        admitted: Dict[int, PartialSum] = {}
+        for node, ps in sorted(partials.items()):
+            reason = screen.admit(node, round_idx, ps.ct)
+            if reason is not None:
+                self._event("upload_screened", tier,
+                            reg.counter(f"tier/{tier}/screened"), 1,
+                            round=round_idx, node=node, reason=reason)
+                continue
+            admitted[node] = ps
+        for node, reason in screen.close_round(round_idx).items():
+            if admitted.pop(node, None) is not None:
+                self._event("upload_screened", tier,
+                            reg.counter(f"tier/{tier}/screened"), 1,
+                            round=round_idx, node=node, reason=reason)
+        return admitted
 
     def _restart_edge(self, round_idx: int, tier: int, node: int,
                       dead: EdgeAggregator, reg) -> EdgeAggregator:
@@ -335,13 +415,20 @@ class TreeRunner:
                             reg.counter(f"tier/{L - 1}/quorum_closes"), 1,
                             round=round_idx, node=e, received=n_recv,
                             expected=expected)
-            mean = jax.tree.unflatten(
-                self._treedef,
-                [s / jnp.float32(total_w) for s in sum_leaves])
+            if getattr(cohort, "returns_mean", False):
+                # robust cohorts reduce straight to the coordinate-wise
+                # statistic — already the mean, no division
+                mean = jax.tree.unflatten(
+                    self._treedef, [jnp.asarray(s) for s in sum_leaves])
+            else:
+                mean = jax.tree.unflatten(
+                    self._treedef,
+                    [s / jnp.float32(total_w) for s in sum_leaves])
             key = derive_key(self.seed, round_idx,
                              _EDGE_KEY_BASE + ((L - 1) << 20) + e)
             ct = self.codec.encode(mean, key=key, is_delta=True)
-            partials[e] = PartialSum(ct, total_w, n_recv)
+            partials[e] = self._maybe_corrupt(
+                L - 1, e, round_idx, PartialSum(ct, total_w, n_recv), reg)
             upload_bytes += n_recv * self.per_client_wire_nbytes
             peak_chunk_bytes = max(
                 peak_chunk_bytes,
@@ -361,6 +448,11 @@ class TreeRunner:
                         reg) -> Dict[int, PartialSum]:
         """One interior tier: children's partials → this tier's partials."""
         dead_here = self._dead(tier + 1, round_idx)  # children that died
+        # ring 1 at this tier's ingress: corrupt child uplinks are
+        # refused before any aggregator buffers them — the child is
+        # simply missing this round (quorum close handles the rest)
+        child_partials = self._screen_partials(
+            tier + 1, round_idx, child_partials, reg)
         out: Dict[int, PartialSum] = {}
         upload_bytes = 0
         for node, agg in enumerate(self.aggregators[tier]):
@@ -428,7 +520,8 @@ class TreeRunner:
                                 1,
                                 round=round_idx, node=node,
                                 received=received, expected=len(expected))
-                out[node] = ps
+                out[node] = self._maybe_corrupt(tier, node, round_idx,
+                                                ps, reg)
             self._tier_peak_buffer[tier] = max(
                 self._tier_peak_buffer.get(tier, 0),
                 agg.peak_buffered_nbytes)
@@ -476,6 +569,7 @@ class TreeRunner:
             "levels": list(topo.levels),
             "rounds": int(rounds),
             "codec": self.codec.spec,
+            "agg_robust": self.agg_robust,
             "secagg": self.secagg,
             "seed": self.seed,
             "quorum": self.quorum,
@@ -503,7 +597,9 @@ class TreeRunner:
             partials = self._leaf_round(r, reg)
             if L == 1:
                 # 2-tier degenerate tree: the root IS the single leaf
-                # cohort's edge — decode its partial directly
+                # cohort's edge — decode its partial directly (screened
+                # first: the root is this partial's consuming tier)
+                partials = self._screen_partials(0, r, partials, reg)
                 if 0 not in partials:
                     raise RuntimeError(
                         f"global round {r} below quorum at the root "

@@ -1,0 +1,104 @@
+"""Update-integrity containment — the layer that survives a *bad update*.
+
+Every failure mode the resilience stack hardens (PR 5 quorum/dropout,
+PR 9 SecAgg recovery, PR 12 journal, PR 13 preemption) is a *process*
+failure; this package defends the MODEL against a corrupt or hostile
+update — a client shipping NaN/Inf blocks, a diverging loss, or a
+poisoned delta. Three concentric rings, all on the fused compressed
+aggregation path (classic robust-aggregation results compose naturally
+with the block-quantized wire: Krum, Blanchard et al. 2017;
+coordinate-wise median / trimmed mean, Yin et al. 2018):
+
+- :mod:`screen` — **ring 1, admission**: every upload is screened in
+  the compressed domain inside one jitted program (non-finite blocks /
+  scales, norm overflow vs the cohort median, per-block robust-z
+  outliers read straight off int8 blocks × scales — no f32
+  materialization). Flagged uploads are dropped-and-counted like PR 5
+  stale uploads and their senders quarantined.
+- :mod:`robust_agg` — **ring 2, aggregation**: coordinate-wise trimmed
+  mean and median as dequant-fused alternatives to the weighted mean —
+  one jitted reduction over the stacked blocks, so the reference's
+  ``requires_full_trees()`` decode fallback is no longer the price of a
+  robust aggregate.
+- :mod:`rollback` — **ring 3, acceptance**: a post-aggregate guard
+  (non-finite params, eval-loss spike vs EWMA history) that restores
+  the last committed round state, quarantines the suspects, journals a
+  ``round_rolled_back`` record and re-runs the round with a fresh
+  cohort — bounded by ``max_rollbacks`` with a loud abort.
+
+:mod:`quarantine` holds the :class:`QuarantineList` both outer rings
+feed; it composes with the PR 5 evict/probe/rejoin machinery — a
+quarantined client that rejoins stays excluded from selection until its
+``quarantine_rounds`` elapse.
+
+Everything lands in the ``integrity/*`` metric namespace (one segment,
+counter/gauge only — lint-enforced) plus ``integrity_event`` records in
+``health.jsonl`` and the flight recorder, which is what ``telemetry
+doctor``'s "update integrity" section reads. See ``docs/integrity.md``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from fedml_tpu.integrity.quarantine import QuarantineList
+from fedml_tpu.integrity.robust_agg import (
+    fused_robust_sum,
+    parse_robust_spec,
+    resolve_agg_robust,
+)
+from fedml_tpu.integrity.rollback import AcceptanceGuard, RollbackBudgetExceeded
+from fedml_tpu.integrity.screen import UpdateScreen, screen_stats
+
+
+class IntegrityConfig:
+    """The integrity knobs, read once off the flat args namespace.
+
+    ``integrity: true`` arms rings 1 and 3 together; each ring can be
+    toggled individually (``integrity_screen`` / ``integrity_rollback``).
+    Ring 2 is selected by ``agg_robust`` (or an active fused defense) —
+    see :func:`resolve_agg_robust`. Defaults keep pre-subsystem behavior:
+    everything off.
+    """
+
+    def __init__(self, args: Any = None):
+        g = lambda k, d: getattr(args, k, d) if args is not None else d
+        master = bool(g("integrity", False))
+        self.screen_enabled = bool(g("integrity_screen", master))
+        self.rollback_enabled = bool(g("integrity_rollback", master))
+        # ring 1: an upload whose norm exceeds mult × the running cohort
+        # median is an overflow; a per-block robust z past the threshold
+        # is an outlier (8.0 is deliberately far past the health
+        # tracker's 4.0 ANOMALY threshold — screening DROPS data, so it
+        # must only fire on updates no honest client produces)
+        self.norm_mult = float(g("integrity_norm_mult", 10.0))
+        self.z_threshold = float(g("integrity_z_threshold", 8.0))
+        # quarantine: rounds a flagged sender sits out of selection
+        self.quarantine_rounds = int(g("quarantine_rounds", 2))
+        # ring 3: eval-loss spike factor vs the accepted-rounds EWMA, the
+        # history needed before the spike rule can fire, and the rollback
+        # budget before the federation aborts loudly
+        self.loss_mult = float(g("integrity_loss_mult", 2.0))
+        self.loss_min_history = int(g("integrity_loss_min_history", 1))
+        self.max_rollbacks = int(g("max_rollbacks", 2))
+
+    @property
+    def any_enabled(self) -> bool:
+        return self.screen_enabled or self.rollback_enabled
+
+    @classmethod
+    def from_args(cls, args: Any) -> Optional["IntegrityConfig"]:
+        cfg = cls(args)
+        return cfg if cfg.any_enabled else None
+
+
+__all__ = [
+    "AcceptanceGuard",
+    "IntegrityConfig",
+    "QuarantineList",
+    "RollbackBudgetExceeded",
+    "UpdateScreen",
+    "fused_robust_sum",
+    "parse_robust_spec",
+    "resolve_agg_robust",
+    "screen_stats",
+]

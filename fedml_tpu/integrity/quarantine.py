@@ -1,0 +1,106 @@
+"""QuarantineList — time-boxed exclusion that composes with evict/rejoin.
+
+Eviction (PR 5) answers "is this peer ALIVE?"; quarantine answers "is
+this peer TRUSTED?". The two are orthogonal by design: a screened-out
+client is usually evicted too (its upload went missing for the round),
+then probed, then readmitted on its next sign of life — but readmission
+only restores *liveness*. Selection asks the quarantine list as well,
+so the client keeps sitting out until its ``quarantine_rounds`` elapse,
+and its first post-quarantine selection goes through the normal rejoin
+resync (fresh model, EF residual reset — exactly a rejoiner's state).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["QuarantineList"]
+
+
+class QuarantineList:
+    """client → round the quarantine expires (exclusive).
+
+    A client quarantined at round ``r`` for ``rounds`` sits out
+    selections for rounds ``r+1 .. r+rounds`` and becomes selectable at
+    ``r+rounds+1``. Re-quarantining extends, never shortens. Thread-safe
+    (comm receive thread + deadline timer thread both flag senders).
+    """
+
+    def __init__(self, rounds: int = 2, registry=None):
+        from fedml_tpu.telemetry.registry import get_registry
+
+        self.rounds = int(rounds)
+        self._reg = registry or get_registry()
+        self._lock = threading.Lock()
+        self._until: Dict[Any, int] = {}
+        self._reason: Dict[Any, str] = {}
+
+    def quarantine(self, client: Any, round_idx: int,
+                   reason: str = "") -> bool:
+        """Quarantine ``client`` as of ``round_idx``; False if an equal
+        or longer quarantine was already in place."""
+        from fedml_tpu.telemetry import flight_recorder
+        from fedml_tpu.telemetry.health import log_health_event
+
+        until = int(round_idx) + self.rounds
+        with self._lock:
+            if self._until.get(client, -1) >= until:
+                return False
+            self._until[client] = until
+            self._reason[client] = str(reason)
+            active = len(self._until)
+        self._reg.counter("integrity/quarantined").inc()
+        self._reg.gauge("integrity/quarantine_active").set(active)
+        rec = {"kind": "integrity_event", "event": "quarantined",
+               "client": client, "round": int(round_idx),
+               "until_round": until, "reason": str(reason)}
+        try:
+            log_health_event(rec)
+        except Exception:  # pragma: no cover - observability must not kill
+            logger.exception("quarantine event logging failed")
+        flight_recorder.record("integrity_event", event="quarantined",
+                               client=client, round=int(round_idx),
+                               until_round=until, reason=str(reason))
+        logger.warning("client %s QUARANTINED until round %d: %s",
+                       client, until, reason)
+        return True
+
+    def is_quarantined(self, client: Any, round_idx: int) -> bool:
+        with self._lock:
+            until = self._until.get(client)
+        return until is not None and int(round_idx) <= until
+
+    def active(self, round_idx: int) -> List[Any]:
+        """Clients quarantined at ``round_idx`` (expired entries are
+        dropped — release is implicit, no message round-trip)."""
+        released = []
+        with self._lock:
+            for c in [c for c, u in self._until.items()
+                      if u < int(round_idx)]:
+                self._until.pop(c, None)
+                self._reason.pop(c, None)
+                released.append(c)
+            out = sorted(self._until, key=str)
+            active = len(self._until)
+        if released:
+            self._reg.counter("integrity/quarantine_released").inc(
+                len(released))
+            self._reg.gauge("integrity/quarantine_active").set(active)
+            logger.info("quarantine released for %s at round %d",
+                        released, round_idx)
+        return out
+
+    def reason(self, client: Any) -> Optional[str]:
+        with self._lock:
+            return self._reason.get(client)
+
+    def filter_selection(self, candidates: List[Any],
+                         round_idx: int) -> List[Any]:
+        """Selection hook: candidates minus the active quarantine."""
+        q = set(self.active(round_idx))
+        if not q:
+            return list(candidates)
+        return [c for c in candidates if c not in q]

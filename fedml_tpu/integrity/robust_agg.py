@@ -1,0 +1,252 @@
+"""Ring 2 — Byzantine-robust aggregation fused into the compressed domain.
+
+Coordinate-wise trimmed mean and median (Yin et al., ICML'18) as
+drop-in alternatives to the weighted mean of
+:func:`fedml_tpu.compression.fused_weighted_sum`: the stacked client
+blocks reduce inside ONE jitted program (``integrity/robust_agg`` in
+the program catalog) — per-block dequant → sort along the client axis →
+trim → mean — so the server never materializes N decoded f32 client
+trees. Dequantized values exist only as XLA temporaries inside the
+reduction, the same contract every fused path in this repo holds; the
+host-visible peak is the stacked int8 blocks (wire size) plus the one
+aggregated f32 tree.
+
+These statistics are SHIFT-EQUIVARIANT, which is why they compose with
+the delta wire: ``median_i(g + d_i) = g + median_i(d_i)`` (likewise the
+trimmed mean), so the robust statistic of the *deltas* plus the global
+equals the reference defenses' statistic of the full client *models* —
+up to quantization, which the acceptance tests bound. They are also
+deliberately UNWEIGHTED: an ``n_k``-weighted robust statistic would
+hand a poisoner back the exact lever (claim a huge sample count) the
+robustness exists to remove.
+
+The spec (``agg_robust: trimmed_mean@0.1 | median``) rides the
+round-config negotiation header exactly like the PR 3 codec spec, so
+every aggregation point of a federation — server, or any tier of an
+aggregation tree — applies the same statistic.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.compression.codecs import (
+    Codec,
+    CompressedTree,
+    _is_float_meta,
+    get_codec,
+)
+
+Pytree = Any
+
+__all__ = [
+    "ROBUST_MODES",
+    "fused_robust_sum",
+    "masked_robust_leaf",
+    "parse_robust_spec",
+    "resolve_agg_robust",
+    "robust_reduce_leaf",
+    "robust_spec_str",
+    "trim_k",
+]
+
+ROBUST_MODES = ("trimmed_mean", "median")
+
+
+def parse_robust_spec(spec: Any) -> Optional[Tuple[str, float]]:
+    """``'trimmed_mean@0.1' | 'trimmed_mean' | 'median' | '' → None``.
+
+    Returns ``(mode, trim_fraction)``; the fraction is per side and only
+    meaningful for ``trimmed_mean``. Unknown modes and malformed or
+    out-of-range fractions raise ``ValueError`` — a misheard negotiation
+    header must fail loudly, not silently average.
+    """
+    spec = str(spec or "").strip().lower()
+    if spec in ("", "none", "off"):
+        return None
+    base, _, param = spec.partition("@")
+    if base not in ROBUST_MODES:
+        raise ValueError(
+            f"unknown agg_robust mode {base!r}; "
+            f"available: {', '.join(ROBUST_MODES)}")
+    if base == "median":
+        if param:
+            raise ValueError(f"agg_robust median takes no parameter ({spec!r})")
+        return ("median", 0.0)
+    trim = 0.1
+    if param:
+        try:
+            trim = float(param)
+        except ValueError:
+            raise ValueError(
+                f"malformed trim fraction in agg_robust spec {spec!r}"
+            ) from None
+    if not 0.0 < trim < 0.5:
+        raise ValueError(
+            f"agg_robust trim fraction must be in (0, 0.5), got {trim}")
+    return ("trimmed_mean", trim)
+
+
+def robust_spec_str(mode: str, trim: float) -> str:
+    """The negotiation-header form (inverse of :func:`parse_robust_spec`)."""
+    return "median" if mode == "median" else f"trimmed_mean@{trim:g}"
+
+
+def trim_k(n: int, trim: float) -> int:
+    """Per-side trim count for an ``n``-client cohort — the SAME rule the
+    reference :class:`TrimmedMeanDefense` applies, so the fused path and
+    the decode-fallback defense agree on which ranks are discarded."""
+    return min(int(float(trim) * int(n)), (int(n) - 1) // 2)
+
+
+def masked_robust_leaf(dec: jax.Array, valid: jax.Array, mode: str,
+                       trim: float) -> jax.Array:
+    """Traced robust statistic over axis 0 with a validity mask.
+
+    The fixed-shape twin of :func:`robust_reduce_leaf` for compiled
+    cohort programs where dead/padded slots are weight-masks, not shape
+    changes (the PR 6 leaf-chunk contract): invalid rows sort to the
+    end behind a big sentinel and the statistic is computed over the
+    traced valid count — same +1e-4 truncation guard as the reference
+    ``TrimmedMeanDefense.defend_stacked`` so f32 ``trim·nv`` landing
+    just under an exact integer can't disagree with the host path.
+    """
+    big = jnp.float32(3.0e38)
+    nv = jnp.sum(valid.astype(jnp.int32))
+    vcol = valid.reshape((-1,) + (1,) * (dec.ndim - 1))
+    s = jnp.sort(jnp.where(vcol, dec, big), axis=0)
+    if mode == "median":
+        lo = (nv - 1) // 2
+        hi = nv // 2
+        return 0.5 * (jnp.take(s, lo, axis=0) + jnp.take(s, hi, axis=0))
+    k = jnp.minimum((jnp.float32(trim) * nv + 1e-4).astype(jnp.int32),
+                    (nv - 1) // 2)
+    rank = jnp.arange(dec.shape[0]).reshape((-1,) + (1,) * (dec.ndim - 1))
+    keep = (rank >= k) & (rank < nv - k)
+    denom = jnp.maximum(nv - 2 * k, 1).astype(jnp.float32)
+    return jnp.sum(jnp.where(keep, s, 0.0), axis=0) / denom
+
+
+def robust_reduce_leaf(dec: jax.Array, mode: str, k: int) -> jax.Array:
+    """Traced robust statistic over axis 0 of dequantized [C, ...] values.
+
+    ``jnp.median`` semantics for even counts (mean of the two middles);
+    trimmed mean discards ``k`` per side then averages the rest.
+    """
+    if mode == "median":
+        return jnp.median(dec, axis=0)
+    xs = jnp.sort(dec, axis=0)
+    n = dec.shape[0]
+    kept = jax.lax.slice_in_dim(xs, k, n - k, axis=0)
+    return jnp.mean(kept, axis=0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _robust_agg_program(codec: Codec, meta, mode: str, k: int, stacked):
+    """Per-block dequant-sort-trim as ONE program over all leaves."""
+    out = []
+    for parts, (dt, sh) in zip(stacked, meta):
+        if _is_float_meta(dt):
+            dec = jax.vmap(
+                lambda *p, dt=dt, sh=sh: codec.decode_leaf(p, dt, sh)
+            )(*parts).astype(jnp.float32)
+        else:
+            dec = parts[0].astype(jnp.float32)
+        red = robust_reduce_leaf(dec, mode, k)
+        from fedml_tpu.compression.codecs import _dtype_from_str
+
+        out.append(red.astype(_dtype_from_str(dt)))
+    return tuple(out)
+
+
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
+
+_robust_agg_program = _wrap_jit(
+    "integrity/robust_agg", _robust_agg_program,
+    static_argnums=(0, 1, 2, 3), multi_shape=True)
+
+
+def fused_robust_sum(cts: Sequence[CompressedTree], mode: str,
+                     trim: float = 0.1) -> Pytree:
+    """Coordinate-wise robust statistic of ``decode(ct_i)`` over clients.
+
+    The robust twin of :func:`~fedml_tpu.compression.fused_weighted_sum`
+    — same homogeneity contract, same stacked-block layout, but the
+    reduction is a sort-based statistic instead of an einsum, and there
+    are no weights (see module docstring). Bit-deterministic: two
+    same-seed runs stack identical blocks and sort identically.
+    """
+    if mode not in ROBUST_MODES:
+        raise ValueError(f"unknown robust aggregation mode {mode!r}")
+    if not cts:
+        raise ValueError("empty compressed update list")
+    first = cts[0]
+    for ct in cts[1:]:
+        if (ct.codec != first.codec or ct.version != first.version
+                or ct.meta != first.meta
+                or ct.is_delta != first.is_delta):
+            raise ValueError(
+                "cannot robust-fuse heterogeneous compressed updates "
+                f"({ct.codec}/v{ct.version} vs {first.codec}/"
+                f"v{first.version})")
+    codec = get_codec(first.codec)
+    if getattr(codec, "maskable", False):
+        raise ValueError(
+            "masked (secure-aggregation) updates cannot ride robust "
+            "aggregation — per-coordinate sorting needs per-client "
+            "values, which the masks exist to hide")
+    if codec.name == "topk":
+        raise ValueError(
+            "agg_robust needs dense per-coordinate values; topk updates "
+            "leave most coordinates implicit-zero, which would let a "
+            "sparse poisoner dominate every coordinate it keeps — use "
+            "int8/bf16/identity with robust aggregation")
+    n_leaves = len(first.meta)
+    if any(len(ct.arrays) != n_leaves for ct in cts):
+        raise ValueError("compressed update leaf count mismatch")
+    for ct in cts:
+        codec.check_wire(ct)
+    try:
+        stacked = tuple(
+            tuple(jnp.stack([ct.arrays[j][p] for ct in cts])
+                  for p in range(len(first.arrays[j])))
+            for j in range(n_leaves)
+        )
+    except (ValueError, TypeError) as e:
+        raise ValueError(
+            "compressed update block shapes differ across clients "
+            f"({first.codec}): {e}") from None
+    k = trim_k(len(cts), trim) if mode == "trimmed_mean" else 0
+    flat = _robust_agg_program(codec, first.meta, mode, k, stacked)
+    return jax.tree.map(lambda i: flat[i], first.structure)
+
+
+def resolve_agg_robust(args: Any, codec: Any = None) -> Optional[str]:
+    """The run's robust-aggregation spec, normalized — from an explicit
+    ``agg_robust`` arg, else from an active fused-capable defense
+    (``trimmed_mean`` / ``coordinate_wise_median``), else None.
+
+    ONE definition for every caller (cross-silo server, sp simulation,
+    tree runner), so the negotiation header, the fused reduction and
+    ``requires_full_trees(codec)`` can never disagree about which
+    statistic a run aggregates with. An EXPLICIT spec always resolves
+    (its caller validates codec compatibility and refuses loudly at
+    construction); a DEFENSE-derived spec resolves only when ``codec``
+    is a dense plain codec — uncompressed and top-k runs keep the
+    reference defense on the decode path, exactly as before.
+    """
+    parsed = parse_robust_spec(getattr(args, "agg_robust", ""))
+    if parsed is not None:
+        return robust_spec_str(*parsed)
+    if (codec is None or not getattr(codec, "broadcast_safe", False)
+            or getattr(codec, "maskable", False)):
+        return None
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    defender = FedMLDefender.get_instance()
+    if defender.is_fused_defense():
+        return defender.fused_agg_spec()
+    return None

@@ -1,0 +1,151 @@
+"""Ring 3 — post-aggregate acceptance guard + round rollback bookkeeping.
+
+The last line of defense: an update that slipped both outer rings (or a
+poisoned *cohort* whose members look individually plausible) still has
+to land a model the server will *accept*. After every aggregate the
+guard checks two facts:
+
+- **finiteness** of the new global params (one jitted all-isfinite
+  reduction, one scalar readback — ``integrity/accept_check`` in the
+  program catalog);
+- **eval-loss spike**: the round's eval loss against an EWMA of the
+  accepted-rounds history (``loss > loss_mult × ewma``, armed only once
+  ``min_history`` rounds have been accepted so a cold start can't trip
+  it).
+
+A rejected round is the *caller's* to unwind — restore the last
+committed round state (under durability that state IS the last PR 12
+checkpoint: the journal forces a checkpoint at every commit), quarantine
+the suspects, journal ``round_rolled_back``, re-run with a fresh cohort.
+This class owns the decision and the budget: past ``max_rollbacks``
+consecutive rollbacks it raises :class:`RollbackBudgetExceeded`, which
+every engine turns into a loud federation abort — a persistently
+poisoned federation must die visibly, not oscillate forever.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+__all__ = ["AcceptanceGuard", "RollbackBudgetExceeded", "params_finite"]
+
+
+class RollbackBudgetExceeded(RuntimeError):
+    """More consecutive rollbacks than ``max_rollbacks`` — the poisoning
+    is persistent and containment has failed; abort loudly."""
+
+
+@jax.jit
+def _finite_program(leaves):
+    finite = jnp.asarray(True)
+    for x in leaves:
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            finite = finite & jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+    return finite
+
+
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
+
+_finite_program = _wrap_jit("integrity/accept_check", _finite_program,
+                            multi_shape=True)
+
+
+def params_finite(params: Pytree) -> bool:
+    """All float leaves finite — one program, one scalar readback."""
+    return bool(_finite_program(tuple(jax.tree.leaves(params))))
+
+
+class AcceptanceGuard:
+    """Accept-or-rollback decision per aggregated round."""
+
+    def __init__(self, loss_mult: float = 2.0, min_history: int = 1,
+                 max_rollbacks: int = 2, ewma_alpha: float = 0.3):
+        self.loss_mult = float(loss_mult)
+        self.min_history = max(1, int(min_history))
+        self.max_rollbacks = int(max_rollbacks)
+        self.ewma_alpha = float(ewma_alpha)
+        self._loss_ewma: Optional[float] = None
+        self._accepted = 0
+        # CONSECUTIVE rollbacks — an accepted round proves containment
+        # worked and re-arms the budget
+        self.rollbacks = 0
+        self.total_rollbacks = 0
+
+    def check(self, params: Optional[Pytree],
+              eval_loss: Optional[float] = None) -> Optional[str]:
+        """None = accept; else the rejection reason.
+
+        ``params=None`` skips the finiteness reduction — for a second
+        gate on params a FIRST gate already proved finite this round
+        (the whole-model all-isfinite pass is not free on large models).
+        """
+        if params is not None and not params_finite(params):
+            return "aggregated params contain non-finite values"
+        if eval_loss is not None:
+            try:
+                loss = float(eval_loss)
+            except (TypeError, ValueError):
+                return None
+            if not math.isfinite(loss):
+                return f"eval loss is non-finite ({eval_loss})"
+            if (self._accepted >= self.min_history
+                    and self._loss_ewma is not None
+                    and self._loss_ewma > 0
+                    and loss > self.loss_mult * self._loss_ewma):
+                return (f"eval loss {loss:.4g} spiked past "
+                        f"{self.loss_mult:g}x the accepted-history EWMA "
+                        f"{self._loss_ewma:.4g}")
+        return None
+
+    def accept(self, eval_loss: Optional[float] = None) -> None:
+        """The round passed: fold its loss into the history, re-arm the
+        consecutive-rollback budget."""
+        self._accepted += 1
+        self.rollbacks = 0
+        if eval_loss is not None:
+            try:
+                loss = float(eval_loss)
+            except (TypeError, ValueError):
+                return
+            if math.isfinite(loss):
+                a = self.ewma_alpha
+                self._loss_ewma = (loss if self._loss_ewma is None
+                                   else a * loss + (1 - a) * self._loss_ewma)
+
+    def record_rollback(self, round_idx: int, reason: str) -> None:
+        """Book one rollback; raises past the consecutive budget."""
+        from fedml_tpu.telemetry import flight_recorder
+        from fedml_tpu.telemetry.health import log_health_event
+        from fedml_tpu.telemetry.registry import get_registry
+
+        self.rollbacks += 1
+        self.total_rollbacks += 1
+        get_registry().counter("integrity/rollbacks").inc()
+        rec = {"kind": "integrity_event", "event": "round_rolled_back",
+               "round": int(round_idx), "reason": str(reason),
+               "consecutive": self.rollbacks}
+        try:
+            log_health_event(rec)
+        except Exception:  # pragma: no cover - observability must not kill
+            logger.exception("rollback event logging failed")
+        flight_recorder.record("integrity_event", event="round_rolled_back",
+                               round=int(round_idx), reason=str(reason),
+                               consecutive=self.rollbacks)
+        logger.error("round %d REJECTED (%s) — rolling back to the last "
+                     "accepted state (rollback %d/%d)", round_idx, reason,
+                     self.rollbacks, self.max_rollbacks)
+        if self.rollbacks > self.max_rollbacks:
+            get_registry().counter("integrity/rollback_aborts").inc()
+            raise RollbackBudgetExceeded(
+                f"round {round_idx} rolled back {self.rollbacks} "
+                f"consecutive time(s) (> max_rollbacks="
+                f"{self.max_rollbacks}): the corruption is persistent — "
+                "aborting instead of oscillating")

@@ -1,0 +1,324 @@
+"""Ring 1 — admission screening in the compressed domain.
+
+Every upload is reduced to two facts inside ONE jitted program
+(``integrity/screen`` in the program catalog): *is every block and scale
+finite*, and *what is the per-leaf squared norm* — for int8 read
+straight off the blocks × scales (``scale² · Σq²``), for top-k off the
+kept values, so no per-client f32 tree is ever materialized. The host
+then applies three rules:
+
+- **non-finite**: any NaN/Inf block, scale or leaf → dropped outright
+  (a single NaN coordinate would otherwise poison the whole aggregate —
+  NaN is absorbing under every weighted sum);
+- **norm overflow**: the upload's total norm exceeds ``norm_mult ×`` the
+  running median of previously *accepted* upload norms (the same
+  cohort-median basis the PR 4 health tracker scores against) — the
+  classic magnitude attack;
+- **per-block robust z** (at round close, when the cohort is known):
+  median/MAD z of each leaf's norm across this round's cohort; an
+  upload whose worst block sits past ``z_threshold`` is an outlier even
+  when its total norm hides inside the cohort envelope.
+
+Flagged uploads are dropped-and-counted like PR 5 stale uploads; the
+senders go to the :class:`~fedml_tpu.integrity.quarantine.QuarantineList`.
+Screening does NOT run under masked secure aggregation — a masked
+upload is exactly the thing the server must not be able to introspect
+(``docs/privacy.md``); SecAgg's own bound clip is its admission control.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import math
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression.codecs import (
+    CompressedTree,
+    _is_float_meta,
+    _tree_meta,
+    get_codec,
+)
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+__all__ = ["ScreenStats", "UpdateScreen", "screen_stats"]
+
+
+def _part_finite(x) -> jax.Array:
+    """all-finite reduction of one array (ints are finite by dtype)."""
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+    return jnp.asarray(True)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _screen_program(codec_name: Optional[str], meta, arrays):
+    """(all_finite: bool, per-leaf sqnorm: f32[n_leaves]) in one program.
+
+    ``arrays`` is the codec-positional tuple-of-tuples of a
+    :class:`CompressedTree` (or ``((leaf,), ...)`` for a plain tree with
+    ``codec_name=None``). The int8 branch never decodes: the leaf's
+    squared norm is ``scale² · Σq²`` with the int8 blocks cast only as
+    an XLA temporary inside the reduction.
+    """
+    finite = jnp.asarray(True)
+    sqnorms: List[jax.Array] = []
+    for parts, (dt, shape) in zip(arrays, meta):
+        for p in parts:
+            finite = finite & _part_finite(p)
+        if not _is_float_meta(dt):
+            sqnorms.append(jnp.sum(jnp.square(
+                jnp.asarray(parts[0]).astype(jnp.float32))))
+            continue
+        if codec_name == "int8":
+            q, scale = parts
+            sqnorms.append(jnp.square(scale.astype(jnp.float32))
+                           * jnp.sum(jnp.square(q.astype(jnp.float32))))
+        elif codec_name == "topk":
+            # kept values carry the whole mass; indices are positions
+            sqnorms.append(jnp.sum(jnp.square(
+                parts[0].astype(jnp.float32))))
+        elif codec_name in (None, "identity", "bf16"):
+            sqnorms.append(jnp.sum(jnp.square(
+                parts[0].astype(jnp.float32))))
+        else:
+            # unknown third-party codec: decode THIS leaf in-program (an
+            # XLA temporary, not a host tree) and norm the result
+            leaf = get_codec(codec_name).decode_leaf(parts, dt, shape)
+            sqnorms.append(jnp.sum(jnp.square(leaf.astype(jnp.float32))))
+    return finite, jnp.stack(sqnorms)
+
+
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
+
+_screen_program = _wrap_jit("integrity/screen", _screen_program,
+                            static_argnums=(0, 1), multi_shape=True)
+
+
+class ScreenStats:
+    """One upload's screen facts (the single device→host readback)."""
+
+    __slots__ = ("finite", "norm", "leaf_norms")
+
+    def __init__(self, finite: bool, norm: float, leaf_norms: np.ndarray):
+        self.finite = bool(finite)
+        self.norm = float(norm)
+        self.leaf_norms = np.asarray(leaf_norms, np.float64)
+
+
+def screen_stats(payload: Any, base: Optional[Pytree] = None) -> ScreenStats:
+    """Screen facts for one upload — compressed or plain.
+
+    ``CompressedTree`` deltas are screened straight off their wire
+    arrays (no decode); a plain pytree is screened as ``payload − base``
+    (or raw without a base). One jitted program, one readback.
+    """
+    if isinstance(payload, CompressedTree):
+        codec = get_codec(payload.codec)
+        if getattr(codec, "maskable", False):
+            raise ValueError(
+                "masked (secure-aggregation) uploads cannot be screened — "
+                "per-client introspection is what the masks exist to "
+                "prevent")
+        if not payload.is_delta and base is not None:
+            # a compressed FULL model with a base: the norm that matters
+            # is the displacement, which only exists decoded — this is
+            # the rare non-delta-upload path, not the fused hot path
+            # (check_wire inside decode guards the scales first)
+            return screen_stats(codec.decode(payload), base=base)
+        arrays = tuple(tuple(p) for p in payload.arrays)
+        finite, sq = _screen_program(payload.codec, payload.meta, arrays)
+    else:
+        tree = payload
+        if base is not None:
+            from fedml_tpu.compression.codecs import tree_delta
+
+            tree = tree_delta(payload, base)
+        leaves = jax.tree.leaves(tree)
+        meta = _tree_meta(leaves)
+        finite, sq = _screen_program(
+            None, meta, tuple((leaf,) for leaf in leaves))
+    sq = np.asarray(sq, np.float64)
+    # a non-finite block yields NaN/Inf sqnorms — norm stays honest
+    total = float(np.sqrt(np.sum(sq))) if np.all(np.isfinite(sq)) else (
+        float("nan"))
+    return ScreenStats(bool(finite), total, np.sqrt(np.maximum(sq, 0.0)))
+
+
+class UpdateScreen:
+    """Per-round admission screen + cohort outlier close.
+
+    Drive :meth:`admit` as uploads arrive (immediate verdicts:
+    non-finite, norm overflow), then :meth:`close_round` once the
+    cohort is assembled (per-block robust z needs the whole cohort).
+    Thread-safe: cross-silo handlers run on the comm thread while the
+    deadline close runs on the timer thread.
+    """
+
+    def __init__(self, norm_mult: float = 10.0, z_threshold: float = 8.0,
+                 norm_history: int = 256, registry=None):
+        from fedml_tpu.telemetry.registry import get_registry
+
+        self.norm_mult = float(norm_mult)
+        self.z_threshold = float(z_threshold)
+        self._reg = registry or get_registry()
+        self._lock = threading.Lock()
+        # accepted-upload norms across rounds: the overflow baseline
+        # (needs >= 4 accepted uploads before the rule can fire — a cold
+        # start must not flag the first honest client it sees)
+        self._norm_hist: deque = deque(maxlen=int(norm_history))
+        # round -> client -> ScreenStats of ADMITTED uploads (the z close
+        # and the rollback-suspect ranking read these)
+        self._pending: Dict[int, Dict[Any, ScreenStats]] = {}
+        self.last_round_stats: Dict[Any, ScreenStats] = {}
+
+    def _flag(self, counter: str, client: Any, round_idx: int,
+              reason: str) -> str:
+        from fedml_tpu.telemetry import flight_recorder
+        from fedml_tpu.telemetry.health import log_health_event
+
+        self._reg.counter("integrity/screened_uploads").inc()
+        self._reg.counter(counter).inc()
+        rec = {"kind": "integrity_event", "event": "upload_screened",
+               "client": client, "round": int(round_idx), "reason": reason}
+        try:
+            log_health_event(rec)
+        except Exception:  # pragma: no cover - observability must not kill
+            logger.exception("integrity event logging failed")
+        flight_recorder.record("integrity_event", event="upload_screened",
+                               client=client, round=int(round_idx),
+                               reason=reason)
+        return reason
+
+    def admit(self, client: Any, round_idx: int, payload: Any,
+              base: Optional[Pytree] = None) -> Optional[str]:
+        """Screen one upload at arrival. Returns a reason string when the
+        upload must be DROPPED (never aggregated), None when admitted."""
+        try:
+            stats = screen_stats(payload, base=base)
+        except ValueError as e:
+            if "non-finite" in str(e):
+                # the decode-side wire guard tripped first (non-delta
+                # payloads decode for their displacement norm): same
+                # verdict as the in-program finite check
+                return self._flag("integrity/nonfinite_uploads", client,
+                                  round_idx, str(e))
+            raise  # maskable refusal = caller misconfiguration
+        except Exception:  # screening must never break the round
+            logger.exception("upload screen failed for client %s "
+                             "(admitting unscreened)", client)
+            return None
+        if not stats.finite or not math.isfinite(stats.norm):
+            return self._flag("integrity/nonfinite_uploads", client,
+                              round_idx, "non-finite blocks or scales")
+        with self._lock:
+            hist = list(self._norm_hist)
+        if len(hist) >= 4:
+            from fedml_tpu.telemetry.health import _median
+
+            med = _median(hist)
+            if med > 0 and stats.norm > self.norm_mult * med:
+                return self._flag(
+                    "integrity/norm_overflows", client, round_idx,
+                    f"norm {stats.norm:.3g} > {self.norm_mult:g}x cohort "
+                    f"median {med:.3g}")
+        with self._lock:
+            self._pending.setdefault(int(round_idx), {})[client] = stats
+        return None
+
+    def drop(self, client: Any, round_idx: int) -> None:
+        """Forget an admitted upload (the caller dropped it for its own
+        reasons — secagg validation, stale close)."""
+        with self._lock:
+            self._pending.get(int(round_idx), {}).pop(client, None)
+
+    def _screen_z(self, values: Dict[Any, float]) -> Dict[Any, float]:
+        """High-side robust z for SCREENING — stricter than the health
+        tracker's :func:`~fedml_tpu.telemetry.health.robust_z`, because
+        screening DROPS data where health only scores it.
+
+        Two hardenings against small-cohort MAD instability (four
+        near-identical honest norms make the MAD vanish, exploding any
+        legitimate spread into z of 10+): the scale gets a relative
+        floor of 20% of the median (norm variation inside the cohort's
+        own envelope can never flag), and only the HIGH side counts with
+        a 3× ratio condition (a block 2% above its siblings is noise; a
+        poisoned block is a multiple of them — a *small* block is a weak
+        update, not an attack).
+        """
+        if len(values) < 4:
+            return {}
+        from fedml_tpu.telemetry.health import _median
+
+        vals = list(values.values())
+        med = _median(vals)
+        if med <= 0:
+            # a frozen/near-frozen block: most of the cohort is exactly
+            # zero, the relative floor vanishes, and any tiny nonzero
+            # value would z past every threshold — there is no cohort
+            # envelope to be an outlier OF (a poisoner hiding here still
+            # trips the total-norm and nonzero-block rules)
+            return {}
+        mad = _median([abs(v - med) for v in vals])
+        scale = max(1.4826 * mad, 0.2 * abs(med), 1e-12)
+        return {k: (v - med) / scale for k, v in values.items()
+                if v > 3.0 * med}
+
+    def close_round(self, round_idx: int) -> Dict[Any, str]:
+        """Per-block robust-z outlier pass over the round's admitted
+        cohort; returns {client: reason} for uploads to drop. Accepted
+        clients' norms enter the overflow baseline."""
+        with self._lock:
+            cohort = self._pending.pop(int(round_idx), {})
+        flagged: Dict[Any, str] = {}
+        if len(cohort) >= 4:
+            n_leaves = min(len(s.leaf_norms) for s in cohort.values())
+            worst: Dict[Any, Tuple[float, int]] = {
+                c: (0.0, -1) for c in cohort}
+            for j in range(n_leaves):
+                zs = self._screen_z({c: float(s.leaf_norms[j])
+                                     for c, s in cohort.items()})
+                for c, z in zs.items():
+                    if abs(z) > worst[c][0]:
+                        worst[c] = (abs(z), j)
+            for c, (z, j) in worst.items():
+                if z >= self.z_threshold:
+                    flagged[c] = self._flag(
+                        "integrity/z_outliers", c, round_idx,
+                        f"block {j} robust z {z:.1f} >= "
+                        f"{self.z_threshold:g}")
+        accepted = {c: s for c, s in cohort.items() if c not in flagged}
+        with self._lock:
+            for s in accepted.values():
+                self._norm_hist.append(s.norm)
+            self.last_round_stats = accepted
+        return flagged
+
+    def suspects(self) -> List[Any]:
+        """The last accepted round's DISTINGUISHED suspects, ranked
+        most-suspicious first: clients whose total update norm exceeds
+        2× the round's cohort median (after ring 1's z pass, magnitude
+        is the strongest signal a poisoned-but-admitted update leaves),
+        falling back to the single largest update when nothing stands
+        out. Deliberately a subset — a rollback must quarantine the
+        likely poisoner, not the cohort that happened to be present."""
+        from fedml_tpu.telemetry.health import _median
+
+        with self._lock:
+            stats = dict(self.last_round_stats)
+        if not stats:
+            return []
+        norms = {c: s.norm for c, s in stats.items()}
+        med = _median(list(norms.values()))
+        out = [c for c, n in norms.items() if n > 2.0 * med]
+        if not out:
+            out = [max(norms, key=lambda c: norms[c])]
+        return sorted(out, key=lambda c: -norms[c])

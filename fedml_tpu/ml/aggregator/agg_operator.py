@@ -67,7 +67,7 @@ class FedMLAggOperator:
     @staticmethod
     def agg_compressed(
         args: Any, raw_list: List[Tuple[int, Any]], global_params: Pytree,
-        clip_factors: Any = None,
+        clip_factors: Any = None, agg_robust: Any = None,
     ) -> Pytree:
         """Dequant-fused aggregation of compressed client updates.
 
@@ -77,6 +77,13 @@ class FedMLAggOperator:
         Since the weights are normalized, x̄ = Σpᵢxᵢ = g + Σpᵢdᵢ — so the
         stacked int8 blocks + scales reduce inside one jitted weighted
         sum and only the final aggregated f32 tree is materialized.
+
+        ``agg_robust`` (a spec like ``trimmed_mean@0.1`` / ``median``)
+        swaps the weighted mean for the coordinate-wise robust statistic
+        of ``fedml_tpu.integrity.fused_robust_sum`` — same fused
+        contract, sort-based reduction, deliberately unweighted (the
+        statistic is shift-equivariant, so robust(deltas) + g equals
+        the reference defense applied to full client models).
         """
         from fedml_tpu.compression import CompressedTree, fused_weighted_sum
         from fedml_tpu.compression.codecs import tree_undelta
@@ -89,6 +96,20 @@ class FedMLAggOperator:
         if not all(ct.is_delta for ct in cts):
             raise ValueError(
                 "agg_compressed requires delta-encoded updates")
+        if agg_robust:
+            from fedml_tpu.integrity import (
+                fused_robust_sum,
+                parse_robust_spec,
+            )
+
+            if clip_factors is not None:
+                raise ValueError(
+                    "agg_robust cannot compose with norm-clip factors — "
+                    "the robust statistic is unweighted, so there is no "
+                    "weight to fold the clip into; pick one defense")
+            mode, trim = parse_robust_spec(agg_robust)
+            return tree_undelta(global_params,
+                                fused_robust_sum(cts, mode, trim))
         weights = FedMLAggOperator._weights(args, raw_list)
         if clip_factors is not None:
             # norm-only defense on the fused path: clipping client i's

@@ -32,9 +32,12 @@ reads.
 from fedml_tpu.resilience.chaos import (
     AgentKillWindow,
     ChaosInjector,
+    CorruptUpdateWindow,
+    NaNWindow,
     NodeDrain,
     ServerKillWindow,
     chaos_from_args,
+    corrupt_model_payload,
     run_chaos_scenario,
 )
 from fedml_tpu.resilience.dedup import MessageDeduper
@@ -59,9 +62,12 @@ from fedml_tpu.resilience.quorum import (
 __all__ = [
     "AgentKillWindow",
     "ChaosInjector",
+    "CorruptUpdateWindow",
+    "NaNWindow",
     "NodeDrain",
     "ServerKillWindow",
     "chaos_from_args",
+    "corrupt_model_payload",
     "run_chaos_scenario",
     "MessageDeduper",
     "RoundJournal",

@@ -29,6 +29,22 @@ kill/partition window, which also filters inbound delivery so a "dead"
 peer's in-flight messages cannot leak through. ``fedml_tpu chaos`` runs
 a full in-proc cross-silo federation under a spec and prints one JSON
 summary line (:func:`run_chaos_scenario`).
+
+The **update-corruption family** (:class:`CorruptUpdateWindow`,
+:class:`NaNWindow`) targets the MODEL instead of the transport: during
+the window, the model payload a rank sends is mutated at the comm seam
+— NaN poked into a block/scale, or every scale inflated by a factor —
+exactly the damage a sick accelerator or a hostile client would land.
+It exists to prove the integrity layer (``fedml_tpu/integrity``)
+contains a bad *update* the way the rest of this package contains a bad
+*process*::
+
+    chaos:
+      corrupt_update:           # list; per-rank windows
+        - rank: 2
+          round: 1              # [round, until)
+          mode: nan             # nan | scale
+          factor: 50.0          # scale mode only
 """
 from __future__ import annotations
 
@@ -68,6 +84,17 @@ class ChaosSpec:
                 "heal_round": int(part.get("heal_round", 1 << 30)),
             })
         self.partitions = partitions
+        # update-corruption windows (a dict is a single window)
+        corrupt = spec.get("corrupt_update") or []
+        if isinstance(corrupt, dict):
+            corrupt = [corrupt]
+        self.corrupt_updates = [
+            CorruptUpdateWindow(
+                rank=int(c["rank"]), round=int(c.get("round", 0)),
+                until=c.get("until"), mode=str(c.get("mode", "scale")),
+                factor=float(c.get("factor", 50.0)))
+            for c in corrupt
+        ]
 
     @property
     def any_probabilistic(self) -> bool:
@@ -84,6 +111,104 @@ class ChaosSpec:
             raise ValueError(f"chaos spec must be a dict/JSON object, "
                              f"got {type(raw).__name__}")
         return cls(raw, seed=seed)
+
+
+class CorruptUpdateWindow:
+    """Corrupt rank ``rank``'s outbound MODEL payloads for rounds
+    ``[round, until)`` (default: one round).
+
+    ``mode='nan'`` pokes NaN into the first float block/scale — the
+    classic sick-accelerator artifact; ``mode='scale'`` multiplies every
+    scale (or leaf) by ``factor`` — the classic magnitude-poisoning
+    attack. ``tier`` targets a node's uplink inside an aggregation tree
+    (:class:`~fedml_tpu.hierarchy.runner.TreeRunner` consumes it); None
+    means a flat federation rank at the comm-manager seam.
+    """
+
+    __slots__ = ("rank", "round", "until", "mode", "factor", "tier")
+
+    def __init__(self, rank: int, round: int, until: Optional[int] = None,
+                 mode: str = "scale", factor: float = 50.0,
+                 tier: Optional[int] = None):
+        if mode not in ("nan", "scale"):
+            raise ValueError(
+                f"corrupt_update mode must be nan|scale, got {mode!r}")
+        self.rank = int(rank)
+        self.round = int(round)
+        self.until = int(until) if until is not None else self.round + 1
+        self.mode = mode
+        self.factor = float(factor)
+        self.tier = int(tier) if tier is not None else None
+
+    def active_at(self, rank: int, round_idx: Optional[int]) -> bool:
+        return (round_idx is not None and self.rank == int(rank)
+                and self.round <= int(round_idx) < self.until)
+
+
+class NaNWindow(CorruptUpdateWindow):
+    """Sugar: a :class:`CorruptUpdateWindow` that ships NaN — the
+    non-finite-upload chaos the integrity screen exists to catch."""
+
+    def __init__(self, rank: int, round: int, until: Optional[int] = None,
+                 tier: Optional[int] = None):
+        super().__init__(rank, round, until=until, mode="nan", tier=tier)
+
+
+def corrupt_model_payload(payload: Any, mode: str,
+                          factor: float = 50.0) -> Any:
+    """Seeded-deterministic payload corruption (pure function of the
+    payload — no RNG at all, so same-seed replays stay bit-identical).
+
+    ``CompressedTree``: nan → the first float leaf's scale-like part
+    becomes NaN (multi-part codecs) or its first element does
+    (single-part); scale → every float part multiplies by ``factor``.
+    Plain pytree: nan → first element of the first float leaf; scale →
+    every float leaf multiplies. Always returns mutated HOST arrays —
+    the corruption models what arrives off the wire.
+    """
+    import numpy as np
+
+    from fedml_tpu.compression import CompressedTree
+    from fedml_tpu.compression.codecs import _is_float_meta
+
+    def _nan_first(a):
+        a = np.array(a, copy=True)
+        flat = a.reshape(-1)
+        if flat.size:
+            flat[0] = np.nan
+        return a
+
+    if isinstance(payload, CompressedTree):
+        arrays = [[np.asarray(p) for p in parts] for parts in payload.arrays]
+        for j, ((dt, _), parts) in enumerate(zip(payload.meta, arrays)):
+            if not _is_float_meta(dt):
+                continue
+            if mode == "nan":
+                k = 1 if len(parts) > 1 else 0
+                arrays[j][k] = _nan_first(parts[k])
+                break
+            for k, p in enumerate(parts):
+                if np.issubdtype(np.asarray(p).dtype, np.floating):
+                    arrays[j][k] = np.asarray(p) * np.float32(factor)
+        return CompressedTree(payload.codec, payload.version,
+                              payload.is_delta, payload.raw_nbytes,
+                              payload.meta, payload.structure, arrays,
+                              sa=payload.sa)
+    import jax
+
+    leaves, treedef = jax.tree.flatten(payload)
+    out = []
+    done = False
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating):
+            if mode == "nan" and not done:
+                a = _nan_first(a)
+                done = True
+            elif mode == "scale":
+                a = a * a.dtype.type(factor)
+        out.append(a)
+    return jax.tree.unflatten(treedef, out)
 
 
 class ChaosInjector:
@@ -160,6 +285,26 @@ class ChaosInjector:
             self._m_injected("partition_drop")
             return False
         return True
+
+    def corrupt_payload(self, msg: Any) -> None:
+        """Mutate an outbound MODEL payload in place on the message when
+        an update-corruption window is live for this rank — called by
+        ``FedMLCommManager.send_message`` right before the transport, so
+        the corruption lands exactly at the comm seam (after encode,
+        before the wire) like real accelerator/DMA damage would."""
+        if not self.spec.corrupt_updates:
+            return
+        from fedml_tpu.core.distributed.message import Message
+
+        payload = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        if payload is None:
+            return
+        rnd = self._round_of(msg)
+        for w in self.spec.corrupt_updates:
+            if w.tier is None and w.active_at(self.rank, rnd):
+                self._m_injected("corrupt_update")
+                payload = corrupt_model_payload(payload, w.mode, w.factor)
+                msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
 
 
 class ServerKillWindow:
@@ -283,9 +428,21 @@ def run_chaos_scenario(
     round_deadline_s: float = 30.0,
     round_quorum: float = 2.0 / 3.0,
     timeout: float = 300.0,
+    corrupt_rank: Optional[int] = None,
+    corrupt_round: int = 1,
+    corrupt_mode: str = "nan",
+    corrupt_factor: float = 50.0,
+    integrity: bool = False,
+    agg_robust: str = "",
 ) -> Dict:
     """Run an in-proc cross-silo federation under a chaos spec; return a
-    JSON-safe summary (shared by the CLI and the recovery tests)."""
+    JSON-safe summary (shared by the CLI and the recovery tests).
+
+    ``corrupt_rank`` arms an update-corruption window (NaN or scaled
+    payloads from that rank at ``corrupt_round``); pair it with
+    ``integrity=True`` (screen + rollback) and/or ``agg_robust`` to
+    prove containment — the summary's integrity counters show what was
+    screened, quarantined and rolled back."""
     import fedml_tpu
     from fedml_tpu import models as models_mod
     from fedml_tpu.arguments import load_arguments_from_dict
@@ -305,6 +462,10 @@ def run_chaos_scenario(
         chaos["duplicate"] = float(duplicate)
     if delay_ms:
         chaos["delay_ms"] = float(delay_ms)
+    if corrupt_rank is not None:
+        chaos["corrupt_update"] = [{
+            "rank": int(corrupt_rank), "round": int(corrupt_round),
+            "mode": str(corrupt_mode), "factor": float(corrupt_factor)}]
     cfg = {
         "common_args": {"training_type": "cross_silo", "random_seed": seed,
                         "run_id": f"chaos_{seed}"},
@@ -321,6 +482,8 @@ def run_chaos_scenario(
             "round_quorum": round_quorum,
             "chaos": chaos, "chaos_seed": seed,
             **({"compression": compression} if compression else {}),
+            **({"integrity": True} if integrity else {}),
+            **({"agg_robust": agg_robust} if agg_robust else {}),
             **({"secagg": secagg, "secagg_clip": secagg_clip}
                if secagg else {}),
         },
@@ -341,6 +504,12 @@ def run_chaos_scenario(
         "resilience/quorum_rounds", "resilience/clients_evicted",
         "resilience/clients_rejoined", "resilience/stale_uploads",
         "resilience/duplicates_dropped", "resilience/chaos_injections"]
+    if integrity or corrupt_rank is not None:
+        counter_names += [
+            "integrity/screened_uploads", "integrity/nonfinite_uploads",
+            "integrity/norm_overflows", "integrity/z_outliers",
+            "integrity/quarantined", "integrity/rollbacks",
+            "integrity/nonfinite_wire"]
     if secagg:
         counter_names += ["secagg/rounds", "secagg/recoveries",
                           "secagg/seeds_revealed",

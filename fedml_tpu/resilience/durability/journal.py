@@ -12,6 +12,9 @@ file colocated with the checkpoints:
 - ``quorum_close``        — the round closed on quorum; missing positions
 - ``aggregate_committed`` — the aggregate landed in a durable checkpoint;
   every earlier record is now obsolete and the journal resets
+- ``round_rolled_back``   — the integrity layer REJECTED the round
+  (``fedml_tpu/integrity``): its uploads must never be salvaged, so the
+  record is terminal exactly like a commit
 
 A killed server replays the journal at restart (:func:`salvage_round`) and
 re-enters the interrupted round mid-flight: salvaged uploads rehydrate
@@ -214,7 +217,7 @@ class SalvagedRound:
 
 def scan_open_round(
     records: List[Dict],
-    terminal_kinds: tuple = ("aggregate_committed",),
+    terminal_kinds: tuple = ("aggregate_committed", "round_rolled_back"),
     note_kinds: tuple = ("quorum_close",),
 ) -> tuple:
     """The ONE journal-replay state machine every consumer shares:

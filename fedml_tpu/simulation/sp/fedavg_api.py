@@ -98,6 +98,43 @@ class FedAvgAPI:
             else:
                 self._codec = codec
 
+        # update-integrity containment (integrity: true / agg_robust):
+        # same three rings as the cross-silo server — admission screen on
+        # the encoded uplinks, robust fused aggregation, post-eval
+        # acceptance guard with round rollback (docs/integrity.md)
+        from fedml_tpu.integrity import (
+            AcceptanceGuard,
+            IntegrityConfig,
+            QuarantineList,
+            UpdateScreen,
+            parse_robust_spec,
+            resolve_agg_robust,
+        )
+
+        self._agg_robust = resolve_agg_robust(args, codec=self._codec)
+        # explicit agg_robust without a codec is a misconfiguration; a
+        # fused-capable DEFENSE without one keeps its decode path
+        if (parse_robust_spec(getattr(args, "agg_robust", "")) is not None
+                and self._codec is None):
+            raise ValueError(
+                "agg_robust rides the compressed fused aggregation path; "
+                "set compression (int8/bf16/identity), or use "
+                "enable_defense + defense_type for uncompressed runs")
+        icfg = IntegrityConfig.from_args(args)
+        self._screen = None
+        self._quarantine = None
+        self._guard = None
+        self._round_snapshot = None
+        if icfg is not None:
+            self._quarantine = QuarantineList(icfg.quarantine_rounds)
+            if icfg.screen_enabled:
+                self._screen = UpdateScreen(icfg.norm_mult,
+                                            icfg.z_threshold)
+            if icfg.rollback_enabled:
+                self._guard = AcceptanceGuard(
+                    icfg.loss_mult, icfg.loss_min_history,
+                    icfg.max_rollbacks)
+
         # round checkpoint/resume (SURVEY §5 improvement over the reference)
         from fedml_tpu.core.checkpoint import engine_checkpointer
 
@@ -150,14 +187,31 @@ class FedAvgAPI:
         from fedml_tpu.core.checkpoint import apply_round_state
 
         self.global_params = state["global_params"]
-        if int(state["has_c"]):
-            self._c_global = state["c_global"]
-        if int(state["has_mime"]):
-            self._mime_s = state["mime_s"]
+        # absent state restores to ABSENT: a ring-3 rollback of the first
+        # SCAFFOLD/Mime round must discard the rejected round's freshly
+        # minted control variate/momentum, not leave it live
+        self._c_global = state["c_global"] if int(state["has_c"]) else None
+        self._mime_s = state["mime_s"] if int(state["has_mime"]) else None
         self._start_round = apply_round_state(state, self.server_opt)
 
     # -- client sampling (parity: fedavg_api.py:128-141) ------------------
     def _client_sampling(self, round_idx: int) -> List[int]:
+        if self._quarantine is not None:
+            quarantined = set(self._quarantine.active(round_idx))
+            if quarantined:
+                allowed = [c for c in range(int(self.args.client_num_in_total))
+                           if c not in quarantined]
+                if not allowed:
+                    raise RuntimeError(
+                        "every client is quarantined; the federation has "
+                        "no trustworthy cohort left (see integrity/* "
+                        "counters and docs/integrity.md)")
+                from fedml_tpu.simulation.sampling import sample_from_list
+
+                return sample_from_list(
+                    allowed,
+                    min(int(self.args.client_num_per_round), len(allowed)),
+                    round_idx, int(getattr(self.args, "random_seed", 0)))
         return sample_clients(self.args, round_idx)
 
     # -- compressed uplink simulation -------------------------------------
@@ -165,11 +219,15 @@ class FedAvgAPI:
                           w_locals: List[Tuple[int, Pytree]]):
         """Run each client's update through the wire codec.
 
-        Returns ``(w_locals, w_agg)``: on the fast path ``w_agg`` is the
-        dequant-fused aggregate (stacked compressed blocks reduced in one
-        jitted program); when a trust-stack hook or contribution
-        assessment needs full client models, each delta is decoded back
-        instead and ``w_agg`` is None so the standard chain runs.
+        Returns ``(w_locals, w_agg, kept)``: on the fast path ``w_agg``
+        is the dequant-fused aggregate (stacked compressed blocks reduced
+        in one jitted program — the robust statistic when ``agg_robust``
+        is live); when a trust-stack hook or contribution assessment
+        needs full client models, each delta is decoded back instead and
+        ``w_agg`` is None so the standard chain runs. ``kept`` is the
+        per-client keep mask after ring-1 screening: a screened upload
+        is dropped exactly like a cross-silo screened upload — never
+        aggregated, its sender quarantined, its EF residual reset.
         """
         from fedml_tpu.compression import (
             ErrorFeedback,
@@ -181,31 +239,62 @@ class FedAvgAPI:
         from fedml_tpu.telemetry.health import update_norm
 
         seed = int(getattr(self.args, "random_seed", 0))
-        enc: List[Tuple[int, Any]] = []
-        for cid, (n_k, w) in zip(client_ids, w_locals):
+        kept = [True] * len(client_ids)
+        enc: List[Tuple[Any, int, int, Any]] = []  # (cid, idx, n_k, ct)
+        for i, (cid, (n_k, w)) in enumerate(zip(client_ids, w_locals)):
             ef = self._ef_by_client.setdefault(
                 cid, ErrorFeedback(self._codec))
             ct = ef.encode(tree_delta(w, self.global_params),
                            key=derive_key(seed, round_idx, cid))
+            if self._screen is not None:
+                # ring 1 admission, on the upload AS ENCODED — the
+                # same compressed-domain view the wire would carry
+                reason = self._screen.admit(cid, round_idx, ct)
+                if reason is not None:
+                    kept[i] = False
+                    self._quarantine.quarantine(cid, round_idx, reason)
+                    self._ef_by_client.pop(cid, None)
+                    continue
             # anomaly scoring sees the norm of the delta AS ENCODED —
             # quantization error and EF residual included, exactly what
             # the wire would carry
             self._health.observe(cid, round_idx, update_norm=update_norm(ct))
-            enc.append((n_k, ct))
-        if not (requires_full_trees() or self._contrib.is_enabled()):
+            enc.append((cid, i, n_k, ct))
+        if self._screen is not None:
+            flagged = self._screen.close_round(round_idx)
+            for cid, i, _, _ in enc:
+                if cid in flagged:
+                    kept[i] = False
+                    self._quarantine.quarantine(cid, round_idx,
+                                                flagged[cid])
+                    self._ef_by_client.pop(cid, None)
+            enc = [e for e in enc if e[0] not in flagged]
+        if not enc:
+            raise RuntimeError(
+                f"round {round_idx}: every upload was screened out — "
+                "nothing trustworthy to aggregate (see integrity/* "
+                "counters)")
+        pairs = [(n_k, ct) for _, _, n_k, ct in enc]
+        w_kept = [w_locals[i] for _, i, _, _ in enc]
+        if not (requires_full_trees(self._codec)
+                or self._contrib.is_enabled()):
             # norm-only defenses ride the fused path: clip factors from
-            # blocks × scales (no decode), folded into the weights
+            # blocks × scales (no decode), folded into the weights;
+            # agg_robust swaps the weighted mean for the fused
+            # coordinate-wise robust statistic
             from fedml_tpu.core.security.defender import FedMLDefender
 
-            return w_locals, FedMLAggOperator.agg_compressed(
-                self.args, enc, self.global_params,
-                clip_factors=FedMLDefender.get_instance()
-                .fused_clip_factors([ct for _, ct in enc]))
+            return w_kept, FedMLAggOperator.agg_compressed(
+                self.args, pairs, self.global_params,
+                clip_factors=None if self._agg_robust else
+                FedMLDefender.get_instance()
+                .fused_clip_factors([ct for _, ct in pairs]),
+                agg_robust=self._agg_robust), kept
         decoded = [
             (n, tree_undelta(self.global_params, self._codec.decode(ct)))
-            for n, ct in enc
+            for n, ct in pairs
         ]
-        return decoded, None
+        return decoded, None, kept
 
     # -- round ------------------------------------------------------------
     def train_one_round(self, round_idx: int) -> dict:
@@ -220,6 +309,11 @@ class FedAvgAPI:
             get_trace_controller().on_round_end(round_idx)
 
     def _train_one_round(self, round_idx: int) -> dict:
+        if self._guard is not None:
+            # ring 3's restore point: the round-open state (equals the
+            # last accepted round's post-aggregate state — with
+            # checkpoint_frequency 1, exactly the last checkpoint)
+            self._round_snapshot = self._ckpt_state()
         with self.tracer.span(f"round/{round_idx}/sample"):
             client_ids = self._client_sampling(round_idx)
         ctx = Context()
@@ -291,9 +385,41 @@ class FedAvgAPI:
         agg_span = self.tracer.begin(f"round/{round_idx}/aggregate")
         ctx.add("global_model_for_defense", self.global_params)
         w_agg = None
+        kept = [True] * len(client_ids)
         if self._codec is not None:
-            w_locals, w_agg = self._compress_uplinks(
+            w_locals, w_agg, kept = self._compress_uplinks(
                 round_idx, client_ids, w_locals)
+        elif self._screen is not None:
+            # uncompressed runs screen the raw displacement against the
+            # round's broadcast (same rules, plain-tree program branch)
+            for i, (cid, (n_k, w)) in enumerate(zip(client_ids, w_locals)):
+                reason = self._screen.admit(cid, round_idx, w,
+                                            base=self.global_params)
+                if reason is not None:
+                    kept[i] = False
+                    self._quarantine.quarantine(cid, round_idx, reason)
+            flagged = self._screen.close_round(round_idx)
+            for i, cid in enumerate(client_ids):
+                if cid in flagged:
+                    kept[i] = False
+                    self._quarantine.quarantine(cid, round_idx,
+                                                flagged[cid])
+            w_locals = [p for p, k in zip(w_locals, kept) if k]
+            if not w_locals:
+                raise RuntimeError(
+                    f"round {round_idx}: every upload was screened out — "
+                    "nothing trustworthy to aggregate (see integrity/* "
+                    "counters)")
+        if not all(kept):
+            # screened clients contribute nothing this round: their
+            # optimizer side-channels must drop too, or FedNova's tau
+            # weighting (and SCAFFOLD/Mime averages) would misalign with
+            # the surviving contributions
+            taus = [t for t, k in zip(taus, kept) if k]
+            if len(c_deltas) == len(kept):
+                c_deltas = [c for c, k in zip(c_deltas, kept) if k]
+            if len(mime_grads) == len(kept):
+                mime_grads = [g for g, k in zip(mime_grads, kept) if k]
         if w_agg is None:
             w_list, _ = self.aggregator.on_before_aggregation(w_locals)
             w_agg = self.aggregator.aggregate(w_list)
@@ -307,7 +433,13 @@ class FedAvgAPI:
             # cross-silo the aggregate ships encrypted and the CLIENT hook
             # decrypts (on_before_local_training)
             w_agg = fhe.fhe_dec(w_agg)
-        self._assess_contributions(client_ids, w_locals, round_idx)
+        # contribution assessment pairs phi[i] with client_ids[i] — after
+        # screening, w_locals holds only the KEPT subset, so the id list
+        # must shrink with it or every later index misattributes (or
+        # walks off the end of) the Shapley values
+        self._assess_contributions(
+            [c for c, k in zip(client_ids, kept) if k], w_locals,
+            round_idx)
         tau_eff = None
         if str(getattr(self.args, "federated_optimizer", "")) == "FedNova" and taus:
             counts = np.asarray([float(n) for n, _ in w_locals])
@@ -347,6 +479,29 @@ class FedAvgAPI:
         self._devstats.sample("aggregate", round_idx)
         self._health.finish_round(round_idx)
 
+        report = {"round": round_idx, "clients": client_ids}
+        flight_recorder.record("round_end", round=round_idx)
+        freq = int(getattr(self.args, "frequency_of_the_test", 1))
+        do_eval = (round_idx % max(freq, 1) == 0
+                   or round_idx == int(self.args.comm_round) - 1)
+        metrics = None
+        if do_eval:
+            with self.tracer.span(f"round/{round_idx}/eval"):
+                metrics = self.aggregator.test(
+                    self.global_params, self.dataset.test_data_global,
+                    self.device, self.args
+                )
+            self._devstats.sample("eval", round_idx)
+        if self._guard is not None:
+            # ring 3: non-finite params every round, eval-loss spike on
+            # eval rounds — BEFORE the checkpoint save below, so a
+            # rejected round's state can never become durable
+            reason = self._guard.check(self.global_params,
+                                       (metrics or {}).get("test_loss"))
+            if reason is not None:
+                return self._rollback_round(round_idx, reason, client_ids)
+            self._guard.accept((metrics or {}).get("test_loss"))
+
         if self._ckpt is not None:
             from fedml_tpu.core.checkpoint import should_save
 
@@ -357,16 +512,7 @@ class FedAvgAPI:
                 # last durable round — recorded only after a completed save
                 flight_recorder.record("checkpoint", round=round_idx)
 
-        report = {"round": round_idx, "clients": client_ids}
-        flight_recorder.record("round_end", round=round_idx)
-        freq = int(getattr(self.args, "frequency_of_the_test", 1))
-        if round_idx % max(freq, 1) == 0 or round_idx == int(self.args.comm_round) - 1:
-            with self.tracer.span(f"round/{round_idx}/eval"):
-                metrics = self.aggregator.test(
-                    self.global_params, self.dataset.test_data_global,
-                    self.device, self.args
-                )
-            self._devstats.sample("eval", round_idx)
+        if metrics is not None:
             report.update(metrics)
             self.test_history.append(report)
             logger.info(
@@ -377,10 +523,62 @@ class FedAvgAPI:
             )
         return report
 
+    def _rollback_round(self, round_idx: int, reason: str,
+                        client_ids: List[int]) -> dict:
+        """Ring 3 (sp): the round was REJECTED — restore the round-open
+        snapshot, quarantine the suspects, reset the cohort's EF
+        residuals (their encodes were discarded, so their residuals must
+        roll back too — a rejoiner's state), and signal ``train()`` to
+        re-run this round index with a fresh cohort. Raises past the
+        consecutive ``max_rollbacks`` budget."""
+        self._guard.record_rollback(round_idx, reason)
+        suspects = []
+        if self._screen is not None:
+            suspects = [c for c in self._screen.suspects()
+                        if c in client_ids]
+        if not suspects:
+            suspects = list(client_ids)
+        if self._quarantine is not None:
+            # leave the re-run a cohort (same rule as the cross-silo
+            # server): suspects covering every remaining client are NOT
+            # quarantined — the bounded rollback budget decides instead
+            pool = self._quarantine.filter_selection(
+                [c for c in range(int(self.args.client_num_in_total))
+                 if c not in set(suspects)], round_idx)
+            if pool:
+                for cid in suspects:
+                    self._quarantine.quarantine(
+                        cid, round_idx,
+                        f"round {round_idx} rolled back: {reason}")
+            else:
+                logger.warning(
+                    "rollback suspects %s cover every remaining client — "
+                    "re-running unquarantined (bounded by max_rollbacks)",
+                    suspects)
+        for cid in client_ids:
+            self._ef_by_client.pop(cid, None)
+        if self._round_snapshot is None:  # pragma: no cover - defensive
+            raise RuntimeError(
+                f"round {round_idx} rejected ({reason}) with no snapshot "
+                "to roll back to")
+        self._apply_ckpt_state(self._round_snapshot)
+        logger.warning(
+            "round %d rolled back (%s); suspects %s quarantined — "
+            "re-running with a fresh cohort", round_idx, reason, suspects)
+        return {"round": round_idx, "clients": client_ids,
+                "rolled_back": True, "reason": reason}
+
     def train(self) -> dict:
         t0 = time.time()
-        for round_idx in range(self._start_round, int(self.args.comm_round)):
-            self.train_one_round(round_idx)
+        round_idx = self._start_round
+        while round_idx < int(self.args.comm_round):
+            report = self.train_one_round(round_idx)
+            if report.get("rolled_back"):
+                # re-run the SAME round index with the quarantine applied
+                # (a fresh cohort); the guard's consecutive budget bounds
+                # this loop — past it, record_rollback raises
+                continue
+            round_idx += 1
         wall = time.time() - t0
         # land every span + the registry snapshot in the run dir so
         # `fedml_tpu telemetry report` works the moment training returns

@@ -654,6 +654,82 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "secagg", "no data: no secagg/* metrics or secagg_event "
             "records (secure aggregation was off)")
 
+    # -- update integrity (screen / quarantine / rollback) ----------------
+    # integrity/* counters + integrity_event records: who was screened
+    # out and why, who sits in quarantine, and which rounds were
+    # rejected and rolled back to their last accepted state
+    latest_int: Dict[Any, float] = {}
+    for rec in metric_records:
+        name = rec.get("name", "")
+        if name.startswith("integrity/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest_int[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    int_counters: Dict[str, float] = {}
+    for (name, _), val in latest_int.items():
+        key = name.split("/", 1)[1]
+        int_counters[key] = int_counters.get(key, 0.0) + val
+    int_events = [e for e in health_events
+                  if e.get("kind") == "integrity_event"]
+    quarantined_clients: Dict[str, Dict] = {}
+    rollback_rounds: List[Dict] = []
+    for e in int_events:
+        ev = e.get("event")
+        if ev == "quarantined":
+            quarantined_clients[str(e.get("client"))] = {
+                "round": e.get("round"),
+                "until_round": e.get("until_round"),
+                "reason": e.get("reason"),
+            }
+        elif ev == "round_rolled_back":
+            rollback_rounds.append({
+                "round": e.get("round"), "reason": e.get("reason"),
+                "suspects": e.get("suspects"),
+                "consecutive": e.get("consecutive")})
+    integrity: Dict[str, Any] = {
+        "counters": int_counters,
+        "events": int_events[-16:],
+        "quarantined_clients": quarantined_clients,
+        "rollbacks": rollback_rounds,
+    }
+    screened = int_counters.get("screened_uploads", 0.0)
+    if screened:
+        kinds = []
+        for key, label in (("nonfinite_uploads", "non-finite"),
+                           ("norm_overflows", "norm overflow"),
+                           ("z_outliers", "block-z outlier")):
+            if int_counters.get(key):
+                kinds.append(f"{int_counters[key]:.0f} {label}")
+        verdict.append(
+            f"{screened:.0f} corrupt upload(s) SCREENED OUT before "
+            f"aggregation ({', '.join(kinds) or 'reasons in events'}) — "
+            "senders quarantined, rounds closed over the survivors")
+    for cid, q in sorted(quarantined_clients.items()):
+        verdict.append(
+            f"client {cid} QUARANTINED at round {q['round']} until round "
+            f"{q['until_round']}: {q['reason']}")
+    for rb in rollback_rounds:
+        verdict.append(
+            f"round {rb['round']} ROLLED BACK to its last accepted state "
+            f"({rb['reason']}) — suspects quarantined, round re-run with "
+            "a fresh cohort")
+    if int_counters.get("rollback_aborts"):
+        verdict.append(
+            f"{int_counters['rollback_aborts']:.0f} federation abort(s): "
+            "consecutive rollbacks exceeded max_rollbacks — the "
+            "corruption was persistent; containment refused to oscillate")
+    if int_counters.get("nonfinite_wire"):
+        verdict.append(
+            f"{int_counters['nonfinite_wire']:.0f} wire payload(s) with "
+            "non-finite scales refused at decode — a peer is corrupt or "
+            "hostile (see integrity/nonfinite_wire)")
+    if not int_counters and not int_events:
+        notes.setdefault(
+            "integrity",
+            "no data: no integrity/* metrics or integrity_event records "
+            "(update-integrity containment was off, or nothing was "
+            "corrupt)")
+
     # -- performance attribution (program catalog + roofline) -------------
     # three verdicts the multichip plan and perf triage read directly:
     # the top peak-HBM consumer (ROADMAP item 1's direct input), treedef
@@ -784,6 +860,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "jobplane": jobplane,
         "tiers": tiers,
         "secagg": secagg,
+        "integrity": integrity,
         "profile": profile,
         "live": live,
         "verdict": verdict,
@@ -950,6 +1027,22 @@ def format_doctor(d: Dict) -> str:
                 if k not in ("kind", "ts") and not isinstance(v, dict)))
     else:
         add(f"  {notes.get('secagg', 'no data')}")
+
+    add("")
+    add("update integrity (screen / quarantine / rollback):")
+    integ = d.get("integrity") or {}
+    int_counters = integ.get("counters") or {}
+    if int_counters or integ.get("events"):
+        for name, v in sorted(int_counters.items()):
+            add(f"  integrity/{name:<33s}{v:>14.0f}")
+        for cid, q in sorted((integ.get("quarantined_clients")
+                              or {}).items()):
+            add(f"  client {cid}: quarantined at round {q.get('round')} "
+                f"until round {q.get('until_round')} ({q.get('reason')})")
+        for rb in (integ.get("rollbacks") or [])[-6:]:
+            add(f"  rollback: round {rb.get('round')} ({rb.get('reason')})")
+    else:
+        add(f"  {notes.get('integrity', 'no data')}")
 
     add("")
     add("serving (live endpoint freshness / SLO):")

@@ -32,6 +32,7 @@ slow or weird, round by round, after the fact.
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 import threading
@@ -41,6 +42,8 @@ from typing import Any, Dict, List, Optional
 
 from fedml_tpu.telemetry import flight_recorder
 from fedml_tpu.telemetry.registry import get_registry
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "HEALTH_FILENAME",
@@ -250,9 +253,25 @@ class ClientHealthTracker:
                     if now - ts <= self.heartbeat_window_s)
         self._reg.gauge("health/clients_reporting").set(n)
         if fields and fields.get("mem_bytes"):
-            self._reg.gauge(
-                "health/client_mem_bytes",
-                labels={"client": str(client_id)}).set(float(fields["mem_bytes"]))
+            try:
+                mem = float(fields["mem_bytes"])
+            except (TypeError, ValueError):
+                mem = float("nan")
+            if math.isfinite(mem):
+                self._reg.gauge(
+                    "health/client_mem_bytes",
+                    labels={"client": str(client_id)}).set(mem)
+            else:
+                self._nonfinite_dropped(client_id, "mem_bytes")
+
+    def _nonfinite_dropped(self, client_id: Any, field: str) -> None:
+        """A sick client shipped NaN/Inf in a heartbeat field — the
+        reading is dropped (a single NaN would poison every median/MAD
+        statistic downstream: NaN is absorbing under sort-based
+        medians), counted, and left visible to the doctor."""
+        self._reg.counter("health/nonfinite_dropped").inc()
+        logger.warning("dropping non-finite %s heartbeat field from "
+                       "client %s", field, client_id)
 
     def observe(self, client_id: Any, round_idx: int,
                 latency_s: Optional[float] = None,
@@ -263,14 +282,26 @@ class ClientHealthTracker:
             obs = self._pending.setdefault(int(round_idx), {}).setdefault(
                 client_id, {})
             if latency_s is not None:
-                obs["latency_s"] = float(latency_s)
+                # a NaN latency (sick client clock, poisoned train_ms)
+                # would ride into the cohort-median straggler scoring
+                if math.isfinite(latency_s):
+                    obs["latency_s"] = float(latency_s)
+                else:
+                    self._nonfinite_dropped(client_id, "latency")
             if update_norm is not None and math.isfinite(update_norm):
                 obs["update_norm"] = float(update_norm)
             if train_loss is not None:
                 try:
-                    obs["train_loss"] = float(train_loss)
+                    loss = float(train_loss)
                 except (TypeError, ValueError):
-                    pass
+                    loss = None
+                if loss is not None:
+                    # same rule as update_norm: non-finite never enters
+                    # the median-MAD z scoring
+                    if math.isfinite(loss):
+                        obs["train_loss"] = loss
+                    else:
+                        self._nonfinite_dropped(client_id, "train_loss")
             self.last_seen[client_id] = time.time()
         if heartbeat:
             self.heartbeat(client_id, heartbeat)

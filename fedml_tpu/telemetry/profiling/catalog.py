@@ -247,6 +247,16 @@ class CatalogedProgram:
             if variant is not None and not variant.fallback:
                 cat._flops_total += variant.flops
                 cat._bytes_total += variant.bytes_accessed
+                if variant.flops and not rec.flops:
+                    # a REUSED variant calling into a fresh (re-homed
+                    # after reset_catalog) record re-lands its analysis:
+                    # flops/bytes are properties of the compiled program,
+                    # not of the accounting epoch — without this, any
+                    # earlier run that already compiled this signature
+                    # would leave the new epoch's record claiming
+                    # flops=0 for a program that demonstrably ran
+                    rec.flops = variant.flops
+                    rec.bytes_accessed = variant.bytes_accessed
 
     def __call__(self, *args, **kwargs):
         cat = self._catalog

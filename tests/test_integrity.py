@@ -1,0 +1,799 @@
+"""Update-integrity containment: screen/quarantine/robust-agg/rollback
+units, the fused-robust == reference-defense equivalence, non-finite
+wire fuzz (satellite 2), health heartbeat hardening (satellite 1),
+quarantine × rejoin composition (satellite 3), the tree-tier robust +
+corrupt-screen legs, the doctor section, the span-lint rule, the bench
+smoke + compare gates — and THE acceptance run: a 5-round int8+prefetch
+cross-silo federation with seeded NaN injection (round 1) and a
+poisoned cohort (round 3), finishing with every corrupt upload screened
+or rolled back, the poisoned client quarantined, final eval within
+tolerance of the clean same-seed run, and the doctor naming both."""
+import copy
+import json
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.compression import derive_key, fused_weighted_sum, get_codec
+from fedml_tpu.integrity import (
+    AcceptanceGuard,
+    QuarantineList,
+    RollbackBudgetExceeded,
+    UpdateScreen,
+    fused_robust_sum,
+    parse_robust_spec,
+    resolve_agg_robust,
+    screen_stats,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    from fedml_tpu.telemetry import get_registry
+
+    return get_registry().counter(name).value
+
+
+def _delta_trees(n=5, seed=0, leaves=(("w", (8, 6)), ("b", (6,)))):
+    out = []
+    for c in range(n):
+        rng = np.random.default_rng(seed + c)
+        out.append({k: (rng.normal(size=sh) * 1e-2).astype(np.float32)
+                    for k, sh in leaves})
+    return out
+
+
+# -- ring 2: fused robust aggregation --------------------------------------
+def test_parse_robust_spec():
+    assert parse_robust_spec("") is None
+    assert parse_robust_spec("none") is None
+    assert parse_robust_spec("median") == ("median", 0.0)
+    assert parse_robust_spec("trimmed_mean") == ("trimmed_mean", 0.1)
+    assert parse_robust_spec("TRIMMED_MEAN@0.2") == ("trimmed_mean", 0.2)
+    for bad in ("krum", "median@0.1", "trimmed_mean@0.6",
+                "trimmed_mean@x"):
+        with pytest.raises(ValueError):
+            parse_robust_spec(bad)
+
+
+@pytest.mark.parametrize("mode,trim", [("median", 0.0),
+                                       ("trimmed_mean", 0.2)])
+def test_fused_robust_equals_reference_defense(mode, trim):
+    """The fused statistic on identity-codec DELTAS plus the base must
+    equal the reference defense applied to the full client models —
+    shift-equivariance is what makes requires_full_trees() narrowable."""
+    from fedml_tpu.core.security.defense.coord_median import _median_tree
+    from fedml_tpu.core.security.defense.trimmed_mean import (
+        _trimmed_mean_tree,
+    )
+    from fedml_tpu.integrity.robust_agg import trim_k
+    from fedml_tpu.utils.tree import tree_stack
+
+    deltas = _delta_trees(6)
+    base = {k: np.float32(0.5) + v for k, v in deltas[0].items()}
+    models = [jax.tree.map(lambda b, d: b + d, base, d) for d in deltas]
+    codec = get_codec("identity")
+    cts = [codec.encode(d, key=derive_key(0, 0, c), is_delta=True)
+           for c, d in enumerate(deltas)]
+    fused = fused_robust_sum(cts, mode, trim)
+    fused_models = jax.tree.map(lambda b, d: b + d, base, fused)
+    if mode == "median":
+        ref = _median_tree(tree_stack(models))
+    else:
+        ref = _trimmed_mean_tree(tree_stack(models),
+                                 trim_k(len(models), trim))
+    for a, b in zip(jax.tree.leaves(fused_models), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_robust_discards_poisoned_client():
+    """One client at 1000x magnitude must not move the median/trimmed
+    aggregate past the honest envelope (the weighted mean would)."""
+    deltas = _delta_trees(5)
+    deltas[2] = jax.tree.map(lambda x: x * 1000.0, deltas[2])
+    codec = get_codec("int8")
+    cts = [codec.encode(d, key=derive_key(0, 0, c), is_delta=True)
+           for c, d in enumerate(deltas)]
+    w = np.full((5,), 0.2, np.float32)
+    mean = fused_weighted_sum(cts, w)
+    robust = fused_robust_sum(cts, "trimmed_mean", 0.2)
+    honest_max = max(float(np.abs(x).max())
+                     for i, d in enumerate(deltas) if i != 2
+                     for x in jax.tree.leaves(d))
+    assert max(float(np.abs(x).max())
+               for x in jax.tree.leaves(mean)) > 10 * honest_max
+    assert max(float(np.abs(x).max())
+               for x in jax.tree.leaves(robust)) <= 2 * honest_max
+
+
+def test_fused_robust_refusals():
+    deltas = _delta_trees(4)
+    topk = get_codec("topk")
+    cts = [topk.encode(d, key=derive_key(0, 0, c), is_delta=True)
+           for c, d in enumerate(deltas)]
+    with pytest.raises(ValueError, match="dense"):
+        fused_robust_sum(cts, "median")
+    with pytest.raises(ValueError):
+        fused_robust_sum([], "median")
+
+
+def test_requires_full_trees_narrowed_for_fused_defenses():
+    from fedml_tpu.compression import requires_full_trees
+    from fedml_tpu.core.security.defender import FedMLDefender
+
+    args = load_arguments_from_dict(
+        {"security_args": {"enable_defense": True,
+                           "defense_type": "trimmed_mean", "beta": 0.2}})
+    FedMLDefender.reset()
+    try:
+        FedMLDefender.get_instance().init(args)
+        defender = FedMLDefender.get_instance()
+        assert defender.is_fused_defense()
+        assert defender.fused_agg_spec() == "trimmed_mean@0.2"
+        # narrowed ONLY for a dense plain codec — uncompressed (None)
+        # and sparse (topk) callers keep the decode-fallback defense
+        assert not requires_full_trees(get_codec("int8"))
+        assert requires_full_trees()
+        assert requires_full_trees(get_codec("topk"))
+        assert (resolve_agg_robust(object(), codec=get_codec("int8"))
+                == "trimmed_mean@0.2")
+        assert resolve_agg_robust(object()) is None
+        assert resolve_agg_robust(object(), codec=get_codec("topk")) is None
+    finally:
+        FedMLDefender.reset()
+    # a list-based defense still forces the decode fallback everywhere
+    args = load_arguments_from_dict(
+        {"security_args": {"enable_defense": True,
+                           "defense_type": "krum"}})
+    try:
+        FedMLDefender.get_instance().init(args)
+        assert not FedMLDefender.get_instance().is_fused_defense()
+        assert requires_full_trees(get_codec("int8"))
+    finally:
+        FedMLDefender.reset()
+
+
+# -- ring 1: screen units ---------------------------------------------------
+def test_screen_stats_compressed_no_decode_and_plain():
+    tree = _delta_trees(1)[0]
+    ct = get_codec("int8").encode(tree, key=derive_key(0, 0, 1),
+                                  is_delta=True)
+    s = screen_stats(ct)
+    assert s.finite and math.isfinite(s.norm) and len(s.leaf_norms) == 2
+    # plain tree vs base
+    base = jax.tree.map(lambda x: x + 1.0, tree)
+    s2 = screen_stats(base, base=tree)
+    exact = math.sqrt(sum(float(np.sum(np.square(np.asarray(x) + 1.0
+                                                 - np.asarray(x))))
+                          for x in jax.tree.leaves(tree)))
+    assert abs(s2.norm - exact) < 1e-3 * exact
+
+
+def test_screen_admit_rules_and_counters():
+    screen = UpdateScreen(norm_mult=10.0, z_threshold=8.0)
+    tree = _delta_trees(1)[0]
+    codec = get_codec("int8")
+    # non-finite scale → dropped
+    bad = codec.encode(tree, key=derive_key(0, 0, 9), is_delta=True)
+    bad.arrays[0][1] = np.float32("nan")
+    b = _counter("integrity/nonfinite_uploads")
+    assert screen.admit(9, 0, bad) is not None
+    assert _counter("integrity/nonfinite_uploads") == b + 1
+    # build a norm baseline, then overflow it
+    for r, c in enumerate(range(4)):
+        assert screen.admit(c, 0, codec.encode(
+            _delta_trees(1, seed=c)[0], key=derive_key(0, 0, c),
+            is_delta=True)) is None
+    screen.close_round(0)
+    big = codec.encode(jax.tree.map(lambda x: x * 1e3, tree),
+                       key=derive_key(0, 1, 5), is_delta=True)
+    b = _counter("integrity/norm_overflows")
+    assert screen.admit(5, 1, big) is not None
+    assert _counter("integrity/norm_overflows") == b + 1
+
+
+def test_screen_z_outlier_flags_poison_not_honest_spread():
+    """The close-time z pass must flag a 10x-block poisoner and NEVER an
+    honest client in a tight small cohort (MAD-instability hardening)."""
+    codec = get_codec("int8")
+    screen = UpdateScreen(norm_mult=1e9, z_threshold=8.0)
+    for c in range(5):
+        d = _delta_trees(1, seed=c)[0]
+        if c == 3:
+            d = jax.tree.map(lambda x: x * 8.0, d)  # inside norm gate
+        assert screen.admit(c, 2, codec.encode(
+            d, key=derive_key(0, 2, c), is_delta=True)) is None
+    flagged = screen.close_round(2)
+    assert list(flagged) == [3], flagged
+    # honest-only cohort with near-identical norms: nothing flagged
+    for c in range(5):
+        assert screen.admit(c, 3, codec.encode(
+            _delta_trees(1, seed=20 + c)[0],
+            key=derive_key(0, 3, c), is_delta=True)) is None
+    assert screen.close_round(3) == {}
+
+
+def test_screen_z_frozen_block_never_flags():
+    """A near-frozen block (cohort median norm 0) has no envelope to be
+    an outlier of — a tiny nonzero value must not explode the z (the
+    relative MAD floor vanishes at median 0)."""
+    screen = UpdateScreen(norm_mult=1e9, z_threshold=8.0)
+    codec = get_codec("identity")
+    for c in range(5):
+        tree = {"w": np.zeros((8, 6), np.float32),
+                "b": (np.random.default_rng(c).normal(size=(6,))
+                      * 1e-2).astype(np.float32)}
+        if c == 1:
+            tree["w"][0, 0] = 1e-9  # honest numerical dust
+        assert screen.admit(c, 0, codec.encode(
+            tree, key=derive_key(0, 0, c), is_delta=True)) is None
+    assert screen.close_round(0) == {}
+
+
+def test_screen_refuses_masked_uploads():
+    class FakeMasked:
+        pass
+
+    tree = _delta_trees(1)[0]
+    ct = get_codec("int8").encode(tree, key=derive_key(0, 0, 1),
+                                  is_delta=True)
+    ct.codec = "secagg_int8"
+    with pytest.raises(ValueError, match="masked"):
+        screen_stats(ct)
+
+
+# -- quarantine -------------------------------------------------------------
+def test_quarantine_expiry_and_filter():
+    q = QuarantineList(rounds=2)
+    assert q.quarantine(5, 3, "poison")
+    assert not q.quarantine(5, 2, "older")  # never shortens
+    assert q.is_quarantined(5, 4) and q.is_quarantined(5, 5)
+    assert not q.is_quarantined(5, 6)
+    assert q.filter_selection([4, 5, 6], 4) == [4, 6]
+    assert q.filter_selection([4, 5, 6], 6) == [4, 5, 6]  # released
+    assert q.active(6) == []
+
+
+# -- satellite 2: non-finite wire fuzz --------------------------------------
+def test_nonfinite_scale_wire_fuzz():
+    """NaN/Inf scales (int8) and values (topk) must be a loud, counted
+    ValueError at decode AND at the fused sums — after a real wire
+    roundtrip, exactly what a hostile peer controls."""
+    from fedml_tpu.utils.serialization import safe_dumps, safe_loads
+
+    def _poke_values_nan(ct):
+        v = np.array(ct.arrays[0][0], copy=True)  # wire arrays are RO
+        v[0] = np.nan
+        ct.arrays[0][0] = v
+
+    tree = _delta_trees(1)[0]
+    for codec_name, poke in [
+        ("int8", lambda ct: ct.arrays[0].__setitem__(
+            1, np.float32("nan"))),
+        ("int8", lambda ct: ct.arrays[1].__setitem__(
+            1, np.float32("inf"))),
+        ("topk", _poke_values_nan),
+    ]:
+        codec = get_codec(codec_name)
+        ct = codec.encode(tree, key=derive_key(0, 0, 1), is_delta=True)
+        ct = safe_loads(safe_dumps({"m": ct}))["m"]  # host wire arrays
+        poke(ct)
+        b = _counter("integrity/nonfinite_wire")
+        with pytest.raises(ValueError, match="non-finite"):
+            codec.decode(ct)
+        assert _counter("integrity/nonfinite_wire") == b + 1
+        good = safe_loads(safe_dumps({"m": codec.encode(
+            tree, key=derive_key(0, 0, 2), is_delta=True)}))["m"]
+        with pytest.raises(ValueError, match="non-finite"):
+            fused_weighted_sum([good, ct],
+                               np.asarray([0.5, 0.5], np.float32))
+        if codec_name == "int8":
+            with pytest.raises(ValueError, match="non-finite"):
+                fused_robust_sum([good, ct, good, good], "median")
+    # clean trees still decode after all that
+    ct = get_codec("int8").encode(tree, key=derive_key(0, 0, 3),
+                                  is_delta=True)
+    get_codec("int8").decode(ct)
+
+
+# -- satellite 1: health heartbeat hardening --------------------------------
+def test_health_drops_nonfinite_heartbeat_fields():
+    from fedml_tpu.telemetry.health import ClientHealthTracker
+
+    t = ClientHealthTracker()
+    b = _counter("health/nonfinite_dropped")
+    t.observe(1, 0, latency_s=1.0, train_loss=0.5, update_norm=1.0)
+    t.observe(2, 0, latency_s=float("nan"), train_loss=float("inf"),
+              update_norm=2.0)
+    t.heartbeat(2, {"mem_bytes": float("nan")})
+    assert _counter("health/nonfinite_dropped") == b + 3
+    for c in (3, 4):
+        t.observe(c, 0, latency_s=1.1, train_loss=0.6, update_norm=1.2)
+    out = t.finish_round(0)
+    # the sick client's NaN fields never entered the scoring: every
+    # emitted statistic is finite
+    for rec in out.values():
+        for k in ("z_norm", "z_loss", "straggler_score", "anomaly_score"):
+            assert math.isfinite(rec[k]), (k, rec)
+    assert out[2]["train_loss"] is None
+    assert out[2]["latency_ms"] is None
+
+
+# -- ring 3: guard units ----------------------------------------------------
+def test_acceptance_guard_rules_and_budget():
+    g = AcceptanceGuard(loss_mult=2.0, min_history=1, max_rollbacks=1)
+    nan_tree = {"w": np.full((3,), np.nan, np.float32)}
+    ok_tree = {"w": np.ones((3,), np.float32)}
+    assert g.check(nan_tree) is not None
+    assert g.check(ok_tree) is None
+    g.accept(1.0)
+    assert g.check(ok_tree, 1.1) is None      # no spike
+    assert g.check(ok_tree, 5.0) is not None  # 5x EWMA
+    assert g.check(ok_tree, float("nan")) is not None
+    g.record_rollback(3, "spike")             # within budget
+    with pytest.raises(RollbackBudgetExceeded):
+        g.record_rollback(3, "spike again")
+    g2 = AcceptanceGuard(min_history=3)
+    g2.accept(1.0)
+    assert g2.check(ok_tree, 50.0) is None    # history not armed yet
+
+
+# -- sp engine: the three rings ---------------------------------------------
+def _sp_args(**over):
+    cfg = {
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                      "partition_alpha": 0.5, "train_size": 500,
+                      "test_size": 150, "class_num": 5, "feature_dim": 16},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 5,
+                       "client_num_per_round": 5, "comm_round": 4,
+                       "epochs": 1, "batch_size": 32,
+                       "learning_rate": 0.3, **over},
+    }
+    return fedml_tpu.init(load_arguments_from_dict(cfg))
+
+
+def _sp_api(args):
+    from fedml_tpu import device as device_mod
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    device = device_mod.get_device(args)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    return FedAvgAPI(args, device, ds, model)
+
+
+class _PoisonTrainer:
+    """Wraps the compiled trainer; poisons (cid, rounds >= rnd)."""
+
+    def __init__(self, inner, cid, rnd, fn):
+        self._inner = inner
+        self._pc, self._pr, self._fn = cid, rnd, fn
+        self._cid = None
+        self._rnd = None
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def set_id(self, cid):
+        self._cid = cid
+        self._inner.set_id(cid)
+
+    def set_round(self, r):
+        self._rnd = r
+        self._inner.set_round(r)
+
+    def run_local_training(self, params, data, device, args):
+        w, m = self._inner.run_local_training(params, data, device, args)
+        if self._cid == self._pc and self._rnd >= self._pr:
+            w = self._fn(params, w)
+        return w, m
+
+
+def test_sp_screen_contains_magnitude_poison():
+    """Ring 1 on the sp engine: the poisoner is screened (z at round 0,
+    norm overflow once the baseline exists), quarantined out of
+    selection, and the run converges as if it never existed."""
+    args = _sp_args(compression="int8", integrity=True)
+    api = _sp_api(args)
+    api.trainer = _PoisonTrainer(
+        api.trainer, cid=2, rnd=0,
+        fn=lambda g, w: jax.tree.map(lambda x: x * 200.0, w))
+    b = _counter("integrity/screened_uploads")
+    r = api.train()
+    assert r["test_acc"] > 0.5, r
+    assert _counter("integrity/screened_uploads") - b >= 2
+    assert api._quarantine.reason(2) is not None
+
+
+def test_sp_rollback_recovers_and_names_suspect():
+    """Ring 3 on the sp engine: a screen-admitted loss-spike poison is
+    rejected post-eval, the round rolls back and re-runs without the
+    suspect, and training ends healthy."""
+    args = _sp_args(compression="identity", integrity=True,
+                    integrity_norm_mult=1e9, integrity_z_threshold=1e9,
+                    comm_round=5)
+    api = _sp_api(args)
+    api.trainer = _PoisonTrainer(
+        api.trainer, cid=3, rnd=2,
+        fn=lambda g, w: jax.tree.map(
+            lambda gg, xx: gg + 200.0 * (gg - xx), g, w))
+    b = _counter("integrity/rollbacks")
+    r = api.train()
+    assert _counter("integrity/rollbacks") - b == 1
+    assert math.isfinite(r["test_acc"]) and r["test_acc"] > 0.5, r
+    assert "rolled back" in (api._quarantine.reason(3) or "")
+    # the rolled-back round's state never became durable history: the
+    # loss EWMA reflects only accepted rounds
+    assert api._guard._loss_ewma is not None
+    assert api._guard._loss_ewma < 2.0
+
+
+def test_sp_rollback_budget_aborts_loudly():
+    """Persistent unidentifiable corruption (screen off, whole cohort
+    suspect) must exhaust max_rollbacks and raise — never oscillate."""
+    args = _sp_args(compression="identity", integrity_rollback=True,
+                    max_rollbacks=1)
+    api = _sp_api(args)
+    api.trainer = _PoisonTrainer(
+        api.trainer, cid=1, rnd=1,
+        fn=lambda g, w: jax.tree.map(
+            lambda x: x * np.float32("nan"), w))
+    b = _counter("integrity/rollback_aborts")
+    with pytest.raises(RollbackBudgetExceeded):
+        api.train()
+    assert _counter("integrity/rollback_aborts") == b + 1
+
+
+# -- hierarchy: robust tiers + per-tier corrupt screen ----------------------
+def test_tree_robust_bit_identical_and_no_f32_trees():
+    """Acceptance leg: trimmed-mean fused tier aggregation is
+    bit-identical across two same-seed runs and never materializes
+    per-client f32 trees (the PR 6 peak-buffer contract)."""
+    from fedml_tpu.hierarchy.runner import TreeRunner
+    from fedml_tpu.hierarchy.tree import TreeTopology
+
+    topo = TreeTopology(levels=(1, 8, 512))
+    outs = [TreeRunner(topo, codec="int8", seed=3, quorum=0.5,
+                       agg_robust="trimmed_mean@0.2").run(2)
+            for _ in range(2)]
+    assert outs[0]["final_digest"] == outs[1]["final_digest"]
+    assert outs[0]["agg_robust"] == "trimmed_mean@0.2"
+    f32_all = outs[0]["f32_tree_nbytes"] * outs[0]["clients"]
+    for d, row in outs[0]["per_tier"].items():
+        assert row["peak_buffer_bytes"] < 0.05 * f32_all, (d, row)
+
+
+def test_tree_median_matches_flat_median_identity():
+    """2-tier identity tree with median tiers == flat coordinate median
+    of the same seeded deltas (per-tier robust semantics sanity)."""
+    from fedml_tpu.hierarchy.runner import TreeRunner, _make_delta_fn
+    from fedml_tpu.hierarchy.tree import TreeTopology
+    from fedml_tpu.integrity.robust_agg import robust_reduce_leaf
+
+    topo = TreeTopology(levels=(1, 9))
+    runner = TreeRunner(topo, codec="identity", seed=4, quorum=1.0,
+                        agg_robust="median")
+    out = runner.run(1)
+    assert out["completed"]
+    # reference: median over each client's seeded delta
+    from fedml_tpu.compression.codecs import derive_key_data
+
+    delta_fn = _make_delta_fn(runner.meta)
+    deltas = []
+    for cid in range(9):
+        key = jax.random.wrap_key_data(
+            jax.numpy.asarray(derive_key_data(4, 0, cid)))
+        deltas.append([np.asarray(x) for x in delta_fn(
+            jax.random.fold_in(key, 1))])
+    got = runner.global_leaves
+    for j in range(len(runner.meta)):
+        stack = np.stack([d[j] for d in deltas])
+        ref = np.asarray(robust_reduce_leaf(
+            jax.numpy.asarray(stack), "median", 0))
+        np.testing.assert_allclose(got[j], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_tree_corrupt_uplink_screened_per_tier():
+    """A NaN-corrupted tier-1 uplink is refused at the tier above; the
+    round closes over the survivors and the run stays finite."""
+    from fedml_tpu.hierarchy.runner import TreeRunner
+    from fedml_tpu.hierarchy.tree import TreeTopology
+    from fedml_tpu.resilience.chaos import NaNWindow
+
+    b_scr = _counter("integrity/screened_uploads")
+    topo = TreeTopology(levels=(1, 4, 96))
+    runner = TreeRunner(topo, codec="int8", seed=5, quorum=0.5,
+                        screen=True,
+                        chaos=[NaNWindow(rank=2, round=1, tier=1)])
+    out = runner.run(3)
+    assert out["completed"]
+    for leaf in runner.global_leaves:
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert _counter("integrity/screened_uploads") - b_scr >= 1
+    assert _counter("tier/1/screened") >= 1
+
+
+# -- chaos family -----------------------------------------------------------
+def test_corrupt_model_payload_modes():
+    from fedml_tpu.resilience import corrupt_model_payload
+
+    tree = _delta_trees(1)[0]
+    ct = get_codec("int8").encode(tree, key=derive_key(0, 0, 1),
+                                  is_delta=True)
+    nan_ct = corrupt_model_payload(ct, "nan")
+    assert not screen_stats(nan_ct).finite
+    scaled = corrupt_model_payload(ct, "scale", 50.0)
+    assert screen_stats(scaled).finite
+    assert screen_stats(scaled).norm > 40 * screen_stats(ct).norm
+    # plain trees too; determinism: same input → same corruption
+    nan_tree = corrupt_model_payload(tree, "nan")
+    assert not bool(np.isfinite(
+        list(jax.tree.leaves(nan_tree))[0]).all())
+    again = corrupt_model_payload(ct, "nan")
+    for a, b in zip(nan_ct.arrays, again.arrays):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_chaos_spec_parses_corrupt_update():
+    from fedml_tpu.resilience.chaos import ChaosSpec
+
+    spec = ChaosSpec({"corrupt_update": {"rank": 2, "round": 1,
+                                         "mode": "nan"}})
+    assert len(spec.corrupt_updates) == 1
+    w = spec.corrupt_updates[0]
+    assert w.active_at(2, 1) and not w.active_at(2, 2)
+    assert not w.active_at(1, 1)
+    with pytest.raises(ValueError):
+        ChaosSpec({"corrupt_update": [{"rank": 1, "mode": "evil"}]})
+
+
+# -- THE acceptance: cross-silo containment ---------------------------------
+def _cross_silo_cfg(run_id, seed=9, rounds=5, extra_train=None,
+                    log_dir=None):
+    extra = dict(extra_train or {})
+    if log_dir is not None:
+        extra["log_file_dir"] = str(log_dir)
+    return {
+        "common_args": {"training_type": "cross_silo",
+                        "random_seed": seed, "run_id": run_id},
+        "data_args": {"dataset": "synthetic", "train_size": 240,
+                      "test_size": 60, "class_num": 4,
+                      "feature_dim": 12},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 4,
+                       "client_num_per_round": 4,
+                       "comm_round": rounds, "epochs": 1,
+                       "batch_size": 32, "learning_rate": 0.3,
+                       **extra},
+    }
+
+
+def _run_federation(cfg, timeout=240.0):
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.message_define import MyMessage
+    from fedml_tpu.cross_silo.run_inproc import run_managers_to_completion
+    from fedml_tpu.cross_silo.server.server import Server
+    from fedml_tpu.data import load_federated
+
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    server = Server(args, None, ds, model)
+    clients = []
+    for rank in range(1, int(args.client_num_per_round) + 1):
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        clients.append(Client(cargs, None, ds, model))
+    managers = [server.manager] + [c.manager for c in clients]
+    result = run_managers_to_completion(
+        managers, cfg["common_args"]["run_id"],
+        MyMessage.MSG_TYPE_CONNECTION_IS_READY, timeout)
+    return result, server.manager
+
+
+_INTEGRITY_TRAIN = {
+    "compression": "int8", "prefetch": True,
+    "round_deadline_s": 30.0, "round_quorum": 0.5,
+    "integrity": True, "quarantine_rounds": 2,
+    # the round-3 poison must reach ring 3: open the norm/z screens
+    # wide (the NaN rule is unconditional and still guards round 1)
+    "integrity_norm_mult": 1e6, "integrity_z_threshold": 1e6,
+}
+
+
+def _run_poisoned_federation(run_id, log_dir=None):
+    extra = dict(_INTEGRITY_TRAIN)
+    extra["chaos"] = {"corrupt_update": [
+        {"rank": 2, "round": 1, "mode": "nan"},
+        {"rank": 3, "round": 3, "mode": "scale", "factor": 100.0},
+    ]}
+    extra["chaos_seed"] = 9
+    return _run_federation(
+        _cross_silo_cfg(run_id, extra_train=extra, log_dir=log_dir))
+
+
+def test_acceptance_nan_and_poison_contained(tmp_path):
+    """THE acceptance chaos run (ISSUE 15): 5-round int8+prefetch
+    cross-silo with seeded NaN injection at round 1 and a poisoned
+    cohort at round 3 — every corrupt upload screened or rolled back,
+    the poisoned client quarantined, final eval within tolerance of the
+    clean same-seed run, and the doctor naming the quarantined clients
+    and the rollback round."""
+    names = ["integrity/screened_uploads", "integrity/nonfinite_uploads",
+             "integrity/quarantined", "integrity/rollbacks",
+             "resilience/clients_evicted", "resilience/rejoin_syncs"]
+    before = {n: _counter(n) for n in names}
+    result, mgr = _run_poisoned_federation("integ_acc", log_dir=tmp_path)
+    assert result is not None, "federation did not complete"
+    delta = {n: _counter(n) - before[n] for n in names}
+    # round 1: the NaN upload was screened at admission, its sender
+    # quarantined + evicted; round 3: the magnitude poison slipped the
+    # (opened) screen, tripped the loss-spike guard, and rolled back
+    assert delta["integrity/nonfinite_uploads"] == 1, delta
+    assert delta["integrity/rollbacks"] == 1, delta
+    assert delta["integrity/quarantined"] >= 2, delta
+    assert delta["resilience/clients_evicted"] >= 1, delta
+    # satellite 3: the screened client REJOINED (liveness restored, EF
+    # residual reset via the rejoin sync)…
+    assert delta["resilience/rejoin_syncs"] >= 1, delta
+    assert mgr.liveness.evicted() == []
+    # …but stayed out of selection until quarantine_rounds elapsed:
+    # it was scored in fewer rounds than the always-honest client 1
+    hist = {cid: len(h) for cid, h in mgr._health._score_hist.items()}
+    assert hist.get(2, 0) < hist[1], hist
+    # the model survived: finite, and within tolerance of a clean
+    # same-seed run
+    clean, _ = _run_federation(
+        _cross_silo_cfg("integ_clean", extra_train=dict(_INTEGRITY_TRAIN)))
+    assert math.isfinite(result["test_acc"])
+    assert abs(result["test_acc"] - clean["test_acc"]) <= 0.1, (
+        result, clean)
+
+    # the doctor names the quarantined clients and the rollback round
+    from fedml_tpu import telemetry
+    from fedml_tpu.telemetry.doctor import build_doctor, format_doctor
+
+    telemetry.flush_run()
+    d = build_doctor(os.path.join(str(tmp_path), "run_integ_acc"))
+    integ = d["integrity"]
+    assert set(integ["quarantined_clients"]) >= {"2", "3"}, integ
+    assert any(rb["round"] == 3 for rb in integ["rollbacks"]), integ
+    assert any("QUARANTINED" in v and "client 2" in v
+               for v in d["verdict"]), d["verdict"]
+    assert any("ROLLED BACK" in v and "round 3" in str(v)
+               for v in d["verdict"]), d["verdict"]
+    out = format_doctor(d)
+    assert "update integrity" in out
+    assert "client 2" in out and "rollback: round 3" in out
+
+
+def test_screened_upload_closes_round_without_deadline():
+    """No round_deadline_s configured (legacy wait-forever regime): a
+    screened upload must still close the round over the survivors —
+    the screen KNOWS that sender will never re-upload, so waiting for a
+    deadline that does not exist would hang the federation."""
+    extra = {"compression": "int8", "integrity": True,
+             "chaos": {"corrupt_update": [
+                 {"rank": 2, "round": 1, "mode": "nan"}]},
+             "chaos_seed": 3}
+    result, mgr = _run_federation(
+        _cross_silo_cfg("integ_nodl", rounds=3, extra_train=extra),
+        timeout=120.0)
+    assert result is not None and math.isfinite(result["test_acc"])
+    assert mgr._quarantine.reason(2) is not None
+
+
+def test_agg_robust_negotiated_cross_silo():
+    """A robust-aggregation cross-silo round: the agg_robust spec rides
+    the round-config header, the fused robust statistic closes every
+    round, and the run converges."""
+    result, mgr = _run_federation(_cross_silo_cfg(
+        "integ_robust", rounds=3,
+        extra_train={"compression": "int8",
+                     "agg_robust": "trimmed_mean@0.25"}))
+    assert result is not None and result["test_acc"] > 0.5, result
+    assert mgr._agg_robust == "trimmed_mean@0.25"
+
+
+def test_agg_robust_construction_refusals():
+    from fedml_tpu.cross_silo.server.server import Server
+    from fedml_tpu.data import load_federated
+    from fedml_tpu import models as models_mod
+
+    cfg = _cross_silo_cfg("integ_refuse", extra_train={
+        "agg_robust": "median"})  # no codec
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    with pytest.raises(ValueError, match="agg_robust"):
+        Server(args, None, ds, model)
+
+
+def test_rolled_back_round_never_salvaged(tmp_path):
+    """Crash window: a kill between the round_rolled_back append and
+    the journal reset must NOT salvage the rejected round's (poisoned)
+    uploads on restart — the rollback record is terminal like a
+    commit."""
+    from fedml_tpu.resilience.durability import RoundJournal, salvage_round
+
+    j = RoundJournal(str(tmp_path / "rb.journal"), fsync=False)
+    j.append("round_open", round=3, cohort=[1, 2], silo_index={1: 0, 2: 1},
+             seed=0, codec="int8", secagg=False)
+    j.append("upload_received", round=3, client=1, msg_id="m1",
+             n_samples=10, payload={"w": np.ones((2,), np.float32)})
+    assert salvage_round(j.records(), 3) is not None  # pre-rollback: yes
+    j.append("round_rolled_back", round=3, reason="loss spike",
+             suspects=[1])
+    assert salvage_round(j.records(), 3) is None      # post-rollback: no
+    j.close()
+
+
+# -- lint / bench / compare -------------------------------------------------
+def test_span_lint_integrity_rules():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_span_names",
+        os.path.join(REPO, "tools", "check_span_names.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    entries = [
+        ("x.py", 1, "counter", "integrity/screened_uploads"),   # fine
+        ("x.py", 2, "gauge", "integrity/quarantine_active"),    # fine
+        ("x.py", 3, "counter", "integrity/client/2/drops"),     # labels!
+        ("x.py", 4, "histogram", "integrity/screen_ms"),        # no hists
+        ("x.py", 5, "span", "integrity/screen"),                # namespace
+    ]
+    problems = lint.check(entries)
+    assert len(problems) == 3, problems
+
+
+def test_integrity_bench_smoke(monkeypatch):
+    """Tier-1 smoke of `bench.py --integrity`: a reduced run must emit
+    the full gate schema with every gate green."""
+    monkeypatch.setenv("FEDML_INTEGRITY_ROUNDS", "3")
+    monkeypatch.setenv("FEDML_INTEGRITY_PARAMS", "40000")
+    from tools.integrity_bench import run_integrity_bench
+
+    row = run_integrity_bench()
+    assert row["ok"], row
+    for key in ("ok_seam", "ok_acc", "ok_mttr", "screen_seam_pct",
+                "screen_us_per_upload", "mttr_s", "acc_clean",
+                "screened_uploads"):
+        assert key in row, key
+    assert row["screened_uploads"] >= 1
+    assert row["rollbacks"] >= 1
+
+
+def test_compare_integrity_gates(tmp_path):
+    from tools.bench_compare import compare_integrity
+
+    base = {"metric": "integrity_screen_seam_pct", "value": 0.1,
+            "ok_seam": True, "ok_acc": True, "ok_mttr": True,
+            "screen_seam_pct": 0.1, "mttr_s": 1.0}
+    (tmp_path / "INTEGRITY_r01.json").write_text(json.dumps(base))
+    good = dict(base, screen_seam_pct=0.11, mttr_s=1.1)
+    (tmp_path / "INTEGRITY_r02.json").write_text(json.dumps(good))
+    out = compare_integrity(str(tmp_path))
+    assert out["ok"], out
+    bad = dict(base, ok_acc=False, mttr_s=5.0)
+    (tmp_path / "INTEGRITY_r03.json").write_text(json.dumps(bad))
+    out = compare_integrity(str(tmp_path))
+    assert not out["ok"]
+    notes = " ".join(out["regressions"])
+    assert "ok_acc" in notes and "MTTR" in notes

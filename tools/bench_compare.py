@@ -21,8 +21,8 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["compare_preempt", "compare_recover", "load_headline",
-           "run_compare", "main"]
+__all__ = ["compare_integrity", "compare_preempt", "compare_recover",
+           "load_headline", "run_compare", "main"]
 
 
 def _natural_key(path: str):
@@ -198,6 +198,51 @@ def compare_preempt(bench_dir: str = ".",
     return out
 
 
+def compare_integrity(bench_dir: str = ".",
+                      regression_threshold: float = 0.50) -> Optional[Dict]:
+    """Diff the newest two ``INTEGRITY_*.json`` containment records.
+
+    Same contract as :func:`compare_recover`: any GATE going false where
+    it was true (screen seam blown, poisoned accuracy out of tolerance,
+    rollback MTTR over budget) is a regression at any magnitude, and the
+    seam/MTTR numbers themselves fail past ``regression_threshold`` —
+    a screen that got 50% slower is eating the round it protects. None
+    when fewer than two files exist."""
+    files = sorted(glob.glob(os.path.join(bench_dir, "INTEGRITY_*.json")),
+                   key=_natural_key)
+    if len(files) < 2:
+        return None
+    prev_rec = _load_record(files[-2])
+    new_rec = _load_record(files[-1])
+    if prev_rec is None or new_rec is None:
+        return {"ok": True,
+                "note": "no parseable integrity record in "
+                        f"{files[-2] if prev_rec is None else files[-1]}"}
+    out: Dict = {
+        "ok": True,
+        "prev_file": os.path.basename(files[-2]),
+        "new_file": os.path.basename(files[-1]),
+        "regressions": [],
+    }
+    for field, label in (("screen_seam_pct", "screen seam"),
+                         ("mttr_s", "rollback MTTR")):
+        prev_v = prev_rec.get(field)
+        new_v = new_rec.get(field)
+        if prev_v and new_v is not None:
+            delta = (float(new_v) - float(prev_v)) / float(prev_v)
+            out[f"{field}_prev"] = prev_v
+            out[f"{field}_new"] = new_v
+            if delta > regression_threshold:
+                out["regressions"].append(
+                    f"{label} regressed {delta * 100:.1f}% "
+                    f"({prev_v} -> {new_v})")
+    for gate in ("ok_seam", "ok_acc", "ok_mttr"):
+        if prev_rec.get(gate) is True and new_rec.get(gate) is False:
+            out["regressions"].append(f"integrity gate {gate} went false")
+    out["ok"] = not out["regressions"]
+    return out
+
+
 def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                 pattern: str = "BENCH_*.json") -> Dict:
     """Diff the newest two BENCH files; ``ok`` is False only on a real,
@@ -246,10 +291,12 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
     # rounds/s drop
     recover = compare_recover(bench_dir)
     preempt = compare_preempt(bench_dir)
+    integrity = compare_integrity(bench_dir)
     return {
         "ok": (delta >= -threshold and not program_regressions
                and (recover is None or recover["ok"])
-               and (preempt is None or preempt["ok"])),
+               and (preempt is None or preempt["ok"])
+               and (integrity is None or integrity["ok"])),
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -262,6 +309,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
         "program_regressions": program_regressions,
         **({"recover": recover} if recover is not None else {}),
         **({"preempt": preempt} if preempt is not None else {}),
+        **({"integrity": integrity} if integrity is not None else {}),
     }
 
 

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Update-integrity containment gates — one JSON line.
+
+Three gates, matching the containment layer's cost/benefit contract
+(``fedml_tpu/integrity``, docs/integrity.md):
+
+- ``ok_seam``  — ring 1's admission screen costs < 2% of a round:
+  the per-upload jitted screen program is micro-measured on a
+  resnet-sized int8 delta, multiplied by the uploads per round, and
+  compared against a measured clean federation round;
+- ``ok_acc``   — a poisoned federation (NaN injection + magnitude
+  poison at the comm seam) finishes within tolerance of the clean
+  same-seed run: every corrupt upload screened or rolled back, the
+  model unharmed;
+- ``ok_mttr``  — a round rollback (reject → restore → re-run) lands
+  inside its wall-clock budget, measured on a loss-spike scenario the
+  screen deliberately admits.
+
+Archived as ``INTEGRITY_r0N.json``; ``tools/bench_compare.py``'s
+``compare_integrity`` fails any gate that goes false between archives
+(and seam/MTTR regressions past 50%). Env knobs: ``FEDML_INTEGRITY_*``
+(see ``_env`` below). Also reachable as ``python bench.py --integrity``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _env(name: str, default, cast=float):
+    raw = os.environ.get(f"FEDML_INTEGRITY_{name}")
+    return cast(raw) if raw else default
+
+
+def _screen_us(tree) -> float:
+    """Steady-state per-upload cost of the jitted screen program."""
+    from fedml_tpu.compression import derive_key, get_codec
+    from fedml_tpu.integrity import screen_stats
+
+    ct = get_codec("int8").encode(tree, key=derive_key(0, 0, 1),
+                                  is_delta=True)
+    screen_stats(ct)  # compile
+    trials = 20
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        screen_stats(ct)
+    return (time.perf_counter() - t0) / trials * 1e6
+
+
+def measure_screen_seam(n_params: int, uploads_per_round: int,
+                        round_wall_s: float, model_tree) -> dict:
+    """The admission screen's cost against the round it protects.
+
+    The GATED seam is honest about scale: it screens an upload of the
+    MEASURED federation's own model shape against that federation's own
+    round wall (a seam measured on an 11M-param tree against a tiny-lr
+    round would compare two different workloads). The resnet-sized
+    per-upload cost is reported alongside as the large-model data point
+    — its round would be dominated by training, not screening.
+    """
+    from tools.wire_bench import make_resnet_sized_tree
+
+    per_upload_us = _screen_us(model_tree)
+    seam_pct = (per_upload_us * 1e-6 * uploads_per_round) / max(
+        round_wall_s, 1e-9) * 100.0
+    return {
+        "screen_us_per_upload": round(per_upload_us, 1),
+        "screen_us_per_upload_resnet": round(
+            _screen_us(make_resnet_sized_tree(n_params)), 1),
+        "screen_seam_pct": round(seam_pct, 3),
+    }
+
+
+def run_integrity_bench() -> dict:
+    """Clean vs poisoned same-seed federations + screen seam + MTTR."""
+    from fedml_tpu.resilience import run_chaos_scenario
+
+    seed = _env("SEED", 11, int)
+    rounds = _env("ROUNDS", 5, int)
+    clients = _env("CLIENTS", 4, int)
+    n_params = _env("PARAMS", 400_000, int)
+    acc_tol = _env("ACC_TOL", 0.1)
+    mttr_budget_s = _env("MTTR_BUDGET_S", 20.0)
+    seam_budget_pct = _env("SEAM_BUDGET_PCT", 2.0)
+
+    common = dict(seed=seed, rounds=rounds, clients=clients,
+                  compression="int8", round_deadline_s=30.0,
+                  round_quorum=0.5, timeout=180.0)
+
+    t0 = time.perf_counter()
+    clean = run_chaos_scenario(integrity=True, **common)
+    clean_wall = time.perf_counter() - t0
+    round_wall_s = clean_wall / max(rounds, 1)
+
+    # the poisoned twin: NaN blocks at round 1, magnitude poison at
+    # round 3 — both from the comm seam, both must be screened
+    t0 = time.perf_counter()
+    poisoned = run_chaos_scenario(
+        integrity=True, corrupt_rank=2, corrupt_round=1,
+        corrupt_mode="nan", **common)
+    poisoned_wall = time.perf_counter() - t0
+    scaled = run_chaos_scenario(
+        integrity=True, corrupt_rank=min(3, clients), corrupt_round=3,
+        corrupt_mode="scale", corrupt_factor=200.0, **common)
+
+    # the measured federation's model shape (run_chaos_scenario's lr on
+    # synthetic(feature_dim=10, class_num=4)) — what its uploads carry
+    import numpy as np
+
+    model_tree = {"w": np.zeros((10, 4), np.float32),
+                  "b": np.zeros((4,), np.float32)}
+
+    acc_clean = float((clean.get("result") or {}).get("test_acc") or 0.0)
+    acc_nan = float((poisoned.get("result") or {}).get("test_acc") or 0.0)
+    acc_scaled = float((scaled.get("result") or {}).get("test_acc") or 0.0)
+    acc_poisoned = min(acc_nan, acc_scaled)
+    screened = (poisoned["counters"].get("screened_uploads", 0)
+                + scaled["counters"].get("screened_uploads", 0))
+    rollbacks = (poisoned["counters"].get("rollbacks", 0)
+                 + scaled["counters"].get("rollbacks", 0))
+
+    seam = measure_screen_seam(n_params, clients, round_wall_s,
+                               model_tree)
+
+    # rollback MTTR: reject → restore → re-run, measured on an sp
+    # loss-spike run the screen deliberately admits (huge thresholds);
+    # the poisoned run's extra wall over its clean twin, per rollback
+    mttr = measure_rollback_mttr(seed)
+
+    ok_seam = seam["screen_seam_pct"] < seam_budget_pct
+    ok_acc = (clean.get("completed") and poisoned.get("completed")
+              and scaled.get("completed")
+              and screened + rollbacks >= 1
+              and abs(acc_clean - acc_poisoned) <= acc_tol)
+    ok_mttr = (mttr["rollbacks"] >= 1
+               and mttr["mttr_s"] <= mttr_budget_s)
+    return {
+        "metric": "integrity_screen_seam_pct",
+        "value": seam["screen_seam_pct"],
+        "unit": "%",
+        "ok": bool(ok_seam and ok_acc and ok_mttr),
+        "ok_seam": bool(ok_seam),
+        "ok_acc": bool(ok_acc),
+        "ok_mttr": bool(ok_mttr),
+        **seam,
+        "seam_budget_pct": seam_budget_pct,
+        "round_wall_s": round(round_wall_s, 3),
+        "acc_clean": round(acc_clean, 4),
+        "acc_poisoned_nan": round(acc_nan, 4),
+        "acc_poisoned_scale": round(acc_scaled, 4),
+        "acc_tol": acc_tol,
+        "screened_uploads": screened,
+        "quarantined": (poisoned["counters"].get("quarantined", 0)
+                        + scaled["counters"].get("quarantined", 0)),
+        "mttr_s": mttr["mttr_s"],
+        "rollbacks": mttr["rollbacks"],
+        "mttr_budget_s": mttr_budget_s,
+        "clean_wall_s": round(clean_wall, 3),
+        "poisoned_wall_s": round(poisoned_wall, 3),
+    }
+
+
+def measure_rollback_mttr(seed: int) -> dict:
+    """Time one full ring-3 rollback: reject → restore → re-run round.
+
+    An sp federation with screen thresholds opened wide (the poison must
+    reach the aggregate) and a loss-spiking client at round 2; MTTR is
+    the wall from the rejection to the re-run round's acceptance,
+    measured around the guarded section itself.
+    """
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu import device as device_mod
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    cfg = {
+        "common_args": {"training_type": "simulation",
+                        "random_seed": int(seed),
+                        "run_id": f"integrity_bench_{seed}"},
+        "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                      "partition_alpha": 0.5, "train_size": 400,
+                      "test_size": 100, "class_num": 4, "feature_dim": 16},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg", "client_num_in_total": 5,
+            "client_num_per_round": 5, "comm_round": 4, "epochs": 1,
+            "batch_size": 32, "learning_rate": 0.3,
+            "compression": "identity", "integrity": True,
+            "integrity_norm_mult": 1e9, "integrity_z_threshold": 1e9,
+        },
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    device = device_mod.get_device(args)
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    api = FedAvgAPI(args, device, ds, model)
+
+    inner = api.trainer
+
+    class _Poison:
+        """Client 3 runs gradient ascent at round 2 — finite, admitted
+        by the wide-open screen, rejected by the loss-spike guard."""
+
+        def __init__(self):
+            self.cid = None
+            self.rnd = None
+
+        def __getattr__(self, k):
+            return getattr(inner, k)
+
+        def set_id(self, cid):
+            self.cid = cid
+            inner.set_id(cid)
+
+        def set_round(self, r):
+            self.rnd = r
+            inner.set_round(r)
+
+        def run_local_training(self, params, data, device, args):
+            w, m = inner.run_local_training(params, data, device, args)
+            if self.cid == 3 and self.rnd == 2:
+                w = jax.tree.map(lambda g, x: g + 50.0 * (g - x),
+                                 params, w)
+            return w, m
+
+    api.trainer = _Poison()
+    marks = {}
+    orig_rollback = api._rollback_round
+
+    def timed_rollback(round_idx, reason, client_ids):
+        marks["rejected_at"] = time.perf_counter()
+        return orig_rollback(round_idx, reason, client_ids)
+
+    api._rollback_round = timed_rollback
+    orig_accept = api._guard.accept
+
+    def timed_accept(loss=None):
+        if "rejected_at" in marks and "resumed_at" not in marks:
+            marks["resumed_at"] = time.perf_counter()
+        return orig_accept(loss)
+
+    api._guard.accept = timed_accept
+    api.train()
+    rollbacks = api._guard.total_rollbacks
+    mttr_s = (marks["resumed_at"] - marks["rejected_at"]
+              if "resumed_at" in marks and "rejected_at" in marks
+              else float("inf"))
+    return {"mttr_s": round(mttr_s, 3), "rollbacks": int(rollbacks)}
+
+
+def main() -> int:
+    row = run_integrity_bench()
+    print(json.dumps(row))
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
