@@ -381,6 +381,25 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--tracepath" in sys.argv:
+        # causal-tracing overhead gate: the SAME in-proc federation run
+        # with span streaming on vs off (rounds/s within tolerance), the
+        # micro-measured span-batch seam as a fraction of a round
+        # (<1%), and the steady-state trace wire bytes per node per
+        # round (bounded) — one JSON line (tools/tracepath_bench.py;
+        # FEDML_TRACEPATH_* env knobs)
+        from tools.tracepath_bench import run_tracepath_bench
+
+        row = run_tracepath_bench()
+        print(json.dumps(row))
+        # ok_rounds (the end-to-end on/off rounds/s ratio) is reported
+        # but not gated: at in-proc round walls the A/B diff is host
+        # noise — the deterministic seam measurement is the gate
+        if not (row["completed"] and row["ok_overhead"]
+                and row["ok_bytes"]):
+            raise SystemExit(1)
+        return
+
     if "--serve" in sys.argv:
         # live-serving SLO gate: sustained concurrent HTTP load through
         # the OpenAI endpoint across N federation hot swaps — qps,

@@ -85,6 +85,14 @@ _INTEGRITY_SHAPE = re.compile(r"^integrity/[a-z0-9_]+$")
 # HBM readings are levels, capture/recompile signals are counts — a
 # histogram here would violate the bounded-frame live-plane contract)
 _PROFILE_SHAPE = re.compile(r"^profile/[a-z0-9_]+$")
+# causal tracing: tracepath/* is the span-stream/critical-path meta-
+# namespace (frames, merged records, seq gaps, the latest round's
+# critical phase/share) — metric-only (the traced spans themselves keep
+# their own round/*, comm/* names), one signal segment (node/job ride
+# labels); counters or gauges only — frame/record signals are occurrence
+# counts, critical-phase readings are levels, and a histogram would
+# break the bounded live-frame contract
+_TRACEPATH_SHAPE = re.compile(r"^tracepath/[a-z0-9_]+$")
 
 
 def normalize(literal: str, is_fstring: bool) -> str:
@@ -151,10 +159,11 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
                     "or compress/decode")
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
-                 "secagg/", "profile/", "sched/", "integrity/")):
+                 "secagg/", "profile/", "sched/", "integrity/",
+                 "tracepath/")):
             bad(f"{name!r} — mem/, health/, resilience/, tier/, "
-                "live/, secagg/, profile/, sched/ and integrity/ are "
-                "metric namespaces, not span names")
+                "live/, secagg/, profile/, sched/, integrity/ and "
+                "tracepath/ are metric namespaces, not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 bad(f"span {name!r} must be serve/stage, "
@@ -227,6 +236,14 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
                     "fields)")
             elif kind == "histogram":
                 bad(f"{kind} {name!r} — sched/* signals are "
+                    "occurrence counts (counter) or levels (gauge), not "
+                    "histograms")
+        if kind != "span" and name.startswith("tracepath/"):
+            if not _TRACEPATH_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be tracepath/<signal> "
+                    "(one segment; node/job dimensions ride labels)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — tracepath/* signals are "
                     "occurrence counts (counter) or levels (gauge), not "
                     "histograms")
         if kind != "span" and name.startswith("secagg/"):

@@ -880,6 +880,91 @@ def telemetry_doctor(run_dir: str, as_json: bool,
         click.echo(format_doctor(triage))
 
 
+@telemetry.command("trace")
+@click.argument("run_dir")
+@click.option("--round", "round_idx", type=int, default=None,
+              help="restrict to ONE round index (default: all rounds)")
+@click.option("--perfetto", "perfetto_out", default=None,
+              help="write a Perfetto/Chrome trace-event JSON file here")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the critical-path summary dict as JSON")
+def telemetry_trace(run_dir: str, round_idx, perfetto_out,
+                    as_json: bool) -> None:
+    """Assemble the federation-wide causal trace and walk its critical
+    path.
+
+    RUN_DIR is a run's sink directory; spans from remote nodes shipped
+    over the live plane land in ``spans_remote.jsonl`` next to the local
+    ``spans.jsonl`` and are merged into one clock-aligned timeline. The
+    critical path names, for every round, the causal chain of
+    compute/wire/queue segments the round actually waited on.
+    """
+    from fedml_tpu.telemetry.report import load_programs
+    from fedml_tpu.telemetry.tracing import (
+        assemble_trace,
+        compute_critical_paths,
+        summarize_critical_paths,
+        write_perfetto,
+    )
+
+    trace = assemble_trace(run_dir)
+    if not trace.spans:
+        click.echo(f"no spans recorded under {run_dir}")
+        raise SystemExit(1)
+    rounds = [int(round_idx)] if round_idx is not None else None
+    programs = load_programs(run_dir)
+    cps = compute_critical_paths(trace, rounds=rounds,
+                                 programs=programs or None)
+    if perfetto_out:
+        write_perfetto(trace, perfetto_out, critical_paths=cps,
+                       rounds=rounds)
+        click.echo(f"perfetto trace -> {perfetto_out} "
+                   f"(load at https://ui.perfetto.dev)", err=True)
+    if as_json:
+        summary = summarize_critical_paths(cps)
+        summary["schema"] = "fedml_tpu.telemetry.trace/v1"
+        summary["run_dir"] = run_dir
+        summary["nodes"] = trace.nodes
+        summary["clocks"] = [trace.clocks[n].to_dict()
+                             for n in sorted(trace.clocks)]
+        click.echo(json.dumps(summary, indent=1, sort_keys=True,
+                              default=str))
+        return
+    click.echo(f"causal trace: {run_dir}")
+    click.echo(f"  nodes: {', '.join(trace.nodes)} "
+               f"(reference clock: {trace.ref_node})")
+    for node in sorted(trace.clocks):
+        c = trace.clocks[node]
+        if c.method == "reference":
+            continue
+        unc = (f"±{c.uncertainty_s * 1e3:.1f} ms"
+               if c.uncertainty_s is not None else "unbounded")
+        click.echo(f"  clock {node}: offset {c.offset_s * 1e3:+.1f} ms "
+                   f"{unc} ({c.method}, {c.pairs} pair(s))")
+    if not cps:
+        click.echo("  no round spans found — nothing to walk")
+        raise SystemExit(1)
+    for cp in cps:
+        d = cp.to_dict()
+        click.echo(f"\nround {d['round']}: path {d['path_ms']:.1f} ms / "
+                   f"wall {d['wall_ms']:.1f} ms "
+                   f"(coverage {100 * d['coverage']:.0f}%)")
+        for seg in cp.segments:
+            label = seg.phase
+            if seg.program:
+                label += f" [{seg.program}]"
+            click.echo(f"  {seg.duration_ms:>9.2f} ms  {seg.kind:<8s}"
+                       f"{seg.node:<18s}{label}")
+        st = d.get("straggler")
+        if st:
+            where = ("ON the critical path"
+                     if st["on_critical_path"] else "has slack")
+            click.echo(f"  straggler client {st['client']}: {where} "
+                       f"(what-if savings {st['savings_ms']:.1f} ms)")
+        for flag in d.get("flags") or []:
+            click.echo(f"  note: {flag}")
+
+
 @telemetry.command("watch")
 @click.argument("target")
 @click.option("--interval", default=2.0, show_default=True,

@@ -75,6 +75,10 @@ class FedMLCommManager(Observer):
         # bound. Both default off — the production hot path is two
         # None-checks.
         self.live_streamer = None
+        # causal tracing: a SpanStreamer piggybacks bounded span-batch
+        # frames the same way (rate-limited, drop/duplicate-tolerant);
+        # inbound trace frames route to the LivePlane's TraceCollector
+        self.trace_streamer = None
         # the authoritative round for windowed chaos faults: the client
         # FSM's own round_idx, or the server's args.round_idx
         self._chaos = chaos_from_args(
@@ -160,6 +164,14 @@ class FedMLCommManager(Observer):
                 ingest_frame(frame)
             except Exception:  # observability must not break the round
                 logger.exception("telemetry frame ingest failed")
+        tframe = msg_params.get(Message.MSG_ARG_KEY_TRACE)
+        if tframe is not None:
+            try:
+                from fedml_tpu.telemetry.live import ingest_trace_frame
+
+                ingest_trace_frame(tframe)
+            except Exception:  # observability must not break the round
+                logger.exception("trace frame ingest failed")
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.warning("rank %d: no handler for %s", self.rank, msg_type)
@@ -172,12 +184,24 @@ class FedMLCommManager(Observer):
             "comm_recv", msg_type=str(msg_type), rank=self.rank,
             sender=msg_params.get_sender_id(),
             **({"round": rnd} if rnd is not None else {}))
+        # receive-side half of the clock-alignment pair: a point event on
+        # THIS node's wall clock for the sender's msg_id (the send-side
+        # twin was stamped by the peer's send_message)
+        if msg_id is not None:
+            telemetry.get_tracer().event(
+                "comm/recv", msg_id=msg_id,
+                peer=msg_params.get_sender_id(),
+                msg_type=str(msg_type),
+                **({"round": rnd} if rnd is not None else {}))
         ctx = telemetry.extract_context(msg_params.get_params())
         token = telemetry.activate_context(ctx)
         try:
             if ctx is not None:
                 with telemetry.get_tracer().span(
-                    "comm/dispatch", msg_type=str(msg_type), rank=self.rank
+                    "comm/dispatch", msg_type=str(msg_type), rank=self.rank,
+                    sender=msg_params.get_sender_id(),
+                    **({"msg_id": msg_id} if msg_id is not None else {}),
+                    **({"round": rnd} if rnd is not None else {}),
                 ):
                     handler(msg_params)
             else:
@@ -212,7 +236,23 @@ class FedMLCommManager(Observer):
         # carry the current trace context as a message header so the
         # receiving rank's spans join this round's timeline
         telemetry.inject_context(message.get_params())
+        # idempotent-send header: stamped once per logical message (a
+        # retried send reuses it, so the receiver's deduper catches the
+        # case where the first attempt DID land). Stamped before the
+        # send event below so the event can carry the id the receiver's
+        # comm/recv twin will match on — chaos duplicate copies share the
+        # id on purpose, which keeps the pairing unambiguous
+        if message.get(Message.MSG_ARG_KEY_MSG_ID) is None:
+            message.add_params(Message.MSG_ARG_KEY_MSG_ID,
+                               self._msg_id_prefix + str(next(self._send_seq)))
         rnd = message.get("round")
+        # send-side half of the clock-alignment pair; recorded under the
+        # current span so the critical-path walk can cross the wire back
+        # to the span that caused this message
+        telemetry.get_tracer().event(
+            "comm/send", msg_id=message.get(Message.MSG_ARG_KEY_MSG_ID),
+            peer=message.get_receiver_id(), msg_type=message.get_type(),
+            **({"round": rnd} if rnd is not None else {}))
         flight_recorder.record(
             "comm_send", msg_type=message.get_type(), rank=self.rank,
             receiver=message.get_receiver_id(),
@@ -248,17 +288,22 @@ class FedMLCommManager(Observer):
                     reg.counter("live/frames_piggybacked").inc()
             except Exception:  # observability must not break the send
                 logger.exception("telemetry frame piggyback failed")
+        # causal tracing: one prepared span-batch frame per message, same
+        # contract as the metric frame above (rate-limited, BEFORE the
+        # chaos seam — the collector's index merge absorbs drop/duplicate)
+        if (self.trace_streamer is not None
+                and message.get(Message.MSG_ARG_KEY_TRACE) is None):
+            try:
+                tframe = self.trace_streamer.pop_frame()
+                if tframe is not None:
+                    message.add_params(Message.MSG_ARG_KEY_TRACE, tframe)
+            except Exception:  # observability must not break the send
+                logger.exception("trace frame piggyback failed")
         # chaos: update-corruption windows mutate the model payload at
         # exactly this seam — after encode, before the wire (None-check
         # in production; the injector no-ops without corrupt windows)
         if self._chaos is not None:
             self._chaos.corrupt_payload(message)
-        # idempotent-send header: stamped once per logical message (a
-        # retried send reuses it, so the receiver's deduper catches the
-        # case where the first attempt DID land)
-        if message.get(Message.MSG_ARG_KEY_MSG_ID) is None:
-            message.add_params(Message.MSG_ARG_KEY_MSG_ID,
-                               self._msg_id_prefix + str(next(self._send_seq)))
         copies, delay_s = (1, 0.0) if self._chaos is None else (
             self._chaos.on_send(message))
         if delay_s > 0:

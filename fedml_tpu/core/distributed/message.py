@@ -50,6 +50,10 @@ class Message:
     # telemetry/live/frames.py) piggybacked on an existing message — the
     # collector side merges it; like health, never its own round-trip
     MSG_ARG_KEY_TELEMETRY = "telemetry_frame"
+    # causal tracing: one seq-numbered span-batch frame (JSON-safe dict,
+    # see telemetry/tracing/stream.py) piggybacked the same way — the
+    # TraceCollector merges it idempotently by absolute record index
+    MSG_ARG_KEY_TRACE = "trace_frame"
 
     def __init__(self, type_: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type_)

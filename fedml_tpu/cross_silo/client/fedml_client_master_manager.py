@@ -73,6 +73,19 @@ class ClientMasterManager(FedMLCommManager):
                 job=str(getattr(args, "run_id", "0") or "0"),
                 interval_s=float(getattr(args, "live_interval_s", 1.0)),
             ).start()
+            # causal tracing: this process's span stream rides the same
+            # piggyback carrier, so the server's TraceCollector can place
+            # client train spans on the assembled round timeline live.
+            # Same LOCAL exclusion — in-proc ranks share one tracer, and
+            # the server plane's loopback SpanStreamer already covers it.
+            if bool(getattr(args, "trace_streaming", True)):
+                from fedml_tpu.telemetry.tracing import SpanStreamer
+
+                self.trace_streamer = SpanStreamer(
+                    f"rank{self.rank}",
+                    job=str(getattr(args, "run_id", "0") or "0"),
+                    interval_s=float(getattr(args, "live_interval_s", 1.0)),
+                ).attach()
 
     def _heartbeat_fields(self) -> dict:
         """JSON-safe health scalars piggybacked on existing messages —
@@ -254,11 +267,15 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_finish(self, msg: Message) -> None:
         logger.debug("client %d finished", self.rank)
-        if self.live_streamer is not None:
-            # stream close: one last status message carries a FULL frame,
-            # so the collector's totals for this node end exact
+        if self.live_streamer is not None or self.trace_streamer is not None:
+            # stream close: one last status message carries a FULL frame
+            # (metric and span alike), so the collector's totals and the
+            # assembled trace for this node end exact
             try:
-                self.live_streamer.flush_final()
+                if self.live_streamer is not None:
+                    self.live_streamer.flush_final()
+                if self.trace_streamer is not None:
+                    self.trace_streamer.flush_final()
                 self.send_client_status(0)
             except Exception:
                 logger.debug("final telemetry flush failed", exc_info=True)
@@ -271,6 +288,8 @@ class ClientMasterManager(FedMLCommManager):
         self._finished.set()
         if self.live_streamer is not None:
             self.live_streamer.stop()
+        if self.trace_streamer is not None:
+            self.trace_streamer.stop()
         super().finish()
 
     # -- actions -----------------------------------------------------------

@@ -95,7 +95,8 @@ class FedMLServerManager(FedMLCommManager):
 
         # bind the run-dir sinks (spans/health/flight recorder) for
         # cross-silo runs the same way the simulation engines do
-        tracer = telemetry.configure_from_args(args)
+        tracer = telemetry.configure_from_args(args,
+                                               service=f"rank{self.rank}")
         self._health = ClientHealthTracker()
         self._devstats = DeviceStatsSampler()
         self._bcast_ts: Dict[int, float] = {}
@@ -128,6 +129,15 @@ class FedMLServerManager(FedMLCommManager):
         # secagg mask recovery rides the same deadline machinery: its
         # bounded waves re-arm this timer, never the round's own
         self._recovery_deadline = RoundDeadline(self._on_recovery_deadline)
+        # finish-linger: after _send_finish the receive loop stays up
+        # until every client's final status lands (it carries the
+        # flush_final FULL metric + span frames) or a short grace
+        # deadline fires — stopping first would truncate every remote
+        # node's trace tail and break the last rounds' critical path
+        self._finishing = False
+        self._finished_once = False
+        self._final_status_pending: set = set()
+        self._finish_grace_timer: Optional[threading.Timer] = None
 
         # crash-anywhere durability (durability: true): a write-ahead
         # round journal colocated with the checkpoints records every
@@ -465,6 +475,19 @@ class FedMLServerManager(FedMLCommManager):
                     logger.warning(
                         "dropping malformed secagg key advertisement "
                         "from client %s", msg.get_sender_id())
+        # finish-linger: during the post-FINISH grace the handler is a
+        # pure sink — the frame ingest already happened on the receive
+        # path; once every client's final status is in, stop waiting
+        with self._round_lock:
+            if self._finishing:
+                self._final_status_pending.discard(msg.get_sender_id())
+                drained = not self._final_status_pending
+            else:
+                drained = None
+        if drained is not None:
+            if drained:
+                self.finish()
+            return
         # any sign of life from an evicted client is its reconnect
         if self.is_initialized and self.liveness.is_evicted(
                 msg.get_sender_id()):
@@ -487,7 +510,7 @@ class FedMLServerManager(FedMLCommManager):
                 with self._round_lock:
                     self.result = {"rounds": self.round_num, **metrics}
                 self._send_finish()
-                self.finish()
+                self._finish_after_final_frames()
                 return
             with self._round_lock:
                 salvaged = self._salvaged is not None
@@ -1006,9 +1029,11 @@ class FedMLServerManager(FedMLCommManager):
         if self._live is not None:
             # per-round loopback: the fresh health/mem/resilience scores
             # land on the scrape endpoint (and in front of the online
-            # doctor) the moment the round closes, not at process exit
+            # doctor) the moment the round closes, not at process exit —
+            # and the just-closed round's critical path becomes the
+            # tracepath/* gauges the watch column reads
             try:
-                self._live.pump()
+                self._live.pump(round_idx=int(self.args.round_idx))
             except Exception:  # observability must never break the round
                 logger.exception("live telemetry pump failed at round %d",
                                  self.args.round_idx)
@@ -1069,7 +1094,7 @@ class FedMLServerManager(FedMLCommManager):
             with self._round_lock:
                 self.result = {"rounds": self.round_num, **metrics}
             self._send_finish()
-            self.finish()
+            self._finish_after_final_frames()
             return
 
         self._select_round_clients()
@@ -1418,7 +1443,42 @@ class FedMLServerManager(FedMLCommManager):
             m = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), client_id)
             self.send_message(m)
 
+    def _finish_after_final_frames(self) -> None:
+        """Finish, but let remote clients land their final frames first.
+
+        On FINISH each client flush_final()s its metric and span
+        streamers and ships one last status message carrying the FULL
+        frames. Tearing the receive loop down before those arrive loses
+        the tail of every remote node's trace (the last rounds' dispatch
+        and train spans), so the critical path for those rounds cannot
+        assemble. In-proc LOCAL runs share the process tracer — nothing
+        is in flight, finish immediately.
+        """
+        import threading
+
+        backend = str(getattr(self.args, "comm_backend", "LOCAL")
+                      or "LOCAL").upper()
+        if (backend == "LOCAL" or self._live is None
+                or self.client_num <= 0):
+            self.finish()
+            return
+        grace = float(getattr(self.args, "finish_grace_s", 3.0) or 3.0)
+        with self._round_lock:
+            self._finishing = True
+            self._final_status_pending = set(
+                range(1, self.client_num + 1))
+            timer = threading.Timer(grace, self.finish)
+            timer.daemon = True
+            self._finish_grace_timer = timer
+        timer.start()
+
     def finish(self) -> None:
+        with self._round_lock:
+            if self._finished_once:
+                return
+            self._finished_once = True
+            if self._finish_grace_timer is not None:
+                self._finish_grace_timer.cancel()
         self._deadline.cancel()
         self._recovery_deadline.cancel()
         if self._journal is not None:

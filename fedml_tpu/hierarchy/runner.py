@@ -626,7 +626,7 @@ class TreeRunner:
                     logger.exception("round listener failed at round %d", r)
             if self.live is not None:
                 try:
-                    self.live.pump()
+                    self.live.pump(round_idx=r)
                 except Exception:  # observability must never corrupt it
                     logger.exception("live telemetry pump failed at "
                                      "round %d", r)

@@ -192,6 +192,7 @@ class FederatedServingBridge(FedMLCommManager):
         # nothing). Own-process only, like the cross-silo client: never on
         # the shared-registry LOCAL path.
         self._telemetry_streamer = None
+        self._span_streamer = None
         if (bool(getattr(args, "live_telemetry", False))
                 and str(backend).upper() != constants.COMM_BACKEND_LOCAL):
             from fedml_tpu.telemetry.live import MetricStreamer
@@ -205,6 +206,18 @@ class FederatedServingBridge(FedMLCommManager):
                 interval_s=float(getattr(args, "live_interval_s", 1.0)),
                 send_cb=self._send_telemetry_frame,
             ).start()
+            # causal tracing: the endpoint's serve/swap spans ride their
+            # own dedicated carrier too, so the assembled round timeline
+            # extends through the serving hot-swap
+            if bool(getattr(args, "trace_streaming", True)):
+                from fedml_tpu.telemetry.tracing import SpanStreamer
+
+                self._span_streamer = SpanStreamer(
+                    "serve",
+                    job=str(getattr(args, "run_id", None) or run_id or "0"),
+                    interval_s=float(getattr(args, "live_interval_s", 1.0)),
+                    send_cb=self._send_trace_frame,
+                ).start()
 
     def run_async(self):
         """Start the receive loop AND announce ourselves: on distributed
@@ -242,11 +255,22 @@ class FederatedServingBridge(FedMLCommManager):
         m.add_params(Message.MSG_ARG_KEY_TELEMETRY, frame)
         self.send_message(m)
 
+    def _send_trace_frame(self, frame: dict) -> None:
+        """Dedicated carrier for span-batch frames: same route as the
+        metric frames, under the trace param key."""
+        m = Message(ServeMessage.MSG_TYPE_S2P_TELEMETRY,
+                    self.get_sender_id(), 0)
+        m.add_params(Message.MSG_ARG_KEY_TRACE, frame)
+        self.send_message(m)
+
     def finish(self) -> None:
-        if self._telemetry_streamer is not None:
+        for attr in ("_telemetry_streamer", "_span_streamer"):
+            streamer = getattr(self, attr, None)
+            if streamer is None:
+                continue
             # stream close while the transport is still up: the final FULL
             # frame makes the collector's totals for this node exact
-            streamer, self._telemetry_streamer = self._telemetry_streamer, None
+            setattr(self, attr, None)
             try:
                 streamer.close()
             except Exception:  # pragma: no cover - transport already down

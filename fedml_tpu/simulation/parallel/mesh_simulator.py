@@ -76,7 +76,7 @@ class MeshFedAvgAPI:
         self.server_opt = ServerOptimizer(args)
         self.estimator = RuntimeEstimator()
         self.event = MLOpsProfilerEvent(args)
-        self.tracer = telemetry.configure_from_args(args)
+        self.tracer = telemetry.configure_from_args(args, service="mesh")
         self._m_round_ms = telemetry.get_registry().histogram("mesh/round_ms")
         # per-phase device/HBM introspection: stage vs dispatch vs eval
         # (the prefetch worker samples its own "prefetch" phase, so

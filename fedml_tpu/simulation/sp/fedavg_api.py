@@ -63,7 +63,7 @@ class FedAvgAPI:
         self._mime_s = None  # Mime server momentum
         self._mime_beta = float(getattr(args, "mime_beta", 0.9))
         self.event = MLOpsProfilerEvent(args)
-        self.tracer = telemetry.configure_from_args(args)
+        self.tracer = telemetry.configure_from_args(args, service="sp")
         self._m_client_ms = telemetry.get_registry().histogram(
             "sp/client_train_ms")
         self._m_rounds = telemetry.get_registry().counter("sp/rounds")

@@ -32,6 +32,7 @@ from fedml_tpu.telemetry.spans import (
     TraceContext,
     Tracer,
     activate_context,
+    add_span_listener,
     configure,
     configure_from_args,
     current_context,
@@ -41,11 +42,17 @@ from fedml_tpu.telemetry.spans import (
     get_tracer,
     inject_context,
     install_jax_compile_listener,
+    remove_span_listener,
     reset_tracer,
     unwrap_frame_body,
     wrap_frame_body,
 )
-from fedml_tpu.telemetry.report import build_report, format_report, load_spans
+from fedml_tpu.telemetry.report import (
+    RunData,
+    build_report,
+    format_report,
+    load_spans,
+)
 from fedml_tpu.telemetry import flight_recorder
 from fedml_tpu.telemetry.device_stats import (
     DeviceStatsSampler,
@@ -81,6 +88,18 @@ from fedml_tpu.telemetry.profiling import (  # noqa: E402 - after spans
     reset_trace_controller,
     wrap_jit,
 )
+from fedml_tpu.telemetry.tracing import (  # noqa: E402 - after report
+    AssembledTrace,
+    RoundCriticalPath,
+    SpanStreamer,
+    TraceCollector,
+    assemble_trace,
+    compute_critical_path,
+    compute_critical_paths,
+    export_perfetto,
+    summarize_critical_paths,
+    write_perfetto,
+)
 
 __all__ = [
     "BYTES_BUCKETS",
@@ -96,6 +115,7 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "activate_context",
+    "add_span_listener",
     "configure",
     "configure_from_args",
     "current_context",
@@ -105,9 +125,11 @@ __all__ = [
     "get_tracer",
     "inject_context",
     "install_jax_compile_listener",
+    "remove_span_listener",
     "reset_tracer",
     "unwrap_frame_body",
     "wrap_frame_body",
+    "RunData",
     "build_report",
     "format_report",
     "load_spans",
@@ -137,4 +159,14 @@ __all__ = [
     "reset_catalog",
     "reset_trace_controller",
     "wrap_jit",
+    "AssembledTrace",
+    "RoundCriticalPath",
+    "SpanStreamer",
+    "TraceCollector",
+    "assemble_trace",
+    "compute_critical_path",
+    "compute_critical_paths",
+    "export_perfetto",
+    "summarize_critical_paths",
+    "write_perfetto",
 ]

@@ -22,9 +22,8 @@ from typing import Any, Dict, List, Optional
 
 from fedml_tpu.telemetry.health import _median
 from fedml_tpu.telemetry.report import (
-    _load_jsonl,
+    RunData,
     build_report,
-    load_metrics,
     normalize_name,
 )
 
@@ -52,7 +51,7 @@ def _fmt_bytes(b: float) -> str:
     return f"{b:.1f} GiB"  # pragma: no cover
 
 
-def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
+def build_doctor(run_dir, straggler_threshold: float = 2.0,
                  anomaly_threshold: float = 4.0,
                  mem_growth_threshold: float = 1.5,
                  min_rounds: int = 3,
@@ -60,8 +59,13 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
     notes: Dict[str, str] = {}
     verdict: List[str] = []
 
+    # Share one RunData with build_report so every sink file is read at
+    # most once per doctor invocation.
+    data = run_dir if isinstance(run_dir, RunData) else RunData(run_dir)
+    run_dir = data.run_dir
+
     health_path = os.path.join(run_dir, "health.jsonl")
-    health_events = _load_jsonl(health_path)
+    health_events = data.health
     if not os.path.exists(health_path):
         notes["health"] = "no data: health.jsonl missing (run predates the " \
                           "health layer, or no health events fired)"
@@ -69,14 +73,14 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         notes["health"] = "no data: health.jsonl is empty or unparseable"
 
     fr_path = os.path.join(run_dir, "flight_recorder.jsonl")
-    fr_events = _load_jsonl(fr_path)
+    fr_events = data.flight
     if not os.path.exists(fr_path):
         notes["crash"] = "no data: flight_recorder.jsonl missing (process " \
                          "still alive, or recorder not bound)"
     elif not fr_events:
         notes["crash"] = "no data: flight_recorder.jsonl is empty"
 
-    report = build_report(run_dir)
+    report = build_report(data)
     for key, val in (report.get("notes") or {}).items():
         notes.setdefault(key, val)
 
@@ -270,9 +274,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             f"compression is not paying off: raw->wire ratio "
             f"{comp['ratio']:.2f}x — check codec choice vs payload dtypes")
     # encode/decode duration outliers: individual spans way past the p50
-    from fedml_tpu.telemetry.report import load_spans
-
-    spans = load_spans(run_dir)
+    spans = data.spans
     codec_spans = [s for s in spans
                    if normalize_name(s["name"]).startswith("compress/")]
     by_name: Dict[str, List[Dict]] = {}
@@ -303,7 +305,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
     # connectivity / tier sections below — it holds append-mode
     # CUMULATIVE registry snapshots, so each section keeps the latest
     # record per key rather than summing the stream.
-    metric_records = load_metrics(run_dir)
+    metric_records = data.metrics
 
     # -- live serving plane (hot-swap freshness + latency SLO) ------------
     serving: Dict[str, Any] = {}
@@ -836,6 +838,58 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
             "live", "no data: no live/* metrics or doctor_alert records "
             "(run predates the live plane, or live_telemetry was off)")
 
+    # -- causal critical path (tracepath) ---------------------------------
+    # the report already assembled the federation-wide trace; here we only
+    # cross-reference it with the flagged stragglers so the verdict can
+    # tell "the round waits on this client" apart from "this client is
+    # slow but hidden behind slack"
+    cp = dict(report.get("critical_path") or {})
+    cp_rounds = cp.get("rounds") or []
+    tracepath: Dict[str, Any] = {
+        "rounds_traced": len(cp_rounds),
+        "by_kind_ms": cp.get("by_kind_ms") or {},
+        "clients_on_path": {},
+        "stragglers": [],
+    }
+    if cp_rounds:
+        on_path_rounds: Dict[str, List[int]] = {}
+        for row in cp_rounds:
+            for cid in row.get("clients_on_path") or []:
+                on_path_rounds.setdefault(str(cid), []).append(row["round"])
+        tracepath["clients_on_path"] = on_path_rounds
+        flagged = {str(r["client"]) for r in stragglers}
+        flagged.update(str(r["client"]) for r in span_stragglers)
+        for cid in sorted(flagged):
+            hit = on_path_rounds.get(cid, [])
+            savings = [
+                float((row.get("straggler") or {}).get("savings_ms") or 0.0)
+                for row in cp_rounds
+                if str((row.get("straggler") or {}).get("client")) == cid
+                and (row.get("straggler") or {}).get("on_critical_path")]
+            entry = {
+                "client": cid,
+                "rounds_on_path": hit,
+                "rounds_traced": len(cp_rounds),
+                "max_savings_ms": max(savings) if savings else 0.0,
+            }
+            tracepath["stragglers"].append(entry)
+            if hit:
+                save = (f" — up to {entry['max_savings_ms']:.0f} ms/round "
+                        "recoverable" if savings else "")
+                verdict.append(
+                    f"straggler client {cid} is ON the critical path in "
+                    f"{len(hit)}/{len(cp_rounds)} traced round(s) "
+                    f"{hit}: the round waits on it{save}")
+            else:
+                verdict.append(
+                    f"straggler client {cid} has slack: never on the "
+                    f"critical path across {len(cp_rounds)} traced "
+                    "round(s) — the round does not wait on it")
+    else:
+        notes.setdefault(
+            "tracepath",
+            "no data: no spans to assemble a causal trace from")
+
     if not (fr_events or health_events or report["n_spans"]
             or report.get("n_metrics")):
         notes["run"] = f"no telemetry data of any kind under {run_dir}"
@@ -863,6 +917,7 @@ def build_doctor(run_dir: str, straggler_threshold: float = 2.0,
         "integrity": integrity,
         "profile": profile,
         "live": live,
+        "tracepath": tracepath,
         "verdict": verdict,
     }
 
@@ -1106,4 +1161,20 @@ def format_doctor(d: Dict) -> str:
             add(f"  {name:<44s}{v!s:>14s}")
     else:
         add(f"  {notes.get('services', 'no data')}")
+
+    add("")
+    add("critical path:")
+    tp = d.get("tracepath") or {}
+    if tp.get("rounds_traced"):
+        add(f"  rounds traced: {tp['rounds_traced']}")
+        kinds = tp.get("by_kind_ms") or {}
+        if kinds:
+            add("  time by kind: " + ", ".join(
+                f"{k} {v:.0f} ms" for k, v in sorted(kinds.items())))
+        for s in tp.get("stragglers") or []:
+            where = (f"ON path in rounds {s['rounds_on_path']}"
+                     if s["rounds_on_path"] else "has slack (never on path)")
+            add(f"  straggler client {s['client']}: {where}")
+    else:
+        add(f"  {notes.get('tracepath', 'no data')}")
     return "\n".join(lines)

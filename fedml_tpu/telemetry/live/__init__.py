@@ -45,6 +45,7 @@ __all__ = [
     "fetch_state",
     "frame_nbytes",
     "ingest_frame",
+    "ingest_trace_frame",
     "render_state",
     "reset_live_plane",
     "watch",
@@ -67,12 +68,29 @@ class LivePlane:
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "127.0.0.1",
                  interval_s: float = 1.0,
-                 doctor_kwargs: Optional[Dict[str, Any]] = None):
+                 doctor_kwargs: Optional[Dict[str, Any]] = None,
+                 tracing: bool = True):
         self.collector = LiveCollector(job=job)
         self.doctor = OnlineDoctor(self.collector, run_dir=run_dir,
                                    **(doctor_kwargs or {}))
         self.streamer = MetricStreamer(node, job=job,
                                        interval_s=interval_s).start()
+        self._run_dir = run_dir
+        # causal tracing: merge span-batch frames from every node (and
+        # this process's own spans via a loopback SpanStreamer) so the
+        # per-round critical path is computable while the run is live,
+        # and the merged set persists as spans_remote.jsonl on close
+        self.trace_collector = None
+        self.trace_streamer = None
+        if tracing:
+            from fedml_tpu.telemetry.tracing import (
+                SpanStreamer,
+                TraceCollector,
+            )
+
+            self.trace_collector = TraceCollector(job=job)
+            self.trace_streamer = SpanStreamer(
+                node, job=job, interval_s=interval_s).attach()
         self.scrape: Optional[MetricsScrapeServer] = None
         if metrics_port is not None:
             self.scrape = MetricsScrapeServer(
@@ -104,17 +122,49 @@ class LivePlane:
                 "anomaly_threshold": float(
                     getattr(args, "anomaly_threshold", 4.0)),
             },
+            tracing=bool(getattr(args, "trace_streaming", True)),
         )
 
     @property
     def url(self) -> Optional[str]:
         return self.scrape.url if self.scrape is not None else None
 
-    def pump(self) -> None:
+    def pump(self, round_idx: Optional[int] = None) -> None:
         """Loopback this process's own registry into the collector (the
         server calls this once per closed round; rounds are derived from
-        the pumped health/rounds_scored metric, not passed in)."""
+        the pumped health/rounds_scored metric). With ``round_idx`` and
+        tracing enabled, also compute the just-closed round's critical
+        path from the merged span set and publish it as ``tracepath/*``
+        gauges (the ``telemetry watch`` critical-phase column)."""
+        if self.trace_streamer is not None:
+            self.trace_streamer.pump(self.trace_collector, force=True)
+        if round_idx is not None and self.trace_collector is not None:
+            try:
+                self._pump_critical_path(int(round_idx))
+            except Exception:  # observability must never break the round
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "critical-path pump failed at round %s", round_idx)
         self.streamer.pump(self.collector, force=True)
+
+    def _pump_critical_path(self, round_idx: int) -> None:
+        from fedml_tpu.telemetry.registry import get_registry
+        from fedml_tpu.telemetry.tracing import (
+            assemble_records,
+            compute_critical_path,
+            phase_code,
+        )
+
+        trace = assemble_records(self.trace_collector.records())
+        cp = compute_critical_path(trace, round_idx)
+        if cp is None or not cp.segments:
+            return
+        reg = get_registry()
+        reg.gauge("tracepath/critical_round").set(float(cp.round))
+        reg.gauge("tracepath/critical_phase").set(
+            float(phase_code(cp.top_phase())))
+        reg.gauge("tracepath/critical_share").set(float(cp.top_share()))
 
     def close(self, drain_s: float = 3.0) -> None:
         """Final full loopback frame, then stop the plane's threads. The
@@ -142,9 +192,24 @@ class LivePlane:
                     last_count, last_change = count, time.time()
                 elif time.time() - last_change >= 0.25:
                     break
+        # span stream closes FIRST: its close/ingest bump tracepath/*
+        # counters in the process registry, and the final metric FULL
+        # frame below must snapshot those totals — the other order
+        # leaves the collector's mirror permanently short of post-hoc
+        if self.trace_streamer is not None:
+            tfinal = self.trace_streamer.close()
+            if tfinal is not None and self.trace_collector is not None:
+                self.trace_collector.ingest(tfinal)
         final = self.streamer.close()
         if final is not None:
             self.collector.ingest(final)
+        if self.trace_collector is not None and self._run_dir:
+            try:
+                # the merged federation-wide span set lands next to the
+                # local sink for post-hoc assembly (trace CLI / report)
+                self.trace_collector.persist(self._run_dir)
+            except OSError:  # pragma: no cover - sink dir gone at exit
+                pass
         if self.scrape is not None:
             self.scrape.stop()
         global _plane
@@ -168,6 +233,15 @@ def ingest_frame(frame: Any) -> bool:
     return plane.collector.ingest(frame)
 
 
+def ingest_trace_frame(frame: Any) -> bool:
+    """Route a remote node's span-batch frame to this process's plane's
+    TraceCollector (no-op when no plane, or tracing is off)."""
+    plane = current_live_plane()
+    if plane is None or plane.trace_collector is None:
+        return False
+    return plane.trace_collector.ingest(frame)
+
+
 def reset_live_plane() -> None:
     """Drop the process-global plane (test isolation)."""
     global _plane
@@ -178,5 +252,7 @@ def reset_live_plane() -> None:
             if plane.scrape is not None:
                 plane.scrape.stop()
             plane.streamer.stop()
+            if plane.trace_streamer is not None:
+                plane.trace_streamer.stop()
         except Exception:  # pragma: no cover - teardown best effort
             pass

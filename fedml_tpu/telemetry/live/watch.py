@@ -87,7 +87,8 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
             "node": node, "round": None, "clients": None,
             "straggler": None, "straggler_client": None,
             "mem_bytes": None, "wire_bytes": 0.0, "serving_round": None,
-            "mfu": None, "hbm_bound": None})
+            "mfu": None, "hbm_bound": None,
+            "critical_phase": None, "critical_share": None})
         name = rec.get("name", "")
         val = float(rec.get("value", rec.get("count", 0)) or 0)
         if name == "health/rounds_scored" and val:
@@ -110,12 +111,19 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
             row["mfu"] = val
         elif name == "profile/hbm_bound":
             row["hbm_bound"] = bool(val)
+        elif name == "tracepath/critical_phase":
+            # phase_code()-encoded top phase of the latest round's
+            # critical path, pumped by the live plane each round
+            row["critical_phase"] = int(val)
+        elif name == "tracepath/critical_share":
+            row["critical_share"] = val
     detail = state.get("nodes_detail") or {}
     for node, d in detail.items():
         row = by_node.setdefault(node, {
             "node": node, "round": None, "clients": None, "straggler": None,
             "straggler_client": None, "mem_bytes": None, "wire_bytes": 0.0,
-            "serving_round": None, "mfu": None, "hbm_bound": None})
+            "serving_round": None, "mfu": None, "hbm_bound": None,
+            "critical_phase": None, "critical_share": None})
         row["seq"] = d.get("seq")
         row["seq_gaps"] = d.get("seq_gaps", 0)
     return [by_node[n] for n in sorted(by_node)]
@@ -135,7 +143,7 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
     add("")
     add(f"  {'node':<14s}{'round':>6s}{'clients':>8s}{'straggler':>12s}"
         f"{'mem':>10s}{'wire':>10s}{'mfu':>7s}{'roofline':>10s}"
-        f"{'serving':>8s}{'gaps':>6s}")
+        f"{'critical':>16s}{'serving':>8s}{'gaps':>6s}")
     for row in _node_rows(state):
         strag = ("-" if row.get("straggler") is None else
                  f"{row['straggler']:.1f}x"
@@ -145,6 +153,14 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
                else f"{row['mfu']:.2f}")
         roofline = ("-" if row.get("hbm_bound") is None
                     else ("HBM" if row["hbm_bound"] else "compute"))
+        if row.get("critical_phase") is None:
+            critical = "-"
+        else:
+            from fedml_tpu.telemetry.tracing import phase_label
+
+            critical = phase_label(row["critical_phase"])
+            if row.get("critical_share") is not None:
+                critical += f" {100 * row['critical_share']:.0f}%"
         add(f"  {row['node']:<14s}"
             f"{row['round'] if row['round'] is not None else '-':>6}"
             f"{row['clients'] if row['clients'] is not None else '-':>8}"
@@ -153,6 +169,7 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
             f"{_fmt_bytes(row.get('wire_bytes')):>10s}"
             f"{mfu:>7s}"
             f"{roofline:>10s}"
+            f"{critical:>16s}"
             f"{row['serving_round'] if row['serving_round'] is not None else '-':>8}"
             f"{row.get('seq_gaps', 0):>6}")
     alerts = state.get("alerts") or []

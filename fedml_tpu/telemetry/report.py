@@ -59,15 +59,74 @@ def _pct(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
 
 
-def load_spans(run_dir: str) -> List[Dict]:
-    spans = _load_jsonl(os.path.join(run_dir, "spans.jsonl"))
-    for e in _load_jsonl(os.path.join(run_dir, "events.jsonl")):
+def _spans_from_raw(spans_raw: List[Dict], events_raw: List[Dict]
+                    ) -> List[Dict]:
+    spans = list(spans_raw)
+    for e in events_raw:
         # legacy event records: {"event", "edge_id", started/ended/duration}
         if "event" in e and "name" not in e:
             e = dict(e)
             e["name"] = f"event/{e.pop('event')}"
         spans.append(e)
     return [s for s in spans if "name" in s and "duration_ms" in s]
+
+
+class RunData:
+    """Single-pass shared load of a run dir's JSONL sinks.
+
+    Every sink file is parsed at most once, whoever asks first; the
+    report's sections, the doctor, and the trace assembler all consume
+    the same cached parse. Build one per run dir and pass it to
+    ``build_report``/``build_doctor`` when composing them (the CLI's
+    ``doctor`` builds the report internally and would otherwise re-read
+    every file)."""
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self._raw: Dict[str, List[Dict]] = {}
+
+    def raw(self, filename: str) -> List[Dict]:
+        if filename not in self._raw:
+            self._raw[filename] = _load_jsonl(
+                os.path.join(self.run_dir, filename))
+        return self._raw[filename]
+
+    @property
+    def spans(self) -> List[Dict]:
+        return _spans_from_raw(self.raw("spans.jsonl"),
+                               self.raw("events.jsonl"))
+
+    @property
+    def metrics(self) -> List[Dict]:
+        return self.raw("telemetry.jsonl")
+
+    @property
+    def programs(self) -> List[Dict]:
+        return self.raw("programs.jsonl")
+
+    @property
+    def health(self) -> List[Dict]:
+        return self.raw("health.jsonl")
+
+    @property
+    def flight(self) -> List[Dict]:
+        return self.raw("flight_recorder.jsonl")
+
+    @property
+    def trace_records(self) -> List[Dict]:
+        """Raw span + point-event records for trace assembly: the local
+        sink plus the live-plane-collected remote sink."""
+        from fedml_tpu.telemetry.tracing.assemble import (
+            REMOTE_SPANS_FILENAME,
+        )
+
+        return self.raw("spans.jsonl") + self.raw(REMOTE_SPANS_FILENAME)
+
+
+def load_spans(run_dir: str) -> List[Dict]:
+    return _spans_from_raw(
+        _load_jsonl(os.path.join(run_dir, "spans.jsonl")),
+        _load_jsonl(os.path.join(run_dir, "events.jsonl")))
 
 
 def load_metrics(run_dir: str) -> List[Dict]:
@@ -81,9 +140,11 @@ def load_programs(run_dir: str) -> List[Dict]:
     return _load_jsonl(os.path.join(run_dir, "programs.jsonl"))
 
 
-def build_report(run_dir: str) -> Dict:
-    spans = load_spans(run_dir)
-    metrics = load_metrics(run_dir)
+def build_report(run_dir) -> Dict:
+    data = run_dir if isinstance(run_dir, RunData) else RunData(run_dir)
+    run_dir = data.run_dir
+    spans = data.spans
+    metrics = data.metrics
 
     # partial runs degrade to explicit per-section notes, not tracebacks:
     # a crashed writer leaves missing/truncated sinks and the report must
@@ -292,7 +353,7 @@ def build_report(run_dir: str) -> Dict:
     # yields achieved FLOP/s + bytes/s per phase, a roofline class per
     # program, and the per-round MFU decomposition (same "xla"
     # provenance as bench.py's whole-run number)
-    programs = load_programs(run_dir)
+    programs = data.programs
     attribution: Dict = {}
     if programs:
         from fedml_tpu.telemetry.profiling.roofline import build_attribution
@@ -309,6 +370,26 @@ def build_report(run_dir: str) -> Dict:
 
     # -- stitched (cross-process) spans ----------------------------------
     stitched = [s for s in spans if s.get("remote_parent")]
+
+    # -- causal critical path (per-round assembled-trace walk) ------------
+    critical_path: Dict = {}
+    if spans:
+        try:
+            from fedml_tpu.telemetry.tracing import (
+                assemble_records,
+                compute_critical_paths,
+                summarize_critical_paths,
+            )
+
+            trace = assemble_records(data.trace_records)
+            cps = compute_critical_paths(trace, programs=programs or None)
+            if cps:
+                critical_path = summarize_critical_paths(cps)
+                critical_path["clocks"] = [
+                    c.to_dict() for c in sorted(trace.clocks.values(),
+                                                key=lambda c: c.node)]
+        except Exception as e:  # report must degrade, never traceback
+            notes["critical_path"] = f"trace assembly failed: {e!r}"
 
     return {
         "schema": "fedml_tpu.telemetry.report/v1",
@@ -328,6 +409,7 @@ def build_report(run_dir: str) -> Dict:
         "mem_gauges": mem_gauges,
         "services": services,
         "attribution": attribution,
+        "critical_path": critical_path,
         "stitched_spans": stitched,
     }
 
@@ -467,6 +549,35 @@ def format_report(report: Dict) -> str:
     elif "attribution" in notes:
         add("")
         add(f"performance attribution: {notes['attribution']}")
+    cp = report.get("critical_path") or {}
+    if cp.get("rounds"):
+        add("")
+        add("critical path (per-round longest causal chain, aligned "
+            "timeline):")
+        for r in cp["rounds"]:
+            strag = r.get("straggler") or {}
+            extra = ""
+            if strag:
+                extra = (f"  straggler client {strag['client']} "
+                         + ("ON path" if strag.get("on_critical_path")
+                            else "has slack")
+                         + f", removing saves <= {strag['savings_ms']:.1f} ms")
+            add(f"  round {r['round']}: path {r['path_ms']:.1f} ms / wall "
+                f"{r['wall_ms']:.1f} ms, top phase {r['top_phase']} "
+                f"({100 * (r.get('top_share') or 0):.0f}%)" + extra)
+            kinds = r.get("by_kind") or {}
+            if kinds:
+                add("    " + "  ".join(f"{k} {v:.1f} ms"
+                                       for k, v in sorted(kinds.items())))
+        clocks = [c for c in cp.get("clocks") or []
+                  if c.get("method") not in ("reference", None)]
+        if clocks:
+            add("  clock alignment:")
+            for c in clocks:
+                unc = c.get("uncertainty_ms")
+                add(f"    node {c['node']}: offset {c['offset_ms']:+.2f} ms "
+                    f"+/- {unc if unc is not None else '?'} ms "
+                    f"({c['method']}, {c['pairs']} pairs)")
     if report["stitched_spans"]:
         add("")
         add(f"cross-process stitched spans: {len(report['stitched_spans'])}")

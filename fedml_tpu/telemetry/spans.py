@@ -70,7 +70,8 @@ class _ActiveSpan:
     """Mutable in-flight span; becomes an immutable record at end()."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "started",
-                 "attrs", "remote_parent", "placeholder", "compile_ms")
+                 "started_mono", "attrs", "remote_parent", "placeholder",
+                 "compile_ms")
 
     def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
                  remote_parent: bool, attrs: Dict[str, Any]):
@@ -78,7 +79,11 @@ class _ActiveSpan:
         self.trace_id = trace_id
         self.span_id = uuid.uuid4().hex[:16]
         self.parent_id = parent_id
+        # wall clock for human-readable placement, monotonic for durations:
+        # an NTP step mid-run shifts `started` but cannot corrupt the
+        # measured length of the span
         self.started = time.time()
+        self.started_mono = time.perf_counter()
         self.attrs = attrs
         self.remote_parent = remote_parent
         self.placeholder = False
@@ -215,6 +220,41 @@ def _flush_live_tracers() -> None:
 atexit.register(_flush_live_tracers)
 
 
+# -- span listeners --------------------------------------------------------
+# Process-global observers of completed span/event records — the live
+# tracing plane (SpanStreamer) taps here so remote nodes can ship their
+# spans without the tracer knowing anything about transports. Listener
+# exceptions are swallowed: observability must never break the traced code.
+_span_listeners: List[Any] = []
+_span_listeners_lock = threading.Lock()
+
+
+def add_span_listener(fn) -> None:
+    """Register ``fn(record: dict)`` to observe every completed span and
+    every point event recorded by any tracer in this process."""
+    with _span_listeners_lock:
+        if fn not in _span_listeners:
+            _span_listeners.append(fn)
+
+
+def remove_span_listener(fn) -> None:
+    with _span_listeners_lock:
+        try:
+            _span_listeners.remove(fn)
+        except ValueError:
+            pass
+
+
+def _notify_span_listeners(rec: Dict) -> None:
+    with _span_listeners_lock:
+        listeners = list(_span_listeners)
+    for fn in listeners:
+        try:
+            fn(rec)
+        except Exception:  # noqa: BLE001 - listeners must never raise out
+            pass
+
+
 class Tracer:
     """Span factory + buffered JSONL sink.
 
@@ -252,15 +292,24 @@ class Tracer:
         return span
 
     def end(self, span: _ActiveSpan, ended: Optional[float] = None) -> Dict:
-        ended = ended or time.time()
+        if ended is None:
+            # duration from the monotonic clock; `ended` derived so the
+            # ended - started == duration invariant survives for readers
+            duration_ms = (time.perf_counter() - span.started_mono) * 1e3
+            ended = span.started + duration_ms / 1e3
+        else:
+            # explicit end times are wall-clock by contract (backfill,
+            # tests) — keep the historical wall math for them
+            duration_ms = (ended - span.started) * 1e3
         rec = {
             "name": span.name,
             "trace_id": span.trace_id,
             "span_id": span.span_id,
             "parent_id": span.parent_id,
             "started": span.started,
+            "mono": span.started_mono,
             "ended": ended,
-            "duration_ms": (ended - span.started) * 1e3,
+            "duration_ms": duration_ms,
         }
         if span.compile_ms:
             rec["compile_ms"] = span.compile_ms
@@ -282,6 +331,41 @@ class Tracer:
         # a condensed copy rides the flight-recorder ring so a crash dump
         # shows the last spans even when the sink buffer died with them
         flight_recorder.on_span(rec)
+        _notify_span_listeners(rec)
+        return rec
+
+    def event(self, name: str, **attrs: Any) -> Dict:
+        """Record a zero-duration point event at the current instant.
+
+        Point records land in the same JSONL sink as spans but carry
+        ``point: true`` and no ``duration_ms``, so ``load_spans``-based
+        consumers (report phases, stragglers) skip them while the trace
+        assembler can use them as precise causal markers — e.g. the
+        ``comm/send``/``comm/recv`` pairs that clock alignment matches.
+        """
+        rec: Dict[str, Any] = {
+            "name": name,
+            "point": True,
+            "ts": time.time(),
+            "mono": time.perf_counter(),
+        }
+        ctx = current_context()
+        if ctx is not None:
+            rec["trace_id"] = ctx.trace_id
+            rec["span_id"] = ctx.span_id
+        if self.service:
+            rec["service"] = self.service
+        if attrs:
+            rec["attrs"] = attrs
+        overflow = None
+        with self._lock:
+            self._records.append(rec)
+            if len(self._records) >= self._limit:
+                overflow = self._records
+                self._records = []
+        if overflow is not None:
+            self._write(overflow)
+        _notify_span_listeners(rec)
         return rec
 
     @contextlib.contextmanager
@@ -343,14 +427,16 @@ def configure(run_dir: str, service: str = "") -> Tracer:
     return t
 
 
-def configure_from_args(args: Any) -> Tracer:
+def configure_from_args(args: Any, service: str = "") -> Tracer:
     """Derive the sink dir from run args — same layout core/mlops uses:
     ``<log_file_dir>/run_<run_id>/``. Also applies the run's deep-trace
     budget knobs (``trace_max_captures`` / ``trace_byte_budget`` /
-    ``trace_rounds``) to the process TraceController."""
+    ``trace_rounds``) to the process TraceController. ``service`` stamps
+    this process's records with its node identity, which is what lets
+    trace assembly tell nodes apart in a shared run dir."""
     run_id = str(getattr(args, "run_id", "0") or "0")
     base = str(getattr(args, "log_file_dir", "") or ".fedml_logs")
-    tracer = configure(os.path.join(base, f"run_{run_id}"))
+    tracer = configure(os.path.join(base, f"run_{run_id}"), service=service)
     from fedml_tpu.telemetry.profiling import trace as _trace
 
     _trace.configure_from_args(args)

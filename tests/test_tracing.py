@@ -1,0 +1,656 @@
+"""Causal round tracing: clock alignment, the critical-path walk,
+span-frame chaos convergence, monotonic span timestamps, the shared
+single-pass RunData load, and THE acceptance: a 5-round int8+prefetch
+cross-silo federation with the slow client in its OWN process over the
+broker backend — the exported Perfetto JSON validates, every round's
+critical-path segments sum within 5% of the traced round wall, and the
+deliberately slowed client is named on the critical path for exactly
+its slowed rounds (compile-warm rounds only; round 0 is JIT noise).
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+import fedml_tpu
+from fedml_tpu import telemetry
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.telemetry.tracing import (
+    SpanStreamer,
+    TraceCollector,
+    assemble_records,
+    assemble_trace,
+    compute_critical_path,
+    compute_critical_paths,
+    export_perfetto,
+    phase_code,
+    phase_label,
+    summarize_critical_paths,
+    write_perfetto,
+)
+from fedml_tpu.telemetry.tracing.clock import align_clocks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SLOW_ROUNDS = (1, 3)     # rounds the subprocess client sleeps through
+SLOW_SLEEP_S = 1.0       # the deliberate straggler
+BASE_SLEEP_S = 0.3       # in-proc client's every-round handicap: makes
+#                          the non-slowed rounds' critical path land on
+#                          client 1 deterministically
+
+
+# -- clock alignment -------------------------------------------------------
+def test_clock_offset_recovered_from_matched_pairs():
+    """cli's clock runs 5 s ahead; symmetric 10 ms latency. The min-RTT
+    estimator must recover offset == skew, uncertainty == latency."""
+    skew, lat = 5.0, 0.010
+    sends = {"m1": [{"node": "srv", "ts": 100.0}],
+             "m2": [{"node": "cli", "ts": 100.5 + skew}]}
+    recvs = {"m1": [{"node": "cli", "ts": 100.0 + lat + skew}],
+             "m2": [{"node": "srv", "ts": 100.5 + lat}]}
+    clocks = align_clocks(sends, recvs, "srv")
+    assert clocks["srv"].method == "reference"
+    assert clocks["srv"].offset_s == 0.0
+    c = clocks["cli"]
+    assert c.method == "paired" and c.pairs == 2
+    assert c.offset_s == pytest.approx(skew, abs=1e-9)
+    assert c.uncertainty_s == pytest.approx(lat, abs=1e-9)
+    # aligned time puts the cli stamp back on the srv timeline
+    assert c.align(100.0 + lat + skew) == pytest.approx(100.0 + lat)
+
+
+def test_clock_one_way_and_unaligned_degrade():
+    sends = {"m1": [{"node": "srv", "ts": 10.0}]}
+    recvs = {"m1": [{"node": "cli", "ts": 12.0}],
+             "m9": [{"node": "ghost", "ts": 50.0}]}
+    clocks = align_clocks(sends, recvs, "srv")
+    # one direction only: the offset absorbs the (unknown) latency and
+    # the uncertainty says so
+    assert clocks["cli"].method == "one_way"
+    assert clocks["cli"].offset_s == pytest.approx(2.0)
+    assert clocks["cli"].uncertainty_s == pytest.approx(2.0)
+    # a node with no matched pair at all stays explicitly unaligned
+    assert clocks["ghost"].method == "unaligned"
+    assert clocks["ghost"].uncertainty_s is None
+    d = clocks["ghost"].to_dict()
+    assert d["uncertainty_ms"] is None and d["method"] == "unaligned"
+
+
+# -- critical-path walk ----------------------------------------------------
+def _two_node_round(skew: float):
+    """Synthetic one-round federation: server sync -> config wire ->
+    client dispatch/train -> upload wire -> server dispatch/aggregate.
+    The client's wall clock runs ``skew`` seconds ahead."""
+    lat = 0.005
+    srv = [
+        {"name": "round/0/sync", "trace_id": "t", "span_id": "a",
+         "started": 10.000, "duration_ms": 100.0, "service": "srv"},
+        {"name": "comm/send", "point": True, "ts": 10.090,
+         "span_id": "a", "service": "srv",
+         "attrs": {"msg_id": "m1", "round": 0}},
+        {"name": "comm/recv", "point": True, "ts": 10.290 + lat,
+         "span_id": "a", "service": "srv",
+         "attrs": {"msg_id": "m2", "round": 0}},
+        {"name": "comm/dispatch", "trace_id": "t", "span_id": "d",
+         "parent_id": "b", "remote_parent": True, "started": 10.296,
+         "duration_ms": 50.0, "service": "srv",
+         "attrs": {"msg_id": "m2", "round": 0}},
+        {"name": "round/0/aggregate", "trace_id": "t", "span_id": "e",
+         "parent_id": "d", "started": 10.300, "duration_ms": 30.0,
+         "service": "srv"},
+    ]
+    cli = [
+        {"name": "comm/recv", "point": True, "ts": 10.090 + lat + skew,
+         "service": "cli", "attrs": {"msg_id": "m1", "round": 0}},
+        {"name": "comm/dispatch", "trace_id": "t", "span_id": "b",
+         "parent_id": "a", "remote_parent": True,
+         "started": 10.096 + skew, "duration_ms": 200.0, "service": "cli",
+         "attrs": {"msg_id": "m1", "round": 0}},
+        {"name": "round/0/client/1/train", "trace_id": "t", "span_id": "c",
+         "parent_id": "b", "started": 10.100 + skew,
+         "duration_ms": 180.0, "service": "cli"},
+        {"name": "comm/send", "point": True, "ts": 10.290 + skew,
+         "span_id": "b", "service": "cli",
+         "attrs": {"msg_id": "m2", "round": 0}},
+    ]
+    return srv + cli
+
+
+def test_critical_path_tiles_the_round():
+    trace = assemble_records(_two_node_round(skew=2.0))
+    assert trace.ref_node == "srv"  # aggregate owner anchors the timeline
+    assert trace.clocks["cli"].method == "paired"
+    assert trace.clocks["cli"].offset_s == pytest.approx(2.0, abs=1e-6)
+
+    cp = compute_critical_path(trace, 0)
+    assert cp is not None
+    d = cp.to_dict()
+    # the walk crossed both wires and both nodes
+    nodes = {s.node for s in cp.segments}
+    assert {"srv", "cli", "srv->cli", "cli->srv"} <= nodes
+    kinds = {s.kind for s in cp.segments}
+    assert {"compute", "wire", "queue"} <= kinds
+    assert d["clients_on_path"] == ["1"]
+    # segments tile [chain start, anchor end] exactly: no gaps, no
+    # overlap — so the sum IS the path
+    total = sum(s.duration_ms for s in cp.segments)
+    assert total == pytest.approx(d["path_ms"], abs=1e-6)
+    assert d["path_ms"] == pytest.approx(346.0, abs=1e-3)
+    assert d["coverage"] == pytest.approx(1.0, abs=1e-6)
+    # phase decomposition: train dominates
+    assert max(d["by_phase"], key=d["by_phase"].get) == "train"
+    assert d["by_kind"]["compute"] == pytest.approx(300.0, abs=1e-3)
+    assert d["by_kind"]["wire"] == pytest.approx(12.0, abs=1e-3)
+
+
+def test_critical_path_is_clock_skew_invariant():
+    """Any constant skew on the client clock must leave the critical
+    path byte-identical — that is what alignment is FOR."""
+    base = compute_critical_path(
+        assemble_records(_two_node_round(skew=0.0)), 0)
+    for skew in (2.0, -7.5, 3600.0):
+        cp = compute_critical_path(
+            assemble_records(_two_node_round(skew=skew)), 0)
+        assert [(s.node, s.phase, s.kind) for s in cp.segments] == \
+               [(s.node, s.phase, s.kind) for s in base.segments]
+        for got, want in zip(cp.segments, base.segments):
+            assert got.duration_ms == pytest.approx(want.duration_ms,
+                                                    abs=1e-6)
+
+
+def test_summarize_and_perfetto_export_synthetic():
+    trace = assemble_records(_two_node_round(skew=1.0))
+    cps = compute_critical_paths(trace)
+    summary = summarize_critical_paths(cps)
+    assert summary["rounds"][0]["round"] == 0
+    assert "segments" not in summary["rounds"][0]  # rollup, not the dump
+    assert summary["total_ms"] == pytest.approx(346.0, abs=1e-3)
+
+    doc = export_perfetto(trace, critical_paths=cps)
+    evs = doc["traceEvents"]
+    # process metadata for both nodes, slices for every span, flow
+    # events for both matched messages, and the critical-path overlay
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"node:srv", "node:cli"} <= names
+    assert sum(1 for e in evs if e["ph"] == "X") >= 5
+    assert sum(1 for e in evs if e["ph"] == "s") == 2
+    assert sum(1 for e in evs if e["ph"] == "f") == 2
+    assert all("ts" in e and "pid" in e for e in evs if e["ph"] != "M")
+
+
+def test_phase_codes_roundtrip():
+    assert phase_label(phase_code("train")) == "train"
+    assert phase_label(phase_code("nonsense")) == "other"
+
+
+# -- span-frame streaming under chaos --------------------------------------
+def _frame_stream(records, resync_every=3):
+    """Streamer frames for a record stream, one pop per record."""
+    streamer = SpanStreamer("cli", job="chaos", interval_s=0.0,
+                            resync_every=resync_every)
+    frames = []
+    for rec in records:
+        streamer.on_record(rec)
+        f = streamer.pop_frame(force=True)
+        if f is not None:
+            frames.append(f)
+    final = streamer.close()
+    if final is not None:
+        frames.append(final)
+    return frames
+
+
+def test_chaos_frames_assemble_to_identical_critical_path():
+    """Dropped, duplicated, and reordered span frames must converge to
+    the exact same record set — and therefore the exact same critical
+    path — as loss-free delivery (FULL resync frames heal drops, index
+    -based merge makes duplicates no-ops)."""
+    records = _two_node_round(skew=2.0)
+    cli_records = [r for r in records if r["service"] == "cli"]
+    srv_records = [r for r in records if r["service"] == "srv"]
+    frames = _frame_stream(cli_records)
+    assert len(frames) >= 4
+    assert any(f["full"] for f in frames)
+
+    clean = TraceCollector(job="chaos")
+    for f in frames:
+        clean.ingest(copy.deepcopy(f))
+
+    from fedml_tpu.telemetry.registry import MetricsRegistry
+
+    chaos_reg = MetricsRegistry()
+    chaos = TraceCollector(job="chaos", registry=chaos_reg)
+    # deterministic chaos: drop every 3rd frame, deliver the rest in
+    # reverse order, duplicating every other one — then the final FULL
+    # frame (kept: a dying client flushes it) lands last
+    delivered = [f for i, f in enumerate(frames[:-1]) if i % 3 != 0]
+    delivered.reverse()
+    delivered += [copy.deepcopy(f) for f in delivered[::2]]
+    delivered.append(frames[-1])
+    assert len(delivered) < 2 * len(frames)
+    for f in delivered:
+        chaos.ingest(copy.deepcopy(f))
+
+    key = lambda r: (r["node"], r.get("span_id") or "", r["name"])  # noqa: E731
+    assert sorted(chaos.records(), key=key) == \
+           sorted(clean.records(), key=key)
+
+    cp_clean = compute_critical_path(
+        assemble_records(srv_records + clean.records()), 0)
+    cp_chaos = compute_critical_path(
+        assemble_records(srv_records + chaos.records()), 0)
+    assert [s.to_dict() for s in cp_chaos.segments] == \
+           [s.to_dict() for s in cp_clean.segments]
+
+    # and the stream accounted the damage on the tracepath/* counters
+    counts = {rec["name"]: rec.get("value", 0)
+              for rec in chaos_reg.snapshot()}
+    assert counts["tracepath/frames_duplicate"] > 0
+    assert counts["tracepath/seq_gaps"] > 0
+    assert chaos.stats()["cli"]["records"] == len(cli_records)
+
+
+def test_collector_job_gate_and_bad_frames():
+    col = TraceCollector(job="right")
+    assert col.ingest({"kind": "trace", "v": 1, "node": "n", "job": "wrong",
+                       "seq": 0, "base": 0, "full": True,
+                       "records": [{"name": "x"}]}) is False
+    assert col.ingest(None) is False
+    assert col.ingest({"kind": "metrics"}) is False
+    assert col.records() == []
+
+
+# -- monotonic span timestamps (satellite) ---------------------------------
+def test_span_duration_survives_wall_clock_step(tmp_path, monkeypatch):
+    """An NTP step (wall clock yanked backward mid-span) must not
+    corrupt the duration: it comes from the monotonic clock."""
+    from fedml_tpu.telemetry import spans as spans_mod
+
+    tracer = spans_mod.Tracer(sink_dir=str(tmp_path), service="t")
+    real_time = time.time
+    step = [0.0]
+    monkeypatch.setattr(spans_mod.time, "time",
+                        lambda: real_time() + step[0])
+    span = tracer.begin("round/0/sync")
+    step[0] = -3600.0  # the wall clock jumps back an hour mid-span
+    time.sleep(0.02)
+    rec = tracer.end(span)
+    assert 15.0 <= rec["duration_ms"] < 5000.0, rec["duration_ms"]
+    assert "mono" in rec
+    # ended stays consistent with started + duration (wall-clock schema
+    # is backward compatible: started remains the raw wall stamp)
+    assert rec["ended"] == pytest.approx(
+        rec["started"] + rec["duration_ms"] / 1e3)
+
+
+def test_tracer_event_is_a_point_record(tmp_path):
+    from fedml_tpu.telemetry import spans as spans_mod
+    from fedml_tpu.telemetry.report import _spans_from_raw
+
+    tracer = spans_mod.Tracer(sink_dir=str(tmp_path), service="t")
+    with tracer.span("round/0/sync"):
+        rec = tracer.event("comm/send", msg_id="m1", peer=1)
+    assert rec["point"] is True
+    assert "duration_ms" not in rec
+    assert rec["attrs"]["msg_id"] == "m1"
+    assert rec["span_id"]  # stamped with the enclosing span's context
+    assert rec["mono"] > 0
+    # point events are invisible to duration-based span consumers
+    assert _spans_from_raw([rec], []) == []
+
+
+def test_span_listener_receives_spans_and_events(tmp_path):
+    from fedml_tpu.telemetry import spans as spans_mod
+
+    tracer = spans_mod.Tracer(sink_dir=str(tmp_path), service="t")
+    got = []
+    spans_mod.add_span_listener(got.append)
+    try:
+        with tracer.span("round/0/sync"):
+            tracer.event("comm/send", msg_id="m")
+    finally:
+        spans_mod.remove_span_listener(got.append)
+    names = [r["name"] for r in got]
+    assert names == ["comm/send", "round/0/sync"]
+    tracer.event("comm/send", msg_id="m2")  # after remove: not seen
+    assert len(got) == 2
+
+
+# -- RunData single-pass load (satellite) ----------------------------------
+def test_report_and_doctor_share_one_read_per_sink(tmp_path, monkeypatch):
+    import collections
+
+    from fedml_tpu.telemetry import report as report_mod
+    from fedml_tpu.telemetry.doctor import build_doctor
+
+    run_dir = tmp_path / "run_x"
+    run_dir.mkdir()
+    span = {"name": "round/0/sync", "trace_id": "t", "span_id": "s",
+            "started": 1.0, "ended": 1.005, "duration_ms": 5.0,
+            "service": "srv"}
+    (run_dir / "spans.jsonl").write_text(json.dumps(span) + "\n")
+    (run_dir / "telemetry.jsonl").write_text(json.dumps(
+        {"name": "comm/raw_bytes", "kind": "counter", "value": 10}) + "\n")
+    (run_dir / "health.jsonl").write_text("")
+
+    calls = collections.Counter()
+    orig = report_mod._load_jsonl
+
+    def counting(path):
+        calls[os.path.basename(path)] += 1
+        return orig(path)
+
+    monkeypatch.setattr(report_mod, "_load_jsonl", counting)
+    data = report_mod.RunData(str(run_dir))
+    report = report_mod.build_report(data)
+    doctor = build_doctor(data)
+    assert report["n_spans"] == 1
+    assert doctor["run_dir"] == str(run_dir)
+    # every sink file parsed at most ONCE across report + doctor
+    assert calls and max(calls.values()) == 1, calls
+
+
+# -- THE acceptance: 2-process cross-silo over the broker ------------------
+_CLIENT2_CODE = textwrap.dedent("""
+    import sys, time
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.core.distributed.message import Message
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.message_define import MyMessage
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.ml.trainer.classification_trainer import (
+        ClassificationTrainer,
+    )
+
+    cfg = {cfg!r}
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    args.rank = 2
+    args.role = "client"
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+
+    class SlowTrainer(ClassificationTrainer):
+        def train(self, params, train_data, device, a):
+            out = super().train(params, train_data, device, a)
+            if self._round_seed in {slow_rounds!r}:
+                time.sleep({slow_s!r})
+            return out
+
+    client = Client(args, None, ds, model,
+                    client_trainer=SlowTrainer(model, args))
+    thread = client.manager.run_async()
+    client.manager.send_message(Message(
+        MyMessage.MSG_TYPE_CONNECTION_IS_READY, 2, 2))
+    thread.join(timeout=300)
+    sys.exit(0 if not thread.is_alive() else 3)
+""")
+
+
+def _acceptance_cfg(tmp_path, host, port, *, log_dir):
+    return {
+        "common_args": {"training_type": "cross_silo", "random_seed": 9,
+                        "run_id": "trace_acc", "log_file_dir": str(log_dir)},
+        "data_args": {"dataset": "synthetic", "train_size": 160,
+                      "test_size": 60, "class_num": 4, "feature_dim": 12},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "comm_backend": "BROKER",
+                       "broker_host": host, "broker_port": port,
+                       "object_store_dir": str(tmp_path / "store"),
+                       "client_num_in_total": 2,
+                       "client_num_per_round": 2,
+                       "comm_round": 5, "epochs": 1, "batch_size": 32,
+                       "learning_rate": 0.3,
+                       "compression": "int8", "prefetch": True,
+                       "live_telemetry": True, "metrics_port": 0,
+                       "trace_streaming": True},
+    }
+
+
+def _run_two_process_federation(tmp_path):
+    from fedml_tpu import models as models_mod
+    from fedml_tpu.core.distributed.communication.broker import PubSubBroker
+    from fedml_tpu.core.distributed.message import Message
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.message_define import MyMessage
+    from fedml_tpu.cross_silo.server.server import Server
+    from fedml_tpu.data import load_federated
+    from fedml_tpu.ml.trainer.classification_trainer import (
+        ClassificationTrainer,
+    )
+
+    broker = PubSubBroker().start()
+    host, port = broker.address
+    server_logs = tmp_path / "server_logs"
+    client2_logs = tmp_path / "client2_logs"
+    cfg = _acceptance_cfg(tmp_path, host, port, log_dir=server_logs)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    sub_cfg = copy.deepcopy(cfg)
+    sub_cfg["common_args"]["log_file_dir"] = str(client2_logs)
+    code = _CLIENT2_CODE.format(cfg=sub_cfg, slow_rounds=set(SLOW_ROUNDS),
+                                slow_s=SLOW_SLEEP_S)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True, env=env, text=True)
+
+    try:
+        args = fedml_tpu.init(load_arguments_from_dict(cfg))
+        args.rank = 0
+        args.role = "server"
+        ds = load_federated(args)
+        model = models_mod.create(args, ds.class_num)
+        server = Server(args, None, ds, model)
+
+        class HandicappedTrainer(ClassificationTrainer):
+            """Every-round sleep: pins the non-slowed rounds' critical
+            path on client 1, so client 2 shows up ONLY when slowed."""
+
+            def train(self, params, train_data, device, a):
+                out = super().train(params, train_data, device, a)
+                time.sleep(BASE_SLEEP_S)
+                return out
+
+        cargs = copy.copy(args)
+        cargs.rank = 1
+        cargs.role = "client"
+        client1 = Client(cargs, None, ds, model,
+                         client_trainer=HandicappedTrainer(model, args))
+
+        managers = [server.manager, client1.manager]
+        threads = [m.run_async() for m in managers]
+        for m in managers:
+            m.send_message(Message(
+                MyMessage.MSG_TYPE_CONNECTION_IS_READY, m.rank, m.rank))
+        deadline = time.time() + 280
+        while any(t.is_alive() for t in threads) and time.time() < deadline:
+            err = next((getattr(m, "handler_error", None) for m in managers
+                        if getattr(m, "handler_error", None)), None)
+            assert err is None, err
+            time.sleep(0.05)
+        assert not any(t.is_alive() for t in threads), "federation hung"
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0, f"client 2 subprocess failed:\n{out}"
+        result = server.manager.result
+        assert result is not None and result["rounds"] == 5
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        broker.stop()
+    telemetry.flush_run()
+    from fedml_tpu.telemetry.live import reset_live_plane
+
+    reset_live_plane()
+    return os.path.join(str(server_logs), "run_trace_acc")
+
+
+@pytest.fixture(scope="module")
+def acceptance_run(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("trace_acc")
+    return _run_two_process_federation(tmp_path)
+
+
+def test_acceptance_remote_spans_shipped_and_clock_aligned(acceptance_run):
+    assert os.path.exists(os.path.join(acceptance_run,
+                                       "spans_remote.jsonl"))
+    trace = assemble_trace(acceptance_run)
+    # client 2's spans crossed the process boundary over the live plane
+    assert "rank2" in trace.nodes, trace.nodes
+    assert any(s.node == "rank2" and s.client == "2" for s in trace.spans)
+    # and its clock got aligned from matched send/recv pairs
+    clock = trace.clocks["rank2"]
+    assert clock.method in ("paired", "one_way"), clock.to_dict()
+    assert clock.uncertainty_s is not None
+
+
+def test_acceptance_critical_path_sums_to_round_wall(acceptance_run):
+    trace = assemble_trace(acceptance_run)
+    cps = compute_critical_paths(trace)
+    assert [cp.round for cp in cps] == [0, 1, 2, 3, 4]
+    for cp in cps:
+        d = cp.to_dict()
+        total = sum(s.duration_ms for s in cp.segments)
+        assert total == pytest.approx(d["path_ms"], abs=2e-3)
+        # ISSUE gate: per-round critical-path edge durations sum within
+        # 5% of the traced round wall
+        assert 0.95 <= d["coverage"] <= 1.0 + 1e-6, d
+        # every edge is attributed
+        for seg in cp.segments:
+            assert seg.node and seg.phase and seg.kind in (
+                "compute", "wire", "queue")
+
+
+def test_acceptance_slowed_client_on_path_exactly_when_slowed(
+        acceptance_run):
+    trace = assemble_trace(acceptance_run)
+    cps = {cp.round: cp.to_dict() for cp in compute_critical_paths(trace)}
+    # round 0 is excluded: each process pays its own JIT compile there,
+    # and whichever compiles slower is HONESTLY on the path
+    for r in range(1, 5):
+        on_path = "2" in cps[r]["clients_on_path"]
+        assert on_path == (r in SLOW_ROUNDS), (
+            f"round {r}: clients_on_path={cps[r]['clients_on_path']}")
+    # the what-if says removing the straggler shortens the slowed rounds
+    for r in SLOW_ROUNDS:
+        st = cps[r]["straggler"]
+        assert st is not None and st["client"] == "2", cps[r]
+        assert st["on_critical_path"] is True
+        assert st["savings_ms"] >= 0.5 * SLOW_SLEEP_S * 1e3, st
+
+
+def test_acceptance_perfetto_export_validates(acceptance_run, tmp_path):
+    trace = assemble_trace(acceptance_run)
+    cps = compute_critical_paths(trace)
+    out = os.path.join(str(tmp_path), "trace.json")
+    write_perfetto(trace, out, critical_paths=cps)
+    with open(out) as f:
+        doc = json.load(f)  # valid JSON end to end
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) >= 30
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    # both processes named; flow arrows cross them; CP overlay present
+    pnames = {e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "node:rank2" in pnames
+    assert any(e["ph"] == "s" for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+
+
+def test_acceptance_report_doctor_cli_surfaces(acceptance_run):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    res = CliRunner().invoke(cli, ["telemetry", "report", acceptance_run,
+                                   "--json"])
+    assert res.exit_code == 0, res.output
+    report = json.loads(res.output)
+    assert report["schema"] == "fedml_tpu.telemetry.report/v1"
+    assert list(report) == sorted(report)  # stable machine contract
+    cp = report["critical_path"]
+    assert len(cp["rounds"]) == 5
+    assert cp["by_kind_ms"].get("compute", 0) > 0
+    assert any(c["node"] == "rank2" for c in cp["clocks"])
+
+    res = CliRunner().invoke(cli, ["telemetry", "doctor", acceptance_run,
+                                   "--json"])
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output)
+    assert doc["schema"] == "fedml_tpu.telemetry.doctor/v1"
+    assert list(doc) == sorted(doc)
+    assert doc["tracepath"]["rounds_traced"] == 5
+    # the doctor's straggler verdicts distinguish on-path from slack
+    tp_clients = doc["tracepath"]["clients_on_path"]
+    assert set(tp_clients.get("2", [])) >= set(SLOW_ROUNDS)
+
+    res = CliRunner().invoke(cli, ["telemetry", "trace", acceptance_run])
+    assert res.exit_code == 0, res.output
+    assert "causal trace:" in res.output
+    assert "rank2" in res.output
+    for r in range(5):
+        assert f"round {r}:" in res.output
+
+    res = CliRunner().invoke(cli, ["telemetry", "trace", acceptance_run,
+                                   "--round", "3", "--json"])
+    assert res.exit_code == 0, res.output
+    summary = json.loads(res.output)
+    assert summary["schema"] == "fedml_tpu.telemetry.trace/v1"
+    assert [r["round"] for r in summary["rounds"]] == [3]
+
+
+def test_trace_cli_empty_dir(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import cli
+
+    res = CliRunner().invoke(cli, ["telemetry", "trace", str(tmp_path)])
+    assert res.exit_code == 1
+    assert "no spans" in res.output
+
+
+# -- bench + lint (satellites) ---------------------------------------------
+def test_tracepath_bench_smoke_schema(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, REPO)
+    from tools.tracepath_bench import run_tracepath_bench
+
+    row = run_tracepath_bench(rounds=2, clients=2, trials=1)
+    assert row["completed"]
+    assert row["metric"] == "tracepath_overhead"
+    assert row["frames"] > 0 and row["frame_bytes"] > 0
+    # the deterministic gates (the end-to-end on/off ratio is reported
+    # but too host-noise-sensitive to assert in CI)
+    assert row["ok_overhead"], row
+    assert row["ok_bytes"], row
+
+
+def test_span_lint_rejects_tracepath_misuse():
+    from fedml_tpu.analysis.passes.span_names import check
+
+    problems = check([
+        ("x.py", 1, "span", "tracepath/frames_emitted"),
+        ("x.py", 2, "histogram", "tracepath/frame_bytes"),
+        ("x.py", 3, "counter", "tracepath/too/deep"),
+        ("x.py", 4, "counter", "tracepath/frames_emitted"),
+        ("x.py", 5, "gauge", "tracepath/critical_share"),
+    ])
+    assert len(problems) == 3, problems
+    assert any("metric namespaces" in p for p in problems)
+    assert any("not" in p and "histograms" in p for p in problems)

@@ -1,0 +1,177 @@
+"""Causal-tracing overhead bench — proves span streaming is (nearly)
+free.
+
+Runs the SAME in-proc cross-silo federation twice — live plane on in
+BOTH arms, span streaming (``trace_streaming``) off then on — and
+reports:
+
+- ``rounds_per_s_off`` / ``rounds_per_s_on`` (best of ``trials`` each,
+  interleaved so host noise drifts cancel) and their ratio, gated at
+  ``tolerance`` (default 1%);
+- the micro-measured span-batch seam: wall cost of one listener→frame→
+  ingest pump over a realistic per-round span batch, as a fraction of
+  the measured round wall (``overhead_ratio``, gated < ``tolerance``) —
+  this is the deterministic gate; the end-to-end rounds/s ratio is the
+  honest-but-noisy one;
+- steady-state trace wire bytes per node per round (from the
+  ``tracepath/frame_bytes`` counter), gated under
+  ``max_bytes_per_round``.
+
+Env knobs: ``FEDML_TRACEPATH_ROUNDS`` / ``FEDML_TRACEPATH_CLIENTS`` /
+``FEDML_TRACEPATH_TRIALS`` / ``FEDML_TRACEPATH_TOL`` /
+``FEDML_TRACEPATH_MAX_BYTES``. One JSON line via
+``bench.py --tracepath``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+def _run_once(seed: int, rounds: int, clients: int, tracing: bool,
+              run_id: str, log_dir: Optional[str] = None) -> float:
+    """One in-proc cross-silo run (live plane always on); returns wall
+    seconds."""
+    import fedml_tpu
+    from fedml_tpu import models as models_mod
+    from fedml_tpu import telemetry
+    from fedml_tpu.arguments import load_arguments_from_dict
+    from fedml_tpu.cross_silo.run_inproc import run_cross_silo_inproc
+    from fedml_tpu.data import load_federated
+
+    cfg = {
+        "common_args": {"training_type": "cross_silo", "random_seed": seed,
+                        "run_id": run_id,
+                        **({"log_file_dir": log_dir} if log_dir else {})},
+        "data_args": {"dataset": "synthetic", "train_size": 60 * clients,
+                      "test_size": 60, "class_num": 4, "feature_dim": 10},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": clients,
+            "client_num_per_round": clients,
+            "comm_round": rounds, "epochs": 1, "batch_size": 32,
+            "learning_rate": 0.3,
+            "live_telemetry": True, "metrics_port": 0,
+            "trace_streaming": tracing,
+        },
+    }
+    args = fedml_tpu.init(load_arguments_from_dict(cfg))
+    ds = load_federated(args)
+    model = models_mod.create(args, ds.class_num)
+    t0 = time.perf_counter()
+    result = run_cross_silo_inproc(args, ds, model, timeout=300)
+    wall = time.perf_counter() - t0
+    if result is None:
+        raise RuntimeError("federation run did not complete")
+    telemetry.reset_live_plane()
+    return wall
+
+
+def _frame_stats():
+    """(frames_emitted, frame_bytes) from the process registry."""
+    from fedml_tpu.telemetry import get_registry
+
+    frames = bytes_sum = 0.0
+    for rec in get_registry().snapshot():
+        if rec["name"] == "tracepath/frames_emitted":
+            frames += rec.get("value", 0.0)
+        elif rec["name"] == "tracepath/frame_bytes":
+            bytes_sum += rec.get("value", 0.0)
+    return frames, bytes_sum
+
+
+def _micro_pump_seconds(n: int = 50, spans_per_round: int = 24) -> float:
+    """Wall seconds of ONE span-batch listener→frame→ingest pump over a
+    realistic per-round span batch (deterministic seam measurement — the
+    counterpart of live_bench's registry-pump gate)."""
+    from fedml_tpu.telemetry import get_registry
+    from fedml_tpu.telemetry.tracing import SpanStreamer, TraceCollector
+
+    reg = get_registry()
+    streamer = SpanStreamer("bench", job="tracepath_bench",
+                            interval_s=3600.0, registry=reg)
+    collector = TraceCollector(job="tracepath_bench", registry=reg)
+    base = {"name": "round/0/client/1/train", "ts": 0.0,
+            "duration_ms": 1.0, "trace_id": "bench",
+            "service": "bench", "attrs": {"round": 0}}
+    streamer.pump(collector, force=True)  # absorb the first empty build
+    t0 = time.perf_counter()
+    for i in range(n):
+        for j in range(spans_per_round):
+            streamer.on_record({**base, "span_id": f"s{i}_{j}"})
+        streamer.pump(collector, force=True)
+    return (time.perf_counter() - t0) / n
+
+
+def run_tracepath_bench(rounds: Optional[int] = None,
+                        clients: Optional[int] = None,
+                        trials: Optional[int] = None,
+                        tolerance: Optional[float] = None,
+                        max_bytes_per_round: Optional[float] = None
+                        ) -> Dict[str, Any]:
+    rounds = int(rounds or os.environ.get("FEDML_TRACEPATH_ROUNDS", 5))
+    clients = int(clients or os.environ.get("FEDML_TRACEPATH_CLIENTS", 3))
+    trials = int(trials or os.environ.get("FEDML_TRACEPATH_TRIALS", 3))
+    tolerance = float(tolerance
+                      or os.environ.get("FEDML_TRACEPATH_TOL", 0.01))
+    max_bytes = float(
+        max_bytes_per_round
+        or os.environ.get("FEDML_TRACEPATH_MAX_BYTES", 256 * 1024))
+
+    walls_off, walls_on = [], []
+    frames0, bytes0 = _frame_stats()
+    for t in range(trials):
+        # interleaved A/B so slow host-noise drift cancels out of the
+        # ratio (same methodology as live_bench)
+        walls_off.append(_run_once(t, rounds, clients, tracing=False,
+                                   run_id=f"tracebench_off_{t}"))
+        walls_on.append(_run_once(t, rounds, clients, tracing=True,
+                                  run_id=f"tracebench_on_{t}"))
+    frames1, bytes1 = _frame_stats()
+    wall_off = min(walls_off)
+    wall_on = min(walls_on)
+    rps_off = rounds / wall_off
+    rps_on = rounds / wall_on
+    ratio = rps_on / rps_off if rps_off else 0.0
+
+    # steady-state wire cost: every emitted span frame, averaged over the
+    # tracing runs' rounds. In-proc there is ONE streaming node (the
+    # plane's loopback streamer); multiprocess deployments add one per
+    # rank.
+    n_frames = frames1 - frames0
+    frame_bytes = bytes1 - bytes0
+    bytes_per_node_per_round = (frame_bytes / (trials * rounds)
+                                if trials * rounds else 0.0)
+
+    pump_s = _micro_pump_seconds()
+    round_wall_s = wall_on / rounds
+    overhead_ratio = (pump_s / round_wall_s) if round_wall_s > 0 else 0.0
+
+    return {
+        "metric": "tracepath_overhead",
+        "rounds": rounds,
+        "clients": clients,
+        "trials": trials,
+        "rounds_per_s_off": round(rps_off, 3),
+        "rounds_per_s_on": round(rps_on, 3),
+        "on_off_ratio": round(ratio, 4),
+        "pump_ms": round(pump_s * 1e3, 3),
+        "overhead_ratio": round(overhead_ratio, 5),
+        "frames": int(n_frames),
+        "frame_bytes": int(frame_bytes),
+        "bytes_per_node_per_round": round(bytes_per_node_per_round, 1),
+        "tolerance": tolerance,
+        "max_bytes_per_round": max_bytes,
+        "ok_overhead": overhead_ratio <= tolerance,
+        "ok_bytes": bytes_per_node_per_round <= max_bytes,
+        "ok_rounds": ratio >= 1.0 - max(tolerance, 0.02),
+        "completed": True,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_tracepath_bench()))
