@@ -426,6 +426,21 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--multichip" in sys.argv:
+        # mesh scale-out gates: fused-round scaling efficiency across
+        # N = 1, 2, 4, … devices (client-parallel lanes on dp, base on
+        # fsdp) and the per-shard HBM plan under the per-device limit —
+        # one JSON line, archived as MULTICHIP_r06.json
+        # (tools/multichip_bench.py; FEDML_MULTICHIP_* env knobs)
+        from tools.multichip_bench import run_multichip_bench, write_artifact
+
+        row = run_multichip_bench()
+        write_artifact(row)
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--stage" in sys.argv:
         # staging-path micro-bench (pipelined round engine): staged
         # bytes/s, vectorized assembly ms, prefetch overlap ratio —

@@ -85,6 +85,13 @@ _INTEGRITY_SHAPE = re.compile(r"^integrity/[a-z0-9_]+$")
 # HBM readings are levels, capture/recompile signals are counts — a
 # histogram here would violate the bounded-frame live-plane contract)
 _PROFILE_SHAPE = re.compile(r"^profile/[a-z0-9_]+$")
+# multichip sharding: shard/* is the per-shard layout namespace (shard
+# counts, per-shard HBM, depth-reduction occurrences on the virtual
+# mesh) — metric-only (program names ride the `program` label exactly
+# as profile/*), one signal segment, counter/gauge only — shard counts
+# and per-shard byte plans are levels, guard trips are occurrence
+# counts, neither is a distribution
+_SHARD_SHAPE = re.compile(r"^shard/[a-z0-9_]+$")
 # causal tracing: tracepath/* is the span-stream/critical-path meta-
 # namespace (frames, merged records, seq gaps, the latest round's
 # critical phase/share) — metric-only (the traced spans themselves keep
@@ -160,10 +167,11 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
                  "secagg/", "profile/", "sched/", "integrity/",
-                 "tracepath/")):
+                 "tracepath/", "shard/")):
             bad(f"{name!r} — mem/, health/, resilience/, tier/, "
-                "live/, secagg/, profile/, sched/, integrity/ and "
-                "tracepath/ are metric namespaces, not span names")
+                "live/, secagg/, profile/, sched/, integrity/, "
+                "tracepath/ and shard/ are metric namespaces, not "
+                "span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 bad(f"span {name!r} must be serve/stage, "
@@ -218,6 +226,15 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
                     "ride labels)")
             elif kind == "histogram":
                 bad(f"{kind} {name!r} — profile/* signals are "
+                    "levels (gauge) or occurrence counts (counter), not "
+                    "histograms")
+        if kind != "span" and name.startswith("shard/"):
+            if not _SHARD_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be shard/<signal> "
+                    "(one segment; program names and mesh axes ride "
+                    "labels)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — shard/* signals are "
                     "levels (gauge) or occurrence counts (counter), not "
                     "histograms")
         if kind != "span" and name.startswith("integrity/"):

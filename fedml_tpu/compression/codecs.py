@@ -362,13 +362,22 @@ def tree_undelta(ref: Pytree, delta: Pytree) -> Pytree:
         ref, delta)
 
 
-def fused_weighted_sum(cts: Sequence[CompressedTree], weights) -> Pytree:
+def fused_weighted_sum(cts: Sequence[CompressedTree], weights,
+                       mesh=None) -> Pytree:
     """Σ_i w_i · decode(ct_i) over clients as ONE dequant-fused program.
 
     The per-client compressed blocks (int8 q + scales, top-k pairs, …)
     are stacked on a leading client axis and reduced inside the same
     jitted weighted sum — the server never materializes the N decoded
     f32 client trees. ``weights`` should already be normalized.
+
+    ``mesh`` (optional, a >1-device jax Mesh) runs the SAME program
+    per-shard: each leaf's largest coordinate axis is split across the
+    mesh while the client axis stays whole, so the per-coordinate
+    weighted reduction is local to a shard and the result is
+    bit-identical to the unsharded call — per-device stacked-wire and
+    f32-temporary bytes drop by the mesh size (see
+    :mod:`fedml_tpu.parallel.multichip`).
     """
     if not cts:
         raise ValueError("empty compressed update list")
@@ -404,6 +413,14 @@ def fused_weighted_sum(cts: Sequence[CompressedTree], weights) -> Pytree:
             f"compressed update block shapes differ across clients "
             f"({first.codec}); check that every peer uses the same codec "
             f"parameters (e.g. compression_topk_ratio): {e}") from None
+    if (mesh is not None and getattr(mesh, "size", 1) > 1
+            and codec.name != "topk"):
+        # dense codecs only: top-k blocks are (indices, values) pairs
+        # whose coordinate ownership is data-dependent — splitting the k
+        # axis would turn the scatter into an all-to-all, not a shard
+        from fedml_tpu.parallel.multichip import shard_stacked
+
+        stacked = shard_stacked(stacked, mesh)
     w = jnp.asarray(weights, jnp.float32)
     flat = _fused_weighted_sum_program(codec, first.meta, stacked, w)
     return jax.tree.map(lambda i: flat[i], first.structure)

@@ -170,7 +170,7 @@ _robust_agg_program = _wrap_jit(
 
 
 def fused_robust_sum(cts: Sequence[CompressedTree], mode: str,
-                     trim: float = 0.1) -> Pytree:
+                     trim: float = 0.1, mesh=None) -> Pytree:
     """Coordinate-wise robust statistic of ``decode(ct_i)`` over clients.
 
     The robust twin of :func:`~fedml_tpu.compression.fused_weighted_sum`
@@ -178,6 +178,12 @@ def fused_robust_sum(cts: Sequence[CompressedTree], mode: str,
     reduction is a sort-based statistic instead of an einsum, and there
     are no weights (see module docstring). Bit-deterministic: two
     same-seed runs stack identical blocks and sort identically.
+
+    ``mesh`` (optional, >1-device) runs the same program per-shard:
+    coordinate axes split across the mesh, the client axis stays whole,
+    so every per-coordinate sort-trim is local to its shard — result
+    bit-identical to the unsharded call, per-device bytes ÷ mesh size
+    (see :mod:`fedml_tpu.parallel.multichip`).
     """
     if mode not in ROBUST_MODES:
         raise ValueError(f"unknown robust aggregation mode {mode!r}")
@@ -219,6 +225,10 @@ def fused_robust_sum(cts: Sequence[CompressedTree], mode: str,
         raise ValueError(
             "compressed update block shapes differ across clients "
             f"({first.codec}): {e}") from None
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from fedml_tpu.parallel.multichip import shard_stacked
+
+        stacked = shard_stacked(stacked, mesh)
     k = trim_k(len(cts), trim) if mode == "trimmed_mean" else 0
     flat = _robust_agg_program(codec, first.meta, mode, k, stacked)
     return jax.tree.map(lambda i: flat[i], first.structure)

@@ -274,13 +274,21 @@ def unmask_finalize(cts: Sequence[CompressedTree], base: Pytree,
                     codec: SecAggInt8Codec,
                     recovery: Optional[Sequence[np.ndarray]] = None,
                     dp_sigma: float = 0.0,
-                    dp_key_data: Optional[np.ndarray] = None) -> Pytree:
+                    dp_key_data: Optional[np.ndarray] = None,
+                    mesh=None) -> Pytree:
     """Fuse the survivors' masked trees into the new global model.
 
     ``recovery`` is the dropout adjustment
     (:func:`masking.recovery_adjustment`), ``dp_sigma`` > 0 adds seeded
     Gaussian noise to the aggregate inside the same program. Raises
     ``ValueError`` on heterogeneous or non-masked inputs.
+
+    ``mesh`` (optional, >1-device) runs the unmask per-shard: masked
+    blocks, recovery and base split on their largest coordinate axis
+    while the client axis stays whole, so the mod-2^k mask cancellation
+    — exact integer arithmetic per coordinate — happens locally on each
+    shard and the unmasked aggregate stays bit-identical to the
+    1-device program (see :mod:`fedml_tpu.parallel.multichip`).
     """
     from fedml_tpu import telemetry
 
@@ -314,11 +322,21 @@ def unmask_finalize(cts: Sequence[CompressedTree], base: Pytree,
     with_noise = float(dp_sigma) > 0.0
     if dp_key_data is None:
         dp_key_data = np.asarray(jax.random.key_data(jax.random.key(0)))
+    base_leaves = tuple(base_leaves)
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from fedml_tpu.parallel.multichip import shard_stacked
+
+        stacked = shard_stacked(stacked, mesh)
+        # recovery and base carry leaf shapes (no client axis): split on
+        # the same coordinate axis the stacked blocks chose
+        rec = shard_stacked(rec, mesh, leading_client_axis=False)
+        base_leaves = shard_stacked(base_leaves, mesh,
+                                    leading_client_axis=False)
     with telemetry.get_tracer().span("compress/decode", codec=codec.name,
                                      n_leaves=len(first.meta)):
         flat = _unmask_program(
             codec.clip, codec.bound, codec.mod_bits, first.meta,
-            with_noise, stacked, rec, tuple(base_leaves),
+            with_noise, stacked, rec, base_leaves,
             jnp.float32(len(cts)), jnp.float32(dp_sigma),
             jnp.asarray(dp_key_data))
     return jax.tree.unflatten(jax.tree.structure(base), list(flat))

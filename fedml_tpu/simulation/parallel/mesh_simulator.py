@@ -72,6 +72,21 @@ class MeshFedAvgAPI:
         self.model = model
         self.mesh = mesh or Mesh(np.asarray(jax.devices()), axis_names=("clients",))
         self.n_devices = self.mesh.devices.size
+        # XLA:CPU virtual meshes SERIALIZE the per-device programs on the
+        # host cores and can abort collectives on a 40s rendezvous timer
+        # when one oversubscribed core can't reach the all-reduce in time
+        # (see fedml_tpu.parallel.multichip) — fine for these small sim
+        # models, fatal for LLM-scale rounds; warn once so a hung-looking
+        # run is attributable
+        from fedml_tpu.parallel.multichip import is_single_core_virtual_mesh
+
+        if is_single_core_virtual_mesh(self.n_devices):
+            logger.warning(
+                "mesh simulator on a single-core VIRTUAL %d-device mesh: "
+                "per-device programs serialize (no speedup) and XLA:CPU "
+                "aborts collectives after its 40s rendezvous timeout if a "
+                "round segment runs long — keep models small or reduce "
+                "devices", self.n_devices)
         self.aggregator = create_server_aggregator(model, args)
         self.server_opt = ServerOptimizer(args)
         self.estimator = RuntimeEstimator()

@@ -758,11 +758,28 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
             if mem_limit > 0:
                 headroom = (f"; {_fmt_bytes(mem_limit - top['peak_hbm_bytes'])}"
                             " HBM headroom left on this device")
-            verdict.append(
-                f"top HBM-headroom consumer: program {top['name']!r} holds "
-                f"{_fmt_bytes(top['peak_hbm_bytes'])} live at peak "
-                f"({top.get('roofline_class') or 'class unknown'})"
-                + headroom + " — the program multichip sharding must split")
+            n_shards = int((top.get("mesh_spec") or {}).get("n_shards") or 1)
+            if n_shards > 1:
+                # XLA memory analysis is per-device, so a sharded
+                # program's peak is already ONE shard's plan — judge it
+                # against the per-device limit, not the model total
+                axes = (top.get("mesh_spec") or {}).get("axes") or {}
+                axes_str = ",".join(
+                    f"{k}={v}" for k, v in sorted(axes.items()) if v > 1)
+                verdict.append(
+                    f"top HBM-headroom consumer: program {top['name']!r} "
+                    f"holds {_fmt_bytes(top['peak_hbm_bytes'])} live at "
+                    f"peak PER SHARD across {n_shards} shards ({axes_str}"
+                    f"; {top.get('roofline_class') or 'class unknown'})"
+                    + headroom
+                    + " — judged against the per-device limit")
+            else:
+                verdict.append(
+                    f"top HBM-headroom consumer: program {top['name']!r} "
+                    f"holds {_fmt_bytes(top['peak_hbm_bytes'])} live at "
+                    f"peak ({top.get('roofline_class') or 'class unknown'})"
+                    + headroom
+                    + " — the program multichip sharding must split")
         for prog in attribution["programs"]:
             if prog.get("multi_shape"):
                 continue  # per-shape variants are that program's design
@@ -1123,8 +1140,11 @@ def format_doctor(d: Dict) -> str:
     if profile.get("programs"):
         top = profile.get("top_hbm_program")
         if top:
+            n_shards = int((top.get("mesh_spec") or {}).get("n_shards") or 1)
+            shard_note = (f" per shard x{n_shards}" if n_shards > 1 else "")
             add(f"  top HBM consumer: {top['name']} "
-                f"({_fmt_bytes(top['peak_hbm_bytes'])} live at peak, "
+                f"({_fmt_bytes(top['peak_hbm_bytes'])} live at peak"
+                f"{shard_note}, "
                 f"{top.get('roofline_class') or 'class unknown'})")
         for p in profile["programs"][:8]:
             ai = p.get("arithmetic_intensity")

@@ -105,6 +105,14 @@ class ProgramRecord:
         self.treedef: Optional[str] = None
         self.first_call_ts: Optional[float] = None
         self.phase_calls: Dict[str, int] = {}
+        # mesh/sharding spec of the latest compiled variant (None for
+        # single-device programs): {"axes": {name: size}, "n_shards": N,
+        # "in_shardings": [...], "out_shardings": [...]}. When n_shards
+        # > 1 every byte figure above (argument/output/temp/peak HBM) is
+        # PER SHARD — XLA's memory_analysis plans one device's slice —
+        # which is exactly the number the per-device admission gate and
+        # the doctor's headroom verdict must compare against the limit.
+        self.mesh_spec: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         from fedml_tpu.telemetry.profiling.roofline import (
@@ -133,6 +141,7 @@ class ProgramRecord:
             "analysis_error": self.analysis_error,
             "treedef": self.treedef,
             "phase_calls": dict(self.phase_calls),
+            "mesh_spec": self.mesh_spec,
             "arithmetic_intensity": ai,
             "roofline_class": classify(ai) if ai is not None else None,
         }
@@ -154,10 +163,31 @@ def _phase_of(span_name: Optional[str], memo: Dict[str, str]) -> str:
     return phase
 
 
+def _shard_token(leaf) -> Any:
+    """A hashable token for a leaf's multi-device sharding, else None.
+
+    Single-device and host leaves all map to None so the signature of
+    every pre-existing (unsharded) call is unchanged — only arrays laid
+    out over a >1-device mesh (the per-shard aggregation path, fsdp
+    params) key distinct compiled variants. Without this, a program
+    called first unsharded then sharded at the same shapes would reuse
+    the wrong executable.
+    """
+    s = getattr(leaf, "sharding", None)
+    if s is None or getattr(s, "mesh", None) is None:
+        return None
+    try:
+        if s.mesh.size <= 1:
+            return None
+        return s  # NamedSharding is hashable
+    except Exception:  # pragma: no cover - exotic sharding type
+        return None
+
+
 def _sig_of(args: Sequence[Any], kwargs: Dict[str, Any],
             static_argnums: Tuple[int, ...]) -> Tuple:
     """Hashable input signature: static args by value, array leaves by
-    (shape, dtype), other hashables by (type, value)."""
+    (shape, dtype[, mesh sharding]), other hashables by (type, value)."""
     import jax
 
     parts: List[Any] = []
@@ -170,7 +200,9 @@ def _sig_of(args: Sequence[Any], kwargs: Dict[str, Any],
         for leaf in leaves:
             shape = getattr(leaf, "shape", None)
             if shape is not None:
-                sig.append((tuple(shape), str(leaf.dtype)))
+                tok = _shard_token(leaf)
+                sig.append((tuple(shape), str(leaf.dtype)) if tok is None
+                           else (tuple(shape), str(leaf.dtype), tok))
             else:
                 sig.append((type(leaf),))  # python scalar: dynamic weak arg
         parts.append((treedef, tuple(sig)))
@@ -181,6 +213,54 @@ def _sig_of(args: Sequence[Any], kwargs: Dict[str, Any],
                 (tuple(x.shape), str(x.dtype)) if hasattr(x, "shape")
                 else (type(x),) for x in leaves)))
     return tuple(parts)
+
+
+def _mesh_spec_of(compiled) -> Optional[Dict[str, Any]]:
+    """The mesh/sharding spec of a compiled executable, or None.
+
+    Introspected off the executable itself (``input_shardings`` /
+    ``output_shardings``) so EVERY cataloged program that runs sharded —
+    the fsdp LLM round, the shard_map mesh simulator, the per-shard
+    fused aggregation — records its partition layout without any caller
+    plumbing. Single-device programs (no mesh, or a 1-device mesh)
+    record nothing: ``mesh_spec is None`` means the byte figures are
+    whole-program, not per-shard.
+    """
+    import jax
+
+    from fedml_tpu.utils.jax_compat import pspec_str, sharding_mesh_axes
+
+    try:
+        in_shardings = jax.tree_util.tree_leaves(compiled.input_shardings)
+        out_shardings = jax.tree_util.tree_leaves(compiled.output_shardings)
+    except Exception:
+        return None
+    axes: Dict[str, int] = {}
+    for s in in_shardings + out_shardings:
+        for name, size in sharding_mesh_axes(s).items():
+            axes[name] = max(axes.get(name, 1), size)
+    n_shards = 1
+    for size in axes.values():
+        n_shards *= size
+    if n_shards <= 1:
+        return None
+
+    def _specs(shardings, cap: int = 16) -> List[str]:
+        seen: List[str] = []
+        for s in shardings:
+            label = pspec_str(s)
+            if label not in seen:
+                seen.append(label)
+            if len(seen) >= cap:
+                break
+        return seen
+
+    return {
+        "axes": axes,
+        "n_shards": n_shards,
+        "in_shardings": _specs(in_shardings),
+        "out_shardings": _specs(out_shardings),
+    }
 
 
 class CatalogedProgram:
@@ -269,9 +349,11 @@ class CatalogedProgram:
                     and self._statics_match(last, args):
                 try:
                     out = last.compiled(*self._dynamic(args))
-                except TypeError:
-                    # pytree/aval mismatch is raised BEFORE dispatch (no
-                    # donation happened) — take the keyed slow path
+                except (TypeError, ValueError):
+                    # pytree/aval mismatch (TypeError) and input-sharding
+                    # mismatch (ValueError) are both raised BEFORE
+                    # dispatch (no donation happened) — take the keyed
+                    # slow path, which keys per-mesh-sharding variants
                     out = self._slow_call(args, kwargs)
                 else:
                     self._note_call(last)
@@ -363,6 +445,9 @@ class CatalogedProgram:
                                      arg + out + tmp - alias)
         except Exception as e:
             rec.analysis_error = f"memory_analysis: {type(e).__name__}"[:200]
+        spec = _mesh_spec_of(compiled)
+        if spec is not None or rec.mesh_spec is None:
+            rec.mesh_spec = spec
         if variant.flops:
             rec.flops = variant.flops
             rec.bytes_accessed = variant.bytes_accessed
@@ -441,6 +526,9 @@ class ProgramCatalog:
                 # per-shape-variant programs are exempt from recompile
                 # regression flags downstream (bench_compare, doctor)
                 "multi_shape": rec.multi_shape,
+                # per-shard layout (None = single-device program); when
+                # present, peak_hbm_bytes above is one shard's plan
+                "mesh_spec": rec.mesh_spec,
             }
         return out
 
@@ -502,6 +590,14 @@ class ProgramCatalog:
             reg.gauge("profile/compile_ms", labels=labels).set(
                 rec.compile_ms)
             reg.gauge("profile/calls", labels=labels).set(float(rec.calls))
+            if rec.mesh_spec:
+                # shard/* namespace: per-shard layout levels the live
+                # plane streams next to profile/* (lint: gauge/counter
+                # only, one segment, program rides the label)
+                reg.gauge("shard/n_shards", labels=labels).set(
+                    float(rec.mesh_spec["n_shards"]))
+                reg.gauge("shard/per_shard_hbm_bytes", labels=labels).set(
+                    rec.peak_hbm_bytes)
         # rolling achieved rate since the last pump → live MFU + roofline
         now = time.perf_counter()
         peaks = device_peaks()

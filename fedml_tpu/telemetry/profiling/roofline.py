@@ -143,6 +143,10 @@ def build_attribution(phases: List[Dict[str, Any]],
             "compile_ms": float(rec.get("compile_ms") or 0.0),
             "recompiles": int(rec.get("recompiles") or 0),
             "multi_shape": bool(rec.get("multi_shape")),
+            # per-shard layout (catalog mesh_spec): when present, every
+            # byte figure in this row is ONE shard's plan, not the whole
+            # program's footprint — the doctor's HBM verdict reads it
+            "mesh_spec": rec.get("mesh_spec"),
             "arithmetic_intensity": ai,
             "roofline_class": classify(ai, ridge),
         })

@@ -386,6 +386,136 @@ class LLMTrainer:
                                    self.shardings)
 
     # -- on-device federated round ----------------------------------------
+    def lane_opt_state(self, client_parallel: int):
+        """Per-lane optimizer state for the client-parallel round.
+
+        The sequential round threads ONE optimizer state through all
+        clients; with ``client_parallel`` lanes running concurrently on
+        the mesh's ``dp`` axis that threading must break — each lane
+        owns its own (tiny, adapters-only) state, stacked on a leading
+        lane axis and sharded ``P("dp")`` so lane ``i``'s state lives
+        with lane ``i``'s compute. Returns ``(opt_states, shardings)``.
+        """
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        cp = int(client_parallel)
+        stacked = jax.tree.map(
+            lambda v: jnp.stack([v] * cp), self.opt_state)
+        shardings = jax.tree.map(
+            lambda v: NamedSharding(self.mesh, P("dp")), stacked)
+        return jax.device_put(stacked, shardings), shardings
+
+    def compile_federated_round_cp(self, n_clients: int, local_steps: int,
+                                   client_parallel: int):
+        """The fused round with client slots data-parallel on ``dp``.
+
+        The multichip form of :meth:`compile_federated_round`: the
+        ``n_clients`` are folded into ``[groups, cp]`` and each group's
+        ``cp`` lanes train CONCURRENTLY across the mesh's ``dp`` axis —
+        every lane client-switches to the round's global adapters, runs
+        its ``local_steps`` under ``lax.scan``, and the count-weighted
+        adapter FedAvg contracts over the lane axis (XLA inserts the
+        one dp all-reduce of the tiny LoRA dict; the frozen base stays
+        fsdp-sharded and dp-replicated, never gathered). Still ONE
+        donated-buffer XLA program; the host touches nothing between
+        clients.
+
+        Semantics vs the sequential round: identical client-switch and
+        FedAvg math, but optimizer state is PER LANE (see
+        :meth:`lane_opt_state`) — concurrent clients cannot thread one
+        adam state, exactly as real cross-silo clients never shared
+        one. Returns ``fed_round(params, opt_states, global_lora, xs,
+        ys, ms, weights)`` with ``xs``/``ys``: ``[groups, cp,
+        local_steps, B, T]``, ``ms``: ``[groups, cp, local_steps, B]``,
+        ``weights``: ``[groups, cp]``; ``params``, ``opt_states`` and
+        ``global_lora`` are donated.
+        """
+        if not self.lora_only:
+            raise ValueError(
+                "compile_federated_round_cp requires a LoRA model")
+        cp = int(client_parallel)
+        mesh_axes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        dp_size = int(mesh_axes.get("dp", 1))
+        if cp != dp_size:
+            raise ValueError(
+                f"client_parallel={cp} must equal the mesh dp axis "
+                f"({dp_size}) — lanes ride dp")
+        if int(n_clients) % cp:
+            raise ValueError(
+                f"n_clients={n_clients} must divide into client_parallel="
+                f"{cp} lanes")
+        loss_fn = self._loss_fn
+        tx = self.tx
+
+        def fed_round(params, opt_states, global_lora, xs, ys, ms, weights):
+            def group(carry, inp):
+                opt_states, acc = carry
+                x_g, y_g, m_g, w_g = inp
+
+                def lane(o, x_c, y_c, m_c):
+                    p = merge_lora(params, global_lora)
+
+                    def local(c, batch):
+                        p_c, o_c = c
+                        x, y, m = batch
+                        wrt = extract_trainable(p_c)
+
+                        def loss_of(t):
+                            return loss_fn(merge_trainable(p_c, t), x, y, m)
+
+                        (loss, _), grads = jax.value_and_grad(
+                            loss_of, has_aux=True)(wrt)
+                        updates, o_c = tx.update(grads, o_c, wrt)
+                        p_c = merge_trainable(
+                            p_c, optax.apply_updates(wrt, updates))
+                        return (p_c, o_c), loss
+
+                    (p, o), losses = jax.lax.scan(
+                        local, (p, o), (x_c, y_c, m_c))
+                    return o, extract_lora(p), jnp.mean(losses)
+
+                opt_states, loras, losses = jax.vmap(lane)(
+                    opt_states, x_g, y_g, m_g)
+                # contraction over the lane axis IS the FedAvg partial
+                # sum — the only cross-lane (dp) communication in the
+                # round, and it moves adapters, not the base
+                acc = jax.tree.map(
+                    lambda a, l: a + jnp.einsum(
+                        "c,c...->...", w_g, l.astype(jnp.float32)),
+                    acc, loras)
+                return (opt_states, acc), jnp.mean(losses)
+
+            acc0 = jax.tree.map(
+                lambda v: jnp.zeros(v.shape, jnp.float32), global_lora)
+            (opt_states, acc), losses = jax.lax.scan(
+                group, (opt_states, acc0), (xs, ys, ms, weights))
+            wsum = jnp.sum(weights)
+            new_global = jax.tree.map(
+                lambda a, g: (a / wsum).astype(g.dtype), acc, global_lora)
+            return params, opt_states, new_global, jnp.mean(losses)
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        lora_shardings = extract_lora(self.shardings)
+        opt_shardings = jax.tree.map(
+            lambda v: NamedSharding(self.mesh, P("dp")), self.opt_state)
+        # lanes on dp, batch on fsdp (ZeRO data sharding), steps/tokens whole
+        data_spec = NamedSharding(self.mesh, P(None, "dp", None, "fsdp"))
+        w_spec = NamedSharding(self.mesh, P(None, "dp"))
+        rep = replicated(self.mesh)
+        from fedml_tpu.telemetry.profiling import wrap_jit
+
+        return wrap_jit("llm/fused_round_cp", jax.jit(
+            fed_round,
+            in_shardings=(self.shardings, opt_shardings, lora_shardings,
+                          data_spec, data_spec, data_spec, w_spec),
+            out_shardings=(self.shardings, opt_shardings, lora_shardings,
+                           rep),
+            donate_argnums=(0, 1, 2),
+        ), multi_shape=True)
+
     def compile_federated_round(self, n_clients: int, local_steps: int):
         """Compile an ENTIRE federated LoRA round into one XLA program.
 

@@ -71,4 +71,42 @@ def pcast_varying(tree: Pytree, axis_names) -> Pytree:
     )
 
 
-__all__ = ["shard_map", "pcast_varying", "axis_size"]
+def sharding_mesh_axes(sharding) -> dict:
+    """``{axis_name: size}`` of a sharding's mesh, or ``{}``.
+
+    Version-tolerant introspection for the program catalog's mesh/
+    sharding records: ``NamedSharding`` exposes a mesh on every jax this
+    repo runs; anything else (``SingleDeviceSharding``, GSPMD opaque
+    shardings from older compilers) reports no axes rather than raising.
+    """
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return {}
+    try:
+        return {str(name): int(size)
+                for name, size in dict(mesh.shape).items()}
+    except Exception:  # pragma: no cover - exotic mesh type
+        return {}
+
+
+def pspec_str(sharding) -> str:
+    """A stable one-line spelling of a sharding's partition spec.
+
+    ``NamedSharding`` → ``"P('dp', None)"``-style; shardings without a
+    ``spec`` (fully replicated, single-device, opaque GSPMD) render via
+    ``repr`` truncated — the catalog wants a human-auditable label, not
+    a round-trippable object.
+    """
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        return f"P{tuple(spec)!r}"
+    return repr(sharding)[:80]
+
+
+__all__ = [
+    "axis_size",
+    "pcast_varying",
+    "pspec_str",
+    "shard_map",
+    "sharding_mesh_axes",
+]

@@ -437,6 +437,22 @@ def test_span_names_pass_on_fixture(tmp_path):
     assert "not" in msgs and "histograms" in msgs
 
 
+def test_span_names_shard_namespace_rules(tmp_path):
+    """shard/* metrics are per-shard layout signals: one segment, gauge
+    or counter only — mesh axes and program names ride labels."""
+    repo = make_repo(tmp_path, {"fedml_tpu/t.py": """
+        def f(reg):
+            reg.gauge("shard/devices").set(4.0)
+            reg.gauge("shard/llm/fused_round_cp/hbm").set(1.0)
+            reg.histogram("shard/depth").observe(2.0)
+    """})
+    found = span_names.run(repo)
+    msgs = " | ".join(f.message for f in found)
+    assert "must be shard/<signal>" in msgs
+    assert "not" in msgs and "histograms" in msgs
+    assert "'shard/devices'" not in msgs  # the well-shaped gauge passes
+
+
 def test_lint_pass_on_fixture(tmp_path):
     repo = make_repo(tmp_path, {"fedml_tpu/t.py": """
         import os

@@ -21,8 +21,8 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["compare_integrity", "compare_preempt", "compare_recover",
-           "load_headline", "run_compare", "main"]
+__all__ = ["compare_integrity", "compare_multichip", "compare_preempt",
+           "compare_recover", "load_headline", "run_compare", "main"]
 
 
 def _natural_key(path: str):
@@ -243,6 +243,58 @@ def compare_integrity(bench_dir: str = ".",
     return out
 
 
+def compare_multichip(bench_dir: str = ".",
+                      regression_threshold: float = 0.10) -> Optional[Dict]:
+    """Diff the newest two parseable ``MULTICHIP_*.json`` scale-out
+    records.
+
+    Seed-era MULTICHIP files are rc-only dry-run wrappers with no
+    headline metric — they are SKIPPED (not compared against, not
+    crashed on); only measured rows (``tools/multichip_bench.py``
+    schema) participate. Fails on a scaling-efficiency regression past
+    ``regression_threshold`` or on any gate (``ok_scaling``/``ok_hbm``)
+    going false where it was true. Efficiency values are only
+    comparable on the same basis — a basis change (virtual mesh ↔ real
+    chips) skips the threshold check and diffs gates alone. None when
+    fewer than two parseable records exist."""
+    files = sorted(glob.glob(os.path.join(bench_dir, "MULTICHIP_*.json")),
+                   key=_natural_key)
+    parseable = [(f, rec) for f in files
+                 if (rec := _load_record(f)) is not None]
+    if len(parseable) < 2:
+        return None
+    (prev_path, prev_rec), (new_path, new_rec) = parseable[-2:]
+    out: Dict = {
+        "ok": True,
+        "prev_file": os.path.basename(prev_path),
+        "new_file": os.path.basename(new_path),
+        "skipped_files": len(files) - len(parseable),
+        "regressions": [],
+    }
+    prev_eff, new_eff = prev_rec.get("value"), new_rec.get("value")
+    same_basis = (prev_rec.get("efficiency_basis")
+                  == new_rec.get("efficiency_basis"))
+    if prev_eff and new_eff is not None and same_basis:
+        delta = (float(new_eff) - float(prev_eff)) / float(prev_eff)
+        out["efficiency_prev"] = prev_eff
+        out["efficiency_new"] = new_eff
+        out["efficiency_delta_pct"] = round(delta * 100.0, 2)
+        if delta < -regression_threshold:
+            out["regressions"].append(
+                f"scaling efficiency regressed {-delta * 100:.1f}% "
+                f"({prev_eff} -> {new_eff})")
+    elif not same_basis:
+        out["note"] = (
+            f"efficiency basis changed "
+            f"({prev_rec.get('efficiency_basis')} -> "
+            f"{new_rec.get('efficiency_basis')}); gates only")
+    for gate in ("ok_scaling", "ok_hbm"):
+        if prev_rec.get(gate) is True and new_rec.get(gate) is False:
+            out["regressions"].append(f"multichip gate {gate} went false")
+    out["ok"] = not out["regressions"]
+    return out
+
+
 def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                 pattern: str = "BENCH_*.json") -> Dict:
     """Diff the newest two BENCH files; ``ok`` is False only on a real,
@@ -292,11 +344,13 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
     recover = compare_recover(bench_dir)
     preempt = compare_preempt(bench_dir)
     integrity = compare_integrity(bench_dir)
+    multichip = compare_multichip(bench_dir)
     return {
         "ok": (delta >= -threshold and not program_regressions
                and (recover is None or recover["ok"])
                and (preempt is None or preempt["ok"])
-               and (integrity is None or integrity["ok"])),
+               and (integrity is None or integrity["ok"])
+               and (multichip is None or multichip["ok"])),
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -310,6 +364,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
         **({"recover": recover} if recover is not None else {}),
         **({"preempt": preempt} if preempt is not None else {}),
         **({"integrity": integrity} if integrity is not None else {}),
+        **({"multichip": multichip} if multichip is not None else {}),
     }
 
 
