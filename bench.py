@@ -277,11 +277,16 @@ def main() -> None:
     if "--wire" in sys.argv:
         # compressed-transport micro-bench: one JSON line per codec
         # (bytes before/after, encode/decode ms) on a resnet-sized
-        # pytree — same ONE-line-per-record contract as --stage
-        from tools.wire_bench import run_wire_bench
+        # pytree — same ONE-line-per-record contract as --stage. The
+        # 4-bit rows carry ratio gates (>=6x vs f32, >=1.8x vs int8);
+        # a failed gate exits 1 like every other gated bench mode.
+        from tools.wire_bench import apply_wire_gates, run_wire_bench
 
-        for row in run_wire_bench():
+        rows = run_wire_bench()
+        for row in rows:
             print(json.dumps(row))
+        if not apply_wire_gates(rows):
+            raise SystemExit(1)
         return
 
     if "--secagg" in sys.argv:
@@ -485,8 +490,15 @@ def main() -> None:
         mesh_tp = 1
         mesh_sp = 1
         random_seed = 0
-        base_quantize = ("int8" if os.environ.get(
-            "FEDML_BENCH_MODEL", "").lower() == "7b_qlora" else "")
+        # FEDML_BENCH_QUANTIZE=int8|int4|nf4 picks the frozen-base
+        # residency directly; 7b_qlora keeps its int8 default.
+        # FEDML_BENCH_QUANTIZE_MIN_SIZE lowers the kernel-size floor so
+        # the CPU tiny-dev model exercises the quantized-resident path.
+        base_quantize = os.environ.get("FEDML_BENCH_QUANTIZE", "").lower() \
+            or ("int8" if os.environ.get(
+                "FEDML_BENCH_MODEL", "").lower() == "7b_qlora" else "")
+        base_quantize_min_size = int(os.environ.get(
+            "FEDML_BENCH_QUANTIZE_MIN_SIZE", 65536))
 
     trainer = LLMTrainer(cfg, Args())
     trainer.init(seed=0)

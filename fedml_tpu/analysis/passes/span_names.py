@@ -92,6 +92,13 @@ _PROFILE_SHAPE = re.compile(r"^profile/[a-z0-9_]+$")
 # and per-shard byte plans are levels, guard trips are occurrence
 # counts, neither is a distribution
 _SHARD_SHAPE = re.compile(r"^shard/[a-z0-9_]+$")
+# quantized residency: quant/* is the 4-bit/int8 base-weight namespace
+# (packed-base bytes, packed-leaf counts) — metric-only (the pack/
+# dequant-matmul programs live in the catalog as quant/<name> PROGRAM
+# names, not spans), one signal segment (formats/blocks ride labels);
+# counters or gauges only — packed footprints are levels, pack events
+# are occurrence counts, neither is a distribution
+_QUANT_SHAPE = re.compile(r"^quant/[a-z0-9_]+$")
 # causal tracing: tracepath/* is the span-stream/critical-path meta-
 # namespace (frames, merged records, seq gaps, the latest round's
 # critical phase/share) — metric-only (the traced spans themselves keep
@@ -167,11 +174,11 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
                  "secagg/", "profile/", "sched/", "integrity/",
-                 "tracepath/", "shard/")):
+                 "tracepath/", "shard/", "quant/")):
             bad(f"{name!r} — mem/, health/, resilience/, tier/, "
                 "live/, secagg/, profile/, sched/, integrity/, "
-                "tracepath/ and shard/ are metric namespaces, not "
-                "span names")
+                "tracepath/, shard/ and quant/ are metric namespaces, "
+                "not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 bad(f"span {name!r} must be serve/stage, "
@@ -254,6 +261,14 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
             elif kind == "histogram":
                 bad(f"{kind} {name!r} — sched/* signals are "
                     "occurrence counts (counter) or levels (gauge), not "
+                    "histograms")
+        if kind != "span" and name.startswith("quant/"):
+            if not _QUANT_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be quant/<signal> "
+                    "(one segment; formats and block sizes ride labels)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — quant/* signals are "
+                    "levels (gauge) or occurrence counts (counter), not "
                     "histograms")
         if kind != "span" and name.startswith("tracepath/"):
             if not _TRACEPATH_SHAPE.match(name):

@@ -468,9 +468,11 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
 @click.option("--max-len", default=512, show_default=True)
 @click.option("--lora-rank", default=0, show_default=True)
 @click.option("--quantize", default=None,
-              type=click.Choice(["int8", "int8_w8a8", "int8_dequant"]),
-              help="int8 weights via the Pallas fused dequant-matmul: "
-                   "halves HBM residency and speeds up decode 1.7x")
+              type=click.Choice(["int8", "int8_w8a8", "int8_dequant",
+                                 "int4", "nf4"]),
+              help="int8 weights via the Pallas fused dequant-matmul "
+                   "(halves HBM residency, 1.7x decode); int4/nf4 pack "
+                   "the base two codes per byte (0.28x of bf16)")
 @click.option("--hf-checkpoint", default=None,
               help="HF Llama checkpoint dir/id to serve real weights "
                    "(converted via models/llm/hf_convert.py)")

@@ -20,6 +20,12 @@ Codecs (QSGD, Alistarh et al. 2017; Deep Gradient Compression, Lin et al.
              kept entries are exact, dropped entries are the error (pair
              with the client-side error-feedback residual,
              :mod:`fedml_tpu.compression.error_feedback`)
+  int4       blockwise stochastic uniform 4-bit quantization — ~7.5×;
+             two codes packed per uint8 + one f32 absmax scale per block
+             (spec ``int4@128`` sets the block size)
+  nf4        blockwise normal-float 4-bit (QLoRA's NF4 codebook,
+             Dettmers et al. 2023) — same packing/ratio as int4, lower
+             error on normally-distributed deltas
 
 Integer/bool leaves always pass through raw — quantizing a step counter
 would corrupt it silently.
@@ -190,6 +196,18 @@ class Codec:
         ``integrity/nonfinite_wire`` on a hit.
         """
 
+    def _resolve_wire(self, ct: "CompressedTree") -> "Codec":
+        """The codec INSTANCE that matches a wire tree.
+
+        Tag-only resolution sites (fused sums, robust agg, screening,
+        serving staging) call :func:`get_codec` with ``ct.codec`` — the
+        bare NAME. Codecs whose decode geometry depends on a parameter
+        (the 4-bit block size) override this to recover the parameter
+        from the wire arrays themselves, so no out-of-band spec is
+        needed to frame the blocks.
+        """
+        return self
+
     def _reject_nonfinite_wire(self, what: str) -> None:
         from fedml_tpu import telemetry
 
@@ -244,6 +262,11 @@ class Codec:
         if ct.version != WIRE_VERSION:
             raise ValueError(
                 f"unsupported compression wire version {ct.version}")
+        eff = self._resolve_wire(ct)
+        if eff is not self:
+            # tag-only callers hold the default-parameter instance; the
+            # wire itself says which block geometry framed it
+            return eff.decode(ct)
         self.check_wire(ct)
         with telemetry.get_tracer().span("compress/decode", codec=self.name,
                                          n_leaves=len(ct.arrays)):
@@ -389,7 +412,7 @@ def fused_weighted_sum(cts: Sequence[CompressedTree], weights,
             raise ValueError(
                 "cannot fuse heterogeneous compressed updates "
                 f"({ct.codec}/v{ct.version} vs {first.codec}/v{first.version})")
-    codec = get_codec(first.codec)
+    codec = get_codec(first.codec)._resolve_wire(first)
     if codec.maskable:
         raise ValueError(
             "masked (secure-aggregation) updates cannot ride the generic "
@@ -548,11 +571,206 @@ class TopKCodec(Codec):
                 self._reject_nonfinite_wire("top-k values")
 
 
+# NF4: the 16-entry normal-float codebook of Dettmers et al. 2023 —
+# quantiles of N(0,1) rescaled so the range is exactly [-1, 1] and zero
+# is representable. Codes are indices into this table.
+NF4_CODEBOOK = np.asarray([
+    -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+    -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+    0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+    0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+    0.7229568362236023, 1.0,
+], np.float32)
+# nearest-codeword binning: code = #{midpoints below v}
+_NF4_MIDPOINTS = (NF4_CODEBOOK[1:] + NF4_CODEBOOK[:-1]) / 2.0
+
+
+class _Blockwise4BitCodec(Codec):
+    """Shared 4-bit machinery: flatten → pad to a block multiple →
+    per-block absmax scale → 4-bit codes packed two per uint8.
+
+    The wire per float leaf is ``[packed uint8 [n_blocks, block//2],
+    scale f32 [n_blocks]]``; element ``2i`` of a block rides in the low
+    nibble of byte ``i``, element ``2i+1`` in the high nibble. Unpacking
+    happens only inside jitted consumers (decode, fused sums, secagg,
+    robust agg) — packed bytes are what HBM and the wire hold.
+    """
+
+    DEFAULT_BLOCK = 128
+    MAX_BLOCK = 1 << 20  # cap: a hostile wire must not dictate a huge
+    # padded decode temporary via an absurd claimed block size
+
+    def __init__(self, block: int = DEFAULT_BLOCK):
+        block = int(block)
+        # powers of two only: besides matching lane tiling, it makes a
+        # truncated pack UNFRAMEABLE — chopping a column off a packed
+        # leaf cannot re-present as a smaller self-consistent block
+        if block < 2 or block & (block - 1) or block > self.MAX_BLOCK:
+            raise ValueError(
+                f"{self.name} block size must be a power of two in "
+                f"[2, {self.MAX_BLOCK}], got {block}")
+        self.block = block
+
+    def _resolve_wire(self, ct):
+        # the packed part's last dim IS block/2 — recover the instance
+        # from the first float leaf (check_wire then validates every
+        # leaf against this geometry); a non-power-of-two claimed block
+        # falls through so check_wire rejects it as truncation
+        for parts, (dt, _) in zip(ct.arrays, ct.meta):
+            if _is_float_meta(dt) and len(parts) == 2:
+                pshape = tuple(getattr(parts[0], "shape", ()) or ())
+                if len(pshape) == 2 and 0 < pshape[1] <= self.MAX_BLOCK // 2:
+                    cand = 2 * int(pshape[1])
+                    if not cand & (cand - 1):
+                        return get_codec(f"{self.name}@{cand}")
+                break
+        return self
+
+    @property
+    def spec(self) -> str:
+        return f"{self.name}@{self.block}"
+
+    def _geometry(self, shape) -> Tuple[int, int]:
+        """(element count, block count) for a leaf shape."""
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        return size, -(-size // self.block)
+
+    # -- subclass hooks ----------------------------------------------------
+    def _scale_from_amax(self, amax):
+        raise NotImplementedError
+
+    def _quantize(self, v, key):
+        """Per-block-normalized values → int32 codes in [0, 15]."""
+        raise NotImplementedError
+
+    def _lookup(self, codes):
+        """int32 codes in [0, 15] → pre-scale f32 values."""
+        raise NotImplementedError
+
+    # -- codec kernels -----------------------------------------------------
+    def encode_leaf(self, x, key):
+        size, n_blocks = self._geometry(x.shape)
+        xf = x.astype(jnp.float32).ravel()
+        xf = jnp.pad(xf, (0, n_blocks * self.block - size))
+        xf = xf.reshape(n_blocks, self.block)
+        amax = jnp.max(jnp.abs(xf), axis=1)
+        scale = jnp.where(amax > 0, self._scale_from_amax(amax),
+                          1.0).astype(jnp.float32)
+        codes = self._quantize(xf / scale[:, None], key)
+        packed = (codes[:, 0::2] | (codes[:, 1::2] << 4)).astype(jnp.uint8)
+        return [packed, scale]
+
+    def _unpack(self, packed):
+        lo = (packed & 0xF).astype(jnp.int32)
+        hi = (packed >> 4).astype(jnp.int32)
+        return jnp.stack([lo, hi], axis=-1).reshape(
+            packed.shape[:-1] + (2 * packed.shape[-1],))
+
+    def decode_leaf(self, parts, dt, shape):
+        packed, scale = parts
+        size, _ = self._geometry(shape)
+        vals = self._lookup(self._unpack(packed)) * scale[:, None]
+        return vals.reshape(-1)[:size].reshape(shape).astype(
+            _dtype_from_str(dt))
+
+    def weighted_sum_leaf(self, stacked, w, dt, shape):
+        # the nibble unpack + codebook lookup are XLA temporaries inside
+        # the fused program; the (w_i · s_ib) product folds the FedAvg
+        # weight and every per-client per-block scale into one einsum —
+        # no stacked f32 client trees in HBM
+        packed, scale = stacked  # [c, nb, block/2] uint8, [c, nb] f32
+        vals = self._lookup(self._unpack(packed))  # [c, nb, block]
+        out = jnp.einsum("cb,cbk->bk", w[:, None] * scale, vals)
+        size, _ = self._geometry(shape)
+        return out.reshape(-1)[:size].reshape(shape).astype(
+            _dtype_from_str(dt))
+
+    def check_wire(self, ct: "CompressedTree") -> None:
+        # structural first: a truncated or odd-length pack mis-frames
+        # every block after the cut; then per-block scales, which are the
+        # whole numeric attack surface (packed nibbles are finite by
+        # construction). Same contract as int8 — host arrays only.
+        for parts, (dt, sh) in zip(ct.arrays, ct.meta):
+            if not _is_float_meta(dt):
+                continue
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{self.name} wire leaf must carry [packed, scale] "
+                    f"(got {len(parts)} parts)")
+            packed, scale = parts
+            size, n_blocks = self._geometry(sh)
+            want = (n_blocks, self.block // 2)
+            pshape = tuple(getattr(packed, "shape", ()))
+            if pshape != want:
+                raise ValueError(
+                    f"{self.name} packed nibble shape {pshape} does not "
+                    f"cover leaf {sh} at block={self.block} (expected "
+                    f"{want}) — truncated or odd-length pack")
+            pdt = getattr(packed, "dtype", None)
+            if pdt is not None and np.dtype(str(pdt)) != np.uint8:
+                raise ValueError(
+                    f"{self.name} packed nibbles must be uint8, got {pdt}")
+            if tuple(getattr(scale, "shape", ())) != (n_blocks,):
+                raise ValueError(
+                    f"{self.name} scale shape "
+                    f"{tuple(getattr(scale, 'shape', ()))} does not match "
+                    f"{n_blocks} blocks for leaf {sh}")
+            if isinstance(scale, (np.ndarray, np.generic, float)) and not (
+                    np.all(np.isfinite(scale))):
+                self._reject_nonfinite_wire("block scale")
+
+
+class Int4Codec(_Blockwise4BitCodec):
+    """Blockwise stochastic uniform int4 (QSGD at 4 bits).
+
+    scale = blockmax|x| / 7; q = ⌊x/scale + u⌋ clipped to [-7, 7],
+    stored offset-binary as q+8 ∈ [1, 15] — unbiased, per-element error
+    bounded by one step (= block scale).
+    """
+
+    name = "int4"
+
+    def _scale_from_amax(self, amax):
+        return amax / 7.0
+
+    def _quantize(self, v, key):
+        q = jnp.floor(v + jax.random.uniform(key, v.shape))
+        return (jnp.clip(q, -7.0, 7.0) + 8.0).astype(jnp.int32)
+
+    def _lookup(self, codes):
+        return codes.astype(jnp.float32) - 8.0
+
+
+class Nf4Codec(_Blockwise4BitCodec):
+    """Blockwise NF4 (normal-float 4-bit, Dettmers et al. 2023).
+
+    scale = blockmax|x|; codes index the 16-entry N(0,1)-quantile
+    codebook by nearest codeword. Deterministic (round-to-nearest in
+    codebook space); pair with error feedback to re-send the bias.
+    """
+
+    name = "nf4"
+
+    def _scale_from_amax(self, amax):
+        return amax
+
+    def _quantize(self, v, key):
+        del key  # nearest-codeword: deterministic by design
+        mids = jnp.asarray(_NF4_MIDPOINTS)
+        return jnp.sum(
+            v[..., None] > mids, axis=-1).astype(jnp.int32)
+
+    def _lookup(self, codes):
+        return jnp.asarray(NF4_CODEBOOK)[codes]
+
+
 _CODEC_CLASSES: Dict[str, type] = {
     IdentityCodec.name: IdentityCodec,
     Bf16Codec.name: Bf16Codec,
     Int8Codec.name: Int8Codec,
     TopKCodec.name: TopKCodec,
+    Int4Codec.name: Int4Codec,
+    Nf4Codec.name: Nf4Codec,
 }
 
 _INSTANCES: Dict[Tuple, Codec] = {}
@@ -612,8 +830,26 @@ def get_codec(name: str, args: Any = None) -> Optional[Codec]:
         raise ValueError(
             f"unknown compression codec {base!r}; "
             f"available: {', '.join(available_codecs())}")
-    if param and base != TopKCodec.name:
+    if param and base not in (TopKCodec.name, Int4Codec.name,
+                              Nf4Codec.name):
         raise ValueError(f"codec {base!r} takes no parameter ({name!r})")
+    if base in (Int4Codec.name, Nf4Codec.name):
+        if param:
+            try:
+                block = int(param)
+            except ValueError:
+                raise ValueError(
+                    f"malformed {base} block size in codec spec {name!r}"
+                ) from None
+        else:
+            block = int(getattr(
+                args, "compression_block_size",
+                _Blockwise4BitCodec.DEFAULT_BLOCK,
+            ) if args is not None else _Blockwise4BitCodec.DEFAULT_BLOCK)
+        cache_key: Tuple = (base, block)
+        if cache_key not in _INSTANCES:
+            _INSTANCES[cache_key] = _CODEC_CLASSES[base](block)
+        return _INSTANCES[cache_key]
     if base == TopKCodec.name:
         if param:
             try:

@@ -198,7 +198,7 @@ def fused_robust_sum(cts: Sequence[CompressedTree], mode: str,
                 "cannot robust-fuse heterogeneous compressed updates "
                 f"({ct.codec}/v{ct.version} vs {first.codec}/"
                 f"v{first.version})")
-    codec = get_codec(first.codec)
+    codec = get_codec(first.codec)._resolve_wire(first)
     if getattr(codec, "maskable", False):
         raise ValueError(
             "masked (secure-aggregation) updates cannot ride robust "

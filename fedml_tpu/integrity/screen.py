@@ -89,6 +89,16 @@ def _screen_program(codec_name: Optional[str], meta, arrays):
         elif codec_name in (None, "identity", "bf16"):
             sqnorms.append(jnp.sum(jnp.square(
                 parts[0].astype(jnp.float32))))
+        elif codec_name in ("int4", "nf4"):
+            # block-size independent: the nibble unpack + codebook
+            # lookup are XLA temporaries, and padding decodes to exact
+            # zero so it adds no mass — Σ_b scale_b² · Σ_k v_bk²
+            packed, scale = parts
+            c4 = get_codec(codec_name)
+            vals = c4._lookup(c4._unpack(packed))
+            sqnorms.append(jnp.sum(
+                jnp.square(scale.astype(jnp.float32))
+                * jnp.sum(jnp.square(vals), axis=-1)))
         else:
             # unknown third-party codec: decode THIS leaf in-program (an
             # XLA temporary, not a host tree) and norm the result

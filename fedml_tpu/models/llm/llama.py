@@ -190,6 +190,31 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array):
     ).astype(x.dtype)
 
 
+def _maybe_packed_param(module, name, init_box, shape, dtype):
+    """``self.param``, except a 4-bit packed kernel is read straight from
+    the variable dict.
+
+    Flax's param path leaf-compares the stored value against the
+    initializer's eval_shape; an int8 :class:`QuantizedTensor` passes
+    (its data keeps the kernel shape) but a :class:`QuantizedTensor4`
+    legitimately differs — packed nibbles are ``[n_blocks, block//2]``.
+    The packed base is frozen (never initialized, never differentiated),
+    so skipping the shape check loses nothing.
+    """
+    from fedml_tpu.ops.quant import QuantizedTensor4
+
+    scope = module.scope
+    if scope.has_variable("params", name):
+        v = scope.get_variable("params", name)
+        # raw model.init params keep flax partitioning boxes; the packed
+        # value may live inside one (the trainer stores unboxed)
+        if isinstance(v, nn.meta.AxisMetadata):
+            v = v.unbox()
+        if isinstance(v, QuantizedTensor4):
+            return v
+    return module.param(name, init_box, shape, dtype)
+
+
 class LoRADense(nn.Module):
     """Dense with optional additive low-rank adapter: y = xW + (x A) B * s.
 
@@ -208,7 +233,8 @@ class LoRADense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel = self.param(
+        kernel = _maybe_packed_param(
+            self,
             "kernel",
             nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), self.kernel_axes
@@ -491,7 +517,8 @@ class LlamaForCausalLM(nn.Module):
         if cfg.tie_word_embeddings:
             logits = x @ emb.astype(cfg.dtype).T
         else:
-            head = self.param(
+            head = _maybe_packed_param(
+                self,
                 "lm_head",
                 nn.with_logical_partitioning(
                     nn.initializers.normal(0.02), ("embed", "vocab")

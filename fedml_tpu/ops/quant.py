@@ -1,9 +1,19 @@
-"""Int8 weight-only quantization for TPU serving.
+"""Int8 and 4-bit weight-only quantization for TPU serving/training.
 
 Quantizes 2-D kernels to per-output-channel int8 and swaps them into the
 params pytree as :class:`QuantizedTensor` leaves; ``LoRADense`` / the lm
 head consume them as ``(x @ q.astype(bf16)) * scale`` — mathematically
 identical to dequantize-then-matmul with the scale folded into outputs.
+
+:class:`QuantizedTensor4` is the 4-bit sibling (QLoRA, Dettmers et al.
+2023): blockwise int4 or NF4 codes packed two per uint8 plus one f32
+absmax scale per block — the same packing layout as the ``int4``/``nf4``
+wire codec, so HBM holds exactly the wire bytes (~0.27× of bf16). The
+dequant is fused into whatever program consumes the matmul: inside a
+trace (the fused round, serving prefill/decode) the unpacked bf16 tile
+is an XLA temporary, and the eager path routes through the cataloged
+``quant/dequant_matmul`` program — a full-precision copy of the base is
+never resident.
 
 What it buys (measured on-chip, PERF_NOTES round-4 addendum): **HBM
 residency halves** (2.25 GB → 1.13 GB for the 1.1B bench model) AND,
@@ -135,6 +145,195 @@ def quantize_params_int8(params: Any, min_size: int = 65536,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+# -- 4-bit residency (QLoRA-style int4/NF4 base weights) -------------------
+#
+# Same packed layout as the int4/nf4 wire codec (two codes per uint8,
+# per-block f32 absmax scale), so a staged wire payload and the resident
+# base are byte-identical formats. Residency uses deterministic
+# round-to-nearest — static weights are quantized ONCE, and there is no
+# error-feedback loop to absorb stochastic-rounding noise like the wire
+# path has, so nearest minimizes per-weight error.
+
+DEFAULT_BLOCK4 = 64  # QLoRA convention for base-weight residency
+
+
+def _unpack4(packed):
+    """[..., k] uint8 → [..., 2k] int32 codes; element 2i is the low
+    nibble of byte i (the wire codec's layout)."""
+    lo = (packed & 0xF).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))
+
+
+def _codes_to_vals(codes, fmt: str):
+    if fmt == "nf4":
+        from fedml_tpu.compression.codecs import NF4_CODEBOOK
+        return jnp.asarray(NF4_CODEBOOK)[codes]
+    return codes.astype(jnp.float32) - 8.0
+
+
+def _quantize4_blocks(w, fmt: str, block: int):
+    """Flatten → pad to blocks → absmax scale → codes → packed nibbles."""
+    flat = jnp.asarray(w, jnp.float32).reshape(-1)
+    size = flat.shape[0]
+    n_blocks = -(-size // block)
+    pad = n_blocks * block - size
+    if pad:
+        # padding encodes to exact 0 in both formats (int4 code 8,
+        # nf4 code 7) — it adds no mass and dequants to zero
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    xb = flat.reshape(n_blocks, block)
+    amax = jnp.max(jnp.abs(xb), axis=1)
+    if fmt == "nf4":
+        from fedml_tpu.compression.codecs import _NF4_MIDPOINTS
+        scale = jnp.where(amax > 0, amax, 1.0)
+        codes = jnp.sum(
+            (xb / scale[:, None])[..., None] > jnp.asarray(_NF4_MIDPOINTS),
+            axis=-1).astype(jnp.int32)
+    else:
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        codes = (jnp.clip(jnp.round(xb / scale[:, None]), -7, 7)
+                 .astype(jnp.int32) + 8)
+    data = (codes[:, 0::2] | (codes[:, 1::2] << 4)).astype(jnp.uint8)
+    return data, scale
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor4:
+    """Blockwise 4-bit weight: ``w ≈ lookup(codes) * scale`` per block.
+
+    ``data`` holds two codes per uint8 (``[n_blocks, block // 2]``),
+    ``scale`` one f32 per block — 0.53125 bytes/element at block 64,
+    ~0.27× of bf16. ``fmt`` is ``"int4"`` (uniform, codes−8) or ``"nf4"``
+    (Dettmers et al. 2023 normal-float codebook; better for the
+    zero-centered bell-shaped weight distributions of trained models).
+
+    The dequantized matrix is never resident: :meth:`matmul` inlines the
+    unpack → lookup → scale chain when tracing (the fused round / serving
+    step fuses it as XLA temporaries), and routes eager calls through the
+    cataloged ``quant/dequant_matmul`` program.
+    """
+
+    def __init__(self, data, scale, shape, fmt: str = "int4",
+                 block: int = DEFAULT_BLOCK4):
+        self.data = data              # uint8 [n_blocks, block // 2]
+        self.scale = scale            # f32   [n_blocks]
+        self.orig_shape = tuple(int(d) for d in shape)
+        self.fmt = fmt
+        self.block = int(block)
+
+    # -- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.scale), (self.orig_shape, self.fmt,
+                                         self.block)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        shape, fmt, block = aux
+        return cls(children[0], children[1], shape, fmt=fmt, block=block)
+
+    # -- array-ish surface ----------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.orig_shape
+
+    @property
+    def ndim(self) -> int:
+        return len(self.orig_shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.orig_shape, dtype=np.int64)) \
+            if self.orig_shape else 1
+
+    def dequantize(self, dtype=jnp.float32):
+        vals = _codes_to_vals(_unpack4(self.data), self.fmt)
+        flat = (vals * self.scale.astype(jnp.float32)[:, None]).reshape(-1)
+        return flat[:self.size].reshape(self.orig_shape).astype(dtype)
+
+    def matmul(self, x, dtype):
+        """``x @ dequant(W)`` with the dequant fused into the consumer."""
+        if isinstance(x, jax.core.Tracer):
+            # inside an enclosing trace (llm/fused_round, serving
+            # prefill/decode): the dequantized tile is an XLA temporary
+            # of THAT program — never call a CatalogedProgram on tracers
+            return x @ self.dequantize(dtype)
+        return _dequant4_matmul_program(
+            self.fmt, self.orig_shape, jnp.dtype(dtype).name,
+            x, self.data, self.scale)
+
+
+def _pack4(fmt, block, w):
+    return _quantize4_blocks(w, fmt, block)
+
+
+def _dequant4_matmul(fmt, shape, dtype_name, x, data, scale):
+    dt = jnp.dtype(dtype_name)
+    vals = _codes_to_vals(_unpack4(data), fmt)
+    flat = (vals * scale.astype(jnp.float32)[:, None]).reshape(-1)
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return x @ flat[:size].reshape(shape).astype(dt)
+
+
+def quantize_int4(w: Any, fmt: str = "int4",
+                  block: int = DEFAULT_BLOCK4) -> QuantizedTensor4:
+    """Blockwise 4-bit quantization of a kernel (round-to-nearest)."""
+    if fmt not in ("int4", "nf4"):
+        raise ValueError(
+            f"4-bit base format must be 'int4' or 'nf4', got {fmt!r}")
+    block = int(block)
+    if block < 2 or block > (1 << 20) or block & (block - 1):
+        raise ValueError(
+            f"4-bit block must be a power of two in [2, 2^20], got {block}")
+    shape = tuple(int(d) for d in w.shape)
+    data, scale = _pack4_program(fmt, block, jnp.asarray(w, jnp.float32))
+    return QuantizedTensor4(data, scale, shape, fmt=fmt, block=block)
+
+
+def quantize_params_int4(params: Any, fmt: str = "int4",
+                         min_size: int = 65536,
+                         block: int = DEFAULT_BLOCK4,
+                         donate: bool = False) -> Any:
+    """Swap every large 2-D non-LoRA kernel leaf for a QuantizedTensor4.
+
+    Same leaf filter and ``donate`` contract as :func:`quantize_params_int8`
+    (LoRA/embeddings/1-D stay full precision; ``donate=True`` frees each
+    source buffer once its packed twin exists). Records the packed
+    footprint in the ``quant/base_bytes`` gauge and bumps
+    ``quant/packed_leaves`` so a round trace shows what is 4-bit-resident.
+    """
+    from fedml_tpu import telemetry
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    out: list = []
+    packed_bytes = 0
+    n_packed = 0
+    for path, leaf in flat:
+        dict_keys = [str(p.key) for p in path if hasattr(p, "key")]
+        name = "/".join(dict_keys)
+        is_kernel = dict_keys and dict_keys[-1] in ("kernel", "lm_head")
+        if (is_kernel and getattr(leaf, "ndim", 0) == 2
+                and leaf.size >= min_size
+                and "lora" not in name
+                and "embed" not in name):
+            q = quantize_int4(leaf, fmt=fmt, block=block)
+            if donate and isinstance(leaf, jax.Array):
+                jax.block_until_ready(q.data)  # q computed before source dies
+                leaf.delete()
+            packed_bytes += int(q.data.size) + 4 * int(q.scale.size)
+            n_packed += 1
+            out.append(q)
+        else:
+            out.append(leaf)
+    reg = telemetry.get_registry()
+    reg.gauge("quant/base_bytes").set(packed_bytes)
+    if n_packed:
+        reg.counter("quant/packed_leaves").inc(n_packed)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 # -- Pallas fused dequant-matmul (the decode-latency path) -----------------
 #
 # XLA lowers x @ convert(int8) by MATERIALIZING the converted bf16 weights
@@ -228,7 +427,7 @@ def matmul_maybe_quantized(x, w, dtype):
     """``x @ w`` that accepts either a plain kernel or a QuantizedTensor —
     the single dispatch point model code uses, so new quantized formats
     only need to be handled here."""
-    if isinstance(w, QuantizedTensor):
+    if isinstance(w, (QuantizedTensor, QuantizedTensor4)):
         return w.matmul(x, dtype)
     return x @ w.astype(dtype)
 
@@ -240,3 +439,16 @@ def tree_bytes(params: Any) -> int:
         n = int(np.prod(getattr(leaf, "shape", (0,)) or (0,)))
         total += n * jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize
     return total
+
+
+# cataloged at module bottom so every helper above exists; imported lazily
+# enough that telemetry's own import graph is settled by now
+from fedml_tpu.telemetry.profiling import wrap_jit as _wrap_jit  # noqa: E402
+
+_pack4_program = _wrap_jit(
+    "quant/pack4", jax.jit(_pack4, static_argnums=(0, 1)),
+    static_argnums=(0, 1), multi_shape=True)
+_dequant4_matmul_program = _wrap_jit(
+    "quant/dequant_matmul",
+    jax.jit(_dequant4_matmul, static_argnums=(0, 1, 2)),
+    static_argnums=(0, 1, 2), multi_shape=True)

@@ -109,10 +109,19 @@ class MultichipPlan:
         return {"dp": self.dp, "fsdp": self.fsdp}
 
 
+# what one element of the frozen base costs relative to bf16, scale
+# arrays included: int8 pays 1 B + a per-output-channel f32 (negligible);
+# int4/nf4 pack two codes per byte + one f32 absmax per 64-block
+# (0.5 + 4/64 = 0.5625 B/elem → 0.28125x)
+_BASE_QUANT_SCALE = {"": 1.0, "bf16": 1.0, "int8": 0.5,
+                     "int4": 0.28125, "nf4": 0.28125}
+
+
 def plan_multichip(n_devices: int, n_layers: int,
                    param_bytes: float = 0.0,
                    hbm_limit_bytes: float = 0.0,
-                   headroom: float = 0.35) -> MultichipPlan:
+                   headroom: float = 0.35,
+                   base_quantize: str = "") -> MultichipPlan:
     """Choose (dp, fsdp) for ``n_devices`` and apply the virtual guard.
 
     ``param_bytes`` is the frozen base's total size (bf16 on the wire
@@ -123,6 +132,11 @@ def plan_multichip(n_devices: int, n_layers: int,
     being the plan. Every remaining factor of two goes to ``dp``:
     client slots are embarrassingly parallel, so dp is where extra
     devices buy rounds/s.
+
+    ``base_quantize`` ("int8" | "int4" | "nf4") scales ``param_bytes``
+    down to what the quantized-resident base actually occupies before
+    the fsdp search — a 4-bit base is ~0.28x of bf16, so shard depth
+    drops and the freed factors of two become dp lanes.
     """
     n = int(n_devices)
     if n < 1:
@@ -131,6 +145,12 @@ def plan_multichip(n_devices: int, n_layers: int,
         raise ValueError(
             f"multichip plan needs a power-of-two device count, got {n} "
             "(pass the largest power of two ≤ your slice)")
+    bq = str(base_quantize or "").lower()
+    if bq not in _BASE_QUANT_SCALE:
+        raise ValueError(
+            f"base_quantize={base_quantize!r}: must be one of "
+            f"{sorted(k for k in _BASE_QUANT_SCALE if k)} (or empty)")
+    param_bytes = float(param_bytes) * _BASE_QUANT_SCALE[bq]
     fsdp = 1
     if param_bytes > 0 and hbm_limit_bytes > 0:
         budget = (1.0 - float(headroom)) * float(hbm_limit_bytes)
@@ -164,7 +184,8 @@ def plan_multichip(n_devices: int, n_layers: int,
         requested_layers=int(n_layers), virtual=virtual,
         depth_reduced=reduced, reason=reason,
         per_shard_param_bytes=float(param_bytes) / fsdp,
-        hbm_limit_bytes=float(hbm_limit_bytes))
+        hbm_limit_bytes=float(hbm_limit_bytes),
+        notes={"base_quantize": bq} if bq else {})
     try:
         from fedml_tpu.telemetry.registry import get_registry
 

@@ -7,7 +7,11 @@ quantized update ever exists on the host, and the wire carries one
 mask-domain word per element (uint8 at ``mod_bits=8`` — same bytes as
 plain int8 blocks; the f32 per-leaf scales of plain int8 are replaced
 by one shared scalar in the codec spec, which is how SecAgg stays
-within the 1.2× wire gate).
+within the 1.2× wire gate). At ``mod_bits=4`` the masked words pack
+two nibbles per byte inside the same program — half the masked wire,
+riding the int4 transport floor — and the unmask side unpacks them as
+XLA temporaries before the mod-16 sum (a packed-byte sum would carry
+between nibbles and corrupt the cancellation).
 
 Server side (:func:`unmask_finalize`): ONE jitted program sums the
 masked words mod ``2^k`` (masks cancel inside the sum — this is the
@@ -54,7 +58,27 @@ __all__ = [
     "unmask_finalize",
 ]
 
-_UINT = {8: jnp.uint8, 16: jnp.uint16}
+_UINT = {4: jnp.uint8, 8: jnp.uint8, 16: jnp.uint16}
+
+
+def _pack_nibbles(y, size: int):
+    """[*leaf] mod-16 words → flat packed uint8 [(size+1)//2].
+
+    Element ``2i`` rides the low nibble of byte ``i``, ``2i+1`` the
+    high nibble — the same layout as the int4/nf4 wire codec."""
+    flat = y.reshape(-1)
+    if size % 2:
+        flat = jnp.concatenate([flat, jnp.zeros((1,), flat.dtype)])
+    pairs = flat.reshape(-1, 2)
+    return (pairs[:, 0] | (pairs[:, 1] << 4)).astype(jnp.uint8)
+
+
+def _unpack_nibbles(packed, size: int):
+    """flat packed uint8 → [size] int32 words in [0, 16)."""
+    lo = (packed & 0xF).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))[..., :size]
 
 # trace-time evidence for the "plain aggregate never hits the host
 # pre-noise" acceptance check: during tracing of the finalize program
@@ -180,7 +204,14 @@ def _masked_encode_program(clip: float, bound: int, mod_bits: int, meta,
         q = q.astype(jnp.int32)
         # uint cast of the int32 low bits IS the mod-2^k wrap
         y = (q + m.astype(jnp.int32)) & ((1 << mod_bits) - 1)
-        masked.append(y.astype(_UINT[mod_bits]))
+        if mod_bits == 4:
+            # the wire carries packed nibbles (two masked words per
+            # byte) — the unpacked word tree exists only inside this
+            # program
+            size = int(np.prod(sh, dtype=np.int64)) if sh else 1
+            masked.append(_pack_nibbles(y, size))
+        else:
+            masked.append(y.astype(_UINT[mod_bits]))
         # residual: everything the server will not see for this client
         # (clip error + quantization error), re-sent next round
         new_res.append(comp - q.astype(jnp.float32) * scale)
@@ -248,10 +279,21 @@ def _unmask_program(clip: float, bound: int, mod_bits: int, meta,
     pre_noise_traced = True
     for i, (ys, rec, base, (dt, sh)) in enumerate(
             zip(stacked, recovery, base_leaves, meta)):
-        udt = _UINT[mod_bits]
-        s = jnp.sum(ys, axis=0, dtype=udt) - rec.astype(udt)
-        c = s.astype(jnp.int32)
-        c = c - ((c >= half).astype(jnp.int32) << mod_bits)
+        if mod_bits == 4:
+            # packed wire: unpack each client's nibbles as XLA
+            # temporaries, then exact mod-16 arithmetic in int32 (a
+            # packed-byte sum would carry between nibbles)
+            size = int(np.prod(sh, dtype=np.int64)) if sh else 1
+            words = _unpack_nibbles(ys, size)  # [C, size]
+            s = (jnp.sum(words, axis=0)
+                 - rec.astype(jnp.int32).reshape(-1)) & 0xF
+            c = s - ((s >= half).astype(jnp.int32) << mod_bits)
+            c = c.reshape(sh)
+        else:
+            udt = _UINT[mod_bits]
+            s = jnp.sum(ys, axis=0, dtype=udt) - rec.astype(udt)
+            c = s.astype(jnp.int32)
+            c = c - ((c >= half).astype(jnp.int32) << mod_bits)
         mean = c.astype(jnp.float32) * scale / n_div
         agg = base.astype(jnp.float32) + mean
         pre_noise_traced = pre_noise_traced and isinstance(

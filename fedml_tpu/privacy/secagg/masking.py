@@ -26,7 +26,9 @@ Headroom: with ``mod_bits=8`` every client quantizes to
 ``[-127, 127]`` and the mod-256 residue decodes exactly. The per-client
 resolution loss (8 → 8−log2(n) bits) is re-sent by error feedback; the
 ``mod_bits=16`` knob trades 2× wire for full int8-grade resolution at
-cohorts up to 255.
+cohorts up to 255, and ``mod_bits=4`` rides the int4 wire — the masked
+nibbles pack two per byte inside the encode program, halving masked
+bytes again (``bound = 7 // n``, cohorts up to 7).
 
 Everything here is transport-free math — the protocol dance lives in
 :mod:`fedml_tpu.privacy.secagg.protocol`.
@@ -47,9 +49,12 @@ __all__ = [
     "recovery_adjustment",
 ]
 
-MOD_BITS_CHOICES = (8, 16)
+MOD_BITS_CHOICES = (4, 8, 16)
 
-_WORD_DTYPE = {8: np.uint8, 16: np.uint16}
+# host-side mask words are UNPACKED (one word per element) even at
+# mod_bits=4 — packing to two nibbles per byte happens only inside the
+# jitted encode program, where the wire is assembled
+_WORD_DTYPE = {4: np.uint8, 8: np.uint8, 16: np.uint16}
 
 
 def _check_mod_bits(mod_bits: int) -> int:
@@ -129,6 +134,12 @@ def _accumulate(meta, signed_seeds: Sequence[Tuple[int, int]],
                 a += m  # uint wraparound IS the mod-2^k arithmetic
             else:
                 a -= m
+    if mod_bits < 8:
+        # sub-byte domain rides uint8 words: the byte wraparound above
+        # is mod-256, which reduces exactly to mod-2^k because 2^k
+        # divides 256 — mask down so words stay in [0, 2^k)
+        for a in acc:
+            a &= (1 << mod_bits) - 1
     return acc
 
 

@@ -758,6 +758,22 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
             if mem_limit > 0:
                 headroom = (f"; {_fmt_bytes(mem_limit - top['peak_hbm_bytes'])}"
                             " HBM headroom left on this device")
+            # a 4-bit-resident base (quant/base_bytes gauge) is the
+            # largest single headroom lever: name what it occupies vs
+            # what a bf16 base would, so the verdict explains where the
+            # headroom came from (or what enabling int4/nf4 would buy)
+            base4 = 0.0
+            for key, v in (report.get("mem_gauges") or {}).items():
+                if key.split("{")[0] == "quant/base_bytes":
+                    base4 = max(base4, float(v or 0.0))
+            if base4 > 0:
+                # packed nibbles + f32/64-block scale = 0.28125x of bf16
+                headroom += (
+                    f"; 4-bit-resident base holds {_fmt_bytes(base4)} "
+                    f"packed (a bf16 base would hold "
+                    f"{_fmt_bytes(base4 / 0.28125)} — "
+                    f"{_fmt_bytes(base4 / 0.28125 - base4)} of the "
+                    "headroom is int4/nf4 residency)")
             n_shards = int((top.get("mesh_spec") or {}).get("n_shards") or 1)
             if n_shards > 1:
                 # XLA memory analysis is per-device, so a sharded

@@ -332,7 +332,8 @@ def build_report(run_dir) -> Dict:
                 "client" in labels):
             row = client_health.setdefault(str(labels["client"]), {})
             row[name.split("/")[1]] = rec.get("value", 0.0)
-        elif name.startswith("mem/") and rec.get("kind") == "gauge":
+        elif name.startswith(("mem/", "quant/")) and (
+                rec.get("kind") == "gauge"):
             lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
             mem_gauges[name + ("{" + lbl + "}" if lbl else "")] = rec.get(
                 "value", 0.0)

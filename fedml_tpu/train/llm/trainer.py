@@ -126,17 +126,21 @@ class LLMTrainer:
             self.tx = base_tx
         else:
             self.tx = base_tx
-        # QLoRA: store the frozen base as per-channel int8
-        # (ops/quant.quantize_int8) — 6.9 GB instead of 13.5 at 7B, which
-        # frees HBM for real batch sizes; matmuls dequantize via the XLA
-        # lowering (many-row training is MXU-bound, the Pallas fused
-        # kernel is the few-row decode path). Requires LoRA (the base
-        # must be frozen: int8 leaves carry no gradient).
+        # QLoRA: store the frozen base quantized — per-channel int8
+        # (ops/quant.quantize_int8, 6.9 GB instead of 13.5 at 7B) or
+        # blockwise 4-bit int4/nf4 (ops/quant.quantize_int4, ~3.6 GB) —
+        # which frees HBM for real batch sizes; matmuls dequantize inside
+        # the fused round program (the dequantized tile is an XLA
+        # temporary — a full-precision base is never materialized).
+        # Requires LoRA (the base must be frozen: integer leaves carry no
+        # gradient).
         self.base_quantize = str(
             getattr(args, "base_quantize", "") or "").lower()
-        if self.base_quantize and self.base_quantize != "int8":
+        if self.base_quantize and self.base_quantize not in (
+                "int8", "int4", "nf4"):
             raise ValueError(
-                f"base_quantize={self.base_quantize!r}: only 'int8'")
+                f"base_quantize={self.base_quantize!r}: must be one of "
+                "'int8', 'int4', 'nf4'")
         if self.base_quantize and not self.lora_only:
             raise ValueError(
                 "base_quantize requires lora_rank > 0 (QLoRA trains "
@@ -207,29 +211,44 @@ class LLMTrainer:
         return self.params
 
     def _quantize_base(self) -> None:
-        from fedml_tpu.ops.quant import QuantizedTensor, quantize_params_int8
+        from fedml_tpu.ops.quant import (QuantizedTensor, QuantizedTensor4,
+                                         quantize_params_int4,
+                                         quantize_params_int8)
 
-        # donate: at 7B the full-precision source and the int8 twin can't
-        # both be resident; each kernel's buffer dies as its twin lands
-        self.params = quantize_params_int8(
-            self.params, mode="dequant", donate=True,
-            min_size=int(getattr(self.args, "base_quantize_min_size",
-                                 65536)))
-        # rebuild the shardings tree to the new structure: int8 data /
-        # scale inherit the source kernel's layout through the jnp
-        # quantization ops (ZeRO-sharded int8 base), so record what the
-        # arrays actually carry; non-quantized leaves keep their original
-        # NamedShardings.
+        # donate: at 7B the full-precision source and the quantized twin
+        # can't both be resident; each kernel's buffer dies as its twin
+        # lands
+        min_size = int(getattr(self.args, "base_quantize_min_size", 65536))
+        if self.base_quantize in ("int4", "nf4"):
+            self.params = quantize_params_int4(
+                self.params, fmt=self.base_quantize, donate=True,
+                min_size=min_size,
+                block=int(getattr(self.args, "base_quantize_block", 64)))
+        else:
+            self.params = quantize_params_int8(
+                self.params, mode="dequant", donate=True, min_size=min_size)
+        # rebuild the shardings tree to the new structure: quantized data
+        # / scale inherit the source kernel's layout through the jnp
+        # quantization ops (ZeRO-sharded quantized base), so record what
+        # the arrays actually carry; non-quantized leaves keep their
+        # original NamedShardings.
         old = {_path_str(p): s for p, s in
                jax.tree_util.tree_flatten_with_path(self.shardings)[0]}
+
+        def _shard_of(path, leaf):
+            if isinstance(leaf, QuantizedTensor4):
+                return QuantizedTensor4(
+                    leaf.data.sharding, leaf.scale.sharding,
+                    leaf.orig_shape, fmt=leaf.fmt, block=leaf.block)
+            if isinstance(leaf, QuantizedTensor):
+                return QuantizedTensor(leaf.data.sharding,
+                                       leaf.scale.sharding, leaf.mode)
+            return old[_path_str(path)]
+
         self.shardings = jax.tree_util.tree_map_with_path(
-            lambda path, leaf: (
-                QuantizedTensor(leaf.data.sharding, leaf.scale.sharding,
-                                leaf.mode)
-                if isinstance(leaf, QuantizedTensor)
-                else old[_path_str(path)]),
-            self.params,
-            is_leaf=lambda x: isinstance(x, QuantizedTensor),
+            _shard_of, self.params,
+            is_leaf=lambda x: isinstance(
+                x, (QuantizedTensor, QuantizedTensor4)),
         )
 
     def _compile(self):
@@ -593,15 +612,29 @@ class LLMTrainer:
         from jax.sharding import PartitionSpec as P
 
         lora_shardings = extract_lora(self.shardings)
-        data_spec = NamedSharding(self.mesh, P(None, None, ("dp", "fsdp")))
+        # pin opt state to its live shardings on BOTH sides: donated
+        # buffers must alias, and leaving the output to GSPMD lets it
+        # pick a different axis than the input holds (the 4-bit packed
+        # base perturbs propagation enough to surface this), which is a
+        # runtime size mismatch on the alias
         rep = replicated(self.mesh)
+
+        def _opt_shard(v):
+            s = getattr(v, "sharding", None)
+            if isinstance(s, NamedSharding) and s.mesh == self.mesh:
+                return s
+            return rep  # scalars (adam count) live on one device
+
+        opt_shardings = jax.tree.map(_opt_shard, self.opt_state)
+        data_spec = NamedSharding(self.mesh, P(None, None, ("dp", "fsdp")))
         from fedml_tpu.telemetry.profiling import wrap_jit
 
         return wrap_jit("llm/fused_round", jax.jit(
             fed_round,
-            in_shardings=(self.shardings, None, lora_shardings,
+            in_shardings=(self.shardings, opt_shardings, lora_shardings,
                           data_spec, data_spec, data_spec, rep),
-            out_shardings=(self.shardings, None, lora_shardings, rep),
+            out_shardings=(self.shardings, opt_shardings, lora_shardings,
+                           rep),
             donate_argnums=(0, 1, 2),
         ), multi_shape=True)
 

@@ -24,7 +24,7 @@ from fedml_tpu.compression import (
 from fedml_tpu.data import load_federated
 from fedml_tpu.utils.serialization import safe_dumps, safe_loads
 
-ALL_CODECS = ("identity", "bf16", "int8", "topk")
+ALL_CODECS = ("identity", "bf16", "int8", "topk", "int4", "nf4")
 
 DTYPE_TREES = {
     "f32": lambda rng: {
@@ -80,6 +80,16 @@ def test_codec_roundtrip_error_bounds(codec_name, dtype_kind):
             # kept entries exact, dropped entries decode to zero
             kept = bf != 0
             np.testing.assert_array_equal(bf[kept], af[kept])
+        elif codec_name == "int4":
+            # stochastic rounding to 15 levels: one step of the per-block
+            # scale, bounded by the global amax (per-block amax ≤ global)
+            bound = np.max(np.abs(af)) / 7.0 + 1e-7
+            assert np.max(np.abs(af - bf)) <= bound
+        elif codec_name == "nf4":
+            # nearest NF4 codeword: half the widest codebook gap
+            # (|-1.0 − -0.696| / 2 ≈ 0.152) times the block absmax
+            bound = 0.16 * np.max(np.abs(af)) + 1e-7
+            assert np.max(np.abs(af - bf)) <= bound
 
 
 def test_int8_stochastic_rounding_is_unbiased():
@@ -426,6 +436,18 @@ def test_sp_int8_error_feedback_loss_within_2pct_of_uncompressed():
     of the uncompressed final loss."""
     base = _run_sp()
     comp = _run_sp(compression="int8")
+    rel = abs(comp["test_loss"] - base["test_loss"]) / max(
+        base["test_loss"], 1e-9)
+    assert rel < 0.02, (comp["test_loss"], base["test_loss"], rel)
+
+
+@pytest.mark.parametrize("spec", ["int4", "nf4"])
+def test_sp_4bit_error_feedback_loss_within_int8_envelope(spec):
+    """ISSUE 18 acceptance: 3 rounds of the 4-bit wire + error feedback
+    converge within the documented int8 envelope (2% of the uncompressed
+    final loss) — EF absorbs the coarser quantization error."""
+    base = _run_sp()
+    comp = _run_sp(compression=spec)
     rel = abs(comp["test_loss"] - base["test_loss"]) / max(
         base["test_loss"], 1e-9)
     assert rel < 0.02, (comp["test_loss"], base["test_loss"], rel)

@@ -1,0 +1,349 @@
+"""Int4/NF4 end to end: 4-bit wire fuzz (truncated blocks, odd-length
+packs, hostile scales → ValueError + integrity counter), bit-determinism
+and robust/secagg parity in the 4-bit domain, the int4/NF4-resident base
+(QuantizedTensor4), serving hot-swap on a 4-bit engine, and the
+multichip plan reading the smaller per-shard base."""
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.compression import derive_key, get_codec
+from fedml_tpu.compression.codecs import fused_weighted_sum
+from fedml_tpu.integrity.robust_agg import fused_robust_sum
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+from fedml_tpu.ops.quant import (
+    DEFAULT_BLOCK4,
+    QuantizedTensor4,
+    quantize_int4,
+    quantize_params_int4,
+)
+from fedml_tpu.telemetry.registry import get_registry
+from fedml_tpu.utils.serialization import safe_dumps, safe_loads
+
+
+def _tree(rng, shapes=((130, 3), (17,), (64,))):
+    return {f"l{i}": np.asarray(rng.normal(size=s), np.float32)
+            for i, s in enumerate(shapes)}
+
+
+def _host(ct):
+    """Wire roundtrip → host-side CompressedTree with mutable arrays."""
+    ct2 = safe_loads(safe_dumps(ct))
+    ct2.arrays = [[np.array(a) for a in parts] for parts in ct2.arrays]
+    return ct2
+
+
+def _counter(name):
+    return get_registry().counter(name).value
+
+
+# -- wire fuzz (satellite: loud rejection, never mis-framing) --------------
+@pytest.mark.parametrize("codec_name", ["int4", "nf4"])
+def test_4bit_wire_fuzz_truncation_and_hostile_scales(codec_name):
+    """Every structural mutilation of a 4-bit wire must raise ValueError
+    from check_wire — a truncated pack must never silently decode with
+    reframed blocks."""
+    codec = get_codec(codec_name)
+    ct = _host(codec.encode(_tree(np.random.default_rng(0)),
+                            key=derive_key(0, 0, 1)))
+    codec.decode(ct)  # the untampered wire is fine
+
+    # column truncation: drop trailing packed bytes from every block
+    bad = copy.copy(ct)
+    bad.arrays = [[parts[0][:, :-1], parts[1]] for parts in ct.arrays]
+    with pytest.raises(ValueError, match="truncated|odd-length"):
+        codec.decode(bad)
+
+    # row truncation: drop the last block entirely
+    bad = copy.copy(ct)
+    bad.arrays = [[ct.arrays[0][0][:-1], ct.arrays[0][1]]] + ct.arrays[1:]
+    with pytest.raises(ValueError, match="does not cover"):
+        codec.decode(bad)
+
+    # odd-length flat pack re-presented as a 1-wide column
+    bad = copy.copy(ct)
+    bad.arrays = [[ct.arrays[0][0].reshape(-1)[:-3].reshape(-1, 1)[:5],
+                   ct.arrays[0][1]]] + ct.arrays[1:]
+    with pytest.raises(ValueError):
+        codec.decode(bad)
+
+    # wrong pack dtype (int16 would smuggle 4 codes per word)
+    bad = copy.copy(ct)
+    bad.arrays = [[ct.arrays[0][0].astype(np.int16), ct.arrays[0][1]]] \
+        + ct.arrays[1:]
+    with pytest.raises(ValueError, match="uint8"):
+        codec.decode(bad)
+
+    # scale truncation / scale-block mismatch
+    bad = copy.copy(ct)
+    bad.arrays = [[ct.arrays[0][0], ct.arrays[0][1][:-1]]] + ct.arrays[1:]
+    with pytest.raises(ValueError, match="scale"):
+        codec.decode(bad)
+
+    # missing scale part entirely
+    bad = copy.copy(ct)
+    bad.arrays = [[ct.arrays[0][0]]] + ct.arrays[1:]
+    with pytest.raises(ValueError, match="parts"):
+        codec.decode(bad)
+
+    # fused consumers run the same gate
+    with pytest.raises(ValueError):
+        fused_weighted_sum([bad], np.ones(1, np.float32))
+
+
+@pytest.mark.parametrize("hostile", [np.inf, -np.inf, np.nan])
+@pytest.mark.parametrize("codec_name", ["int4", "nf4"])
+def test_4bit_hostile_scale_rejected_and_counted(codec_name, hostile):
+    """A non-finite block scale is the whole numeric attack surface of
+    the 4-bit wire (nibbles are finite by construction): ValueError +
+    integrity/nonfinite_wire increments."""
+    codec = get_codec(codec_name)
+    ct = _host(codec.encode(_tree(np.random.default_rng(1)),
+                            key=derive_key(0, 0, 1)))
+    ct.arrays[0][1][0] = hostile
+    before = _counter("integrity/nonfinite_wire")
+    with pytest.raises(ValueError, match="non-finite"):
+        codec.decode(ct)
+    assert _counter("integrity/nonfinite_wire") == before + 1
+
+
+def test_4bit_nondefault_block_resolves_from_packed_geometry():
+    """A tag-only wire (codec="int4") encoded at a non-default block
+    decodes correctly: the block is recovered from the packed column
+    width, and non-power-of-two claims fall through to rejection."""
+    src = get_codec("int4@32")
+    tree = _tree(np.random.default_rng(2))
+    ct = _host(src.encode(tree, key=derive_key(0, 0, 1)))
+    assert ct.arrays[0][0].shape[1] == 16  # 32/2 packed bytes per block
+    dec = get_codec("int4").decode(ct)  # default-block instance
+    amax = max(np.max(np.abs(v)) for v in tree.values())
+    for k in tree:
+        assert np.max(np.abs(np.asarray(dec[k]) - tree[k])) <= amax / 7 + 1e-6
+
+
+def test_int4_same_seed_wire_is_bit_identical():
+    """Stochastic rounding is keyed, not ambient: two encodes of the
+    same tree under the same derived key serialize to identical bytes."""
+    tree = _tree(np.random.default_rng(3))
+    codec = get_codec("int4")
+    w1 = safe_dumps(codec.encode(tree, key=derive_key(7, 3, 1)))
+    w2 = safe_dumps(codec.encode(tree, key=derive_key(7, 3, 1)))
+    assert w1 == w2
+    w3 = safe_dumps(codec.encode(tree, key=derive_key(7, 4, 1)))
+    assert w1 != w3  # a different round really reseeds the dither
+
+
+# -- aggregation parity in the 4-bit domain --------------------------------
+@pytest.mark.parametrize("codec_name", ["int4", "nf4"])
+def test_4bit_robust_median_matches_decoded_stack(codec_name):
+    """fused_robust_sum over 4-bit wires == np.median over the decoded
+    client stack — the packed-domain fusion is an execution strategy,
+    not a different statistic."""
+    codec = get_codec(codec_name)
+    trees = [_tree(np.random.default_rng(20 + c)) for c in range(5)]
+    cts = [codec.encode(t, key=derive_key(0, 0, c + 1))
+           for c, t in enumerate(trees)]
+    agg = fused_robust_sum(cts, "median")
+    dec = [codec.decode(ct) for ct in cts]
+    for k in trees[0]:
+        ref = np.median(np.stack([np.asarray(d[k]) for d in dec]), axis=0)
+        np.testing.assert_allclose(np.asarray(agg[k]), ref,
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_secagg_mod4_masked_aggregate_matches_zero_mask_reference():
+    """mod_bits=4: masked words pack two per byte, masks still cancel —
+    the unmasked aggregate equals a zero-mask encode bit-for-bit."""
+    from fedml_tpu.compression.codecs import _tree_meta
+    from fedml_tpu.privacy import secagg
+    from fedml_tpu.privacy.secagg import masking
+
+    n = 3
+    codec = get_codec(f"secagg_int8@0.05/{masking.client_bound(n, 4)}/4")
+    template = {"w": np.zeros((8, 4), np.float32),
+                "b": np.zeros((4,), np.float32)}
+    meta = _tree_meta(jax.tree.leaves(template))
+    rng = np.random.default_rng(4)
+    deltas = [jax.tree.map(
+        lambda x: np.asarray(rng.normal(0, 0.01, x.shape), np.float32),
+        template) for _ in range(n)]
+    base = jax.tree.map(lambda x: np.zeros(x.shape, np.float32), template)
+
+    secrets = {(i, j): i * 1009 + j * 7919
+               for i in range(1, n + 1) for j in range(i + 1, n + 1)}
+
+    def seeds_for(i):
+        return {j: masking.pair_round_seed(
+            secrets[(min(i, j), max(i, j))], 0)
+            for j in range(1, n + 1) if j != i}
+
+    def encode(mask_fn):
+        cts = []
+        for i, d in enumerate(deltas, start=1):
+            nm = mask_fn(i)
+            ct, _ = secagg.masked_encode(
+                d, nm, codec, derive_key(0, 0, i),
+                sa={"round": 0, "rank": i,
+                    "roster": list(range(1, n + 1))})
+            cts.append(ct)
+        return secagg.unmask_finalize(cts, base, codec)
+
+    masked = encode(lambda i: masking.net_mask_leaves(
+        i, seeds_for(i), meta, codec.mod_bits))
+    zero = encode(lambda i: [np.zeros(sh, np.uint8) for _, sh in meta])
+    for a, b in zip(jax.tree.leaves(masked), jax.tree.leaves(zero)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- int4/NF4-resident base (QuantizedTensor4) -----------------------------
+@pytest.mark.parametrize("fmt", ["int4", "nf4"])
+def test_quantize_int4_roundtrip_error_bound(fmt):
+    rng = np.random.default_rng(5)
+    w = rng.normal(size=(96, 40)).astype(np.float32)
+    q = quantize_int4(w, fmt=fmt)
+    assert isinstance(q, QuantizedTensor4)
+    assert q.data.dtype == jnp.uint8 and q.fmt == fmt
+    assert q.data.shape == (60, DEFAULT_BLOCK4 // 2)  # 3840/64 blocks
+    wq = np.asarray(q.dequantize())
+    assert wq.shape == w.shape
+    scale = np.repeat(np.asarray(q.scale), DEFAULT_BLOCK4)[:w.size]
+    err = np.abs(wq - w).reshape(-1)
+    if fmt == "int4":
+        # round-to-nearest int4: half a step per element, per block
+        assert np.all(err <= 0.5 * scale + 1e-6)
+    else:
+        # widest NF4 codebook gap is ~0.304 of the block amax
+        assert np.all(err <= 0.16 * scale + 1e-6)
+
+
+@pytest.mark.parametrize("fmt", ["int4", "nf4"])
+def test_qt4_matmul_eager_and_traced_agree(fmt):
+    """The eager cataloged program and the traced (fused into the
+    enclosing jit) path are the same math."""
+    rng = np.random.default_rng(6)
+    w = rng.normal(size=(64, 48)).astype(np.float32)
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    q = quantize_int4(w, fmt=fmt)
+    eager = np.asarray(q.matmul(x, jnp.float32))
+    traced = np.asarray(jax.jit(lambda a: q.matmul(a, jnp.float32))(x))
+    np.testing.assert_allclose(eager, traced, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        eager, np.asarray(x @ q.dequantize(jnp.float32)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_qt4_is_a_pytree_and_validates_args():
+    q = quantize_int4(np.ones((8, 8), np.float32), block=16)
+    leaves, treedef = jax.tree.flatten(q)
+    assert len(leaves) == 2  # packed data + scales; aux carries geometry
+    q2 = jax.tree.unflatten(treedef, leaves)
+    assert q2.orig_shape == (8, 8) and q2.block == 16
+    with pytest.raises(ValueError, match="format"):
+        quantize_int4(np.ones((4, 4), np.float32), fmt="int3")
+    with pytest.raises(ValueError, match="power of two"):
+        quantize_int4(np.ones((4, 4), np.float32), block=48)
+
+
+def test_quantize_params_int4_targets_only_large_base_kernels():
+    """Same residency filter as int8: base kernels + lm_head pack, lora
+    adapters and embeddings stay full precision; HBM telemetry records
+    the packed footprint (≤ ~0.55x of a bf16 base)."""
+    cfg = LlamaConfig.tiny(lora_rank=4, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), toks)
+    qparams = quantize_params_int4(params, fmt="nf4", min_size=1024)
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor4))[0]
+
+    def name_of(path):
+        return "/".join(str(p.key) for p in path if hasattr(p, "key"))
+
+    packed = [(name_of(p), leaf) for p, leaf in flat
+              if isinstance(leaf, QuantizedTensor4)]
+    assert packed, "no kernels were packed"
+    for name, leaf in packed:
+        assert "lora" not in name and "embed" not in name, name
+        bf16_bytes = 2 * leaf.size
+        packed_bytes = leaf.data.size + 4 * leaf.scale.size
+        assert packed_bytes <= 0.55 * bf16_bytes, (name, packed_bytes)
+    fp_names = [name_of(p) for p, leaf in flat
+                if not isinstance(leaf, QuantizedTensor4)]
+    assert any("lora_a" in n for n in fp_names)
+    assert any("embed" in n for n in fp_names)
+    assert get_registry().gauge("quant/base_bytes").value > 0
+
+    # the packed base drives the model forward without a bf16 twin
+    logits_fp = model.apply(params, toks)
+    logits_q = model.apply(qparams, toks)
+    rel = float(jnp.max(jnp.abs(logits_q - logits_fp))
+                / (jnp.max(jnp.abs(logits_fp)) + 1e-9))
+    assert np.isfinite(rel) and rel < 0.5, rel
+
+
+# -- serving: int4-resident engine + hot swap ------------------------------
+def test_serving_hot_swap_with_int4_resident_base():
+    """The engine packs its base to int4, serves, hot-swaps a new round
+    through the same packing transform, and the post-swap generation
+    matches a static int4 deployment of that round."""
+    from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.tiny(use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(1, 8)))
+    params = model.init(jax.random.key(0), toks)
+    bumped = jax.tree.map(lambda x: x + 0.02, params)
+    prompt = [int(t) for t in np.asarray(toks[0][:5])]
+
+    def static_engine_tokens(tree):
+        eng = ContinuousBatchingEngine(
+            model, tree, batch_slots=2, max_len=32,
+            quantize="int4", quantize_min_size=1024).start()
+        try:
+            return eng.generate(prompt, max_new_tokens=6)
+        finally:
+            eng.stop()
+
+    expected_r1 = static_engine_tokens(bumped)
+
+    eng = ContinuousBatchingEngine(
+        model, params, batch_slots=2, max_len=32,
+        quantize="int4", quantize_min_size=1024, initial_round=0).start()
+    try:
+        live = eng.model_slots.live_params
+        assert any(isinstance(l, QuantizedTensor4) for l in jax.tree.leaves(
+            live, is_leaf=lambda x: isinstance(x, QuantizedTensor4)))
+        out0 = eng.generate(prompt, max_new_tokens=6)
+        assert len(out0) == 6
+        # hot swap: the transform re-packs the staged round to int4
+        assert eng.model_slots.publish_payload(
+            jax.tree.map(np.asarray, bumped), 1)
+        out1 = eng.generate(prompt, max_new_tokens=6)
+    finally:
+        eng.stop()
+    assert eng.model_slots.live_round == 1
+    assert out1 == expected_r1  # same round, same packing → same tokens
+
+
+# -- multichip: the plan reads the smaller per-shard base ------------------
+def test_multichip_plan_shrinks_fsdp_for_4bit_base():
+    from fedml_tpu.parallel.multichip import plan_multichip
+
+    gb = 1 << 30
+    kw = dict(n_devices=8, n_layers=4, param_bytes=13.5 * gb,
+              hbm_limit_bytes=16 * gb, headroom=0.35)
+    bf16 = plan_multichip(**kw)
+    int4 = plan_multichip(base_quantize="int4", **kw)
+    nf4 = plan_multichip(base_quantize="nf4", **kw)
+    assert int4.fsdp < bf16.fsdp  # 4-bit base needs fewer shards
+    assert int4.dp > bf16.dp  # the freed factors become dp lanes
+    assert nf4.fsdp == int4.fsdp
+    assert int4.notes["base_quantize"] == "int4"
+    assert int4.per_shard_param_bytes < bf16.per_shard_param_bytes
+    with pytest.raises(ValueError, match="base_quantize"):
+        plan_multichip(base_quantize="int3", **kw)

@@ -206,6 +206,18 @@ def test_prefetch_on_off_bit_identical_with_compression():
     np.testing.assert_array_equal(ident, plain)
 
 
+@pytest.mark.parametrize("spec", ["int4", "nf4@64"])
+def test_prefetch_on_off_bit_identical_with_4bit_codec(spec):
+    """The 4-bit wire keeps the prefetch bit-identity contract: int4's
+    stochastic dither is keyed by (seed, round, cid) and nf4 is
+    deterministic, so staging order cannot move a bit either way."""
+    over = {**TRUST_OVER, "compression": spec}
+    api_on, on = _mesh_params({**over, "enable_prefetch": True})
+    assert api_on._pipeline.prefetched_rounds == 2
+    _, off = _mesh_params({**over, "enable_prefetch": False})
+    np.testing.assert_array_equal(on, off)
+
+
 def test_pipelined_mesh_matches_sp_3_rounds_poison_ldp():
     """3 prefetched mesh rounds == 3 sequential sp rounds (poison + LDP).
 

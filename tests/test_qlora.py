@@ -131,6 +131,63 @@ def test_qlora_fused_round_runs():
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
+@pytest.mark.parametrize("fmt", ["int4", "nf4"])
+def test_qlora_4bit_base_trains_and_fused_round_runs(fmt):
+    """base_quantize: int4|nf4 — the frozen base lives packed two codes
+    per byte (QuantizedTensor4); adapters still learn and the fused
+    round runs with the dequant folded into the program trace."""
+    from fedml_tpu.ops.quant import QuantizedTensor4
+
+    class _Q4Args(_Args):
+        base_quantize = fmt
+        base_quantize_min_size = 1024
+
+    cfg = LlamaConfig.tiny(lora_rank=4, use_flash=False)
+    tr = LLMTrainer(cfg, _Q4Args())
+    tr.init(seed=0)
+    qt = [v for v in jax.tree.leaves(
+        tr.params, is_leaf=lambda x: isinstance(x, QuantizedTensor4))
+        if isinstance(v, QuantizedTensor4)]
+    assert qt, "no kernel was packed to 4-bit"
+    assert all(v.data.dtype == jnp.uint8 and v.fmt == fmt for v in qt)
+    # packed + scales ≤ ~0.55x of a bf16 base (the residency win)
+    for v in qt:
+        assert v.data.size + 4 * v.scale.size <= 0.55 * 2 * v.size
+    lora = extract_lora(tr.params)
+    assert lora and all(v.dtype == jnp.float32 for v in lora.values())
+
+    x, y = _data(cfg)
+    m = np.ones((4,), np.float32)
+    losses = [tr.step(x, y, m) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    fed = tr.compile_federated_round(2, 1)
+    rng = np.random.default_rng(2)
+    xs = rng.integers(0, cfg.vocab_size, size=(2, 1, 4, 16)).astype(np.int32)
+    ys = ((xs + 1) % cfg.vocab_size).astype(np.int32)
+    ms = np.ones((2, 1, 4), np.float32)
+    w = np.ones((2,), np.float32)
+    g = jax.tree.map(jnp.copy, extract_lora(tr.params))
+    # params are DONATED into the round — snapshot the packed bytes first
+    base0 = [np.asarray(v.data).copy() for v in jax.tree.leaves(
+        tr.params, is_leaf=lambda x: isinstance(x, QuantizedTensor4))
+        if isinstance(v, QuantizedTensor4)]
+    p, o = tr.params, tr.opt_state
+    fed_losses = []
+    for _ in range(3):
+        p, o, g, loss = fed(p, o, g, xs, ys, ms, w)
+        fed_losses.append(float(loss))
+    assert np.isfinite(fed_losses).all() and fed_losses[-1] < fed_losses[0]
+    # the base stayed bit-frozen through the fused round
+    base1 = [np.asarray(v.data) for v in jax.tree.leaves(
+        p, is_leaf=lambda x: isinstance(x, QuantizedTensor4))
+        if isinstance(v, QuantizedTensor4)]
+    assert len(base0) == len(base1)
+    for a, b in zip(base0, base1):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_trainable_set_includes_router_for_moe():
     cfg = LlamaConfig.tiny(lora_rank=4, num_experts=4, use_flash=False)
     tr = LLMTrainer(cfg, _Args())

@@ -93,7 +93,7 @@ def test_quantized_decode_agrees_with_fp(tmp_path):
         eng.stop()
 
     with pytest.raises(ValueError):
-        ContinuousBatchingEngine(model, params, quantize="int4")
+        ContinuousBatchingEngine(model, params, quantize="int3")
 
 
 def test_pallas_dequant_matmul_matches_xla_dequant():

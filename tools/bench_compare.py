@@ -22,7 +22,8 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["compare_integrity", "compare_multichip", "compare_preempt",
-           "compare_recover", "load_headline", "run_compare", "main"]
+           "compare_recover", "compare_wire", "load_headline",
+           "run_compare", "main"]
 
 
 def _natural_key(path: str):
@@ -243,6 +244,62 @@ def compare_integrity(bench_dir: str = ".",
     return out
 
 
+def compare_wire(bench_dir: str = ".",
+                 regression_threshold: float = 0.10) -> Optional[Dict]:
+    """Diff the newest two ``WIRE_*.json`` transport-bench archives.
+
+    Each archive holds ``{"metric": "wire_bytes_per_codec", "rows":
+    [...]}`` (the ``bench.py --wire`` rows). Flags, per codec present in
+    BOTH archives, a compression-ratio drop past
+    ``regression_threshold`` — the wire got fatter for the same tree —
+    and any 4-bit ratio GATE (``ok_ratio_f32``/``ok_ratio_int8``) going
+    false where it was true, at any magnitude. None when fewer than two
+    archives exist."""
+    files = sorted(glob.glob(os.path.join(bench_dir, "WIRE_*.json")),
+                   key=_natural_key)
+    if len(files) < 2:
+        return None
+
+    def _rows(path: str) -> Dict[str, Dict]:
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        rows = obj.get("rows") if isinstance(obj, dict) else obj
+        if not isinstance(rows, list):
+            return {}
+        return {str(r.get("codec")): r for r in rows
+                if isinstance(r, dict) and r.get("ratio")}
+
+    prev_rows = _rows(files[-2])
+    new_rows = _rows(files[-1])
+    if not prev_rows or not new_rows:
+        return {"ok": True,
+                "note": "no parseable wire rows in "
+                        f"{files[-2] if not prev_rows else files[-1]}"}
+    out: Dict = {
+        "ok": True,
+        "prev_file": os.path.basename(files[-2]),
+        "new_file": os.path.basename(files[-1]),
+        "regressions": [],
+    }
+    for codec in sorted(set(prev_rows) & set(new_rows)):
+        pa = float(prev_rows[codec]["ratio"])
+        pb = float(new_rows[codec]["ratio"])
+        if pa > 0 and (pa - pb) / pa > regression_threshold:
+            out["regressions"].append(
+                f"codec {codec} wire ratio regressed "
+                f"{(pa - pb) / pa * 100:.1f}% ({pa} -> {pb})")
+        for gate in ("ok_ratio_f32", "ok_ratio_int8"):
+            if (prev_rows[codec].get(gate) is True
+                    and new_rows[codec].get(gate) is False):
+                out["regressions"].append(
+                    f"codec {codec} gate {gate} went false")
+    out["ok"] = not out["regressions"]
+    return out
+
+
 def compare_multichip(bench_dir: str = ".",
                       regression_threshold: float = 0.10) -> Optional[Dict]:
     """Diff the newest two parseable ``MULTICHIP_*.json`` scale-out
@@ -345,12 +402,14 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
     preempt = compare_preempt(bench_dir)
     integrity = compare_integrity(bench_dir)
     multichip = compare_multichip(bench_dir)
+    wire = compare_wire(bench_dir, threshold)
     return {
         "ok": (delta >= -threshold and not program_regressions
                and (recover is None or recover["ok"])
                and (preempt is None or preempt["ok"])
                and (integrity is None or integrity["ok"])
-               and (multichip is None or multichip["ok"])),
+               and (multichip is None or multichip["ok"])
+               and (wire is None or wire["ok"])),
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -365,6 +424,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
         **({"preempt": preempt} if preempt is not None else {}),
         **({"integrity": integrity} if integrity is not None else {}),
         **({"multichip": multichip} if multichip is not None else {}),
+        **({"wire": wire} if wire is not None else {}),
     }
 
 

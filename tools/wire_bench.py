@@ -12,6 +12,12 @@ codec and prints ONE JSON line per codec:
   pays the jit compile and is reported separately as ``compile_ms``);
 - ``max_abs_err`` — worst-case element error of decode(encode(x)).
 
+The 4-bit rows (``int4``/``nf4``) carry ratio GATES (ISSUE 18): the
+packed-nibble wire must be at least ``6x`` smaller than the f32 payload
+and at least ``1.8x`` smaller than the int8 wire on the same tree —
+``ok_ratio_f32`` / ``ok_ratio_int8`` ride each row and ``bench.py
+--wire`` exits 1 when either goes false.
+
 Usage: ``python tools/wire_bench.py [--params N] [--codecs a,b,...]``
 (also reachable as ``python bench.py --wire``).
 """
@@ -100,24 +106,58 @@ def bench_codec(name: str, tree, baseline_bytes: int) -> dict:
     }
 
 
+DEFAULT_CODECS = ("identity", "bf16", "int8", "topk", "int4", "nf4")
+
+# ISSUE 18 acceptance gates for the 4-bit wire on the resnet-sized tree
+GATE_MIN_RATIO_VS_F32 = 6.0
+GATE_MIN_RATIO_VS_INT8 = 1.8
+
+
+def apply_wire_gates(rows: list) -> bool:
+    """Annotate the 4-bit rows with their ratio gates, True iff all hold.
+
+    ``ratio`` already measures vs the f32 ``safe_dumps`` payload; the
+    int8 comparison divides the two wires' actual byte counts, so both
+    gates judge what the transport really carries (headers included)."""
+    by = {r.get("codec"): r for r in rows}
+    int8_after = (by.get("int8") or {}).get("bytes_after")
+    all_ok = True
+    for name in ("int4", "nf4"):
+        row = by.get(name)
+        if row is None:
+            continue
+        row["ok_ratio_f32"] = row["ratio"] >= GATE_MIN_RATIO_VS_F32
+        if int8_after:
+            row["ratio_vs_int8"] = round(
+                int8_after / row["bytes_after"], 3)
+            row["ok_ratio_int8"] = (
+                row["ratio_vs_int8"] >= GATE_MIN_RATIO_VS_INT8)
+        all_ok = all_ok and row["ok_ratio_f32"] and row.get(
+            "ok_ratio_int8", True)
+    return all_ok
+
+
 def run_wire_bench(n_params: int = 11_000_000,
-                   codecs=("identity", "bf16", "int8", "topk")) -> list:
+                   codecs=DEFAULT_CODECS) -> list:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from fedml_tpu.utils.serialization import safe_dumps
 
     tree = make_resnet_sized_tree(n_params)
     baseline = len(safe_dumps(tree))
-    return [bench_codec(c, tree, baseline) for c in codecs]
+    rows = [bench_codec(c, tree, baseline) for c in codecs]
+    apply_wire_gates(rows)
+    return rows
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--params", type=int, default=11_000_000)
-    ap.add_argument("--codecs", type=str, default="identity,bf16,int8,topk")
+    ap.add_argument("--codecs", type=str, default=",".join(DEFAULT_CODECS))
     args = ap.parse_args()
-    for row in run_wire_bench(args.params, args.codecs.split(",")):
+    rows = run_wire_bench(args.params, args.codecs.split(","))
+    for row in rows:
         print(json.dumps(row))
-    return 0
+    return 0 if apply_wire_gates(rows) else 1
 
 
 if __name__ == "__main__":
