@@ -56,6 +56,12 @@ _TIER_SHAPE = re.compile(r"^tier/<v>/[a-z0-9_]+$")
 # one signal segment after the prefix — the endpoint id rides a label
 _SERVE_SPAN_SHAPE = re.compile(r"^serve/(?:stage|swap|publish)$")
 _SERVING_SHAPE = re.compile(r"^serving/[a-z0-9_]+$")
+# request lifecycle: req/* spans are exactly the per-request stages the
+# serving engine materializes at retirement (the whole request, its
+# admission queue wait, prefill, decode, and a swap-stall sub-span
+# pinned to the stalled stream) — span-only; the request's aggregate
+# metrics live under serving/* (ttft_ms, tpot_ms, tokens_per_s)
+_REQ_SPAN_SHAPE = re.compile(r"^req/(?:request|queue|prefill|decode|stall)$")
 # live telemetry plane: live/* is the stream/collector meta-namespace
 # (frames, seq gaps, alerts, scrapes) — one signal segment; node/job/rule
 # dimensions ride labels. Metric-only: the plane never opens spans.
@@ -186,6 +192,14 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
         if kind != "span" and name.startswith("serve/"):
             bad(f"{kind} {name!r} — serve/ is the live-plane "
                 "span namespace; its metrics live under serving/")
+        if kind == "span" and name.startswith("req/"):
+            if not _REQ_SPAN_SHAPE.match(name):
+                bad(f"span {name!r} must be req/request, req/queue, "
+                    "req/prefill, req/decode or req/stall")
+        if kind != "span" and name.startswith("req/"):
+            bad(f"{kind} {name!r} — req/ is the request-lifecycle "
+                "span namespace; its aggregate metrics live under "
+                "serving/")
         if kind != "span" and name.startswith("serving/"):
             if not _SERVING_SHAPE.match(name):
                 bad(f"{kind} {name!r} must be serving/<signal> "

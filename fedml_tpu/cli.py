@@ -491,10 +491,24 @@ def deploy_gateway(host: str, port: int, cache_path: str) -> None:
 @click.option("--trace-rounds", default="", show_default=True,
               help="comma-separated federation round indices whose hot-"
                    "swap windows to deep-trace (with --live)")
+@click.option("--slo-ttft-ms", default=0.0, show_default=True,
+              help="time-to-first-token SLO target (0 = undeclared)")
+@click.option("--slo-tpot-ms", default=0.0, show_default=True,
+              help="inter-token latency SLO target (0 = undeclared)")
+@click.option("--slo-e2e-ms", default=0.0, show_default=True,
+              help="whole-request latency SLO target (0 = undeclared)")
+@click.option("--slo-objective", default=0.99, show_default=True,
+              help="objective fraction: 0.99 leaves a 1%% error budget "
+                   "the online doctor's burn-rate alert spends")
+@click.option("--slo-spec", default=None,
+              help="yaml/json SLO spec file (ttft_ms/tpot_ms/e2e_ms/"
+                   "objective); --slo-* flags override nothing — the "
+                   "spec wins when given")
 def serve(model_size: str, host: str, port: int, batch_slots: int,
           max_len: int, lora_rank: int, quantize, hf_checkpoint,
           checkpoint, live_run_id, live_backend: str, broker: str,
-          trace_rounds: str) -> None:
+          trace_rounds: str, slo_ttft_ms: float, slo_tpot_ms: float,
+          slo_e2e_ms: float, slo_objective: float, slo_spec) -> None:
     """Boot a continuous-batching LLM inference endpoint (blocking)."""
     import jax
     import jax.numpy as jnp
@@ -543,13 +557,24 @@ def serve(model_size: str, host: str, port: int, batch_slots: int,
         # cannot hold both copies in HBM while the int8 twin is built
         quantize=quantize, quantize_donate=True,
     )
+    from fedml_tpu.serving.monitor import EndpointMonitor, ServingSLO
     from fedml_tpu.serving.openai_protocol import OpenAIServing
 
+    slo = (ServingSLO.from_spec(slo_spec) if slo_spec
+           else ServingSLO(ttft_ms=slo_ttft_ms, tpot_ms=slo_tpot_ms,
+                           e2e_ms=slo_e2e_ms, objective=slo_objective))
     runner = FedMLInferenceRunner(
         LlamaPredictor(engine), host=host, port=port,
+        monitor=EndpointMonitor(endpoint_id=model_size, slo=slo),
         openai=OpenAIServing(engine, model_name=model_size),
     )
+    # the engine forwards per-stream TTFT/TPOT and swap stalls to the
+    # endpoint monitor through this hook
     engine.model_slots.monitor = runner.monitor
+    if slo:
+        click.echo("SLO: " + ", ".join(
+            f"{k} ≤ {t:.0f} ms" for k, t in slo.targets())
+            + f" @ {slo.objective:g}")
     from fedml_tpu.telemetry.profiling import (
         get_trace_controller,
         parse_rounds,

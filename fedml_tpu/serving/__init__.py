@@ -15,7 +15,8 @@ from fedml_tpu.serving.live import (
 )
 from fedml_tpu.serving.llm_engine import ContinuousBatchingEngine, TokenStream
 from fedml_tpu.serving.llm_predictor import LlamaPredictor
-from fedml_tpu.serving.monitor import EndpointMonitor
+from fedml_tpu.serving.events import serving_event
+from fedml_tpu.serving.monitor import EndpointMonitor, ServingSLO
 from fedml_tpu.serving.predictor import FedMLPredictor
 
 __all__ = [
@@ -25,6 +26,8 @@ __all__ = [
     "TokenStream",
     "LlamaPredictor",
     "EndpointMonitor",
+    "ServingSLO",
+    "serving_event",
     "ModelSlots",
     "SlotLease",
     "FederatedServingBridge",

@@ -1,0 +1,68 @@
+"""serving_event — first-class serving-plane events for crash/doctor triage.
+
+The job plane has ``sched_event`` and secagg has ``_secagg_event``; the
+serving plane's overload signals were until now only counter bumps
+(``serving/rejected``), invisible in crash context and post-hoc triage.
+A ``serving_event`` lands in the three places an operator looks:
+
+- ``<run_dir>/telemetry.jsonl`` — the same stream the online doctor's
+  alerts ride, so ``telemetry doctor`` can surface shed bursts next to
+  the registry snapshots that explain them;
+- the flight-recorder ring — a crash dump shows the overload that
+  preceded death;
+- a ``serving/events`` counter (labeled by event) on the live plane.
+
+Events are **burst-deduped**: a load spike sheds hundreds of requests in
+seconds, and one event per 429 would bury the signal (and the ring).
+Within ``burst_window_s`` of the last emission of the same
+``(event, dedupe_key)`` the call is a cheap no-op returning False — the
+first shed of a burst carries the queue depth at trip time, which is
+the capacity datum the fleet item needs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from fedml_tpu.telemetry import flight_recorder
+from fedml_tpu.telemetry.registry import get_registry
+
+__all__ = ["serving_event", "reset_serving_events"]
+
+_last_emit: Dict[Tuple, float] = {}
+_lock = threading.Lock()
+
+
+def serving_event(event: str, dedupe_key: Optional[str] = None,
+                  burst_window_s: float = 2.0, **fields: Any) -> bool:
+    """Land one serving-plane event everywhere triage looks; returns
+    False when the event falls inside the previous burst's window."""
+    key = (event, dedupe_key)
+    now = time.time()
+    with _lock:
+        if now - _last_emit.get(key, -1e18) < burst_window_s:
+            return False
+        _last_emit[key] = now
+    get_registry().counter("serving/events", labels={"event": event}).inc()
+    flight_recorder.record("serving_event", event=event, **fields)
+    from fedml_tpu.telemetry.spans import get_tracer
+
+    run_dir = get_tracer().sink_dir
+    if run_dir is not None:
+        rec = {"ts": now, "kind": "serving_event", "event": event, **fields}
+        try:
+            os.makedirs(run_dir, exist_ok=True)
+            with open(os.path.join(run_dir, "telemetry.jsonl"), "a") as f:
+                f.write(json.dumps(rec, default=str) + "\n")
+        except OSError:  # pragma: no cover - sink dir gone
+            pass
+    return True
+
+
+def reset_serving_events() -> None:
+    """Forget burst state (test isolation)."""
+    with _lock:
+        _last_emit.clear()

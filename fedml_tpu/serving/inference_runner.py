@@ -37,6 +37,7 @@ from typing import Optional
 
 from fedml_tpu.serving.monitor import EndpointMonitor
 from fedml_tpu.serving.predictor import FedMLPredictor
+from fedml_tpu.utils.bounded_http import AdmissionGate
 
 
 class FedMLInferenceRunner:
@@ -54,9 +55,12 @@ class FedMLInferenceRunner:
         self.monitor = monitor or EndpointMonitor()
         self.openai = openai  # OpenAIServing adapter (optional)
         # bounded admission: a permit per in-flight predictor request;
-        # acquisition waits at most queue_wait_s before shedding with 429
-        self._inflight = threading.BoundedSemaphore(int(max_inflight))
-        self._queue_wait_s = float(queue_wait_s)
+        # acquisition waits at most queue_wait_s before shedding with 429.
+        # Queue waits feed the endpoint's serving/queue_wait_ms histogram;
+        # sheds land as first-class serving_events with the queue depth.
+        self._gate = AdmissionGate(
+            max_inflight, queue_wait_s,
+            on_wait=self._note_queue_wait, on_shed=self._note_shed)
         runner = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -120,32 +124,14 @@ class FedMLInferenceRunner:
                 if path != "/predict" and not is_openai:
                     self.send_error(404)
                     return
-                if not runner._inflight.acquire(
-                        timeout=runner._queue_wait_s):
-                    # overload: shed fast with backpressure advice instead
-                    # of queueing unboundedly behind a saturated engine.
-                    # Drain the unread body first — the connection is
-                    # keep-alive (HTTP/1.1) and leftover bytes would be
-                    # parsed as the NEXT request's request line (400)
-                    n = int(self.headers.get("Content-Length", 0))
-                    if n > (1 << 20):
-                        # too big to drain cheaply — drop the connection
-                        self.close_connection = True
-                    elif n > 0:
-                        self.rfile.read(n)
-                    runner.monitor.record_rejected()
-                    body = json.dumps({"error": "overloaded"}).encode()
-                    self.send_response(429)
-                    self.send_header("Retry-After", "1")
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                if not runner._gate.admit(self):
+                    # overload: the gate shed the request with 429 +
+                    # Retry-After (body drained — keep-alive desync guard)
                     return
                 try:
                     self._do_post_admitted(path, is_openai)
                 finally:
-                    runner._inflight.release()
+                    runner._gate.release()
 
             def _do_post_admitted(self, path, is_openai):
                 t0 = time.time()
@@ -164,8 +150,21 @@ class FedMLInferenceRunner:
                     except (ValueError, KeyError):
                         ctx = None
                 token = telemetry.activate_context(ctx)
-                span = telemetry.get_tracer().begin(
-                    "serving/request", path=path)
+                try:
+                    # span() (not begin()): the request span must be the
+                    # AMBIENT parent while the predictor runs, so
+                    # engine.submit() captures it via current_context()
+                    # and the per-request req/* lifecycle tree stitches
+                    # underneath this HTTP span in `telemetry trace`
+                    with telemetry.get_tracer().span(
+                            "serving/request", path=path) as span:
+                        ok = self._serve_post(path, is_openai)
+                        span.attrs["ok"] = ok
+                finally:
+                    telemetry.deactivate_context(token)
+                    runner.monitor.record_request(time.time() - t0, ok)
+
+            def _serve_post(self, path, is_openai) -> bool:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(n) or b"{}")
@@ -192,7 +191,7 @@ class FedMLInferenceRunner:
                                 f"{len(done):x}\r\n".encode() + done
                                 + b"\r\n")
                             self.wfile.write(b"0\r\n\r\n")
-                            return
+                            return True
                     else:
                         result = runner.predictor.predict(request)
                     if hasattr(result, "__next__"):  # streaming
@@ -215,10 +214,10 @@ class FedMLInferenceRunner:
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
+                    return True
                 except BrokenPipeError:
-                    ok = False
+                    return False
                 except Exception as e:  # predictor errors → 500 + message
-                    ok = False
                     try:
                         body = json.dumps({"error": str(e)}).encode()
                         self.send_response(500)
@@ -228,14 +227,28 @@ class FedMLInferenceRunner:
                         self.wfile.write(body)
                     except BrokenPipeError:
                         pass
-                finally:
-                    span.attrs["ok"] = ok
-                    telemetry.get_tracer().end(span)
-                    telemetry.deactivate_context(token)
-                    runner.monitor.record_request(time.time() - t0, ok)
+                    return False
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+
+    # -- admission-gate observers (best-effort by AdmissionGate contract) --
+    def _note_queue_wait(self, wait_s: float) -> None:
+        self.monitor.record_queue_wait(wait_s * 1e3)
+
+    def _note_shed(self, depth: int, wait_s: float) -> None:
+        self.monitor.record_rejected(queue_depth=depth)
+        # the queue wait WAS this request's whole lifecycle: a backdated
+        # req/request span (shed=True) makes overload visible in the same
+        # trace timeline as the requests that made it through
+        from fedml_tpu.telemetry.spans import get_tracer
+
+        tracer = get_tracer()
+        now = time.time()
+        span = tracer.begin("req/request", shed=True,
+                            queue_wait_ms=round(wait_s * 1e3, 3))
+        span.started = now - wait_s
+        tracer.end(span, ended=now)
 
     @property
     def port(self) -> int:

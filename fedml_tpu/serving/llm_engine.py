@@ -56,6 +56,17 @@ class _Slot:
     active: bool = False
     tokens: List[int] = field(default_factory=list)
     lease: Optional[SlotLease] = None
+    # request-observability bookkeeping (cheap per-token raw timestamps;
+    # spans/histograms are materialized once, at retirement)
+    ctx: Any = None  # TraceContext captured at submit (HTTP request span)
+    t_submit: float = 0.0  # wall clock at submit (span placement anchor)
+    t_submit_mono: float = 0.0
+    t_admit_mono: float = 0.0
+    t_prefill_mono: float = 0.0
+    tok_mono: List[float] = field(default_factory=list)
+    stall_ms: float = 0.0  # swap stall attributed to THIS stream
+    stall_end_mono: float = 0.0
+    stall_round: Optional[int] = None
 
 
 class ContinuousBatchingEngine:
@@ -80,6 +91,7 @@ class ContinuousBatchingEngine:
         quantize_donate: bool = False,
         quantize_min_size: int = 65536,
         initial_round: Optional[int] = None,
+        request_obs: bool = True,
     ):
         self.model = model
         param_transform = None
@@ -165,6 +177,31 @@ class ContinuousBatchingEngine:
         # tests/test_serving_schedule.py.
         self.admit_per_step = 1
         self.oplog: deque = deque(maxlen=4096)  # ("prefill"|"decode", ...)
+
+        # request observability: per-stream req/* span trees, TTFT/TPOT
+        # attribution and engine saturation gauges. The per-token seam is
+        # one perf_counter + list append; everything else happens at
+        # admission/retirement (serve_bench gates the seam < 2% of a
+        # decode step). Toggleable for the bench's on/off A/B.
+        self.request_obs = bool(request_obs)
+        from fedml_tpu.telemetry.registry import get_registry
+
+        reg = get_registry()
+        self._h_ttft = reg.histogram("serving/ttft_ms")
+        self._h_tpot = reg.histogram("serving/tpot_ms")
+        self._g_tps = reg.gauge("serving/tokens_per_s")
+        # saturation accounting. KV names are deliberately allocator-
+        # shaped: today "allocated" is the dense [B, H_kv, S, D] pool and
+        # "in use" is the filled prefix of each active row; a paged-KV
+        # allocator sets the same gauges from its block pool.
+        self._g_occupancy = reg.gauge("serving/batch_occupancy")
+        self._g_queue_depth = reg.gauge("serving/queue_depth")
+        self._g_tokens_in_flight = reg.gauge("serving/tokens_in_flight")
+        self._g_kv_used = reg.gauge("serving/kv_bytes_in_use")
+        self._g_kv_alloc = reg.gauge("serving/kv_bytes_allocated")
+        self._kv_alloc_bytes = float(sum(
+            k.nbytes + v.nbytes for k, v in self.caches))
+        self._g_kv_alloc.set(self._kv_alloc_bytes)
 
         model_apply = model.apply
 
@@ -302,10 +339,20 @@ class ContinuousBatchingEngine:
         with self._lock:
             self._req_counter += 1
             rid = self._req_counter
+        # capture the submitting thread's trace context (the HTTP
+        # handler's serving/request span): the req/* span tree built at
+        # retirement parents under it, stitching each request into the
+        # caller's timeline next to the round swaps
+        ctx = None
+        if self.request_obs:
+            from fedml_tpu.telemetry.spans import current_context
+
+            ctx = current_context()
         self._requests.put(
             (rid, list(map(int, prompt_tokens)), int(max_new_tokens),
              float(temperature), int(seed),
-             self.eos_id if eos_id is None else eos_id, out)
+             self.eos_id if eos_id is None else eos_id, out,
+             ctx, time.time(), time.perf_counter())
         )
         return out
 
@@ -364,21 +411,122 @@ class ContinuousBatchingEngine:
         if prev is None:
             return
         stall_ms = 0.0
+        now = time.perf_counter()
         if self.active_slots and self._last_step_end is not None:
-            stall_ms = max(
-                0.0, (time.perf_counter() - self._last_step_end) * 1e3)
+            stall_ms = max(0.0, (now - self._last_step_end) * 1e3)
         self.model_slots.record_swap_stall(lease.round_idx, stall_ms)
+        if self.request_obs and stall_ms > 0.0:
+            # pin the stall to the streams it actually paused: the ones
+            # in flight at the transition — their decode group moves to
+            # the partitioned gather/scatter program while the fresh
+            # round's stream prefills. Each gets a req/stall child span
+            # at retirement; the engine-wide histogram above keeps the
+            # aggregate view.
+            for s in self.slots:
+                if s.active:
+                    s.stall_ms += stall_ms
+                    s.stall_end_mono = now
+                    s.stall_round = lease.round_idx
 
     def _retire(self, slot: _Slot) -> None:
+        if self.request_obs and slot.tok_mono:
+            self._finish_request_obs(slot)
         slot.out.put(None)
         slot.active = False
         if slot.lease is not None:
             slot.lease.release()
             slot.lease = None
+        if self.request_obs:
+            self._sample_saturation()
+
+    def _finish_request_obs(self, slot: _Slot) -> None:
+        """Materialize one retired stream's observability: TTFT / TPOT /
+        tokens-per-s into the registry (+ the endpoint monitor's labeled
+        twins) and the req/* span tree — queue wait, prefill, decode,
+        and the swap stall pinned to this stream if its decode group
+        transitioned mid-flight. Runs once per request, off the
+        per-token path; failures never kill the stream."""
+        try:
+            from fedml_tpu.telemetry.spans import get_tracer
+
+            round_idx = slot.lease.round_idx if slot.lease else None
+            first, last = slot.tok_mono[0], slot.tok_mono[-1]
+            ttft_ms = (first - slot.t_admit_mono) * 1e3
+            tpot_ms = [(b - a) * 1e3
+                       for a, b in zip(slot.tok_mono, slot.tok_mono[1:])]
+            gen_s = last - slot.t_admit_mono
+            tps = len(slot.tok_mono) / gen_s if gen_s > 0 else 0.0
+            self._h_ttft.observe(ttft_ms)
+            for v in tpot_ms:
+                self._h_tpot.observe(v)
+            self._g_tps.set(round(tps, 3))
+            monitor = getattr(self.model_slots, "monitor", None)
+            if monitor is not None:
+                monitor.record_stream(ttft_ms, tpot_ms, tps)
+
+            # span tree, backfilled from the raw timestamps (explicit
+            # `ended` takes the tracer's wall-math path). Wall placement
+            # anchors on the submit wall clock + monotonic deltas, so an
+            # NTP step mid-request cannot tear the tree apart.
+            tracer = get_tracer()
+            t0 = slot.t_submit_mono
+
+            def wall(mono: float) -> float:
+                return slot.t_submit + (mono - t0)
+
+            root = tracer.begin(
+                "req/request", rid=slot.request_id, round=round_idx,
+                tokens=len(slot.tok_mono), ttft_ms=round(ttft_ms, 3),
+                tokens_per_s=round(tps, 3))
+            if slot.ctx is not None:
+                root.trace_id = slot.ctx.trace_id
+                root.parent_id = slot.ctx.span_id
+            root.started = slot.t_submit
+
+            def child(name: str, m0: float, m1: float, **attrs) -> None:
+                sp = tracer.begin(name, **attrs)
+                sp.trace_id = root.trace_id
+                sp.parent_id = root.span_id
+                sp.started = wall(m0)
+                tracer.end(sp, ended=wall(m1))
+
+            child("req/queue", t0, slot.t_admit_mono, round=round_idx)
+            child("req/prefill", slot.t_admit_mono, slot.t_prefill_mono,
+                  round=round_idx)
+            child("req/decode", slot.t_prefill_mono, last, round=round_idx,
+                  tokens=len(slot.tok_mono))
+            if slot.stall_ms > 0.0:
+                child("req/stall",
+                      slot.stall_end_mono - slot.stall_ms / 1e3,
+                      slot.stall_end_mono, round=round_idx,
+                      round_to=slot.stall_round,
+                      stall_ms=round(slot.stall_ms, 3))
+                root.attrs["stall_ms"] = round(slot.stall_ms, 3)
+            tracer.end(root, ended=wall(last))
+        except Exception:  # noqa: BLE001 - observability must not kill
+            pass
+
+    def _sample_saturation(self) -> None:
+        """Refresh the engine saturation gauges (occupancy, queue depth,
+        tokens in flight, KV bytes). A few gauge sets per decode step —
+        well under the profiling-bench noise floor."""
+        active_tokens = 0
+        n_active = 0
+        for i, s in enumerate(self.slots):
+            if s.active:
+                n_active += 1
+                active_tokens += int(self.lengths[i])
+        self._g_occupancy.set(n_active / self.n_slots)
+        self._g_queue_depth.set(float(self._requests.qsize()))
+        self._g_tokens_in_flight.set(float(active_tokens))
+        self._g_kv_used.set(self._kv_alloc_bytes * active_tokens
+                            / (self.n_slots * self.max_len))
 
     def _admit(self, req) -> None:
-        rid, prompt, max_new, temp, seed, eos, out = req
+        (rid, prompt, max_new, temp, seed, eos, out,
+         ctx, t_wall, t_mono) = req
         slot_idx = next(i for i, s in enumerate(self.slots) if not s.active)
+        t_admit_mono = time.perf_counter()  # queue wait ends here
         # pin the request to the CURRENT weight generation: every prefill
         # and decode step of this stream runs against the leased params,
         # so a mid-request hot swap can never mix rounds in one response
@@ -405,7 +553,17 @@ class ContinuousBatchingEngine:
         slot.eos_id = eos
         slot.active = True
         slot.tokens = []
+        slot.ctx = ctx
+        slot.t_submit = t_wall
+        slot.t_submit_mono = t_mono
+        slot.t_admit_mono = t_admit_mono
+        slot.t_prefill_mono = self._last_step_end
+        slot.tok_mono = []
+        slot.stall_ms = 0.0
+        slot.stall_round = None
         self.lengths[slot_idx] = len(prompt)
+        if self.request_obs:
+            self._sample_saturation()
         if slot.temperature > 0.0:
             self._emit(slot_idx, logits=np.asarray(last_logits))
         else:
@@ -421,6 +579,10 @@ class ContinuousBatchingEngine:
         slot.last_token = tok
         slot.generated += 1
         slot.tokens.append(tok)
+        if self.request_obs:
+            # the whole per-token observability seam: one clock read +
+            # one append; TTFT/TPOT math runs once, at retirement
+            slot.tok_mono.append(time.perf_counter())
         slot.out.put(tok)
         if (slot.eos_id is not None and tok == slot.eos_id) or (
             slot.generated >= slot.max_new
@@ -524,3 +686,5 @@ class ContinuousBatchingEngine:
             else:
                 self._emit(i, tok=greedy_by[i])
         self._last_step_end = time.perf_counter()
+        if self.request_obs:
+            self._sample_saturation()

@@ -8,19 +8,78 @@ activity — so endpoint health appears in ``telemetry report`` /
 ``telemetry doctor`` and the Prometheus exposition without this object
 keeping a private shadow copy; :meth:`snapshot` is just a read of those
 instruments, plus the optional JSONL mirror for the scheduler plane.
+
+Request observability (token-latency + SLO): the engine attributes TTFT,
+inter-token (TPOT) latency and tokens/s per stream and forwards them
+here, where they aggregate per endpoint (``serving/ttft_ms`` /
+``serving/tpot_ms`` histograms, ``serving/tokens_per_s`` gauge — the
+labeled twins of the engine's unlabeled instruments, same split as
+``serving/swap_stall_ms``). A :class:`ServingSLO` spec generalizes the
+old scalar ``slo_ms`` into per-objective targets (TTFT / TPOT / e2e +
+the objective fraction); every observation is also scored against its
+target into cumulative ``serving/slo_total`` / ``serving/slo_breaches``
+counters (labeled by objective), which is exactly the shape a
+multi-window error-budget burn rate needs — the online doctor differences
+them over its windows, and the post-hoc doctor reads the totals.
 """
 from __future__ import annotations
 
 import time
-from typing import Any, Dict
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 from fedml_tpu.telemetry import get_registry
 
 
+@dataclass
+class ServingSLO:
+    """Per-endpoint latency objectives: targets in ms (0 = undeclared)
+    plus the objective fraction (0.99 → 1% error budget)."""
+
+    ttft_ms: float = 0.0
+    tpot_ms: float = 0.0
+    e2e_ms: float = 0.0
+    objective: float = 0.99
+
+    def targets(self) -> Iterator[Tuple[str, float]]:
+        """The declared (objective_name, target_ms) pairs."""
+        for kind, target in (("ttft", self.ttft_ms), ("tpot", self.tpot_ms),
+                             ("e2e", self.e2e_ms)):
+            if target and target > 0:
+                yield kind, float(target)
+
+    def __bool__(self) -> bool:
+        return any(True for _ in self.targets())
+
+    @classmethod
+    def from_spec(cls, path: str) -> "ServingSLO":
+        """Load a yaml/json spec: ``{ttft_ms:, tpot_ms:, e2e_ms:,
+        objective:}`` (unknown keys ignored, all optional)."""
+        import json
+
+        with open(path) as f:
+            text = f.read()
+        try:
+            import yaml
+
+            raw = yaml.safe_load(text) or {}
+        except ImportError:  # pragma: no cover - yaml is in-tree
+            raw = json.loads(text)
+        return cls(
+            ttft_ms=float(raw.get("ttft_ms", 0) or 0),
+            tpot_ms=float(raw.get("tpot_ms", 0) or 0),
+            e2e_ms=float(raw.get("e2e_ms", 0) or 0),
+            objective=float(raw.get("objective", 0.99) or 0.99),
+        )
+
+
 class EndpointMonitor:
     def __init__(self, endpoint_id: str = "default", args: Any = None,
-                 slo_ms: float = 0.0):
+                 slo_ms: float = 0.0, slo: Optional[ServingSLO] = None):
         self.endpoint_id = endpoint_id
+        # back-compat: the scalar slo_ms is the e2e target of the spec
+        self.slo = slo if slo is not None else ServingSLO(
+            e2e_ms=float(slo_ms or 0))
         self._started = time.time()
         self._metrics = None
         reg = get_registry()
@@ -30,7 +89,21 @@ class EndpointMonitor:
         self._g_slo = reg.gauge("serving/slo_ms", labels=labels)
         # set unconditionally: the gauge is cumulative per process, so a
         # redeploy that declares NO SLO must clear the previous one
-        self._g_slo.set(float(slo_ms or 0))
+        self._g_slo.set(float(self.slo.e2e_ms or 0))
+        # the full spec, exported for burn-rate math: per-objective
+        # targets + the objective fraction (budget = 1 - objective)
+        self._g_slo_objective = reg.gauge("serving/slo_objective",
+                                          labels=labels)
+        self._g_slo_objective.set(float(self.slo.objective))
+        self._slo_counters: Dict[str, Tuple] = {}
+        for kind, target in self.slo.targets():
+            klabels = {**labels, "objective": kind}
+            reg.gauge("serving/slo_target_ms", labels=klabels).set(target)
+            self._slo_counters[kind] = (
+                target,
+                reg.counter("serving/slo_total", labels=klabels),
+                reg.counter("serving/slo_breaches", labels=klabels),
+            )
         self._hist = reg.histogram("serving/request_ms", labels=labels)
         self._m_requests = reg.counter("serving/requests", labels=labels)
         self._m_errors = reg.counter("serving/errors", labels=labels)
@@ -46,6 +119,13 @@ class EndpointMonitor:
         self._h_swap_stall = reg.histogram("serving/swap_stall_ms",
                                            labels=labels)
         self._c_rejected = reg.counter("serving/rejected", labels=labels)
+        # token-latency attribution (per-endpoint aggregate of the
+        # engine's per-stream readings) + admission queue wait
+        self._h_ttft = reg.histogram("serving/ttft_ms", labels=labels)
+        self._h_tpot = reg.histogram("serving/tpot_ms", labels=labels)
+        self._g_tps = reg.gauge("serving/tokens_per_s", labels=labels)
+        self._h_queue_wait = reg.histogram("serving/queue_wait_ms",
+                                           labels=labels)
         self._base_rejected = self._c_rejected.value
         self._base_swaps = self._c_swaps.value
         # registry instruments are cumulative per (endpoint, process) —
@@ -66,16 +146,45 @@ class EndpointMonitor:
             except Exception:
                 self._metrics = None
 
+    def _note_slo(self, kind: str, value_ms: float) -> None:
+        """Score one observation against its objective's target."""
+        entry = self._slo_counters.get(kind)
+        if entry is None:
+            return
+        target, c_total, c_bad = entry
+        c_total.inc()
+        if value_ms > target:
+            c_bad.inc()
+
     def record_request(self, latency_s: float, ok: bool = True) -> None:
         self._hist.observe(latency_s * 1e3)
         self._m_requests.inc()
         if not ok:
             self._m_errors.inc()
+        self._note_slo("e2e", latency_s * 1e3)
         now = time.time()
         self._g_last_request.set(now)
         # keep the exported gauge fresh under traffic even when nothing
         # polls snapshot() — a flush mid-serve must not report uptime 0
         self._g_uptime.set(round(now - self._started, 1))
+
+    def record_stream(self, ttft_ms: float, tpot_ms, tokens_per_s: float,
+                      ) -> None:
+        """One finished generation stream's token-latency attribution:
+        TTFT, its inter-token intervals, and its decode rate (the engine
+        computes these once per stream at retirement, off the per-token
+        path)."""
+        self._h_ttft.observe(float(ttft_ms))
+        self._note_slo("ttft", float(ttft_ms))
+        for v in tpot_ms:
+            self._h_tpot.observe(float(v))
+            self._note_slo("tpot", float(v))
+        self._g_tps.set(round(float(tokens_per_s), 3))
+
+    def record_queue_wait(self, wait_ms: float) -> None:
+        """How long a request queued for an admission permit (shed or
+        admitted — the shed ones waited the full timeout)."""
+        self._h_queue_wait.observe(float(wait_ms))
 
     def record_swap(self, round_idx: int) -> None:
         """A new federation round was hot-swapped into the endpoint."""
@@ -86,10 +195,23 @@ class EndpointMonitor:
         """Request-visible pause the engine attributed to one swap."""
         self._h_swap_stall.observe(float(stall_ms))
 
-    def record_rejected(self) -> None:
-        """A request was shed with 429 by the bounded request queue."""
+    def record_rejected(self, queue_depth: Optional[int] = None) -> None:
+        """A request was shed with 429 by the bounded request queue.
+
+        Beyond the counter bump, the start of a shed burst lands as a
+        first-class ``serving_event`` (telemetry.jsonl + flight
+        recorder) carrying the admission queue depth at trip time — the
+        capacity datum overload triage needs.
+        """
         self._c_rejected.inc()
         self._g_last_request.set(time.time())
+        from fedml_tpu.serving.events import serving_event
+
+        serving_event(
+            "shed_burst", dedupe_key=self.endpoint_id,
+            endpoint=self.endpoint_id,
+            queue_depth=int(queue_depth or 0),
+            rejected_total=int(self._c_rejected.value - self._base_rejected))
 
     def snapshot(self) -> Dict:
         hist = self._hist.snapshot()
@@ -118,6 +240,19 @@ class EndpointMonitor:
         stall = self._h_swap_stall.snapshot()
         if stall["count"]:
             snap["swap_stall_max_ms"] = round(stall["max"], 3)
+        ttft = self._h_ttft.snapshot()
+        if ttft["count"]:
+            tpot = self._h_tpot.snapshot()
+            snap["ttft_p95_ms"] = round(ttft["p95"], 3)
+            snap["tpot_p95_ms"] = round(tpot["p95"], 3)
+            snap["tokens_per_s"] = self._g_tps.value
+        if self._slo_counters:
+            slo: Dict[str, Dict] = {}
+            for kind, (target, c_total, c_bad) in self._slo_counters.items():
+                slo[kind] = {"target_ms": target,
+                             "total": int(c_total.value),
+                             "breaches": int(c_bad.value)}
+            snap["slo"] = slo
         if self._metrics is not None:
             try:
                 self._metrics.log({"endpoint": snap})

@@ -333,6 +333,9 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
         rejected = _sval("rejected", 0.0)
         stall = latest_serve.get("swap_stall_ms") or {}
         req = latest_serve.get("request_ms") or {}
+        ttft = latest_serve.get("ttft_ms") or {}
+        tpot = latest_serve.get("tpot_ms") or {}
+        queue_wait = latest_serve.get("queue_wait_ms") or {}
         slo_ms = _sval("slo_ms")
         serving = {
             "round_current": None if cur is None else int(cur),
@@ -344,6 +347,54 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
             "request_p99_ms": req.get("p99"),
             "slo_ms": slo_ms,
         }
+        # token-latency attribution + saturation gauges (absent on runs
+        # that predate request observability — keys only appear with data)
+        if ttft.get("count"):
+            serving["ttft_p95_ms"] = ttft.get("p95")
+            serving["tpot_p95_ms"] = tpot.get("p95")
+            serving["tokens_per_s"] = _sval("tokens_per_s")
+        if queue_wait.get("count"):
+            serving["queue_wait_p95_ms"] = queue_wait.get("p95")
+        for gauge in ("batch_occupancy", "queue_depth", "tokens_in_flight",
+                      "kv_bytes_in_use", "kv_bytes_allocated"):
+            v = _sval(gauge)
+            if v is not None:
+                serving[gauge] = v
+        # SLO scorecard: latest cumulative total/breaches per objective
+        # (these counters are labeled by objective kind, so they need a
+        # label-aware pass — latest_serve collapses label sets)
+        slo_score: Dict[str, Dict[str, float]] = {}
+        for rec in metric_records:
+            name = rec.get("name", "")
+            if name not in ("serving/slo_total", "serving/slo_breaches",
+                            "serving/slo_target_ms"):
+                continue
+            kind = (rec.get("labels") or {}).get("objective", "?")
+            row = slo_score.setdefault(kind, {})
+            row[name.split("/", 1)[1]] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+        objective = _sval("slo_objective")
+        if slo_score:
+            serving["slo_objective"] = objective
+            serving["slo"] = slo_score
+            budget = 1.0 - (objective or 0.99)
+            for kind, row in sorted(slo_score.items()):
+                total = row.get("slo_total", 0.0)
+                bad = row.get("slo_breaches", 0.0)
+                if total > 0 and budget > 0 and bad / total > budget:
+                    verdict.append(
+                        f"endpoint burned its {kind} error budget: "
+                        f"{bad:.0f}/{total:.0f} observations over the "
+                        f"{row.get('slo_target_ms', 0.0):.1f} ms target "
+                        f"({100 * bad / total:.1f}% bad vs "
+                        f"{100 * budget:.1f}% budget)")
+        # shed bursts recorded as first-class serving_events at trip time
+        sheds = [e for e in metric_records
+                 if e.get("kind") == "serving_event"
+                 and e.get("event") == "shed_burst"]
+        if sheds:
+            serving["shed_bursts"] = len(sheds)
+            serving["shed_queue_depth"] = sheds[-1].get("queue_depth")
         if cur is not None and pub is not None and pub - cur >= 2:
             verdict.append(
                 f"endpoint is serving a STALE round: round {cur:.0f} while "
@@ -357,10 +408,12 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
                 f"its SLO of {slo_ms:.1f} ms — engine saturated or swap "
                 "stalls too long (see serving/swap_stall_ms)")
         if rejected:
+            depth = (f" (queue depth {sheds[-1].get('queue_depth')} at "
+                     "burst trip)" if sheds else "")
             verdict.append(
                 f"endpoint shed {rejected:.0f} request(s) with 429 — "
                 "offered load exceeded the bounded request queue "
-                "(raise max_inflight or add replicas)")
+                f"(raise max_inflight or add replicas){depth}")
     else:
         notes.setdefault("serving",
                          "no data: no serving/* metrics (no endpoint in "
@@ -1147,6 +1200,29 @@ def format_doctor(d: Dict) -> str:
             slo = serving.get("slo_ms")
             add(f"  request p99 {serving['request_p99_ms']} ms"
                 + (f" (SLO {slo:.0f} ms)" if slo else ""))
+        if serving.get("ttft_p95_ms") is not None:
+            add(f"  ttft p95 {serving['ttft_p95_ms']} ms, tpot p95 "
+                f"{serving.get('tpot_p95_ms')} ms, "
+                f"{serving.get('tokens_per_s', 0)} tok/s")
+        if serving.get("queue_wait_p95_ms") is not None:
+            add(f"  admission queue wait p95 "
+                f"{serving['queue_wait_p95_ms']} ms")
+        if serving.get("batch_occupancy") is not None:
+            add(f"  saturation: occupancy "
+                f"{serving['batch_occupancy']:.2f}, queue depth "
+                f"{serving.get('queue_depth', 0):.0f}, "
+                f"{serving.get('tokens_in_flight', 0):.0f} tokens in "
+                f"flight, KV {serving.get('kv_bytes_in_use', 0):.0f}/"
+                f"{serving.get('kv_bytes_allocated', 0):.0f} B")
+        for kind, row in sorted((serving.get("slo") or {}).items()):
+            add(f"  slo[{kind}]: {row.get('slo_breaches', 0):.0f}/"
+                f"{row.get('slo_total', 0):.0f} over the "
+                f"{row.get('slo_target_ms', 0):.1f} ms target "
+                f"(objective {serving.get('slo_objective') or 0.99:g})")
+        if serving.get("shed_bursts"):
+            add(f"  {serving['shed_bursts']} shed burst(s) recorded "
+                f"(queue depth {serving.get('shed_queue_depth')} at last "
+                "trip)")
     else:
         add(f"  {notes.get('serving', 'no data')}")
 

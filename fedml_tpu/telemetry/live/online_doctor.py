@@ -40,7 +40,9 @@ class OnlineDoctor:
                  mem_growth_threshold: float = 1.5,
                  min_rounds: int = 3,
                  stale_round_gap: int = 2,
-                 rejoin_grace_rounds: int = 2):
+                 rejoin_grace_rounds: int = 2,
+                 slo_burn_threshold: float = 10.0,
+                 slo_burn_windows_s: Tuple[float, ...] = (60.0, 300.0)):
         self.collector = collector
         self.run_dir = run_dir
         self.straggler_threshold = float(straggler_threshold)
@@ -49,6 +51,9 @@ class OnlineDoctor:
         self.min_rounds = int(min_rounds)
         self.stale_round_gap = int(stale_round_gap)
         self.rejoin_grace_rounds = int(rejoin_grace_rounds)
+        self.slo_burn_threshold = float(slo_burn_threshold)
+        self.slo_burn_windows_s = tuple(
+            sorted(float(w) for w in slo_burn_windows_s))
         self.alerts: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         # serializes rule evaluation: collector hooks fire outside the
@@ -58,6 +63,10 @@ class OnlineDoctor:
         self._eval_lock = threading.Lock()
         self._fired: set = set()
         self._mem_hist: Dict[Tuple, List[Tuple[int, float]]] = {}
+        # (node, endpoint, objective) -> [(ts, slo_total, slo_breaches)]:
+        # the cumulative-counter history the multi-window burn rate is
+        # differenced from
+        self._slo_hist: Dict[Tuple, List[Tuple[float, float, float]]] = {}
         self._quorum_seen: Dict[Tuple, float] = {}
         self._evict_epoch: Dict[str, Tuple[float, Optional[int]]] = {}
         self._rounds: Dict[str, int] = {}
@@ -149,6 +158,7 @@ class OnlineDoctor:
             self._check_stragglers(by_name)
             self._check_memory(by_name)
             self._check_serving(by_name)
+            self._check_slo_burn(by_name)
             self._check_quorum(by_name)
             self._check_never_rejoined(by_name, node, self._round_of(node))
 
@@ -233,6 +243,80 @@ class OnlineDoctor:
                     f"published round {pub:.0f} ({pub - cur:.0f} behind)",
                     node, int(pub), dedupe=(node, int(cur)),
                     round_current=int(cur), round_published=int(pub))
+
+    def _check_slo_burn(self, by_name: Dict[str, List[Dict]]) -> None:
+        """Multi-window error-budget burn rate (SRE-style) over the
+        cumulative ``serving/slo_total`` / ``serving/slo_breaches``
+        counter pairs each endpoint streams, labeled by objective.
+
+        burn = (bad_delta / total_delta) / (1 - objective) over each
+        window; the alert trips only when EVERY window has both spanned
+        its full width (oldest history entry old enough) and burned at
+        ``slo_burn_threshold`` or above — the short window makes the
+        alert fast, the long window keeps a transient blip from paging.
+        """
+        def keyed(metric: str) -> Dict[Tuple, float]:
+            out: Dict[Tuple, float] = {}
+            for rec in by_name.get(metric, ()):
+                labels = rec.get("labels") or {}
+                key = (labels.get("node", "?"), labels.get("endpoint", "?"),
+                       labels.get("objective", "?"))
+                out[key] = float(rec.get("value", rec.get("count", 0)) or 0)
+            return out
+
+        totals = keyed("serving/slo_total")
+        if not totals:
+            return
+        bads = keyed("serving/slo_breaches")
+        objectives: Dict[Tuple, float] = {}
+        for rec in by_name.get("serving/slo_objective", ()):
+            labels = rec.get("labels") or {}
+            objectives[(labels.get("node", "?"),
+                        labels.get("endpoint", "?"))] = float(
+                rec.get("value") or 0.0)
+        now = time.time()
+        long_w = self.slo_burn_windows_s[-1]
+        for key, total in totals.items():
+            node, endpoint, kind = key
+            bad = bads.get(key, 0.0)
+            hist = self._slo_hist.setdefault(key, [])
+            hist.append((now, total, bad))
+            # keep exactly one entry at/past the long-window boundary so
+            # the difference stays well-defined without unbounded history
+            while len(hist) >= 2 and hist[1][0] <= now - long_w:
+                hist.pop(0)
+            objective = objectives.get((node, endpoint), 0.99)
+            budget = 1.0 - objective
+            if budget <= 0:
+                continue
+            burns = []
+            for w in self.slo_burn_windows_s:
+                base = None
+                for ts, t, b in hist:
+                    if ts <= now - w:
+                        base = (t, b)
+                    else:
+                        break
+                if base is None:
+                    burns = None  # window not spanned yet — can't judge
+                    break
+                d_total = total - base[0]
+                d_bad = bad - base[1]
+                bad_frac = d_bad / d_total if d_total > 0 else 0.0
+                burns.append(bad_frac / budget)
+            if burns is None or min(burns) < self.slo_burn_threshold:
+                continue
+            rnd = self._round_of(node)
+            self._emit(
+                "slo_burn",
+                f"{endpoint} on {node} burns its {kind} error budget at "
+                f"{burns[0]:.1f}x (long window {burns[-1]:.1f}x, "
+                f"objective {objective:g})",
+                node, rnd, dedupe=(node, endpoint, kind),
+                endpoint=endpoint, objective=kind,
+                burn=round(burns[0], 2), burn_long=round(burns[-1], 2),
+                budget=round(budget, 4),
+                windows_s=list(self.slo_burn_windows_s))
 
     def _check_quorum(self, by_name: Dict[str, List[Dict]]) -> None:
         for name, recs in by_name.items():

@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from fedml_tpu.telemetry.registry import get_registry
+from fedml_tpu.utils.bounded_http import AdmissionGate, drain_body
 
 __all__ = ["MetricsScrapeServer"]
 
@@ -36,12 +37,16 @@ class MetricsScrapeServer:
                  queue_wait_s: float = 0.05):
         self.collector = collector
         self.doctor = doctor
-        self._inflight = threading.BoundedSemaphore(int(max_inflight))
-        self._queue_wait_s = float(queue_wait_s)
         server = self
         reg = get_registry()
         self._m_scrapes = reg.counter("live/scrapes")
         self._m_rejected = reg.counter("live/scrapes_rejected")
+        # shared bounded-admission policy (same gate as the inference
+        # runner); a shed scrape only bumps the counter — the live plane
+        # has no per-request latency story to tell
+        self._gate = AdmissionGate(
+            max_inflight, queue_wait_s, max_drain_bytes=_MAX_FRAME_BYTES,
+            on_shed=lambda depth, wait_s: self._m_rejected.inc())
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -57,33 +62,8 @@ class MetricsScrapeServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _drain_body(self) -> None:
-                """Error replies on a keep-alive (HTTP/1.1) connection
-                must consume the unread request body, or the NEXT request
-                on the socket is parsed from leftover frame bytes — the
-                same desync PR 7 fixed in the inference runner."""
-                n = int(self.headers.get("Content-Length", 0))
-                if n > _MAX_FRAME_BYTES:
-                    self.close_connection = True  # too big to drain cheaply
-                elif n > 0:
-                    self.rfile.read(n)
-
-            def _admitted(self) -> bool:
-                if server._inflight.acquire(timeout=server._queue_wait_s):
-                    return True
-                server._m_rejected.inc()
-                self._drain_body()
-                body = json.dumps({"error": "overloaded"}).encode()
-                self.send_response(429)
-                self.send_header("Retry-After", "1")
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return False
-
             def do_GET(self):
-                if not self._admitted():
+                if not server._gate.admit(self):
                     return
                 try:
                     path = self.path.split("?")[0].rstrip("/")
@@ -104,20 +84,20 @@ class MetricsScrapeServer:
                 except BrokenPipeError:  # pragma: no cover - client gone
                     pass
                 finally:
-                    server._inflight.release()
+                    server._gate.release()
 
             def do_POST(self):
-                if not self._admitted():
+                if not server._gate.admit(self):
                     return
                 try:
                     path = self.path.rstrip("/")
                     n = int(self.headers.get("Content-Length", 0))
                     if path != "/ingest":
-                        self._drain_body()
+                        drain_body(self, _MAX_FRAME_BYTES)
                         self.send_error(404)
                         return
                     if n <= 0 or n > _MAX_FRAME_BYTES:
-                        self._drain_body()
+                        drain_body(self, _MAX_FRAME_BYTES)
                         self._send(json.dumps(
                             {"error": "bad frame size"}).encode(), status=400)
                         return
@@ -132,7 +112,7 @@ class MetricsScrapeServer:
                 except BrokenPipeError:  # pragma: no cover
                     pass
                 finally:
-                    server._inflight.release()
+                    server._gate.release()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None

@@ -88,7 +88,8 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
             "straggler": None, "straggler_client": None,
             "mem_bytes": None, "wire_bytes": 0.0, "serving_round": None,
             "mfu": None, "hbm_bound": None,
-            "critical_phase": None, "critical_share": None})
+            "critical_phase": None, "critical_share": None,
+            "ttft_p95": None, "occupancy": None, "queue_depth": None})
         name = rec.get("name", "")
         val = float(rec.get("value", rec.get("count", 0)) or 0)
         if name == "health/rounds_scored" and val:
@@ -105,6 +106,14 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
             row["wire_bytes"] += val
         elif name == "serving/round_current":
             row["serving_round"] = int(val)
+        elif name == "serving/ttft_ms":
+            # histogram record: the p95 is the fleet-ready latency column
+            if rec.get("count"):
+                row["ttft_p95"] = float(rec.get("p95") or 0.0)
+        elif name == "serving/batch_occupancy":
+            row["occupancy"] = val
+        elif name == "serving/queue_depth":
+            row["queue_depth"] = val
         elif name == "profile/mfu":
             # streamed by the program catalog's gauge pump: achieved
             # FLOP/s over the device peak, refreshed each phase sample
@@ -123,7 +132,8 @@ def _node_rows(state: Dict[str, Any]) -> List[Dict[str, Any]]:
             "node": node, "round": None, "clients": None, "straggler": None,
             "straggler_client": None, "mem_bytes": None, "wire_bytes": 0.0,
             "serving_round": None, "mfu": None, "hbm_bound": None,
-            "critical_phase": None, "critical_share": None})
+            "critical_phase": None, "critical_share": None,
+            "ttft_p95": None, "occupancy": None, "queue_depth": None})
         row["seq"] = d.get("seq")
         row["seq_gaps"] = d.get("seq_gaps", 0)
     return [by_node[n] for n in sorted(by_node)]
@@ -143,7 +153,8 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
     add("")
     add(f"  {'node':<14s}{'round':>6s}{'clients':>8s}{'straggler':>12s}"
         f"{'mem':>10s}{'wire':>10s}{'mfu':>7s}{'roofline':>10s}"
-        f"{'critical':>16s}{'serving':>8s}{'gaps':>6s}")
+        f"{'critical':>16s}{'serving':>8s}{'ttft':>9s}{'sat':>9s}"
+        f"{'gaps':>6s}")
     for row in _node_rows(state):
         strag = ("-" if row.get("straggler") is None else
                  f"{row['straggler']:.1f}x"
@@ -161,6 +172,13 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
             critical = phase_label(row["critical_phase"])
             if row.get("critical_share") is not None:
                 critical += f" {100 * row['critical_share']:.0f}%"
+        ttft = ("-" if row.get("ttft_p95") is None
+                else f"{row['ttft_p95']:.0f}ms")
+        # saturation: batch-slot occupancy fraction / admission queue depth
+        sat = ("-" if row.get("occupancy") is None
+               else f"{100 * row['occupancy']:.0f}%"
+               + (f"+{row['queue_depth']:.0f}q"
+                  if row.get("queue_depth") else ""))
         add(f"  {row['node']:<14s}"
             f"{row['round'] if row['round'] is not None else '-':>6}"
             f"{row['clients'] if row['clients'] is not None else '-':>8}"
@@ -171,6 +189,8 @@ def render_state(state: Dict[str, Any], now: Optional[float] = None) -> str:
             f"{roofline:>10s}"
             f"{critical:>16s}"
             f"{row['serving_round'] if row['serving_round'] is not None else '-':>8}"
+            f"{ttft:>9s}"
+            f"{sat:>9s}"
             f"{row.get('seq_gaps', 0):>6}")
     alerts = state.get("alerts") or []
     add("")

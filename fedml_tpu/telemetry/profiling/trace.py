@@ -39,7 +39,8 @@ __all__ = ["TraceController", "get_trace_controller", "parse_rounds",
 logger = logging.getLogger(__name__)
 
 # rules whose online-doctor alerts request an automatic capture
-AUTO_CAPTURE_RULES = ("straggler", "memory_growth", "stale_serving_round")
+AUTO_CAPTURE_RULES = ("straggler", "memory_growth", "stale_serving_round",
+                      "slo_burn")
 
 
 def parse_rounds(spec: Any) -> List[int]:

@@ -348,6 +348,31 @@ def build_report(run_dir) -> Dict:
             else:
                 services[key] = rec.get("value", 0.0)
 
+    # -- serving token-latency attribution (TTFT / TPOT / decode rate) ----
+    # full percentile rows per endpoint ("engine" = the engine's own
+    # unlabeled instruments); metrics.jsonl is append-order cumulative
+    # snapshots, so plain overwrite keeps the latest record per key
+    serving_latency: Dict[str, Dict[str, float]] = {}
+    for rec in metrics:
+        name = rec.get("name", "")
+        if name not in ("serving/ttft_ms", "serving/tpot_ms",
+                        "serving/tokens_per_s", "serving/queue_wait_ms"):
+            continue
+        labels = rec.get("labels") or {}
+        row = serving_latency.setdefault(labels.get("endpoint", "engine"), {})
+        # "ttft_ms" -> "ttft": percentile keys carry ms already
+        key = name.split("/", 1)[1]
+        key = key[:-3] if key.endswith("_ms") else key
+        if rec.get("kind") == "histogram":
+            if not rec.get("count"):
+                continue
+            for q in ("p50", "p95", "p99"):
+                row[f"{key}_{q}"] = rec.get(q, 0.0)
+            row[f"{key}_count"] = rec.get("count", 0)
+        else:
+            row[key] = rec.get("value", 0.0)
+    serving_latency = {ep: row for ep, row in serving_latency.items() if row}
+
     # -- performance attribution (program catalog × phase walls) ----------
     # programs.jsonl names every hot-path compiled program with its XLA
     # cost/memory analysis; joining against the measured phase walls
@@ -409,6 +434,7 @@ def build_report(run_dir) -> Dict:
         "client_health": client_health,
         "mem_gauges": mem_gauges,
         "services": services,
+        "serving_latency": serving_latency,
         "attribution": attribution,
         "critical_path": critical_path,
         "stitched_spans": stitched,
@@ -482,6 +508,20 @@ def format_report(report: Dict) -> str:
         add("service health (serving/scheduler):")
         for name, v in sorted(report["services"].items()):
             add(f"  {name:<44s}{v:>14}")
+    if report.get("serving_latency"):
+        add("")
+        add("serving token latency (TTFT / inter-token / decode rate):")
+        for ep, row in sorted(report["serving_latency"].items()):
+            add(f"  endpoint {ep}:")
+            for kind in ("ttft", "tpot", "queue_wait"):
+                if f"{kind}_count" in row:
+                    add(f"    {kind + '_ms':<14s} p50 "
+                        f"{row.get(kind + '_p50', 0.0):>8.2f}  p95 "
+                        f"{row.get(kind + '_p95', 0.0):>8.2f}  p99 "
+                        f"{row.get(kind + '_p99', 0.0):>8.2f}  "
+                        f"(n={row.get(kind + '_count', 0)})")
+            if "tokens_per_s" in row:
+                add(f"    {'tokens_per_s':<14s} {row['tokens_per_s']:.2f}")
     comp = report.get("compression") or {}
     if comp.get("raw_bytes") or comp.get("encode") or comp.get("decode"):
         add("")

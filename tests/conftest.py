@@ -85,3 +85,7 @@ def _reset_singletons():
     telemetry.reset_catalog()
     telemetry.reset_trace_controller()
     reset_health_log()
+    # serving-event burst-dedupe state is module-global too
+    from fedml_tpu.serving.events import reset_serving_events
+
+    reset_serving_events()

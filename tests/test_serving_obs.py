@@ -1,0 +1,626 @@
+"""Serving request observability (PR 19): per-stream lifecycle tracing,
+TTFT/TPOT attribution, saturation gauges, shed-burst events and SLO
+burn-rate alerting.
+
+The contracts under test: every request through the continuous-batching
+engine leaves a ``req/*`` span tree (queue wait / prefill / decode, the
+swap stall pinned to exactly the streams whose decode group transitioned
+mid-flight) stitched under the HTTP handler's ``serving/request`` span;
+token latency aggregates per endpoint as ``serving/ttft_ms`` /
+``serving/tpot_ms`` / ``serving/tokens_per_s``; overload sheds land as
+burst-deduped ``serving_event`` records carrying the admission queue
+depth; and the online doctor's multi-window error-budget burn rate fires
+DURING an overloaded window while an undisturbed endpoint stays quiet —
+all without perturbing the round-pinning outputs (bit-identical to a
+static deployment, per PR 7's contract).
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedml_tpu import telemetry
+from fedml_tpu.models.llm.llama import LlamaConfig, LlamaForCausalLM
+from fedml_tpu.serving import (
+    ContinuousBatchingEngine,
+    EndpointMonitor,
+    FedMLInferenceRunner,
+    FedMLPredictor,
+    LlamaPredictor,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny(vocab_size=64, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _round_tree(params, r: float):
+    return jax.tree.map(lambda x, _r=r: x + jnp.asarray(0.05 * _r, x.dtype),
+                        params)
+
+
+def _drain(q):
+    toks = []
+    while True:
+        t = q.get(timeout=60)
+        if t is None:
+            return toks
+        toks.append(t)
+
+
+def _steady_reference(model, params, rounds, prompts, max_new):
+    # obs off: the reference run must not pollute this test's req/* span
+    # records or the unlabeled token-latency histograms
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   initial_round=0, request_obs=False)
+    expected = {}
+    try:
+        for r in rounds:
+            if r > 0:
+                assert eng.model_slots.publish_payload(
+                    _round_tree(params, r), r)
+            eng.start()
+            expected[r] = {
+                tuple(p): eng.generate(list(p), max_new_tokens=max_new)
+                for p in prompts
+            }
+    finally:
+        eng.stop()
+    return expected
+
+
+def _req_roots(recs):
+    """rid -> completed req/request root record."""
+    return {r["attrs"]["rid"]: r for r in recs
+            if r["name"] == "req/request" and "rid" in (r.get("attrs") or {})}
+
+
+def _children_of(recs, root):
+    return {r["name"]: r for r in recs
+            if r.get("parent_id") == root["span_id"]
+            and r["name"].startswith("req/")}
+
+
+# -- per-stream lifecycle tree + TTFT/TPOT attribution ---------------------
+
+def test_request_span_tree_stitches_and_attributes_token_latency(tiny_model):
+    """One request end to end: the req/* tree parents under the ambient
+    serving/request span, its phases tile the request wall-clock
+    contiguously, and TTFT / TPOT / tokens-per-s land in the registry."""
+    model, params = tiny_model
+    tracer = telemetry.get_tracer()
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   initial_round=0).start()
+    try:
+        with tracer.span("serving/request", path="/v1/completions"):
+            q = eng.submit([1, 2, 3], max_new_tokens=6)
+        toks = _drain(q)
+    finally:
+        eng.stop()
+    assert len(toks) == 6
+
+    recs = tracer.records()
+    outer = next(r for r in recs if r["name"] == "serving/request")
+    roots = _req_roots(recs)
+    assert list(roots) == [1]
+    root = roots[1]
+    # stitched: the engine-thread-built tree joins the HTTP span's trace
+    assert root["trace_id"] == outer["trace_id"]
+    assert root["parent_id"] == outer["span_id"]
+    attrs = root["attrs"]
+    assert attrs["round"] == 0 and attrs["tokens"] == 6
+    assert attrs["ttft_ms"] > 0 and attrs["tokens_per_s"] > 0
+
+    kids = _children_of(recs, root)
+    assert set(kids) == {"req/queue", "req/prefill", "req/decode"}
+    for rec in kids.values():
+        assert rec["trace_id"] == root["trace_id"]
+    # the phases tile the request: queue starts at submit, each phase
+    # starts where the previous ended, decode ends the request
+    approx = pytest.approx
+    assert kids["req/queue"]["started"] == approx(root["started"], abs=1e-6)
+    assert kids["req/prefill"]["started"] == approx(
+        kids["req/queue"]["ended"], abs=1e-6)
+    assert kids["req/decode"]["started"] == approx(
+        kids["req/prefill"]["ended"], abs=1e-6)
+    assert kids["req/decode"]["ended"] == approx(root["ended"], abs=1e-6)
+    assert kids["req/decode"]["attrs"]["tokens"] == 6
+
+    # registry twins: 1 stream -> 1 ttft sample, 5 inter-token intervals
+    reg = telemetry.get_registry()
+    assert reg.histogram("serving/ttft_ms").snapshot()["count"] == 1
+    assert reg.histogram("serving/tpot_ms").snapshot()["count"] == 5
+    assert reg.gauge("serving/tokens_per_s").value > 0
+    # saturation gauges: drained engine, KV accounted
+    assert reg.gauge("serving/batch_occupancy").value == 0.0
+    assert reg.gauge("serving/tokens_in_flight").value == 0.0
+    assert reg.gauge("serving/kv_bytes_allocated").value > 0
+    assert reg.gauge("serving/kv_bytes_in_use").value == 0.0
+
+
+def test_request_obs_off_is_inert_and_bit_identical(tiny_model):
+    """request_obs=False: no req/* spans, no token-latency samples — and
+    the generated tokens are bit-identical either way (observability
+    never touches the numerics)."""
+    model, params = tiny_model
+    prompt, max_new = [5, 6, 7], 5
+
+    eng_on = ContinuousBatchingEngine(model, params, batch_slots=2,
+                                      max_len=32, initial_round=0).start()
+    try:
+        toks_on = eng_on.generate(prompt, max_new_tokens=max_new)
+    finally:
+        eng_on.stop()
+    n_spans = len(_req_roots(telemetry.get_tracer().records()))
+    n_ttft = telemetry.get_registry().histogram(
+        "serving/ttft_ms").snapshot()["count"]
+    assert n_spans == 1 and n_ttft == 1
+
+    eng_off = ContinuousBatchingEngine(model, params, batch_slots=2,
+                                       max_len=32, request_obs=False).start()
+    try:
+        toks_off = eng_off.generate(prompt, max_new_tokens=max_new)
+    finally:
+        eng_off.stop()
+    assert toks_off == toks_on
+    assert len(_req_roots(telemetry.get_tracer().records())) == n_spans
+    assert telemetry.get_registry().histogram(
+        "serving/ttft_ms").snapshot()["count"] == n_ttft
+
+
+def test_http_request_carries_req_tree_and_endpoint_twins(tiny_model):
+    """Through the real HTTP runner: the handler's serving/request span
+    parents the req/* tree, and the endpoint monitor's labeled twins
+    aggregate the stream's TTFT/TPOT."""
+    model, params = tiny_model
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=64,
+                                   initial_round=0)
+    runner = FedMLInferenceRunner(LlamaPredictor(eng)).start()
+    eng.model_slots.monitor = runner.monitor
+    url = f"http://127.0.0.1:{runner.port}/predict"
+    try:
+        req = urllib.request.Request(
+            url, data=json.dumps({"prompt_tokens": [1, 2],
+                                  "max_new_tokens": 3}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+    finally:
+        runner.stop()
+        eng.stop()
+    recs = telemetry.get_tracer().records()
+    outer = next(r for r in recs if r["name"] == "serving/request")
+    assert outer["attrs"]["path"] == "/predict" and outer["attrs"]["ok"]
+    roots = _req_roots(recs)
+    assert len(roots) == 1
+    root = next(iter(roots.values()))
+    assert root["trace_id"] == outer["trace_id"]
+    assert root["parent_id"] == outer["span_id"]
+    snap = runner.monitor.snapshot()
+    assert snap["ttft_p95_ms"] > 0 and snap["tpot_p95_ms"] > 0
+    assert snap["tokens_per_s"] > 0
+
+
+# -- swap-stall attribution (satellite c) ----------------------------------
+
+def test_midflight_swap_pins_stall_to_transitioned_streams(tiny_model):
+    """A mid-flight hot swap pins the stall to exactly the streams in
+    flight at the transition: the round-0 stream's tree carries a
+    req/stall child naming the round it transitioned against; streams
+    admitted on the new round carry none — and every output stays
+    bit-identical to a static deployment of its round."""
+    model, params = tiny_model
+    prompts = [(1, 2, 3, 4), (7, 9, 11), (5, 6)]
+    expected = _steady_reference(model, params, [0, 1], prompts, max_new=8)
+    assert expected[0] != expected[1]  # the flip must change outputs
+
+    eng = ContinuousBatchingEngine(model, params, batch_slots=2, max_len=32,
+                                   initial_round=0)
+    try:
+        qa = eng.submit(list(prompts[0]), max_new_tokens=8)
+        eng._admit(eng._requests.get())
+        eng.step()
+        eng.step()  # A is mid-flight on round 0
+
+        assert eng.model_slots.publish_payload(_round_tree(params, 1), 1)
+
+        # B admits on round 1 while A decodes: A's decode group moves to
+        # the partitioned program — the stall is A's, not B's
+        qb = eng.submit(list(prompts[1]), max_new_tokens=8)
+        eng._admit(eng._requests.get())
+        while eng.active_slots:
+            eng.step()
+
+        # C admits after the transition settled: same round, no stall
+        qc = eng.submit(list(prompts[2]), max_new_tokens=8)
+        eng._admit(eng._requests.get())
+        while eng.active_slots:
+            eng.step()
+
+        a, b, c = _drain(qa), _drain(qb), _drain(qc)
+    finally:
+        eng.stop()
+
+    assert (qa.round_idx, qb.round_idx, qc.round_idx) == (0, 1, 1)
+    assert a == expected[0][prompts[0]]
+    assert b == expected[1][prompts[1]]
+    assert c == expected[1][prompts[2]]
+    assert any(op[0] == "decode_part" for op in eng.oplog)
+
+    recs = telemetry.get_tracer().records()
+    roots = _req_roots(recs)
+    assert set(roots) == {1, 2, 3}
+    stalls = {r["parent_id"]: r for r in recs if r["name"] == "req/stall"}
+    # A carries the stall, pinned to the round it transitioned against
+    sa = stalls.get(roots[1]["span_id"])
+    assert sa is not None, "in-flight stream lost its stall attribution"
+    assert sa["attrs"]["round"] == 0 and sa["attrs"]["round_to"] == 1
+    assert sa["attrs"]["stall_ms"] > 0
+    assert roots[1]["attrs"]["stall_ms"] == sa["attrs"]["stall_ms"]
+    # B (admitted ON the new round) and C (post-transition) carry none,
+    # and their token-latency attribution is intact
+    assert roots[2]["span_id"] not in stalls
+    assert roots[3]["span_id"] not in stalls
+    assert roots[2]["attrs"]["ttft_ms"] > 0
+    assert roots[3]["attrs"]["ttft_ms"] > 0
+
+
+# -- shed bursts as first-class events (satellite b) -----------------------
+
+def test_overload_emits_deduped_shed_burst_event_and_shed_span(tmp_path):
+    """A shed burst lands ONCE in telemetry.jsonl (burst-deduped) with
+    the admission queue depth at trip time; every shed request leaves a
+    backdated req/request span covering its queue wait; the shared gate
+    feeds the endpoint's queue-wait histogram for all four callers."""
+    from fedml_tpu.serving.events import reset_serving_events
+    from fedml_tpu.telemetry import spans as spans_mod
+
+    reset_serving_events()
+    tracer = spans_mod.configure(str(tmp_path))
+
+    class Slow(FedMLPredictor):
+        def predict(self, request):
+            time.sleep(0.5)
+            return {"ok": True}
+
+    monitor = EndpointMonitor("obs_shed")
+    runner = FedMLInferenceRunner(Slow(), monitor=monitor, max_inflight=1,
+                                  queue_wait_s=0.02).start()
+    url = f"http://127.0.0.1:{runner.port}/predict"
+    statuses = []
+    lock = threading.Lock()
+
+    def hit():
+        try:
+            req = urllib.request.Request(
+                url, data=json.dumps({"x": 1}).encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                status = r.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        with lock:
+            statuses.append(status)
+
+    try:
+        threads = [threading.Thread(target=hit) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        runner.stop()
+
+    n_shed = statuses.count(429)
+    assert statuses.count(200) >= 1 and n_shed >= 1
+    assert monitor.snapshot()["rejected"] == n_shed
+    # every admission decision (admitted or shed) measured its wait
+    assert monitor._h_queue_wait.snapshot()["count"] == 4
+
+    with open(os.path.join(str(tmp_path), "telemetry.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    sheds = [r for r in recs if r.get("kind") == "serving_event"
+             and r.get("event") == "shed_burst"]
+    assert len(sheds) == 1, sheds  # the burst dedupes to its first shed
+    assert sheds[0]["endpoint"] == "obs_shed"
+    assert isinstance(sheds[0]["queue_depth"], int)
+    assert sheds[0]["rejected_total"] >= 1
+
+    shed_spans = [r for r in tracer.records()
+                  if r["name"] == "req/request"
+                  and (r.get("attrs") or {}).get("shed")]
+    assert len(shed_spans) == n_shed
+    for s in shed_spans:
+        # backdated over the (~20 ms timeout) wait for a permit
+        assert s["attrs"]["queue_wait_ms"] >= 15.0
+        assert s["duration_ms"] >= 15.0
+
+
+def test_serving_event_dedupe_window_and_counter():
+    from fedml_tpu.serving.events import reset_serving_events, serving_event
+
+    reset_serving_events()
+    assert serving_event("shed_burst", dedupe_key="ep", queue_depth=3)
+    assert not serving_event("shed_burst", dedupe_key="ep", queue_depth=9)
+    # a different endpoint's burst is its own signal
+    assert serving_event("shed_burst", dedupe_key="ep2", queue_depth=1)
+    reg = telemetry.get_registry()
+    assert reg.counter("serving/events",
+                       labels={"event": "shed_burst"}).value == 2
+
+
+# -- SLO burn-rate alerting (tentpole part 4) ------------------------------
+
+def _frame(node, seq, metrics, job="j"):
+    return {"v": 1, "node": node, "job": job, "seq": seq,
+            "ts": time.time(), "full": False, "metrics": metrics}
+
+
+def _gauge(name, value, **labels):
+    e = {"name": name, "kind": "gauge", "value": float(value)}
+    if labels:
+        e["labels"] = {k: str(v) for k, v in labels.items()}
+    return e
+
+
+def _counter(name, value, **labels):
+    e = {"name": name, "kind": "counter", "value": float(value)}
+    if labels:
+        e["labels"] = {k: str(v) for k, v in labels.items()}
+    return e
+
+
+def test_online_doctor_slo_burn_fires_on_hot_endpoint_only(tmp_path):
+    """Multi-window burn rate: the overloaded endpoint trips the alert
+    once both windows span and burn past threshold; the quiet endpoint
+    ingesting the same frames never alerts; staying hot never re-pages
+    (edge-triggered)."""
+    from fedml_tpu.telemetry.live import LiveCollector, OnlineDoctor
+
+    col = LiveCollector(job="j")
+    doc = OnlineDoctor(col, run_dir=str(tmp_path), slo_burn_threshold=5.0,
+                       slo_burn_windows_s=(0.05, 0.12))
+
+    def frame(seq, total_hot, bad_hot, total_quiet):
+        return _frame("serve", seq, [
+            _gauge("serving/slo_objective", 0.99, endpoint="ep_hot"),
+            _gauge("serving/slo_objective", 0.99, endpoint="ep_quiet"),
+            _counter("serving/slo_total", total_hot,
+                     endpoint="ep_hot", objective="ttft"),
+            _counter("serving/slo_breaches", bad_hot,
+                     endpoint="ep_hot", objective="ttft"),
+            _counter("serving/slo_total", total_quiet,
+                     endpoint="ep_quiet", objective="ttft"),
+            _counter("serving/slo_breaches", 0,
+                     endpoint="ep_quiet", objective="ttft"),
+        ])
+
+    col.ingest(frame(1, 100, 0, 100))
+    assert doc.alerts == []  # windows not spanned yet — no judgement
+    time.sleep(0.15)
+    # overloaded window: 60% of observations breach vs a 1% budget
+    col.ingest(frame(2, 200, 60, 200))
+    burn = [a for a in doc.alerts if a["rule"] == "slo_burn"]
+    assert len(burn) == 1, doc.alerts
+    a = burn[0]
+    assert a["endpoint"] == "ep_hot" and a["objective"] == "ttft"
+    assert a["burn"] >= 5.0 and a["burn_long"] >= 5.0
+    assert a["windows_s"] == [0.05, 0.12]
+    # edge-triggered: the endpoint staying hot does not re-page
+    time.sleep(0.15)
+    col.ingest(frame(3, 300, 160, 300))
+    assert len([x for x in doc.alerts if x["rule"] == "slo_burn"]) == 1
+    # the quiet endpoint never alerted, and the alert rode telemetry.jsonl
+    assert all(x.get("endpoint") != "ep_quiet" for x in doc.alerts)
+    with open(os.path.join(str(tmp_path), "telemetry.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    assert [r["rule"] for r in recs if r.get("kind") == "doctor_alert"] == [
+        "slo_burn"]
+    # a burn alert is allowed to request an auto profile capture
+    from fedml_tpu.telemetry.profiling import AUTO_CAPTURE_RULES
+
+    assert "slo_burn" in AUTO_CAPTURE_RULES
+
+
+def test_slo_counters_score_streams_against_targets():
+    """EndpointMonitor scores every TTFT/TPOT/e2e observation against
+    its objective's target into the cumulative counter pairs the burn
+    rate differences."""
+    from fedml_tpu.serving import ServingSLO
+
+    mon = EndpointMonitor("ep_slo", slo=ServingSLO(
+        ttft_ms=100.0, tpot_ms=10.0, e2e_ms=1000.0, objective=0.95))
+    mon.record_stream(50.0, [5.0, 15.0], 40.0)   # ttft ok, 1 of 2 tpot bad
+    mon.record_stream(150.0, [5.0], 40.0)        # ttft bad
+    mon.record_request(0.5, ok=True)             # e2e ok
+    snap = mon.snapshot()
+    assert snap["slo"]["ttft"] == {
+        "target_ms": 100.0, "total": 2, "breaches": 1}
+    assert snap["slo"]["tpot"] == {
+        "target_ms": 10.0, "total": 3, "breaches": 1}
+    assert snap["slo"]["e2e"] == {
+        "target_ms": 1000.0, "total": 1, "breaches": 0}
+    reg = telemetry.get_registry()
+    assert reg.counter(
+        "serving/slo_breaches",
+        labels={"endpoint": "ep_slo", "objective": "ttft"}).value == 1
+    assert reg.gauge("serving/slo_objective",
+                     labels={"endpoint": "ep_slo"}).value == 0.95
+
+
+def test_serving_slo_spec_roundtrip(tmp_path):
+    from fedml_tpu.serving import ServingSLO
+
+    spec = tmp_path / "slo.yaml"
+    spec.write_text("ttft_ms: 250\ntpot_ms: 20\nobjective: 0.999\n")
+    slo = ServingSLO.from_spec(str(spec))
+    assert dict(slo.targets()) == {"ttft": 250.0, "tpot": 20.0}
+    assert slo.objective == 0.999 and bool(slo)
+    assert not ServingSLO()  # nothing declared -> falsy
+
+
+# -- post-hoc surfaces: report / doctor / watch ----------------------------
+
+def _write_metrics(run_dir, recs):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "telemetry.jsonl"), "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_report_serving_latency_section(tmp_path):
+    run_dir = str(tmp_path / "run")
+    _write_metrics(run_dir, [
+        {"name": "serving/ttft_ms", "kind": "histogram",
+         "labels": {"endpoint": "ep0"}, "count": 40, "sum": 2000.0,
+         "max": 120.0, "p50": 40.0, "p95": 90.0, "p99": 110.0},
+        {"name": "serving/tpot_ms", "kind": "histogram",
+         "labels": {"endpoint": "ep0"}, "count": 400, "sum": 2000.0,
+         "max": 9.0, "p50": 4.0, "p95": 7.0, "p99": 8.5},
+        {"name": "serving/queue_wait_ms", "kind": "histogram",
+         "labels": {"endpoint": "ep0"}, "count": 40, "sum": 100.0,
+         "max": 12.0, "p50": 1.0, "p95": 8.0, "p99": 11.0},
+        {"name": "serving/tokens_per_s", "kind": "gauge",
+         "labels": {"endpoint": "ep0"}, "value": 123.4},
+        # zero-count histograms must not fabricate a row
+        {"name": "serving/ttft_ms", "kind": "histogram",
+         "labels": {"endpoint": "idle"}, "count": 0, "sum": 0.0,
+         "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0},
+    ])
+    rep = telemetry.build_report(run_dir)
+    assert set(rep["serving_latency"]) == {"ep0"}
+    row = rep["serving_latency"]["ep0"]
+    assert row["ttft_p95"] == 90.0 and row["ttft_count"] == 40
+    assert row["tpot_p99"] == 8.5 and row["queue_wait_p95"] == 8.0
+    assert row["tokens_per_s"] == 123.4
+    text = telemetry.format_report(rep)
+    assert "serving token latency" in text and "endpoint ep0" in text
+    assert "tokens_per_s" in text
+
+
+def test_doctor_slo_scorecard_saturation_and_shed_bursts(tmp_path):
+    from fedml_tpu.telemetry.doctor import build_doctor, format_doctor
+
+    run_dir = str(tmp_path / "run")
+    ep = {"endpoint": "ep0"}
+    _write_metrics(run_dir, [
+        {"name": "serving/round_current", "kind": "gauge", "value": 5,
+         "labels": ep},
+        {"name": "serving/round_published", "kind": "gauge", "value": 5},
+        {"name": "serving/swaps", "kind": "counter", "value": 5,
+         "labels": ep},
+        {"name": "serving/rejected", "kind": "counter", "value": 4,
+         "labels": ep},
+        {"name": "serving/ttft_ms", "kind": "histogram", "labels": ep,
+         "count": 40, "sum": 2000.0, "max": 300.0, "p50": 40.0,
+         "p95": 120.0, "p99": 250.0},
+        {"name": "serving/tpot_ms", "kind": "histogram", "labels": ep,
+         "count": 400, "sum": 2000.0, "max": 9.0, "p50": 4.0, "p95": 7.0,
+         "p99": 8.5},
+        {"name": "serving/queue_wait_ms", "kind": "histogram", "labels": ep,
+         "count": 44, "sum": 200.0, "max": 25.0, "p50": 2.0, "p95": 18.0,
+         "p99": 24.0},
+        {"name": "serving/tokens_per_s", "kind": "gauge", "value": 210.0,
+         "labels": ep},
+        {"name": "serving/batch_occupancy", "kind": "gauge", "value": 0.875},
+        {"name": "serving/queue_depth", "kind": "gauge", "value": 4},
+        {"name": "serving/tokens_in_flight", "kind": "gauge", "value": 96},
+        {"name": "serving/kv_bytes_in_use", "kind": "gauge", "value": 4e6},
+        {"name": "serving/kv_bytes_allocated", "kind": "gauge", "value": 8e6},
+        {"name": "serving/slo_objective", "kind": "gauge", "value": 0.99,
+         "labels": ep},
+        {"name": "serving/slo_target_ms", "kind": "gauge", "value": 100.0,
+         "labels": {**ep, "objective": "ttft"}},
+        {"name": "serving/slo_total", "kind": "counter", "value": 100,
+         "labels": {**ep, "objective": "ttft"}},
+        {"name": "serving/slo_breaches", "kind": "counter", "value": 30,
+         "labels": {**ep, "objective": "ttft"}},
+        {"ts": time.time(), "kind": "serving_event", "event": "shed_burst",
+         "endpoint": "ep0", "queue_depth": 7, "rejected_total": 4},
+    ])
+    d = build_doctor(run_dir)
+    s = d["serving"]
+    assert s["ttft_p95_ms"] == 120.0 and s["tpot_p95_ms"] == 7.0
+    assert s["tokens_per_s"] == 210.0
+    assert s["queue_wait_p95_ms"] == 18.0
+    assert s["batch_occupancy"] == 0.875 and s["queue_depth"] == 4
+    assert s["kv_bytes_allocated"] == 8e6
+    assert s["slo_objective"] == 0.99
+    assert s["slo"]["ttft"] == {"slo_target_ms": 100.0, "slo_total": 100.0,
+                                "slo_breaches": 30.0}
+    assert s["shed_bursts"] == 1 and s["shed_queue_depth"] == 7
+    v = "\n".join(d["verdict"])
+    # 30% bad vs the 1% budget -> the budget verdict names the objective
+    assert "burned its ttft error budget" in v
+    assert "queue depth 7 at burst trip" in v
+    text = format_doctor(d)
+    assert "ttft p95 120.0 ms" in text
+    assert "saturation: occupancy 0.88" in text
+    assert "slo[ttft]: 30/100" in text
+    assert "1 shed burst(s)" in text
+
+
+def test_watch_renders_ttft_and_saturation_columns():
+    from fedml_tpu.telemetry.live.watch import render_state
+
+    state = {
+        "job": "j", "nodes": 1, "frames": 2, "seq_gaps": 0,
+        "nodes_detail": {"serve": {"seq": 2, "seq_gaps": 0,
+                                   "last_ts": time.time()}},
+        "metrics": [
+            {"name": "serving/round_current", "labels": {"node": "serve"},
+             "kind": "gauge", "value": 3.0},
+            {"name": "serving/ttft_ms", "labels": {"node": "serve"},
+             "kind": "histogram", "count": 12, "sum": 600.0, "max": 110.0,
+             "p50": 40.0, "p95": 84.0, "p99": 100.0},
+            {"name": "serving/batch_occupancy",
+             "labels": {"node": "serve"}, "kind": "gauge", "value": 0.5},
+            {"name": "serving/queue_depth", "labels": {"node": "serve"},
+             "kind": "gauge", "value": 2.0},
+        ],
+        "alerts": [],
+    }
+    text = render_state(state)
+    assert "ttft" in text and "sat" in text
+    assert "84ms" in text
+    assert "50%+2q" in text
+    # absent serving gauges degrade to "-", not 0
+    state["metrics"] = state["metrics"][:1]
+    text = render_state(state)
+    assert "84ms" not in text and "50%" not in text
+
+
+# -- taxonomy lint (satellite e) -------------------------------------------
+
+def test_span_lint_req_namespace_rules():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_span_names", os.path.join(REPO, "tools",
+                                         "check_span_names.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    bad = [
+        ("x.py", 1, "span", "req/request"),        # fine
+        ("x.py", 2, "span", "req/queue"),          # fine
+        ("x.py", 3, "span", "req/stall"),          # fine
+        ("x.py", 4, "span", "req/warmup"),         # unknown lifecycle phase
+        ("x.py", 5, "counter", "req/ttft_ms"),     # metrics live in serving/
+        ("x.py", 6, "histogram", "serving/ttft_ms"),  # fine
+    ]
+    problems = lint.check(bad)
+    assert len(problems) == 2, problems
+    assert any("req/warmup" in p for p in problems)
+    assert any("serving/" in p for p in problems)

@@ -22,8 +22,8 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["compare_integrity", "compare_multichip", "compare_preempt",
-           "compare_recover", "compare_wire", "load_headline",
-           "run_compare", "main"]
+           "compare_recover", "compare_serve", "compare_wire",
+           "load_headline", "run_compare", "main"]
 
 
 def _natural_key(path: str):
@@ -300,6 +300,66 @@ def compare_wire(bench_dir: str = ".",
     return out
 
 
+def compare_serve(bench_dir: str = ".",
+                  regression_threshold: float = 0.25) -> Optional[Dict]:
+    """Diff the newest two ``SERVE_*.json`` serving-bench records.
+
+    Same contract as :func:`compare_recover`: any GATE going false where
+    it was true (dropped requests, missed swaps, a host-side f32 tree on
+    the staging path, the p99-vs-baseline SLO, the request-observability
+    overhead seam) is a regression at any magnitude, and the latency/
+    throughput numbers themselves fail past ``regression_threshold`` —
+    loose by default, serving percentiles on a shared CPU box are
+    noisier than throughput metrics. None when fewer than two files
+    exist."""
+    files = sorted(glob.glob(os.path.join(bench_dir, "SERVE_*.json")),
+                   key=_natural_key)
+    if len(files) < 2:
+        return None
+    prev_rec = _load_record(files[-2])
+    new_rec = _load_record(files[-1])
+    if prev_rec is None or new_rec is None:
+        return {"ok": True,
+                "note": "no parseable serve record in "
+                        f"{files[-2] if prev_rec is None else files[-1]}"}
+    out: Dict = {
+        "ok": True,
+        "prev_file": os.path.basename(files[-2]),
+        "new_file": os.path.basename(files[-1]),
+        "regressions": [],
+    }
+    # higher-is-worse latency fields + lower-is-worse qps
+    for field, label in (("p95_ms", "swap-window p95"),
+                         ("p99_ms", "swap-window p99"),
+                         ("ttft_p95_ms", "TTFT p95"),
+                         ("tpot_p95_ms", "TPOT p95")):
+        prev_v = prev_rec.get(field)
+        new_v = new_rec.get(field)
+        if prev_v and new_v is not None:
+            delta = (float(new_v) - float(prev_v)) / float(prev_v)
+            out[f"{field}_prev"] = prev_v
+            out[f"{field}_new"] = new_v
+            if delta > regression_threshold:
+                out["regressions"].append(
+                    f"{label} regressed {delta * 100:.1f}% "
+                    f"({prev_v} -> {new_v} ms)")
+    prev_qps, new_qps = prev_rec.get("qps"), new_rec.get("qps")
+    if prev_qps and new_qps is not None:
+        delta = (float(new_qps) - float(prev_qps)) / float(prev_qps)
+        out["qps_prev"] = prev_qps
+        out["qps_new"] = new_qps
+        if delta < -regression_threshold:
+            out["regressions"].append(
+                f"swap-window qps regressed {-delta * 100:.1f}% "
+                f"({prev_qps} -> {new_qps})")
+    for gate in ("ok_dropped", "ok_swaps", "ok_no_host_f32", "ok_p99",
+                 "ok_obs_overhead"):
+        if prev_rec.get(gate) is True and new_rec.get(gate) is False:
+            out["regressions"].append(f"serve gate {gate} went false")
+    out["ok"] = not out["regressions"]
+    return out
+
+
 def compare_multichip(bench_dir: str = ".",
                       regression_threshold: float = 0.10) -> Optional[Dict]:
     """Diff the newest two parseable ``MULTICHIP_*.json`` scale-out
@@ -403,13 +463,15 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
     integrity = compare_integrity(bench_dir)
     multichip = compare_multichip(bench_dir)
     wire = compare_wire(bench_dir, threshold)
+    serve = compare_serve(bench_dir)
     return {
         "ok": (delta >= -threshold and not program_regressions
                and (recover is None or recover["ok"])
                and (preempt is None or preempt["ok"])
                and (integrity is None or integrity["ok"])
                and (multichip is None or multichip["ok"])
-               and (wire is None or wire["ok"])),
+               and (wire is None or wire["ok"])
+               and (serve is None or serve["ok"])),
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -425,6 +487,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
         **({"integrity": integrity} if integrity is not None else {}),
         **({"multichip": multichip} if multichip is not None else {}),
         **({"wire": wire} if wire is not None else {}),
+        **({"serve": serve} if serve is not None else {}),
     }
 
 
