@@ -371,6 +371,22 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--fa" in sys.argv:
+        # federated-analytics gates: masked sketch wire ≤ 1.2× the plain
+        # int32 sketch, heavy-hitter recall/precision ≥ 0.95 vs the
+        # plaintext reference on the same seeded data, and the
+        # traced-client-sketch proof (no host-side per-client plaintext
+        # in masked mode) — one JSON line, archived as FA_r01.json
+        # (tools/fa_bench.py; FEDML_FA_* env knobs)
+        from tools.fa_bench import run_fa_bench, write_artifact
+
+        row = run_fa_bench()
+        write_artifact(row)
+        print(json.dumps(row))
+        if not row["ok"]:
+            raise SystemExit(1)
+        return
+
     if "--live" in sys.argv:
         # live-telemetry overhead gate: the SAME in-proc federation run
         # with streaming on vs off (rounds/s within tolerance), the

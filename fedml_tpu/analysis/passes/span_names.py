@@ -105,6 +105,14 @@ _SHARD_SHAPE = re.compile(r"^shard/[a-z0-9_]+$")
 # counters or gauges only — packed footprints are levels, pack events
 # are occurrence counts, neither is a distribution
 _QUANT_SHAPE = re.compile(r"^quant/[a-z0-9_]+$")
+# federated analytics: fa/* is the sketch-round namespace (rounds
+# closed, quorum closes, deadline fires, stale/screened submissions,
+# aborts, heavy-hitter recall, the accounted DP epsilon) — metric-only
+# (an analytics round's spans keep their round/* names; the fused merge
+# keeps compress/*), one signal segment (task/tier ride labels);
+# counters or gauges only — round/drop signals are occurrence counts,
+# recall/epsilon readings are levels, neither is a distribution
+_FA_SHAPE = re.compile(r"^fa/[a-z0-9_]+$")
 # causal tracing: tracepath/* is the span-stream/critical-path meta-
 # namespace (frames, merged records, seq gaps, the latest round's
 # critical phase/share) — metric-only (the traced spans themselves keep
@@ -180,11 +188,11 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
         if kind == "span" and name.startswith(
                 ("mem/", "health/", "resilience/", "tier/", "live/",
                  "secagg/", "profile/", "sched/", "integrity/",
-                 "tracepath/", "shard/", "quant/")):
+                 "tracepath/", "shard/", "quant/", "fa/")):
             bad(f"{name!r} — mem/, health/, resilience/, tier/, "
                 "live/, secagg/, profile/, sched/, integrity/, "
-                "tracepath/, shard/ and quant/ are metric namespaces, "
-                "not span names")
+                "tracepath/, shard/, quant/ and fa/ are metric "
+                "namespaces, not span names")
         if kind == "span" and name.startswith("serve/"):
             if not _SERVE_SPAN_SHAPE.match(name):
                 bad(f"span {name!r} must be serve/stage, "
@@ -283,6 +291,14 @@ def _check_structured(entries) -> List[Tuple[str, int, str]]:
             elif kind == "histogram":
                 bad(f"{kind} {name!r} — quant/* signals are "
                     "levels (gauge) or occurrence counts (counter), not "
+                    "histograms")
+        if kind != "span" and name.startswith("fa/"):
+            if not _FA_SHAPE.match(name):
+                bad(f"{kind} {name!r} must be fa/<signal> "
+                    "(one segment; task/tier dimensions ride labels)")
+            elif kind == "histogram":
+                bad(f"{kind} {name!r} — fa/* signals are occurrence "
+                    "counts (counter) or levels (gauge), not "
                     "histograms")
         if kind != "span" and name.startswith("tracepath/"):
             if not _TRACEPATH_SHAPE.match(name):

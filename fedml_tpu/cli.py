@@ -831,6 +831,97 @@ def tree(clients: int, tiers: int, rounds: int, params: int, codec: str,
         raise SystemExit(1)
 
 
+@cli.command()
+@click.option("--task", default="frequency_estimation", show_default=True,
+              help="FA task (frequency_estimation, heavy_hitter_triehh, "
+                   "histogram, k_percentile_element, union, intersection, "
+                   "cardinality, avg)")
+@click.option("--clients", default=6, show_default=True,
+              help="FSM clients (in-proc transport)")
+@click.option("--sketch", default="auto", show_default=True,
+              help="sketch codec spec (cms@W/D, votevec@W/D, bloom@B/H, "
+                   "hist@N/lo/hi, 'auto' picks per task, '' = plaintext)")
+@click.option("--seed", default=0, show_default=True)
+@click.option("--query", default="", show_default=True,
+              help="comma-separated items to point-query in the result")
+@click.option("--theta", default=2, show_default=True,
+              help="TrieHH vote threshold")
+@click.option("--deadline-s", default=0.0, show_default=True,
+              help="round deadline (0 = wait for every client)")
+@click.option("--quorum", default=None, type=float,
+              help="round close fraction once the deadline fires")
+@click.option("--federation", is_flag=True,
+              help="run the tree-scale heavy-hitter federation (secagg + "
+                   "central DP over TreeRunner) instead of FSM rounds")
+@click.option("--fed-clients", default=4096, show_default=True,
+              help="virtual clients for --federation")
+@click.option("--fed-tiers", default=3, show_default=True)
+@click.option("--dp-sigma", default=0.0, show_default=True,
+              help="central Gaussian noise std on the root sum "
+                   "(--federation only)")
+def fa(task: str, clients: int, sketch: str, seed: int, query: str,
+       theta: int, deadline_s: float, quorum, federation: bool,
+       fed_clients: int, fed_tiers: int, dp_sigma: float) -> None:
+    """Run a federated-analytics round over seeded synthetic data.
+
+    Default mode drives the real FA message FSM in-process (sketch
+    submissions under the negotiated codec spec, deadline/quorum round
+    close). --federation instead runs the one-shot heavy-hitter vote
+    federation over the aggregation tree with secagg masking and
+    central DP. Prints ONE JSON line; same --seed reproduces
+    bit-identically.
+    """
+    import types
+
+    if federation:
+        from fedml_tpu.fa.sketch.federation import run_sketch_federation
+
+        out = run_sketch_federation(
+            n_clients=fed_clients, tiers=fed_tiers, seed=seed,
+            secagg=True, dp_sigma=dp_sigma)
+        out.pop("stats", None)
+        click.echo(json.dumps(out))
+        return
+
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    numeric = task in ("histogram", "k_percentile_element", "avg")
+    words = ["sun", "moon", "star", "rain", "wind", "sea", "sky",
+             "fog", "ice", "ash"]
+    data = {}
+    for r in range(1, int(clients) + 1):
+        if numeric:
+            data[r] = rng.uniform(0, 100, 64).tolist()
+        else:
+            # zipf-ish head: low word ids dominate, so heavy-hitter
+            # and frequency tasks have discoverable structure
+            idx = np.minimum(rng.zipf(1.5, 64) - 1, len(words) - 1)
+            data[r] = [words[i] for i in idx]
+    args = types.SimpleNamespace(
+        run_id=f"fa_cli_{seed}", random_seed=seed, rank=0, fa_task=task,
+        fa_sketch=sketch, fa_theta=theta,
+        fa_query_items=[q for q in query.split(",") if q])
+    if deadline_s > 0:
+        args.round_deadline_s = float(deadline_s)
+    if quorum is not None:
+        args.round_quorum = float(quorum)
+    from fedml_tpu.fa.run_inproc import run_fa_inproc
+
+    try:
+        out = run_fa_inproc(args, data)
+    except (RuntimeError, ValueError, TimeoutError) as e:
+        click.echo(json.dumps({"completed": False, "error": str(e)}))
+        raise SystemExit(1)
+    if out is None:
+        click.echo(json.dumps({"completed": False,
+                               "error": "federation aborted"}))
+        raise SystemExit(1)
+    out = {k: (v.tolist() if hasattr(v, "tolist") else v)
+           for k, v in out.items()}
+    click.echo(json.dumps({"completed": True, **out}))
+
+
 @cli.group()
 def telemetry() -> None:
     """Inspect a run's telemetry sinks (spans, metrics, traces)."""

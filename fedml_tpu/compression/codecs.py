@@ -777,6 +777,11 @@ _INSTANCES: Dict[Tuple, Codec] = {}
 
 _SECAGG_NAME = "secagg_int8"
 
+# the FA sketch codec family (fa/sketch/codec.py) registers itself on
+# import; resolving one of its tags before anything imported the fa
+# package triggers the import instead of failing the lookup
+_SKETCH_NAMES = ("cms", "csk", "votevec", "bloom", "hist")
+
 
 def _load_secagg_codec() -> type:
     """Lazy registration of the maskable codec — privacy.secagg imports
@@ -788,11 +793,19 @@ def _load_secagg_codec() -> type:
     return _CODEC_CLASSES[_SECAGG_NAME]
 
 
+def _load_sketch_codecs() -> None:
+    """Lazy registration of the FA sketch codecs (same pattern as the
+    masked codec: fa.sketch imports this module)."""
+    if _SKETCH_NAMES[0] not in _CODEC_CLASSES:
+        import fedml_tpu.fa.sketch.codec  # noqa: F401  (register_codec)
+
+
 def available_codecs() -> Tuple[str, ...]:
-    # the masked codec is always a legal wire tag, loaded or not — a
-    # receiver must not reject a masked payload just because nothing in
-    # its process imported the privacy package yet
-    return tuple(sorted(set(_CODEC_CLASSES) | {_SECAGG_NAME}))
+    # the masked codec and the sketch family are always legal wire tags,
+    # loaded or not — a receiver must not reject a payload just because
+    # nothing in its process imported the owning package yet
+    return tuple(sorted(
+        set(_CODEC_CLASSES) | {_SECAGG_NAME} | set(_SKETCH_NAMES)))
 
 
 def register_codec(cls: type) -> type:
@@ -826,10 +839,22 @@ def get_codec(name: str, args: Any = None) -> Optional[Codec]:
         if cache_key not in _INSTANCES:
             _INSTANCES[cache_key] = cls(clip, bound, mod_bits)
         return _INSTANCES[cache_key]
+    if base in _SKETCH_NAMES and base not in _CODEC_CLASSES:
+        _load_sketch_codecs()
     if base not in _CODEC_CLASSES:
         raise ValueError(
             f"unknown compression codec {base!r}; "
             f"available: {', '.join(available_codecs())}")
+    cls = _CODEC_CLASSES[base]
+    if hasattr(cls, "parse_param"):
+        # self-describing registered codec (the sketch family): the
+        # class owns its spec grammar and its args-derived defaults
+        params = tuple(cls.parse_param(param) if param
+                       else cls.default_param(args))
+        cache_key = (base,) + params
+        if cache_key not in _INSTANCES:
+            _INSTANCES[cache_key] = cls(*params)
+        return _INSTANCES[cache_key]
     if param and base not in (TopKCodec.name, Int4Codec.name,
                               Nf4Codec.name):
         raise ValueError(f"codec {base!r} takes no parameter ({name!r})")

@@ -30,6 +30,19 @@ def register(name: str):
 
 def create_aggregator(task: str, args: Any = None) -> FAServerAggregator:
     task = (task or "").strip().lower()
+    spec = str(getattr(args, "fa_sketch", "") or "") if args is not None \
+        else ""
+    if spec:
+        # sketch mode: the aggregator owns the negotiated spec (the
+        # server manager advertises aggregator.sketch_spec on the
+        # round-config header); avg has no sketch form and stays plain
+        from fedml_tpu.fa.sketch.aggregators import (
+            create_sketch_aggregator,
+        )
+
+        agg = create_sketch_aggregator(task, args, spec)
+        if agg is not None:
+            return agg
     if task not in _REGISTRY:
         raise ValueError(f"unknown FA task {task!r}; know {sorted(_REGISTRY)}")
     return _REGISTRY[task](args)

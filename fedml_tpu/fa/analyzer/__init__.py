@@ -30,6 +30,17 @@ def register(name: str):
 
 def create_analyzer(task: str, args: Any = None) -> FAClientAnalyzer:
     task = (task or "").strip().lower()
+    spec = str(getattr(args, "fa_sketch", "") or "") if args is not None \
+        else ""
+    if spec:
+        # sketch mode: submissions become CompressedTree payloads under
+        # the server-negotiated spec; tasks with no sketch form (avg)
+        # fall through to their plaintext operator
+        from fedml_tpu.fa.sketch.analyzers import create_sketch_analyzer
+
+        analyzer = create_sketch_analyzer(task, args, spec)
+        if analyzer is not None:
+            return analyzer
     if task not in _REGISTRY:
         raise ValueError(f"unknown FA task {task!r}; know {sorted(_REGISTRY)}")
     return _REGISTRY[task](args)

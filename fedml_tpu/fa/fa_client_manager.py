@@ -54,6 +54,12 @@ class FAClientManager(FedMLCommManager):
         M = FAMessage
         self.analyzer.set_id(int(msg.get(M.MSG_ARG_KEY_CLIENT_INDEX)))
         round_idx = int(msg.get(M.MSG_ARG_KEY_ROUND, 0))
+        # PR 3 negotiation: the server's round-config header carries the
+        # sketch spec every client must encode under — it wins over any
+        # locally-configured default
+        spec = msg.get(M.MSG_ARG_KEY_SKETCH_SPEC)
+        if spec and hasattr(self.analyzer, "set_sketch_spec"):
+            self.analyzer.set_sketch_spec(str(spec))
         submission = self.analyzer.local_analyze(
             self.local_data, msg.get(M.MSG_ARG_KEY_SERVER_STATE), round_idx
         )

@@ -13,3 +13,6 @@ class FAMessage(MyMessage):
     MSG_ARG_KEY_SERVER_STATE = "fa_server_state"
     MSG_ARG_KEY_SUBMISSION = "fa_submission"
     MSG_ARG_KEY_RESULT = "fa_result"
+    # round-config negotiation header (PR 3 codec-spec pattern): the
+    # server advertises the sketch spec every client must encode under
+    MSG_ARG_KEY_SKETCH_SPEC = "fa_sketch_spec"

@@ -1,13 +1,31 @@
-"""FA server FSM: handshake → broadcast analyze request (+state) → collect
-submissions → aggregate → iterate or finish with the result.
+"""FA server FSM: handshake → broadcast analyze request (+state +sketch
+spec) → collect submissions → quorum/deadline close → aggregate →
+iterate or finish with the result.
 
 Parity: ``fa/cross_silo/fa_server_manager`` shape in the reference — the
 cross-silo server FSM with the model-sync phase replaced by analytics
-state broadcast.
+state broadcast, plus the PR 5 resilience contract the reference's FA
+server never had: a round closes on ``round_quorum`` when the
+``round_deadline_s`` timer fires (missing clients are NAMED, stale
+submissions counted and dropped), so a dropped phone can no longer hang
+a collection round forever. In sketch mode the analyze request carries
+the negotiated sketch spec on the round-config header (PR 3 codec
+pattern) and submissions are admission-screened in the compressed
+domain (PR 15 ring 1) before the fused merge sees them.
+
+Message ids / dedup / comm spans ride the standard
+``FedMLCommManager.send_message`` headers — FA messages are ordinary
+transport citizens, which is what makes broker-replay dedup and
+``comm/send``→``comm/recv`` trace pairing work here too.
+
+Everything lands in the ``fa/*`` counter namespace (lint-enforced,
+one literal segment, task in labels) plus ``mlops`` round events — the
+doctor's "federated analytics" section reads both.
 """
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, Optional
 
 from fedml_tpu import constants
@@ -15,6 +33,7 @@ from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
 from fedml_tpu.core.distributed.message import Message
 from fedml_tpu.core.mlops import metrics as mlops
 from fedml_tpu.fa.fa_message_define import FAMessage
+from fedml_tpu.resilience import ResilienceConfig, RoundDeadline, quorum_size
 
 logger = logging.getLogger(__name__)
 
@@ -32,7 +51,28 @@ class FAServerManager(FedMLCommManager):
         self.is_initialized = False
         self.submissions: Dict[int, Any] = {}
         self.result: Optional[dict] = None
+        # sketch mode: the aggregator owns the negotiated spec; the
+        # analyze-request header advertises it to every client
+        self.sketch_spec: Optional[str] = getattr(
+            aggregator, "sketch_spec", None)
+        # PR 5 resilience: deadline + quorum round close (0 = legacy
+        # wait-forever). The deadline fires on a timer thread, so every
+        # round transition holds the lock.
+        self.resilience = ResilienceConfig(args)
+        self._deadline = RoundDeadline(self._on_round_deadline)
+        self._extensions_used = 0
+        # reentrant: _close_round re-arms the next deadline while still
+        # holding the round lock it closed under
+        self._round_lock = threading.RLock()
+        # PR 15 ring 1 on the compressed domain: screen sketch
+        # submissions at admission, before the fused merge
+        self._screen = None
+        if self.sketch_spec and bool(getattr(args, "fa_screen", False)):
+            from fedml_tpu.integrity import UpdateScreen
 
+            self._screen = UpdateScreen()
+
+    # -- handshake ---------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
         M = FAMessage
         self.register_message_receive_handler(
@@ -61,6 +101,7 @@ class FAServerManager(FedMLCommManager):
             self.is_initialized = True
             self._broadcast_request()
 
+    # -- round open --------------------------------------------------------
     def _broadcast_request(self) -> None:
         M = FAMessage
         for cid in range(1, self.client_num + 1):
@@ -69,27 +110,145 @@ class FAServerManager(FedMLCommManager):
             m.add_params(M.MSG_ARG_KEY_SERVER_STATE, self.server_state)
             m.add_params(M.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
             m.add_params(M.MSG_ARG_KEY_ROUND, self.round_idx)
+            if self.sketch_spec:
+                m.add_params(M.MSG_ARG_KEY_SKETCH_SPEC, self.sketch_spec)
             self.send_message(m)
+        self._arm_deadline()
 
+    def _arm_deadline(self) -> None:
+        if self.resilience.round_deadline_s > 0:
+            with self._round_lock:
+                self._extensions_used = 0
+                self._deadline.arm(self.round_idx,
+                                   self.resilience.round_deadline_s)
+
+    # -- submissions -------------------------------------------------------
     def handle_submission(self, msg: Message) -> None:
+        from fedml_tpu import telemetry
+
         M = FAMessage
-        if int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx)) != self.round_idx:
-            return
-        self.submissions[msg.get_sender_id()] = msg.get(M.MSG_ARG_KEY_SUBMISSION)
-        if len(self.submissions) < self.client_num:
-            return
+        sender = msg.get_sender_id()
+        with self._round_lock:
+            msg_round = int(msg.get(M.MSG_ARG_KEY_ROUND, self.round_idx))
+            if msg_round != self.round_idx:
+                # a straggler's upload for an already-closed round:
+                # counted and dropped, never aggregated twice
+                telemetry.get_registry().counter(
+                    "fa/stale_submissions",
+                    labels={"task": self.task}).inc()
+                logger.warning(
+                    "FA round %d: dropping stale submission from client "
+                    "%s (for round %d)", self.round_idx, sender, msg_round)
+                return
+            submission = msg.get(M.MSG_ARG_KEY_SUBMISSION)
+            if self._screen is not None:
+                reason = self._screen.admit(sender, msg_round, submission)
+                if reason is not None:
+                    telemetry.get_registry().counter(
+                        "fa/screened", labels={"task": self.task}).inc()
+                    logger.warning(
+                        "FA round %d: screened out client %s (%s)",
+                        msg_round, sender, reason)
+                    return
+            self.submissions[sender] = submission
+            if len(self.submissions) < self.client_num:
+                return
+            self._close_round(quorum_close=False)
+
+    def _on_round_deadline(self, round_idx: int) -> None:
+        from fedml_tpu import telemetry
+
+        with self._round_lock:
+            if round_idx != self.round_idx or self.result is not None:
+                return  # stale fire: the round already closed
+            reg = telemetry.get_registry()
+            reg.counter("fa/deadline_fired",
+                        labels={"task": self.task}).inc()
+            need = quorum_size(max(1, self.client_num),
+                               self.resilience.round_quorum)
+            if len(self.submissions) >= need:
+                self._close_round(quorum_close=True)
+                return
+            if self._extensions_used < self.resilience.deadline_extensions:
+                self._extensions_used += 1
+                logger.warning(
+                    "FA round %d below quorum at deadline (%d/%d, need "
+                    "%d) — extension %d/%d", round_idx,
+                    len(self.submissions), self.client_num, need,
+                    self._extensions_used,
+                    self.resilience.deadline_extensions)
+                self._deadline.arm(self.round_idx,
+                                   self.resilience.round_deadline_s)
+                return
+            reg.counter("fa/aborts", labels={"task": self.task}).inc()
+            missing = sorted(set(range(1, self.client_num + 1))
+                             - set(self.submissions))
+            err = RuntimeError(
+                f"FA round {round_idx} aborted below quorum: "
+                f"{len(self.submissions)}/{self.client_num} submissions "
+                f"(need {need}); missing clients {missing}")
+            logger.error("%s", err)
+            mlops.log({"event": "fa.abort", "round": round_idx,
+                       "task": self.task,
+                       "missing": ",".join(map(str, missing))})
+            self.handler_error = err  # the harness fails loudly on this
+            self._send_finish_all()
+            self.finish()
+
+    # -- round close -------------------------------------------------------
+    def _close_round(self, quorum_close: bool) -> None:
+        """Aggregate what arrived and advance — caller holds the lock."""
+        from fedml_tpu import telemetry
+
+        self._deadline.cancel()
+        reg = telemetry.get_registry()
+        missing = sorted(set(range(1, self.client_num + 1))
+                         - set(self.submissions))
+        if self._screen is not None:
+            # retrospective ring-1 rejections (cohort-relative norms)
+            for cid, reason in self._screen.close_round(
+                    self.round_idx).items():
+                if self.submissions.pop(cid, None) is not None:
+                    reg.counter("fa/screened",
+                                labels={"task": self.task}).inc()
+                    logger.warning(
+                        "FA round %d: screened out client %s at close "
+                        "(%s)", self.round_idx, cid, reason)
+                    missing.append(cid)
+        if quorum_close:
+            reg.counter("fa/quorum_rounds",
+                        labels={"task": self.task}).inc()
+            logger.warning(
+                "FA round %d quorum close: %d/%d submissions, missing "
+                "clients %s", self.round_idx, len(self.submissions),
+                self.client_num, sorted(missing))
+            mlops.log({"event": "fa.quorum_close", "round": self.round_idx,
+                       "task": self.task,
+                       "missing": ",".join(map(str, sorted(missing)))})
         subs = sorted(self.submissions.items())
         self.submissions = {}
         state, done, result = self.aggregator.aggregate(subs, self.round_idx)
         self.round_idx += 1
+        reg.counter("fa/rounds", labels={"task": self.task}).inc()
         if done:
-            self.result = {"task": self.task, "rounds": self.round_idx, **result}
-            mlops.log({"fa_task": self.task, **{k: str(v) for k, v in result.items()}})
-            M = FAMessage
-            for cid in range(1, self.client_num + 1):
-                self.send_message(Message(
-                    M.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))
+            self.result = {"task": self.task, "rounds": self.round_idx,
+                           **result}
+            if self.sketch_spec:
+                self.result.setdefault("sketch_spec", self.sketch_spec)
+            mlops.log({"fa_task": self.task,
+                       **{k: str(v) for k, v in result.items()}})
+            self._send_finish_all()
             self.finish()
             return
         self.server_state = state
         self._broadcast_request()
+
+    def _send_finish_all(self) -> None:
+        M = FAMessage
+        for cid in range(1, self.client_num + 1):
+            self.send_message(Message(
+                M.MSG_TYPE_S2C_FINISH, self.get_sender_id(), cid))
+
+    def finish(self) -> None:
+        self._deadline.cancel()
+        super().finish()

@@ -1,0 +1,54 @@
+"""Federated analytics on the masked wire — mergeable sketch codecs.
+
+Client data never leaves as plaintext: each client folds its items into
+a linear sketch (count-min / count-sketch frequency tables, Bloom
+bitvectors, fixed-bin histograms, TrieHH vote vectors), ships it as a
+:class:`CompressedTree` under a server-negotiated codec spec, and the
+server reduces the cohort through the same fused weighted-sum /
+secagg / hierarchy / durability stack model deltas ride. Every sketch
+here is *mergeable*: merge(A, B) == sketch(items_A + items_B), so the
+fused sum IS the analytics operator.
+
+- :mod:`.sketches` — host-side numpy sketch structures + estimators
+- :mod:`.codec` — wire codecs (``cms``/``csk``/``votevec``/``bloom``/
+  ``hist``) riding the PR 3 registry
+- :mod:`.analyzers` / :mod:`.aggregators` — sketch-domain FA operators
+  behind the FSM
+- :mod:`.federation` — the one-program hierarchical sketch federation
+  over :class:`TreeRunner` (secagg masking, central DP at the root)
+"""
+from fedml_tpu.fa.sketch.codec import (
+    SKETCH_CODEC_NAMES,
+    BloomCodec,
+    CountMinCodec,
+    CountSketchCodec,
+    HistogramCodec,
+    VoteVectorCodec,
+    sketch_spec_for_task,
+)
+from fedml_tpu.fa.sketch.sketches import (
+    DEFAULT_ALPHABET,
+    BloomSketch,
+    CountMinSketch,
+    CountSketch,
+    HistogramSketch,
+    VoteVectorSketch,
+    k_percentile_from_histogram,
+)
+
+__all__ = [
+    "SKETCH_CODEC_NAMES",
+    "BloomCodec",
+    "CountMinCodec",
+    "CountSketchCodec",
+    "HistogramCodec",
+    "VoteVectorCodec",
+    "sketch_spec_for_task",
+    "DEFAULT_ALPHABET",
+    "BloomSketch",
+    "CountMinSketch",
+    "CountSketch",
+    "HistogramSketch",
+    "VoteVectorSketch",
+    "k_percentile_from_histogram",
+]

@@ -1,0 +1,362 @@
+"""Sketches as first-class compression codecs — the FA wire format.
+
+A client's sketch travels as a :class:`~fedml_tpu.compression.codecs.
+CompressedTree` under one of the tags registered here (``cms``, ``csk``,
+``votevec``, ``bloom``, ``hist``), which is what lets an analytics round
+ride the training stack unchanged: the dequant-fused weighted sum
+aggregates the integer blocks in one program, PR 6 ``PartialSum``s carry
+them between tiers, PR 9 secagg masks them (the sketch leaves are plain
+f32 counter arrays, so the masked cohort path quantizes them with the
+cohort-shared scale like any delta), PR 12 journals them at wire size
+and PR 15 screening admits them in the compressed domain.
+
+Wire form per leaf: ``[q int32, scale f32]`` with a **power-of-two
+shared scale** — ``scale = 2^(⌈log2 max|x|⌉ − 23)``. Integer counters
+(and the dyadic-rational cohort means a power-of-two fan-out produces)
+round-trip bit-exactly, which is what makes the flat == 2-tier == 3-tier
+merge identity hold through re-encodes; non-dyadic values quantize to
+the nearest 2^-k step (one part in 2^23).
+
+``check_wire`` is the hostile-geometry gate: a submission whose blocks
+disagree with the negotiated sketch spec (wrong table shape, truncated
+parts, non-dyadic or non-finite scale, counter overflow past 2^23,
+negative counters on an unsigned family) raises ``ValueError`` before
+anything aggregates it, and counts ``integrity/nonfinite_wire`` like
+every other codec rejection.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression.codecs import (
+    Codec,
+    CompressedTree,
+    _dtype_from_str,
+    _is_float_meta,
+    register_codec,
+)
+
+__all__ = [
+    "BloomCodec",
+    "CountMinCodec",
+    "CountSketchCodec",
+    "HistogramCodec",
+    "SKETCH_CODEC_NAMES",
+    "VoteVectorCodec",
+    "sketch_spec_for_task",
+]
+
+# counters must stay exactly representable in f32 through fused sums
+_COUNT_BOUND = float(1 << 23)
+
+
+def _dyadic_scale(amax):
+    """Smallest power-of-two scale that fits ``amax`` in 23 bits.
+
+    Built from the f32 exponent bits, not ``exp2(ceil(log2 x))`` — XLA
+    lowers exp2/log2 through ``exp(x·ln 2)``, whose last-ulp error would
+    break the exact-roundtrip contract the merge-identity tests pin.
+    """
+    a = jnp.maximum(amax.astype(jnp.float32), 1.0)
+    bits = jax.lax.bitcast_convert_type(a, jnp.int32)
+    e = ((bits >> 23) & 0xFF) - 127                     # floor(log2 a)
+    pow_e = jax.lax.bitcast_convert_type((e + 127) << 23, jnp.float32)
+    e = e + (a > pow_e).astype(jnp.int32)               # ceil(log2 a)
+    return jax.lax.bitcast_convert_type((e + 104) << 23,  # 2^(e-23)
+                                        jnp.float32)
+
+
+class _SketchCodec(Codec):
+    """Shared kernels for the sketch codec family.
+
+    Subclasses fix ``name``, the unsigned/signed rule and the expected
+    leaf geometry; the negotiation-header spec (``cms@1024/4``) carries
+    every parameter a peer must match for the tables to merge
+    cell-for-cell.
+    """
+
+    lossless = True   # exact on integer counters and dyadic means
+    nonneg = True     # count-sketch overrides: its counters are signed
+
+    def _expected_shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    # -- traceable kernels -------------------------------------------------
+    def encode_leaf(self, x, key):
+        xf = x.astype(jnp.float32)
+        scale = _dyadic_scale(jnp.max(jnp.abs(xf)))
+        q = jnp.round(xf / scale).astype(jnp.int32)
+        return [q, scale]
+
+    def decode_leaf(self, parts, dt, shape):
+        q, scale = parts
+        return (q.astype(jnp.float32) * scale).astype(_dtype_from_str(dt))
+
+    def weighted_sum_leaf(self, stacked, w, dt, shape):
+        # dequant fused into the reduction, int8-style: (w_i · s_i)
+        # folds the shared scale and the aggregation weight so the int32
+        # counter blocks reduce in one einsum
+        q, scale = stacked
+        return jnp.einsum(
+            "c,c...->...", w * scale, q.astype(jnp.float32)
+        ).astype(_dtype_from_str(dt))
+
+    # -- hostile-wire gate -------------------------------------------------
+    def check_wire(self, ct: "CompressedTree") -> None:
+        expected = self._expected_shape()
+        if len(ct.arrays) != len(ct.meta):
+            raise ValueError(
+                f"{self.name} wire: {len(ct.arrays)} leaf blocks for "
+                f"{len(ct.meta)} metadata entries — truncated payload")
+        for parts, (dt, sh) in zip(ct.arrays, ct.meta):
+            if not _is_float_meta(dt):
+                continue
+            if tuple(sh) != expected:
+                raise ValueError(
+                    f"{self.name} wire: leaf shape {tuple(sh)} does not "
+                    f"match the negotiated sketch spec {self.spec!r} "
+                    f"(expected {expected}) — refusing to merge a "
+                    "foreign-geometry sketch")
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{self.name} wire: {len(parts)} block parts per leaf "
+                    "(expected q + scale) — truncated payload")
+            q, scale = parts
+            q_host = isinstance(q, (np.ndarray, np.generic))
+            if q_host and tuple(q.shape) != expected:
+                raise ValueError(
+                    f"{self.name} wire: counter block shape "
+                    f"{tuple(q.shape)} != {expected}")
+            if q_host and str(q.dtype) != "int32":
+                raise ValueError(
+                    f"{self.name} wire: counter block dtype {q.dtype} "
+                    "(expected int32)")
+            if isinstance(scale, (np.ndarray, np.generic, float)):
+                s = np.asarray(scale, np.float64)
+                if not np.all(np.isfinite(s)):
+                    self._reject_nonfinite_wire("scale")
+                if s.size != 1 or float(s) <= 0.0 or (
+                        np.frexp(float(s))[0] != 0.5):
+                    raise ValueError(
+                        f"{self.name} wire: scale {float(s):g} is not a "
+                        "positive power of two — sketch counters must "
+                        "ride the dyadic grid")
+            if q_host:
+                if np.abs(q, dtype=np.int64).max(initial=0) > _COUNT_BOUND:
+                    raise ValueError(
+                        f"{self.name} wire: counter magnitude exceeds "
+                        f"2^23 — not exactly representable in f32 sums")
+                if self.nonneg and q.min(initial=0) < 0:
+                    raise ValueError(
+                        f"{self.name} wire: negative counters in an "
+                        "unsigned sketch family")
+
+    def _resolve_wire(self, ct: "CompressedTree") -> "Codec":
+        # tag-only callers (fused sums, screening) hold the default-
+        # parameter instance; the wire's own leaf shape says which
+        # geometry framed it — recover it so check_wire checks the
+        # payload against ITS claimed geometry, not the default's
+        for dt, sh in ct.meta:
+            if _is_float_meta(dt):
+                eff = self._from_wire_shape(tuple(sh))
+                if eff is not None:
+                    return eff
+                break
+        return self
+
+    def _from_wire_shape(self, shape) -> Optional["Codec"]:
+        return None
+
+
+class _TableCodec(_SketchCodec):
+    """(depth, width) counter-table families: cms / csk / votevec."""
+
+    DEFAULT_WIDTH = 1024
+    DEFAULT_DEPTH = 4
+    _width_arg = "fa_sketch_width"
+    _depth_arg = "fa_sketch_depth"
+
+    def __init__(self, width: int = 0, depth: int = 0):
+        self.width = int(width) or self.DEFAULT_WIDTH
+        self.depth = int(depth) or self.DEFAULT_DEPTH
+        if self.width < 2 or self.depth < 1:
+            raise ValueError(
+                f"bad {self.name} geometry width={width} depth={depth}")
+
+    @property
+    def spec(self) -> str:
+        return f"{self.name}@{self.width}/{self.depth}"
+
+    @classmethod
+    def parse_param(cls, param: str) -> Tuple[int, int]:
+        try:
+            w, _, d = param.partition("/")
+            return int(w), int(d or cls.DEFAULT_DEPTH)
+        except ValueError:
+            raise ValueError(
+                f"malformed {cls.name} spec parameter {param!r} "
+                "(want width/depth)") from None
+
+    @classmethod
+    def default_param(cls, args: Any = None) -> Tuple[int, int]:
+        g = lambda k, d: int(getattr(args, k, d) or d) if args is not None \
+            else d
+        return g(cls._width_arg, cls.DEFAULT_WIDTH), \
+            g(cls._depth_arg, cls.DEFAULT_DEPTH)
+
+    def _expected_shape(self) -> Tuple[int, ...]:
+        return (self.depth, self.width)
+
+    def _from_wire_shape(self, shape):
+        if len(shape) == 2 and shape != (self.depth, self.width):
+            return type(self)(shape[1], shape[0])
+        return None
+
+
+@register_codec
+class CountMinCodec(_TableCodec):
+    name = "cms"
+
+
+@register_codec
+class CountSketchCodec(_TableCodec):
+    name = "csk"
+    nonneg = False  # signed counters by construction
+
+
+@register_codec
+class VoteVectorCodec(_TableCodec):
+    name = "votevec"
+    DEFAULT_WIDTH = 2048
+    DEFAULT_DEPTH = 3
+    _width_arg = "fa_vote_width"
+    _depth_arg = "fa_vote_depth"
+
+
+@register_codec
+class BloomCodec(_SketchCodec):
+    name = "bloom"
+    DEFAULT_BITS = 4096
+    DEFAULT_HASHES = 4
+
+    def __init__(self, bits: int = 0, hashes: int = 0):
+        self.bits = int(bits) or self.DEFAULT_BITS
+        self.hashes = int(hashes) or self.DEFAULT_HASHES
+        if self.bits < 8 or not (1 <= self.hashes <= 16):
+            raise ValueError(
+                f"bad bloom geometry bits={bits} hashes={hashes}")
+
+    @property
+    def spec(self) -> str:
+        return f"{self.name}@{self.bits}/{self.hashes}"
+
+    @classmethod
+    def parse_param(cls, param: str) -> Tuple[int, int]:
+        try:
+            b, _, h = param.partition("/")
+            return int(b), int(h or cls.DEFAULT_HASHES)
+        except ValueError:
+            raise ValueError(
+                f"malformed bloom spec parameter {param!r} "
+                "(want bits/hashes)") from None
+
+    @classmethod
+    def default_param(cls, args: Any = None) -> Tuple[int, int]:
+        g = lambda k, d: int(getattr(args, k, d) or d) if args is not None \
+            else d
+        return g("fa_bloom_bits", cls.DEFAULT_BITS), \
+            g("fa_bloom_hashes", cls.DEFAULT_HASHES)
+
+    def _expected_shape(self) -> Tuple[int, ...]:
+        return (self.bits,)
+
+    def _from_wire_shape(self, shape):
+        if len(shape) == 1 and shape != (self.bits,):
+            return type(self)(shape[0], self.hashes)
+        return None
+
+
+@register_codec
+class HistogramCodec(_SketchCodec):
+    name = "hist"
+    DEFAULT_BINS = 64
+    DEFAULT_LO = 0.0
+    DEFAULT_HI = 100.0
+
+    def __init__(self, bins: int = 0, lo: float = DEFAULT_LO,
+                 hi: float = DEFAULT_HI):
+        self.bins = int(bins) or self.DEFAULT_BINS
+        self.lo = float(lo)
+        self.hi = float(hi)
+        if self.bins < 1 or not (self.hi > self.lo):
+            raise ValueError(
+                f"bad histogram geometry bins={bins} lo={lo} hi={hi}")
+
+    @property
+    def spec(self) -> str:
+        return f"{self.name}@{self.bins}/{self.lo:g}/{self.hi:g}"
+
+    @classmethod
+    def parse_param(cls, param: str) -> Tuple[int, float, float]:
+        try:
+            fields = param.split("/")
+            bins = int(fields[0])
+            lo = float(fields[1]) if len(fields) > 1 else cls.DEFAULT_LO
+            hi = float(fields[2]) if len(fields) > 2 else cls.DEFAULT_HI
+            return bins, lo, hi
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"malformed hist spec parameter {param!r} "
+                "(want bins/lo/hi)") from None
+
+    @classmethod
+    def default_param(cls, args: Any = None) -> Tuple[int, float, float]:
+        if args is None:
+            return cls.DEFAULT_BINS, cls.DEFAULT_LO, cls.DEFAULT_HI
+        bins = int(getattr(args, "fa_hist_bins", cls.DEFAULT_BINS)
+                   or cls.DEFAULT_BINS)
+        lo = float(getattr(args, "fa_hist_lo", cls.DEFAULT_LO))
+        hi = float(getattr(args, "fa_hist_hi", cls.DEFAULT_HI))
+        return bins, lo, hi
+
+    def _expected_shape(self) -> Tuple[int, ...]:
+        return (self.bins,)
+
+    def _from_wire_shape(self, shape):
+        if len(shape) == 1 and shape != (self.bins,):
+            return type(self)(shape[0], self.lo, self.hi)
+        return None
+
+
+SKETCH_CODEC_NAMES = (CountMinCodec.name, CountSketchCodec.name,
+                      VoteVectorCodec.name, BloomCodec.name,
+                      HistogramCodec.name)
+
+# which sketch family answers which FA task (the round-config header
+# advertises the full spec; this picks the default family per task)
+_TASK_FAMILY = {
+    "frequency_estimation": CountMinCodec.name,
+    "heavy_hitter_triehh": VoteVectorCodec.name,
+    "union": BloomCodec.name,
+    "intersection": BloomCodec.name,
+    "cardinality": BloomCodec.name,
+    "histogram": HistogramCodec.name,
+    "k_percentile_element": HistogramCodec.name,
+}
+
+
+def sketch_spec_for_task(task: str, args: Any = None) -> Optional[str]:
+    """The negotiation-header sketch spec for an FA task (None when the
+    task has no sketch form — ``avg`` stays a scalar pair)."""
+    from fedml_tpu.compression.codecs import _CODEC_CLASSES
+
+    family = _TASK_FAMILY.get((task or "").strip().lower())
+    if family is None:
+        return None
+    cls = _CODEC_CLASSES[family]
+    params = cls.default_param(args)
+    return cls(*params).spec

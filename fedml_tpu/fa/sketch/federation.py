@@ -1,0 +1,300 @@
+"""Hierarchical sketch federation: 100k+ virtual clients, one program.
+
+This is the FA engine's scale path. Where the FSM in
+:mod:`fedml_tpu.fa` runs a real message-passing round over tens of
+clients, ``run_sketch_federation`` drives a TrieHH-style heavy-hitter
+vote federation over the :class:`TreeRunner` aggregation tree: every
+virtual client folds its (seeded, synthetic) word stream into a
+vote-vector sketch INSIDE the leaf chunk program — the per-client
+table is an XLA temporary, never a host array (see
+:func:`last_sketch_trace`) — and the cohort reduces through the same
+fused / secagg / durability stack model deltas ride. Under secagg the
+edge only ever sees the masked cohort sum; with ``dp_sigma`` the root
+adds seeded Gaussian noise in-program before the global lands
+(:func:`fedml_tpu.hierarchy.runner.last_dp_trace` is the proof probe).
+
+The in-program hash twin reproduces the host family
+(:func:`fedml_tpu.fa.sketch.sketches.hash_bucket`) bit-for-bit:
+``uint32`` multiply-add wraps mod 2^32 by construction, so jax-side
+item streams and the host-side plaintext reference land in identical
+cells — which is what makes the federated heavy-hitter set comparable
+against :func:`reference_sketch_counts` on the same seeded data.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.compression.codecs import derive_key_data_batch, get_codec
+from fedml_tpu.fa.sketch.sketches import hash_bucket, hash_family
+from fedml_tpu.hierarchy.runner import TreeRunner
+from fedml_tpu.hierarchy.tree import TreeTopology
+
+__all__ = [
+    "jax_hash_bucket",
+    "last_sketch_trace",
+    "make_vote_delta_fn",
+    "make_word_stream",
+    "reference_sketch_counts",
+    "run_sketch_federation",
+    "zcdp_epsilon",
+]
+
+# PR 9 proof-probe pattern: set inside the traced delta_fn — True means
+# the per-client sketch only ever existed as a tracer inside the leaf
+# chunk program (no host-side per-client plaintext sketch to leak)
+_SKETCH_TRACE: Dict[str, Any] = {"client_sketch_traced": None}
+
+
+def last_sketch_trace() -> Dict[str, Any]:
+    return dict(_SKETCH_TRACE)
+
+
+def zcdp_epsilon(sigma: float, sensitivity: float, rounds: int = 1,
+                 delta: float = 1e-6) -> float:
+    """(ε, δ)-DP spent by ``rounds`` Gaussian releases at noise std
+    ``sigma`` and per-client L2 sensitivity ``sensitivity``, accounted
+    through zCDP: each release costs ρ = (s/σ)²/2, composition adds,
+    and ρ-zCDP converts to ε = ρ + 2·sqrt(ρ·ln(1/δ))."""
+    if sigma <= 0:
+        return float("inf")
+    rho = float(rounds) * (float(sensitivity) / float(sigma)) ** 2 / 2.0
+    return rho + 2.0 * math.sqrt(rho * math.log(1.0 / float(delta)))
+
+
+def jax_hash_bucket(x_u32: jnp.ndarray, a: int, b: int,
+                    width: int) -> jnp.ndarray:
+    """In-program twin of :func:`hash_bucket` — ``uint32`` multiply-add
+    wraps mod 2^32 natively, so no 64-bit arithmetic is needed."""
+    xa = x_u32.astype(jnp.uint32) * jnp.uint32(int(a) & 0xFFFFFFFF) \
+        + jnp.uint32(int(b) & 0xFFFFFFFF)
+    return (xa % jnp.uint32(int(width))).astype(jnp.int32)
+
+
+def make_word_stream(vocab: int, n_hot: int, p_hot: float,
+                     words_per_client: int):
+    """Traceable seeded item generator: ``key -> [words] uint32 ids``.
+
+    A two-tier popularity model — with probability ``p_hot`` a word is
+    drawn from the hot head ``[0, n_hot)``, else uniformly from the
+    whole vocabulary — so the ground-truth heavy-hitter set is the hot
+    head, discoverable but not baked in."""
+    vocab, n_hot = int(vocab), int(n_hot)
+    words = int(words_per_client)
+    p = float(p_hot)
+
+    def gen_ids(key):
+        ku = jax.random.fold_in(key, 11)
+        kh = jax.random.fold_in(key, 12)
+        kc = jax.random.fold_in(key, 13)
+        u = jax.random.uniform(ku, (words,))
+        hot = jax.random.randint(kh, (words,), 0, n_hot)
+        cold = jax.random.randint(kc, (words,), 0, vocab)
+        return jnp.where(u < p, hot, cold).astype(jnp.uint32)
+
+    return gen_ids
+
+
+def make_vote_delta_fn(width: int, depth: int, hash_seed: int, salt: str,
+                       gen_ids) -> Any:
+    """Build the leaf delta_fn: items → scatter-add vote table, traced.
+
+    The returned callable satisfies the :class:`TreeRunner` contract
+    (``key -> flat leaf tuple`` over a ``{"table": (depth, width) f32}``
+    template) and runs entirely inside the leaf chunk program."""
+    a_rows, b_rows, _, _ = hash_family(int(hash_seed), int(depth), salt)
+    width = int(width)
+
+    def delta_fn(key):
+        ids = gen_ids(key)
+        rows = []
+        for r in range(len(a_rows)):
+            idx = jax_hash_bucket(ids, int(a_rows[r]), int(b_rows[r]),
+                                  width)
+            rows.append(jnp.zeros((width,), jnp.float32).at[idx].add(1.0))
+        table = jnp.stack(rows)
+        _SKETCH_TRACE["client_sketch_traced"] = isinstance(
+            table, jax.core.Tracer)
+        return (table,)
+
+    return delta_fn
+
+
+def reference_sketch_counts(seed: int, round_idx: int,
+                            client_ids: Sequence[int], gen_ids,
+                            vocab: int, chunk: int = 8192) -> np.ndarray:
+    """Ground-truth per-word counts over ``client_ids``' seeded streams.
+
+    Replays the EXACT leaf-program key chain (``derive_key_data_batch``
+    then ``fold_in(key, 1)`` — see ``_leaf_chunk_program``) so the
+    plaintext reference sees byte-identical item streams to the
+    federated clients."""
+    gen_batch = jax.jit(jax.vmap(
+        lambda kd: gen_ids(jax.random.fold_in(
+            jax.random.wrap_key_data(kd), 1))))
+    counts = np.zeros(int(vocab), np.int64)
+    cids = np.asarray(sorted(int(c) for c in client_ids), np.int64)
+    for lo in range(0, len(cids), int(chunk)):
+        batch = cids[lo:lo + int(chunk)]
+        kd = derive_key_data_batch(int(seed), int(round_idx), batch)
+        ids = np.asarray(gen_batch(kd))
+        counts += np.bincount(ids.ravel(), minlength=int(vocab))
+    return counts
+
+
+def _sketch_table_from_counts(counts: np.ndarray, a_rows, b_rows,
+                              width: int, depth: int) -> np.ndarray:
+    """The sketch a single global client holding ALL items would build —
+    scatter the exact per-word counts through the same hash rows."""
+    vocab = len(counts)
+    ids = np.arange(vocab, dtype=np.uint64)
+    table = np.zeros((int(depth), int(width)), np.int64)
+    for r in range(int(depth)):
+        idx = hash_bucket(ids, int(a_rows[r]), int(b_rows[r]), int(width))
+        np.add.at(table[r], idx, counts)
+    return table
+
+
+def _read_min_rows(table: np.ndarray, a_rows, b_rows,
+                   width: int, vocab: int) -> np.ndarray:
+    """Point-query every vocab id: min over rows (count-min read)."""
+    ids = np.arange(int(vocab), dtype=np.uint64)
+    est = None
+    for r in range(table.shape[0]):
+        idx = hash_bucket(ids, int(a_rows[r]), int(b_rows[r]), int(width))
+        row = table[r][idx]
+        est = row if est is None else np.minimum(est, row)
+    return est
+
+
+def run_sketch_federation(
+    n_clients: int = 4096,
+    tiers: int = 3,
+    codec: str = "votevec@4096/3",
+    seed: int = 0,
+    vocab: int = 512,
+    n_hot: int = 12,
+    p_hot: float = 0.5,
+    words_per_client: int = 32,
+    hh_threshold_frac: float = 0.02,
+    levels: Optional[Sequence[int]] = None,
+    quorum: float = 1.0,
+    chunk: int = 2048,
+    secagg: bool = False,
+    secagg_mod_bits: int = 16,
+    dp_sigma: float = 0.0,
+    dp_delta: float = 1e-6,
+    chaos: Optional[Sequence[Any]] = None,
+    durability_dir: Optional[str] = None,
+    reference_client_ids: Optional[Sequence[int]] = None,
+) -> Dict[str, Any]:
+    """One-shot heavy-hitter federation over the aggregation tree.
+
+    Returns the federated heavy-hitter set next to the plaintext
+    reference computed on the same seeded data, plus the runner's full
+    scenario stats (digest, rounds/s, per-tier bytes). ``chaos`` takes
+    the runner's kill windows; under ``secagg`` the per-client clip is
+    pinned to the cohort quant bound so integer votes survive the
+    mask/unmask round-trip exactly. ``reference_client_ids`` overrides
+    the roster the plaintext reference replays (defaults to every
+    client — pass the surviving set when chaos kills leaves).
+    """
+    from fedml_tpu import telemetry
+
+    c = get_codec(str(codec))
+    width = int(getattr(c, "width"))
+    depth = int(getattr(c, "depth"))
+    salt = {"votevec": "votevec", "cms": "cms"}.get(c.name)
+    if salt is None:
+        raise ValueError(
+            f"sketch federation needs an unsigned table codec "
+            f"(cms/votevec), got {c.spec!r}")
+    gen_ids = make_word_stream(vocab, n_hot, p_hot, words_per_client)
+    delta_fn = make_vote_delta_fn(width, depth, seed, salt, gen_ids)
+    template = {"table": np.zeros((depth, width), np.float32)}
+
+    topo = TreeTopology(tuple(int(x) for x in levels)) if levels \
+        else TreeTopology.build(int(n_clients), int(tiers))
+    kw: Dict[str, Any] = {}
+    if secagg:
+        from fedml_tpu.privacy.secagg import masking
+
+        # uniform power-of-two rosters keep one shared bound; clip ==
+        # bound makes the shared quant scale exactly 1.0, so integer
+        # votes pass through floor(q + u) unchanged — masked == plain
+        cohort_n = max(
+            len(topo.children(topo.leaf_tier - 1, e))
+            for e in range(topo.levels[topo.leaf_tier - 1]))
+        kw.update(secagg=True, secagg_mod_bits=int(secagg_mod_bits),
+                  secagg_clip=float(masking.client_bound(
+                      cohort_n, int(secagg_mod_bits))))
+    runner = TreeRunner(
+        topo, template=template, codec=c.spec, seed=int(seed),
+        quorum=float(quorum), chunk=int(chunk), delta_fn=delta_fn,
+        server_lr=1.0, chaos=chaos, durability_dir=durability_dir,
+        dp_sigma=float(dp_sigma), **kw)
+    stats = runner.run(1)
+
+    total_w = float(runner.last_root_weight)
+    sum_table = np.rint(
+        np.asarray(runner.global_leaves[0], np.float64) * total_w
+    ).astype(np.int64)
+
+    a_rows, b_rows, _, _ = hash_family(int(seed), depth, salt)
+    total_words = total_w * float(words_per_client)
+    threshold = max(1, int(math.ceil(float(hh_threshold_frac)
+                                     * total_words)))
+    est = _read_min_rows(sum_table, a_rows, b_rows, width, vocab)
+    fed_hh = sorted(int(i) for i in np.nonzero(est >= threshold)[0])
+
+    ref_ids = reference_client_ids if reference_client_ids is not None \
+        else range(topo.n_clients)
+    true_counts = reference_sketch_counts(seed, 0, ref_ids, gen_ids, vocab)
+    ref_table = _sketch_table_from_counts(true_counts, a_rows, b_rows,
+                                          width, depth)
+    ref_est = _read_min_rows(ref_table, a_rows, b_rows, width, vocab)
+    ref_hh = sorted(int(i) for i in np.nonzero(ref_est >= threshold)[0])
+
+    inter = len(set(fed_hh) & set(ref_hh))
+    recall = inter / max(1, len(ref_hh))
+    precision = inter / max(1, len(fed_hh))
+
+    # L2 sensitivity of one client's vote table: ≤ words · sqrt(depth)
+    # (each word lands in `depth` cells, worst case all words one cell)
+    sensitivity = float(words_per_client) * math.sqrt(float(depth))
+    epsilon = zcdp_epsilon(dp_sigma, sensitivity, rounds=1,
+                           delta=dp_delta) if dp_sigma > 0 else 0.0
+    reg = telemetry.get_registry()
+    reg.counter("fa/rounds",
+                labels={"task": "heavy_hitter_federation"}).inc()
+    if dp_sigma > 0:
+        reg.gauge("fa/dp_epsilon").set(epsilon)
+    reg.gauge("fa/hh_recall").set(recall)
+
+    plain_sketch_bytes = 4 * depth * width  # int32 table, uncompressed
+    return {
+        "task": "heavy_hitter_federation",
+        "spec": c.spec,
+        "clients": topo.n_clients,
+        "levels": list(topo.levels),
+        "secagg": bool(secagg),
+        "dp_sigma": float(dp_sigma),
+        "dp_epsilon": epsilon,
+        "threshold": threshold,
+        "heavy_hitters": fed_hh,
+        "ref_heavy_hitters": ref_hh,
+        "hh_recall": recall,
+        "hh_precision": precision,
+        "root_total_weight": total_w,
+        "per_client_wire_bytes": int(runner.per_client_wire_nbytes),
+        "plain_sketch_bytes": plain_sketch_bytes,
+        "wire_overhead": runner.per_client_wire_nbytes
+        / float(plain_sketch_bytes),
+        "rounds_per_s": stats["rounds_per_s"],
+        "final_digest": stats["final_digest"],
+        "stats": stats,
+    }

@@ -42,11 +42,26 @@ logger = logging.getLogger(__name__)
 Pytree = Any
 
 __all__ = ["EdgeKillWindow", "KillWindow", "TreeRunner",
-           "default_template"]
+           "default_template", "last_dp_trace"]
 
 # key-space offset for tier-aggregator encode keys, so edge re-encode
 # streams can never collide with leaf-client upload streams
 _EDGE_KEY_BASE = 0x40000000
+# key id for the root's central-DP noise draw — its own stream, disjoint
+# from client and edge encode keys
+_DP_KEY_ID = 0x60000000
+
+# proof probe for the central-DP seam (PR 9 pattern): the root mean must
+# be a tracer when the noise lands — i.e. noise is added INSIDE the one
+# jitted root-update program, never to a host-materialized pre-noise
+# aggregate something could log or checkpoint
+_DP_TRACE: Dict[str, Any] = {"pre_noise_traced": None,
+                             "noised_in_program": None}
+
+
+def last_dp_trace() -> Dict[str, Any]:
+    """Snapshot of the central-DP in-program proof probe."""
+    return dict(_DP_TRACE)
 
 
 class KillWindow:
@@ -133,6 +148,7 @@ class TreeRunner:
                  secagg: bool = False,
                  secagg_clip: float = 0.1,
                  secagg_mod_bits: int = 8,
+                 dp_sigma: float = 0.0,
                  durability_dir: Optional[str] = None,
                  agg_robust: Optional[str] = None,
                  screen: bool = False):
@@ -188,6 +204,13 @@ class TreeRunner:
                 "EdgeKillWindow chaos needs durability_dir — a crashed "
                 "edge can only restart from its write-ahead journal")
         self.server_lr = float(server_lr)
+        # central DP at the root: Gaussian noise with std ``dp_sigma``
+        # on the global SUM (so ``dp_sigma / total_weight`` on the mean),
+        # drawn from its own seeded stream INSIDE the jitted root-update
+        # program — the pre-noise aggregate is never a host array
+        self.dp_sigma = float(dp_sigma)
+        self._dp_update_fn = None
+        self.last_root_weight = 0.0
         template = default_template() if template is None else template
         leaves, self._treedef = jax.tree.flatten(template)
         self.global_leaves = [np.array(x) for x in leaves]
@@ -571,6 +594,8 @@ class TreeRunner:
             "codec": self.codec.spec,
             "agg_robust": self.agg_robust,
             "secagg": self.secagg,
+            "dp_sigma": self.dp_sigma,
+            "root_total_weight": self.last_root_weight,
             "seed": self.seed,
             "quorum": self.quorum,
             "wall_s": wall,
@@ -611,14 +636,20 @@ class TreeRunner:
             if self._root_close is None:  # pragma: no cover - defensive
                 raise RuntimeError(f"round {r} never reached the root")
             self._health.finish_round(r)  # edge straggler/EWMA scoring
-            mean, _ = self._root_close
-            new_global = tree_undelta(
-                jax.tree.unflatten(self._treedef, [
-                    jnp.asarray(x) for x in self.global_leaves]),
-                jax.tree.map(
-                    lambda m: jnp.float32(self.server_lr) * m, mean))
-            self.global_leaves = [
-                np.array(x) for x in jax.tree.leaves(new_global)]
+            mean, total_w = self._root_close
+            self.last_root_weight = float(total_w)
+            if self.dp_sigma > 0.0:
+                self.global_leaves = [
+                    np.array(x)
+                    for x in self._dp_root_update(r, mean, total_w)]
+            else:
+                new_global = tree_undelta(
+                    jax.tree.unflatten(self._treedef, [
+                        jnp.asarray(x) for x in self.global_leaves]),
+                    jax.tree.map(
+                        lambda m: jnp.float32(self.server_lr) * m, mean))
+                self.global_leaves = [
+                    np.array(x) for x in jax.tree.leaves(new_global)]
             if self.on_round is not None:
                 try:
                     self.on_round(r, self.global_params)
@@ -633,6 +664,36 @@ class TreeRunner:
             for d, b in self._tier_round_bytes.items():
                 peak_round_bytes[d] = max(peak_round_bytes.get(d, 0), b)
             get_trace_controller().on_round_end(r)
+
+    def _dp_root_update(self, round_idx: int, mean: Pytree, total_w):
+        """Noise + apply the root mean in ONE jitted program.
+
+        The central-DP contract: the only post-aggregation value that
+        ever lands on the host is the *noised* global — the probe
+        records that the pre-noise mean was still a tracer when the
+        Gaussian draw was added (see :func:`last_dp_trace`)."""
+        sigma = jnp.float32(self.dp_sigma)
+        lr = jnp.float32(self.server_lr)
+        if self._dp_update_fn is None:
+
+            def upd(glob, means, w, key):
+                out = []
+                for i, (g, m) in enumerate(zip(glob, means)):
+                    _DP_TRACE["pre_noise_traced"] = isinstance(
+                        m, jax.core.Tracer)
+                    noise = sigma * jax.random.normal(
+                        jax.random.fold_in(key, i), m.shape, jnp.float32)
+                    out.append(g + lr * (m + noise / w))
+                _DP_TRACE["noised_in_program"] = bool(
+                    _DP_TRACE["pre_noise_traced"])
+                return tuple(out)
+
+            self._dp_update_fn = jax.jit(upd)
+        key = derive_key(self.seed, round_idx, _DP_KEY_ID)
+        return self._dp_update_fn(
+            tuple(jnp.asarray(x) for x in self.global_leaves),
+            tuple(jnp.asarray(x) for x in jax.tree.leaves(mean)),
+            jnp.float32(total_w), key)
 
     @property
     def global_params(self) -> Pytree:

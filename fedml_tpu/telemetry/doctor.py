@@ -785,6 +785,71 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
             "(update-integrity containment was off, or nothing was "
             "corrupt)")
 
+    # -- federated analytics (fa/* sketch-round metrics) -------------------
+    # fa/rounds, quorum/deadline/stale/abort counters, screened
+    # contributors, and the privacy readings (DP epsilon, HH recall) —
+    # task identities ride the `task` label, tiers ride the tier section
+    latest_fa: Dict[Any, float] = {}
+    for rec in metric_records:
+        name = rec.get("name", "")
+        if name.startswith("fa/"):
+            labels = tuple(sorted((rec.get("labels") or {}).items()))
+            latest_fa[(name, labels)] = float(
+                rec.get("value", rec.get("count", 0)) or 0)
+    fa_counters: Dict[str, float] = {}
+    fa_rounds_by_task: Dict[str, float] = {}
+    for (name, labels), val in latest_fa.items():
+        key = name.split("/", 1)[1]
+        fa_counters[key] = fa_counters.get(key, 0.0) + val
+        if key == "rounds":
+            task = dict(labels).get("task", "?")
+            fa_rounds_by_task[task] = fa_rounds_by_task.get(task, 0.0) + val
+    analytics: Dict[str, Any] = {"counters": fa_counters,
+                                 "rounds_by_task": fa_rounds_by_task}
+    if fa_counters:
+        if fa_rounds_by_task:
+            per_task = ", ".join(f"{t}: {v:.0f}" for t, v in
+                                 sorted(fa_rounds_by_task.items()))
+            verdict.append(
+                f"federated analytics ran {fa_counters.get('rounds', 0):.0f} "
+                f"sketch round(s) ({per_task})")
+        if fa_counters.get("screened"):
+            verdict.append(
+                f"{fa_counters['screened']:.0f} analytics contribution(s) "
+                "screened out before the merge — hostile or corrupt "
+                "sketches never touched the aggregate")
+        if fa_counters.get("stale_submissions"):
+            verdict.append(
+                f"{fa_counters['stale_submissions']:.0f} stale analytics "
+                "submission(s) dropped (stragglers answering an "
+                "already-closed round; nothing aggregated twice)")
+        if fa_counters.get("quorum_rounds"):
+            verdict.append(
+                f"{fa_counters['quorum_rounds']:.0f} analytics round(s) "
+                "closed on quorum after the deadline — the missing "
+                "clients were named in the log and dropped")
+        if fa_counters.get("aborts"):
+            verdict.append(
+                f"{fa_counters['aborts']:.0f} analytics round(s) ABORTED "
+                "below quorum after exhausting deadline extensions — "
+                "the task failed loudly rather than publish a "
+                "partial answer")
+        if fa_counters.get("dp_epsilon"):
+            verdict.append(
+                f"analytics answers carry central DP: accounted epsilon "
+                f"{fa_counters['dp_epsilon']:.2f} (zCDP conversion; see "
+                "fa/dp_epsilon)")
+        if "hh_recall" in fa_counters and fa_counters["hh_recall"] < 0.95:
+            verdict.append(
+                f"heavy-hitter recall {fa_counters['hh_recall']:.2f} vs "
+                "the plaintext reference — widen the vote table or lower "
+                "the threshold")
+    else:
+        notes.setdefault(
+            "analytics",
+            "no data: no fa/* metrics (no federated-analytics rounds in "
+            "this run)")
+
     # -- performance attribution (program catalog + roofline) -------------
     # three verdicts the multichip plan and perf triage read directly:
     # the top peak-HBM consumer (ROADMAP item 1's direct input), treedef
@@ -1001,6 +1066,7 @@ def build_doctor(run_dir, straggler_threshold: float = 2.0,
         "tiers": tiers,
         "secagg": secagg,
         "integrity": integrity,
+        "analytics": analytics,
         "profile": profile,
         "live": live,
         "tracepath": tracepath,
@@ -1184,6 +1250,18 @@ def format_doctor(d: Dict) -> str:
             add(f"  rollback: round {rb.get('round')} ({rb.get('reason')})")
     else:
         add(f"  {notes.get('integrity', 'no data')}")
+
+    add("")
+    add("federated analytics (sketch rounds / quorum / privacy):")
+    fa = d.get("analytics") or {}
+    fa_counters = fa.get("counters") or {}
+    if fa_counters:
+        for name, v in sorted(fa_counters.items()):
+            add(f"  fa/{name:<40s}{v:>14.2f}")
+        for task, v in sorted((fa.get("rounds_by_task") or {}).items()):
+            add(f"  task {task}: {v:.0f} round(s)")
+    else:
+        add(f"  {notes.get('analytics', 'no data')}")
 
     add("")
     add("serving (live endpoint freshness / SLO):")

@@ -1,0 +1,595 @@
+"""Federated analytics on the masked wire (ISSUE 20 acceptance).
+
+- sketch algebra: merge == bulk add, flat == 2-tier == 3-tier
+  bit-identity over power-of-two fan-outs, CMS ε·N overestimate bound;
+- wire: integer-exact dyadic roundtrip, fused cohort merge == host sum,
+  hostile wire (truncation, spoofed geometry, non-dyadic scale, sign
+  violations) refused with a loud ValueError;
+- FSM: sketch specs negotiated on the round-config header, quorum/
+  deadline round close with missing clients named, stale submissions
+  counted and dropped, below-quorum abort raising loudly;
+- privacy: secagg masked == unmasked bit-identical sketch sums, the
+  per-client sketch only ever a tracer inside the leaf program, central
+  DP noised in-program with finite accounted epsilon;
+- scale: the chaos-torn hierarchical heavy-hitter federation recovers
+  via quorum + journal restart, matches the plaintext reference sketch
+  on the same seeded data, and reproduces digest-identically.
+"""
+import types
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments_from_dict
+from fedml_tpu.compression import fused_weighted_sum, get_codec
+from fedml_tpu.fa.run_inproc import run_fa_inproc
+from fedml_tpu.fa.sketch.federation import (
+    jax_hash_bucket,
+    last_sketch_trace,
+    run_sketch_federation,
+    zcdp_epsilon,
+)
+from fedml_tpu.fa.sketch.sketches import (
+    BloomSketch,
+    CountMinSketch,
+    CountSketch,
+    HistogramSketch,
+    VoteVectorSketch,
+    hash_bucket,
+    hash_family,
+    item_to_u32,
+    k_percentile_from_histogram,
+)
+from fedml_tpu.hierarchy.runner import (
+    EdgeKillWindow,
+    KillWindow,
+    last_dp_trace,
+)
+from fedml_tpu.hierarchy.tree import TreeTopology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    from fedml_tpu import telemetry
+    from fedml_tpu.telemetry.health import reset_health_log
+
+    telemetry.reset_tracer()
+    telemetry.reset_registry()
+    reset_health_log()
+    yield
+    telemetry.reset_tracer()
+    telemetry.reset_registry()
+    reset_health_log()
+
+
+def _counter(name):
+    from fedml_tpu import telemetry
+
+    return sum(m.get("value", 0)
+               for m in telemetry.get_registry().snapshot()
+               if m["name"] == name)
+
+
+def ns(**kw):
+    a = types.SimpleNamespace(random_seed=7, rank=0)
+    for k, v in kw.items():
+        setattr(a, k, v)
+    return a
+
+
+# -- hashing ----------------------------------------------------------------
+def test_hash_parity_numpy_vs_jax():
+    import jax.numpy as jnp
+
+    a_rows, b_rows, _, _ = hash_family(13, 4, "votevec")
+    x = np.random.default_rng(0).integers(0, 2 ** 32, 4096, dtype=np.uint64)
+    for r in range(4):
+        host = hash_bucket(x, int(a_rows[r]), int(b_rows[r]), 1024)
+        dev = np.asarray(jax_hash_bucket(
+            jnp.asarray(x.astype(np.uint32)), int(a_rows[r]),
+            int(b_rows[r]), 1024))
+        np.testing.assert_array_equal(host, dev)
+
+
+def test_item_to_u32_stability():
+    assert item_to_u32(5) == 5
+    assert item_to_u32(2 ** 32 + 5) == 5
+    assert item_to_u32("apple") == item_to_u32("apple")
+    assert item_to_u32("apple") != item_to_u32("apples")
+
+
+# -- sketch algebra ---------------------------------------------------------
+def test_cms_overestimate_bound():
+    """Count-min never underestimates; overestimate ≤ ε·N holds with
+    probability ≥ 1−δ per query (δ = e^-depth), so across the panel the
+    violation rate must stay in the tail."""
+    rng = np.random.default_rng(1)
+    items = np.minimum(rng.zipf(1.3, 20_000) - 1, 4999).astype(np.int64)
+    sk = CountMinSketch(512, 4, seed=3)
+    sk.add(items)
+    true = np.bincount(items, minlength=5000)
+    n = len(items)
+    queries = list(range(50)) + rng.integers(0, 5000, 100).tolist()
+    violations = 0
+    for it in queries:
+        est = sk.query(int(it))
+        assert est >= true[it]
+        if est - true[it] > sk.epsilon * n:
+            violations += 1
+    assert violations / len(queries) <= 0.05
+
+
+def test_sketch_merge_equals_bulk():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 400, 5000)
+    b = rng.integers(0, 400, 3000)
+    for cls, kw in ((CountMinSketch, {}), (CountSketch, {}),
+                    (VoteVectorSketch, {})):
+        s1, s2, bulk = (cls(256, 3, seed=5, **kw) for _ in range(3))
+        s1.add(a)
+        s2.add(b)
+        s1.merge(s2)
+        bulk.add(np.concatenate([a, b]))
+        np.testing.assert_array_equal(s1.table, bulk.table)
+    b1, b2, bb = (BloomSketch(2048, 3, seed=5) for _ in range(3))
+    b1.add(a)
+    b2.add(b)
+    b1.merge(b2)
+    # bloom union merge: cell-sums add, membership union preserved
+    for it in np.unique(np.concatenate([a, b]))[:100]:
+        assert b1.contains(int(it))
+
+
+def test_bloom_cardinality_and_intersection():
+    b1 = BloomSketch(4096, 4, seed=9)
+    b2 = BloomSketch(4096, 4, seed=9)
+    b1.add([f"u{i}" for i in range(60)])
+    b2.add([f"u{i}" for i in range(40, 100)])
+    b1.merge(b2)
+    est = b1.estimate_cardinality(threshold=1)
+    assert abs(est - 100) <= 10
+    for i in range(45, 55):
+        assert b1.contains(f"u{i}", threshold=2)  # in both
+    assert not b1.contains("u5", threshold=2)  # only in b1
+
+
+def test_histogram_k_percentile():
+    h = HistogramSketch(0.0, 100.0, 64)
+    h.add(np.arange(0, 100, 0.5))
+    v = h.quantile(50)
+    assert 45 <= v <= 55
+    v90 = k_percentile_from_histogram(h.counts, h.edges, 90)
+    assert 85 <= v90 <= 95
+
+
+def test_merge_geometry_mismatch_refused():
+    s1 = CountMinSketch(256, 3, seed=5)
+    s2 = CountMinSketch(128, 3, seed=5)
+    with pytest.raises(ValueError):
+        s1.merge(s2)
+    s3 = CountMinSketch(256, 3, seed=6)
+    with pytest.raises(ValueError):
+        s1.merge(s3)
+
+
+# -- wire codecs ------------------------------------------------------------
+def _rewire(ct, arrays):
+    """Clone a CompressedTree with hostile leaf blocks swapped in."""
+    from fedml_tpu.compression.codecs import CompressedTree
+
+    return CompressedTree(ct.codec, ct.version, ct.is_delta,
+                          ct.raw_nbytes, ct.meta, ct.structure,
+                          arrays, ct.sa)
+
+
+def test_sketch_codec_roundtrip_exact():
+    import jax.numpy as jnp
+
+    codec = get_codec("cms@64/3")
+    sk = CountMinSketch(64, 3, seed=1)
+    sk.add(np.random.default_rng(0).integers(0, 1000, 5000))
+    tree = {k: jnp.asarray(v) for k, v in sk.leaves().items()}
+    ct = codec.encode(tree, key=None, is_delta=False)
+    dec = codec.decode(ct)
+    np.testing.assert_array_equal(
+        np.asarray(dec["table"]), sk.leaves()["table"])
+    # the wire scale is a power of two (dyadic — exact for counters)
+    scale = float(np.asarray(ct.arrays[0][1]))
+    m, _ = np.frexp(scale)
+    assert m == 0.5
+
+
+def test_sketch_codec_fused_merge_matches_host_sum():
+    import jax.numpy as jnp
+
+    codec = get_codec("votevec@128/3")
+    tables = []
+    cts = []
+    rng = np.random.default_rng(3)
+    n = 8  # power-of-two cohort: the mean is dyadic, rescale is exact
+    for i in range(n):
+        sk = VoteVectorSketch(128, 3, seed=4)
+        sk.add(rng.integers(0, 500, 200))
+        tables.append(sk.table.copy())
+        cts.append(codec.encode(
+            {"table": jnp.asarray(sk.leaves()["table"])},
+            key=None, is_delta=False))
+    w = np.full(n, 1.0 / n, np.float32)
+    mean = fused_weighted_sum(cts, w)
+    merged = np.rint(np.asarray(mean["table"], np.float64) * n)
+    np.testing.assert_array_equal(merged, np.sum(tables, axis=0))
+
+
+def test_wire_fuzz_hostile_geometry():
+    import jax.numpy as jnp
+
+    codec = get_codec("cms@64/3")
+    sk = CountMinSketch(64, 3, seed=1)
+    sk.add([1, 2, 3, 4])
+    ct = codec.encode({"table": jnp.asarray(sk.leaves()["table"])},
+                      key=None, is_delta=False)
+    codec.check_wire(ct)  # the honest wire passes
+    q = np.asarray(ct.arrays[0][0])
+    scale = np.asarray(ct.arrays[0][1])
+
+    # truncated wire: the scale part missing from the leaf block
+    with pytest.raises(ValueError, match="truncated"):
+        codec.check_wire(_rewire(ct, [[q]]))
+    # truncated wire: a whole leaf block missing
+    with pytest.raises(ValueError, match="truncated"):
+        codec.check_wire(_rewire(ct, []))
+    # spoofed spec: wire carries a 64-wide table, codec negotiated 32
+    with pytest.raises(ValueError, match="foreign-geometry"):
+        get_codec("cms@32/3").check_wire(ct)
+    # non-dyadic scale: quantization lattice forgery
+    with pytest.raises(ValueError, match="power of two"):
+        codec.check_wire(
+            _rewire(ct, [[q, np.asarray(3.7, np.float32)]]))
+    # negative counters on an unsigned family (inside the magnitude
+    # window, so the sign gate is what fires)
+    with pytest.raises(ValueError, match="negative"):
+        codec.check_wire(_rewire(ct, [[-np.abs(q // 2) - 1, scale]]))
+    # counter magnitude past the exact-integer window
+    with pytest.raises(ValueError, match="2\\^23"):
+        codec.check_wire(_rewire(ct, [[np.full_like(q, 1 << 24), scale]]))
+    # wrong counter dtype
+    with pytest.raises(ValueError, match="dtype"):
+        codec.check_wire(_rewire(ct, [[q.astype(np.float32), scale]]))
+
+
+def test_get_codec_sketch_params():
+    c = get_codec("bloom@512/2")
+    assert c.bits == 512 and c.hashes == 2
+    assert c.spec == "bloom@512/2"
+    assert get_codec("bloom@512/2") is c  # instance cache
+    h = get_codec("hist@32/0/10")
+    assert h.bins == 32 and h.lo == 0.0 and h.hi == 10.0
+    from fedml_tpu.compression.codecs import available_codecs
+
+    for name in ("cms", "csk", "votevec", "bloom", "hist"):
+        assert name in available_codecs()
+
+
+# -- FSM: sketch mode -------------------------------------------------------
+def test_fsm_sketch_frequency_exact():
+    args = ns(run_id="fas_freq", fa_task="frequency_estimation",
+              fa_sketch="auto", fa_query_items=["a", "b", "c"])
+    data = {1: ["a"] * 5 + ["b"] * 2, 2: ["a"] * 3 + ["c"], 3: ["b"] * 4}
+    res = run_fa_inproc(args, data)
+    assert res["total"] == 15
+    assert res["estimates"] == {"a": 8, "b": 6, "c": 1}
+    assert res["spec"].startswith("cms@")
+
+
+def test_fsm_sketch_triehh_multiround():
+    args = ns(run_id="fas_hh", fa_task="heavy_hitter_triehh",
+              fa_sketch="auto", fa_theta=3, fa_max_word_len=8)
+    data = {1: ["sun", "sun", "moon"], 2: ["sun", "star", "moon"],
+            3: ["sun", "moon", "moon"]}
+    res = run_fa_inproc(args, data)
+    assert set(res["heavy_hitters"]) == {"sun", "moon"}
+    assert res["rounds"] > 1  # the trie grew level by level over the FSM
+
+
+def test_fsm_sketch_kpercentile_single_round():
+    args = ns(run_id="fas_kp", fa_task="k_percentile_element",
+              fa_sketch="hist@64/0/100", fa_k_percentile=50)
+    data = {1: list(range(0, 40)), 2: list(range(40, 80)),
+            3: list(range(80, 100))}
+    res = run_fa_inproc(args, data)
+    assert res["rounds"] == 1  # vs the plaintext bisection conversation
+    assert 45 <= res["value"] <= 55
+
+
+def test_fsm_spec_negotiation_header_wins():
+    """The server's round-config header dictates the client codec —
+    a client-side 'auto' default yields to the negotiated spec."""
+    args = ns(run_id="fas_nego", fa_task="frequency_estimation",
+              fa_sketch="cms@128/2", fa_query_items=["x"])
+    data = {1: ["x", "y"], 2: ["x"]}
+    res = run_fa_inproc(args, data)
+    assert res["spec"] == "cms@128/2"
+    assert res["sketch_spec"] == "cms@128/2"
+    assert res["estimates"]["x"] == 2
+
+
+def test_fsm_config_path_integration():
+    """Sketch mode reaches the FSM through the real config loader too."""
+    args = fedml_tpu.init(load_arguments_from_dict({
+        "common_args": {"training_type": "federated_analytics",
+                        "random_seed": 0, "run_id": "fas_cfg"},
+        "fa_args": {"fa_task": "cardinality", "fa_sketch": "auto"},
+    }))
+    res = run_fa_inproc(args, {1: [f"u{i}" for i in range(40)],
+                              2: [f"u{i}" for i in range(20, 60)]})
+    assert 50 <= res["cardinality"] <= 70
+    assert res["spec"].startswith("bloom@")
+
+
+# -- FSM: resilience --------------------------------------------------------
+class _SilentClient:
+    """Patch target: a client that never answers analyze requests."""
+
+
+def _build_managers(task, n, silent=(), run_id="fas_q", stale=(), **kw):
+    import copy
+
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+    from fedml_tpu.core.distributed.message import Message
+    from fedml_tpu.fa.aggregator import create_aggregator
+    from fedml_tpu.fa.analyzer import create_analyzer
+    from fedml_tpu.fa.fa_client_manager import FAClientManager
+    from fedml_tpu.fa.fa_message_define import FAMessage
+    from fedml_tpu.fa.fa_server_manager import FAServerManager
+
+    class SilentClient(FAClientManager):
+        def handle_analyze_request(self, msg):
+            pass
+
+    class StaleClient(FAClientManager):
+        """Ships a bogus submission stamped one round behind before the
+        real answer — the server must count and drop the stale copy,
+        then close normally on the genuine one."""
+
+        def handle_analyze_request(self, msg):
+            M = FAMessage
+            round_idx = int(msg.get(M.MSG_ARG_KEY_ROUND, 0))
+            m = Message(M.MSG_TYPE_C2S_SUBMIT, self.get_sender_id(), 0)
+            m.add_params(M.MSG_ARG_KEY_SUBMISSION, {"bogus": 1})
+            m.add_params(M.MSG_ARG_KEY_ROUND, round_idx - 1)
+            self.send_message(m)
+            super().handle_analyze_request(msg)
+
+    LocalBroker.destroy(run_id)
+    args = ns(run_id=run_id, fa_task=task, **kw)
+    server = FAServerManager(args, create_aggregator(task, args),
+                             client_rank=0, client_num=n)
+    mgrs = [server]
+    for rank in range(1, n + 1):
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        cls = FAClientManager
+        if rank in silent:
+            cls = SilentClient
+        elif rank in stale:
+            cls = StaleClient
+        mgrs.append(cls(cargs, create_analyzer(task, cargs),
+                        ["apple"] * rank, rank=rank, size=n + 1))
+    return mgrs, run_id, server
+
+
+def _run(mgrs, run_id, timeout=30.0):
+    from fedml_tpu.cross_silo.run_inproc import run_managers_to_completion
+    from fedml_tpu.fa.fa_message_define import FAMessage
+
+    return run_managers_to_completion(
+        mgrs, run_id, FAMessage.MSG_TYPE_CONNECTION_IS_READY, timeout)
+
+
+def test_fsm_quorum_close_drops_missing_client():
+    mgrs, rid, server = _build_managers(
+        "frequency_estimation", 3, silent={3}, run_id="fas_quorum",
+        fa_sketch="auto", fa_query_items=["apple"],
+        round_deadline_s=0.8, round_quorum=0.66)
+    res = _run(mgrs, rid)
+    # clients 1 and 2 contributed (1 + 2 apples); 3 was named missing
+    assert res["estimates"]["apple"] == 3
+    assert _counter("fa/quorum_rounds") >= 1
+    assert _counter("fa/deadline_fired") >= 1
+
+
+def test_fsm_stale_submission_counted_and_dropped():
+    mgrs, rid, server = _build_managers(
+        "frequency_estimation", 2, stale={2}, run_id="fas_stale",
+        fa_sketch="", fa_query_items=[])
+    res = _run(mgrs, rid)
+    assert res["frequencies"] == {"apple": 1.0}
+    assert _counter("fa/stale_submissions") >= 1
+
+
+def test_fsm_abort_below_quorum_raises():
+    mgrs, rid, server = _build_managers(
+        "frequency_estimation", 2, silent={1, 2}, run_id="fas_abort",
+        fa_sketch="auto", round_deadline_s=0.3, round_quorum=1.0,
+        deadline_extensions=1)
+    with pytest.raises(RuntimeError, match="below quorum"):
+        _run(mgrs, rid)
+    assert _counter("fa/aborts") == 1
+
+
+def test_fsm_wire_spoof_rejected_loudly():
+    """A client shipping hostile geometry under the negotiated spec
+    kills the round with a ValueError naming the client."""
+    import copy
+
+    from fedml_tpu.core.distributed.communication.local_comm import (
+        LocalBroker,
+    )
+    from fedml_tpu.fa.aggregator import create_aggregator
+    from fedml_tpu.fa.analyzer import create_analyzer
+    from fedml_tpu.fa.fa_client_manager import FAClientManager
+    from fedml_tpu.fa.fa_server_manager import FAServerManager
+
+    rid = "fas_spoof"
+    LocalBroker.destroy(rid)
+    args = ns(run_id=rid, fa_task="frequency_estimation",
+              fa_sketch="cms@64/2", fa_query_items=[])
+    server = FAServerManager(args, create_aggregator(
+        "frequency_estimation", args), client_rank=0, client_num=2)
+    mgrs = [server]
+    for rank in (1, 2):
+        cargs = copy.copy(args)
+        cargs.rank = rank
+        an = create_analyzer("frequency_estimation", cargs)
+        if rank == 2:
+            # refuse negotiation and encode under foreign geometry
+            an.set_sketch_spec = lambda spec: None
+            an.spec = "cms@32/2"
+        mgrs.append(FAClientManager(cargs, an, ["apple"],
+                                    rank=rank, size=3))
+    with pytest.raises(RuntimeError, match="client 2"):
+        _run(mgrs, rid)
+
+
+# -- hierarchy: merge identity + privacy ------------------------------------
+_FED = dict(codec="votevec@512/3", seed=3, vocab=64, n_hot=6, p_hot=0.6,
+            words_per_client=16, hh_threshold_frac=0.03)
+
+
+def test_merge_identity_flat_2tier_3tier():
+    """Power-of-two fan-outs: every cohort mean is dyadic, so the
+    federated sum is BIT-identical however the tree re-associates it."""
+    flat = run_sketch_federation(n_clients=64, levels=(1, 64), **_FED)
+    two = run_sketch_federation(n_clients=64, levels=(1, 8, 64), **_FED)
+    three = run_sketch_federation(n_clients=64, levels=(1, 4, 16, 64),
+                                  **_FED)
+    assert flat["final_digest"] == two["final_digest"] \
+        == three["final_digest"]
+    assert flat["heavy_hitters"] == two["heavy_hitters"] \
+        == three["heavy_hitters"] == flat["ref_heavy_hitters"]
+    assert flat["hh_recall"] == 1.0 and flat["hh_precision"] == 1.0
+
+
+def test_secagg_masked_equals_plain_bit_identical():
+    plain = run_sketch_federation(n_clients=64, levels=(1, 8, 64), **_FED)
+    masked = run_sketch_federation(n_clients=64, levels=(1, 8, 64),
+                                   secagg=True, **_FED)
+    assert masked["final_digest"] == plain["final_digest"]
+    assert masked["heavy_hitters"] == plain["heavy_hitters"]
+
+
+def test_client_sketch_never_leaves_the_program():
+    run_sketch_federation(n_clients=64, levels=(1, 8, 64), secagg=True,
+                          **_FED)
+    assert last_sketch_trace()["client_sketch_traced"] is True
+
+
+def test_central_dp_noised_in_program_and_deterministic():
+    kw = dict(n_clients=64, levels=(1, 8, 64), secagg=True, dp_sigma=1.5,
+              **_FED)
+    a = run_sketch_federation(**kw)
+    tr = last_dp_trace()
+    assert tr["pre_noise_traced"] is True
+    assert tr["noised_in_program"] is True
+    assert 0 < a["dp_epsilon"] < float("inf")
+    b = run_sketch_federation(**kw)
+    assert a["final_digest"] == b["final_digest"]
+    # same scenario without DP lands on a different global
+    c = run_sketch_federation(n_clients=64, levels=(1, 8, 64),
+                              secagg=True, **_FED)
+    assert c["final_digest"] != a["final_digest"]
+
+
+def test_zcdp_epsilon_accounting():
+    assert zcdp_epsilon(0.0, 1.0) == float("inf")
+    e1 = zcdp_epsilon(10.0, 1.0, rounds=1)
+    e2 = zcdp_epsilon(10.0, 1.0, rounds=4)
+    assert 0 < e1 < e2  # composition adds
+    assert zcdp_epsilon(20.0, 1.0) < e1  # more noise, less epsilon
+
+
+def _chaos_acceptance(n_clients, levels, tmp_path, run_tag):
+    """Shared chaos-acceptance scenario builder (small + 100k twin):
+    leaf kills + an edge-tier kill + a root crash/journal-restart,
+    under secagg with central DP."""
+    topo = TreeTopology(levels)
+    leaf_tier = topo.leaf_tier
+    dead_leaves = [3, n_clients // 2, n_clients - 5]
+    dead_edge = 1  # tier-1 node: its whole cohort goes missing
+    cohort = topo.children(leaf_tier - 1, dead_edge)
+    survivors = sorted(set(range(n_clients)) - set(dead_leaves)
+                       - set(int(c) for c in cohort))
+    chaos = [KillWindow(leaf_tier, c, 0) for c in dead_leaves]
+    chaos.append(KillWindow(leaf_tier - 1, dead_edge, 0))
+    if leaf_tier >= 2:
+        # crash the ROOT after it accepted 2 children; journal restart
+        chaos.append(EdgeKillWindow(0, 0, 0, after_children=2))
+    kw = dict(n_clients=n_clients, levels=levels, quorum=0.5,
+              secagg=True, dp_sigma=2.0, chaos=chaos,
+              durability_dir=str(tmp_path / run_tag),
+              reference_client_ids=survivors, **_FED)
+    return kw, survivors
+
+
+def test_acceptance_chaos_small(tmp_path):
+    """Small not-slow twin of the 100k acceptance scenario."""
+    kw, survivors = _chaos_acceptance(256, (1, 8, 256), tmp_path, "a")
+    a = run_sketch_federation(**kw)
+    assert a["stats"]["completed"]
+    # every survivor contributed, nobody else
+    assert a["root_total_weight"] == float(len(survivors))
+    # the federated HH set IS the plaintext reference's on the same data
+    assert a["heavy_hitters"] == a["ref_heavy_hitters"]
+    assert a["hh_recall"] == 1.0 and a["hh_precision"] == 1.0
+    # root crash recovered via journal: restart counters ticked
+    assert _counter("resilience/restarts") >= 1
+    assert _counter("resilience/journal_salvaged") >= 1
+    # masked mode: the per-client sketch never left the program
+    assert last_sketch_trace()["client_sketch_traced"] is True
+    assert last_dp_trace()["noised_in_program"] is True
+    kw2, _ = _chaos_acceptance(256, (1, 8, 256), tmp_path, "b")
+    b = run_sketch_federation(**kw2)
+    assert b["final_digest"] == a["final_digest"]  # bit-reproducible
+
+
+def test_fa_bench_smoke(monkeypatch):
+    """``bench.py --fa`` plumbing at toy scale: both segments run, the
+    gates evaluate, and no artifact lands in the repo."""
+    monkeypatch.setenv("FEDML_FA_OUT", "")
+    monkeypatch.setenv("FEDML_FA_COHORT", "32")
+    from tools.fa_bench import run_fa_bench, write_artifact
+
+    row = run_fa_bench(clients=64, tiers=3, width=512, depth=3,
+                       vocab=64, words=16, fsm_clients=2)
+    assert row["bench"] == "fa"
+    assert row["completed"] and row["ok_traced"]
+    assert row["ok_wire"] and row["ok_recall"] and row["ok"]
+    assert row["fsm_rounds"] >= 2 and row["fsm_rounds_per_s"] > 0
+    assert row["rounds_per_s"] > 0
+    assert write_artifact(row) is None  # FEDML_FA_OUT='' disables
+
+
+@pytest.mark.slow
+def test_acceptance_chaos_100k(tmp_path):
+    """ISSUE 20 acceptance: a 100k-client, 3-tier heavy-hitter
+    federation with secagg + central DP survives leaf/edge chaos,
+    recovers via quorum + journal restart, matches the plaintext
+    reference sketch on the same seeded data, and two same-seed runs
+    end digest-identical."""
+    n = 102_400
+    kw, survivors = _chaos_acceptance(n, (1, 800, n), tmp_path, "a")
+    a = run_sketch_federation(**kw)
+    assert a["stats"]["completed"]
+    assert a["clients"] >= 100_000 and len(a["levels"]) == 3
+    assert a["root_total_weight"] == float(len(survivors))
+    assert a["heavy_hitters"] == a["ref_heavy_hitters"]
+    assert a["hh_recall"] == 1.0 and a["hh_precision"] == 1.0
+    assert _counter("resilience/restarts") >= 1
+    assert last_sketch_trace()["client_sketch_traced"] is True
+    assert last_dp_trace()["noised_in_program"] is True
+    kw2, _ = _chaos_acceptance(n, (1, 800, n), tmp_path, "b")
+    b = run_sketch_federation(**kw2)
+    assert b["final_digest"] == a["final_digest"]

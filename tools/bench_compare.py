@@ -21,9 +21,9 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["compare_integrity", "compare_multichip", "compare_preempt",
-           "compare_recover", "compare_serve", "compare_wire",
-           "load_headline", "run_compare", "main"]
+__all__ = ["compare_fa", "compare_integrity", "compare_multichip",
+           "compare_preempt", "compare_recover", "compare_serve",
+           "compare_wire", "load_headline", "run_compare", "main"]
 
 
 def _natural_key(path: str):
@@ -412,6 +412,62 @@ def compare_multichip(bench_dir: str = ".",
     return out
 
 
+def compare_fa(bench_dir: str = ".",
+               regression_threshold: float = 0.10) -> Optional[Dict]:
+    """Diff the newest two ``FA_*.json`` federated-analytics records.
+
+    Same contract as :func:`compare_recover`: a gate going false where
+    it was true (wire overhead, HH recall/precision vs the plaintext
+    reference, the traced-client-sketch proof) is a regression at any
+    magnitude; the tree federation's rounds/s and the recall number
+    itself fail past ``regression_threshold``. None when fewer than two
+    files exist."""
+    files = sorted(glob.glob(os.path.join(bench_dir, "FA_*.json")),
+                   key=_natural_key)
+    if len(files) < 2:
+        return None
+    prev_rec = _load_record(files[-2])
+    new_rec = _load_record(files[-1])
+    if prev_rec is None or new_rec is None:
+        return {"ok": True,
+                "note": "no parseable fa record in "
+                        f"{files[-2] if prev_rec is None else files[-1]}"}
+    out: Dict = {
+        "ok": True,
+        "prev_file": os.path.basename(files[-2]),
+        "new_file": os.path.basename(files[-1]),
+        "regressions": [],
+    }
+    for field, label in (("rounds_per_s", "tree federation rounds/s"),
+                         ("hh_recall", "heavy-hitter recall"),
+                         ("hh_precision", "heavy-hitter precision"),
+                         ("fsm_rounds_per_s", "FSM rounds/s")):
+        prev_v = prev_rec.get(field)
+        new_v = new_rec.get(field)
+        if prev_v and new_v is not None:
+            delta = (float(new_v) - float(prev_v)) / float(prev_v)
+            out[f"{field}_prev"] = prev_v
+            out[f"{field}_new"] = new_v
+            if delta < -regression_threshold:
+                out["regressions"].append(
+                    f"{label} regressed {-delta * 100:.1f}% "
+                    f"({prev_v} -> {new_v})")
+    prev_w, new_w = prev_rec.get("wire_overhead"), \
+        new_rec.get("wire_overhead")
+    if prev_w and new_w is not None:
+        out["wire_overhead_prev"] = prev_w
+        out["wire_overhead_new"] = new_w
+        if (float(new_w) - float(prev_w)) / float(prev_w) \
+                > regression_threshold:
+            out["regressions"].append(
+                f"masked wire overhead grew ({prev_w} -> {new_w})")
+    for gate in ("ok_wire", "ok_recall", "ok_traced", "completed"):
+        if prev_rec.get(gate) is True and new_rec.get(gate) is False:
+            out["regressions"].append(f"fa gate {gate} went false")
+    out["ok"] = not out["regressions"]
+    return out
+
+
 def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                 pattern: str = "BENCH_*.json") -> Dict:
     """Diff the newest two BENCH files; ``ok`` is False only on a real,
@@ -464,6 +520,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
     multichip = compare_multichip(bench_dir)
     wire = compare_wire(bench_dir, threshold)
     serve = compare_serve(bench_dir)
+    fa = compare_fa(bench_dir, threshold)
     return {
         "ok": (delta >= -threshold and not program_regressions
                and (recover is None or recover["ok"])
@@ -471,7 +528,8 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
                and (integrity is None or integrity["ok"])
                and (multichip is None or multichip["ok"])
                and (wire is None or wire["ok"])
-               and (serve is None or serve["ok"])),
+               and (serve is None or serve["ok"])
+               and (fa is None or fa["ok"])),
         "metric": new_metric,
         "prev_file": os.path.basename(prev_path),
         "new_file": os.path.basename(new_path),
@@ -488,6 +546,7 @@ def run_compare(bench_dir: str = ".", threshold: float = 0.10,
         **({"multichip": multichip} if multichip is not None else {}),
         **({"wire": wire} if wire is not None else {}),
         **({"serve": serve} if serve is not None else {}),
+        **({"fa": fa} if fa is not None else {}),
     }
 
 

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Federated-analytics bench: the sketch engine's claims, measured.
+
+Two segments, one JSON line:
+
+- **FSM segment** — real message-passing FA rounds (frequency sketch +
+  multi-round TrieHH) over the in-proc transport: rounds completed,
+  wall seconds, rounds/s.
+- **Federation segment** — the 100k-client 3-tier heavy-hitter vote
+  federation over the aggregation tree, secagg-masked with central DP
+  at the root: rounds/s, per-client masked wire bytes vs the plain
+  int32 sketch, and heavy-hitter recall/precision against the plaintext
+  reference sketch replayed on the same seeded data.
+
+Gates (exit 1 on failure, like every other ``tools/*_bench.py``):
+
+- ``ok_wire`` — masked sketch wire ≤ 1.2× the plain int32 sketch bytes
+- ``ok_recall`` — federated HH recall AND precision ≥ 0.95 vs the
+  plaintext reference at the same width×depth
+- ``ok_traced`` — the per-client sketch existed only as a tracer inside
+  the leaf program (no host-side per-client plaintext in masked mode)
+
+Also reachable as ``python bench.py --fa``; archived as ``FA_r01.json``
+(the ``compare_fa`` baseline). Environment knobs for the driver:
+``FEDML_FA_CLIENTS`` / ``FEDML_FA_TIERS`` / ``FEDML_FA_WIDTH`` /
+``FEDML_FA_DEPTH`` / ``FEDML_FA_VOCAB`` / ``FEDML_FA_WORDS`` /
+``FEDML_FA_COHORT`` / ``FEDML_FA_FSM_CLIENTS`` / ``FEDML_FA_OUT``.
+
+The 3-tier topology pins leaf cohorts to ``FEDML_FA_COHORT`` clients
+(default 128): secagg's pairwise-mask work grows with cohort size, so
+a wide edge tier keeps the 100k default inside a few minutes without
+changing what's measured.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_WIRE_OVERHEAD_GATE = 1.2
+_RECALL_GATE = 0.95
+
+
+def _fsm_segment(n_clients: int, seed: int) -> dict:
+    """Real FSM rounds over the in-proc transport, sketch mode."""
+    from fedml_tpu.fa.run_inproc import run_fa_inproc
+
+    rng_words = ["sun", "moon", "star", "sky", "rain", "wind", "sea"]
+    rounds = 0
+    t0 = time.perf_counter()
+    args = types.SimpleNamespace(
+        run_id="fa_bench_freq", random_seed=seed, rank=0,
+        fa_task="frequency_estimation", fa_sketch="auto",
+        fa_query_items=rng_words[:3])
+    data = {r: [rng_words[(r + i) % len(rng_words)] for i in range(32)]
+            for r in range(1, n_clients + 1)}
+    freq = run_fa_inproc(args, data)
+    rounds += freq["rounds"]
+    args = types.SimpleNamespace(
+        run_id="fa_bench_hh", random_seed=seed, rank=0,
+        fa_task="heavy_hitter_triehh", fa_sketch="auto",
+        fa_theta=max(2, n_clients // 2), fa_max_word_len=4)
+    data = {r: ["sun", "moon", "sun"] for r in range(1, n_clients + 1)}
+    hh = run_fa_inproc(args, data)
+    rounds += hh["rounds"]
+    wall = time.perf_counter() - t0
+    return {
+        "fsm_clients": n_clients,
+        "fsm_rounds": rounds,
+        "fsm_wall_s": round(wall, 3),
+        "fsm_rounds_per_s": round(rounds / wall, 3) if wall > 0 else 0.0,
+        "fsm_heavy_hitters": hh.get("heavy_hitters"),
+        "fsm_freq_spec": freq.get("spec"),
+    }
+
+
+def run_fa_bench(clients: int = None, tiers: int = None, width: int = None,
+                 depth: int = None, vocab: int = None, words: int = None,
+                 seed: int = 0, fsm_clients: int = None) -> dict:
+    clients = int(os.environ.get("FEDML_FA_CLIENTS", 100_000)
+                  if clients is None else clients)
+    tiers = int(os.environ.get("FEDML_FA_TIERS", 3)
+                if tiers is None else tiers)
+    width = int(os.environ.get("FEDML_FA_WIDTH", 1024)
+                if width is None else width)
+    depth = int(os.environ.get("FEDML_FA_DEPTH", 3)
+                if depth is None else depth)
+    vocab = int(os.environ.get("FEDML_FA_VOCAB", 512)
+                if vocab is None else vocab)
+    words = int(os.environ.get("FEDML_FA_WORDS", 32)
+                if words is None else words)
+    fsm_clients = int(os.environ.get("FEDML_FA_FSM_CLIENTS", 6)
+                      if fsm_clients is None else fsm_clients)
+    cohort = int(os.environ.get("FEDML_FA_COHORT", 128))
+
+    from fedml_tpu.fa.sketch.federation import (
+        last_sketch_trace,
+        run_sketch_federation,
+    )
+
+    fsm = _fsm_segment(fsm_clients, seed)
+
+    levels = None
+    if tiers == 3 and clients > cohort:
+        levels = (1, -(-clients // cohort), clients)
+    fed = run_sketch_federation(
+        n_clients=clients, tiers=tiers, levels=levels,
+        codec=f"votevec@{width}/{depth}", seed=seed, vocab=vocab,
+        n_hot=12, p_hot=0.5, words_per_client=words,
+        hh_threshold_frac=0.02, secagg=True, dp_sigma=2.0)
+    traced = last_sketch_trace().get("client_sketch_traced") is True
+
+    ok_wire = fed["wire_overhead"] <= _WIRE_OVERHEAD_GATE
+    ok_recall = (fed["hh_recall"] >= _RECALL_GATE
+                 and fed["hh_precision"] >= _RECALL_GATE)
+    row = {
+        "bench": "fa",
+        "seed": seed,
+        **fsm,
+        "clients": fed["clients"],
+        "tiers": tiers,
+        "levels": fed["levels"],
+        "spec": fed["spec"],
+        "vocab": vocab,
+        "words_per_client": words,
+        "secagg": fed["secagg"],
+        "dp_sigma": fed["dp_sigma"],
+        "dp_epsilon": round(fed["dp_epsilon"], 3),
+        "rounds_per_s": round(fed["rounds_per_s"], 4),
+        "hh_recall": round(fed["hh_recall"], 4),
+        "hh_precision": round(fed["hh_precision"], 4),
+        "heavy_hitters_found": len(fed["heavy_hitters"]),
+        "per_client_wire_bytes": fed["per_client_wire_bytes"],
+        "plain_sketch_bytes": fed["plain_sketch_bytes"],
+        "wire_overhead": round(fed["wire_overhead"], 4),
+        "final_digest": fed["final_digest"],
+        "ok_wire": ok_wire,
+        "ok_recall": ok_recall,
+        "ok_traced": traced,
+        "completed": bool(fed["stats"].get("completed")),
+    }
+    row["ok"] = (row["completed"] and ok_wire and ok_recall and traced)
+    return row
+
+
+def write_artifact(row: dict, bench_dir: str = None):
+    """Archive the emitted row as ``FA_r01.json`` (the compare_fa
+    baseline). ``FEDML_FA_OUT=''`` disables."""
+    name = os.environ.get("FEDML_FA_OUT", "FA_r01.json")
+    if not name:
+        return None
+    path = os.path.join(bench_dir or REPO, name)
+    with open(path, "w") as f:
+        json.dump(row, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--tiers", type=int, default=None)
+    ap.add_argument("--width", type=int, default=None)
+    ap.add_argument("--depth", type=int, default=None)
+    ap.add_argument("--vocab", type=int, default=None)
+    ap.add_argument("--words", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    row = run_fa_bench(clients=args.clients, tiers=args.tiers,
+                       width=args.width, depth=args.depth,
+                       vocab=args.vocab, words=args.words, seed=args.seed)
+    write_artifact(row)
+    print(json.dumps(row))
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
